@@ -1,0 +1,60 @@
+"""hclib_tpu: a TPU-native task-parallel framework.
+
+A from-scratch rebuild of the capabilities of HClib (habanero-rice/hclib) on
+the JAX/XLA/Pallas stack: finish/async structured parallelism, data-driven
+futures/promises, locality-aware parallel loops, and work stealing - with the
+execution core re-imagined as a persistent Pallas "megakernel" in which each
+TPU core runs a resident scheduler loop over device-memory task queues.
+
+Layers:
+- ``hclib_tpu.runtime``  - host runtime (semantics, work-stealing workers)
+- ``hclib_tpu.device``   - task-descriptor ABI + Pallas megakernel scheduler
+- ``hclib_tpu.parallel`` - device mesh, sharding, collectives, multi-chip
+- ``hclib_tpu.ops``      - Pallas/MXU tile kernels used by device tasks
+- ``hclib_tpu.models``   - benchmark workloads (fib, UTS, Cholesky, SW, ...)
+- ``hclib_tpu.native``   - C++ native host runtime (fast CPU path)
+"""
+
+from .runtime import (  # noqa: F401
+    FLAT,
+    RECURSIVE,
+    Finish,
+    Future,
+    Locale,
+    LocalityGraph,
+    MaxReducer,
+    Module,
+    OrReducer,
+    Promise,
+    PromiseError,
+    Reducer,
+    Runtime,
+    SumReducer,
+    Task,
+    WSDeque,
+    allocate_at,
+    async_,
+    async_copy,
+    async_future,
+    current_finish,
+    current_runtime,
+    current_worker,
+    end_finish,
+    end_finish_nonblocking,
+    finish,
+    forasync,
+    forasync_future,
+    free_at,
+    generate_default_graph,
+    launch,
+    load_locality_file,
+    memset_at,
+    num_workers,
+    register_dist_func,
+    register_module,
+    start_finish,
+    unregister_all_modules,
+    yield_,
+)
+
+__version__ = "0.1.0"

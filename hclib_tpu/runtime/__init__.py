@@ -1,0 +1,35 @@
+"""Host-side runtime: the semantic core of hclib_tpu.
+
+Pins the reference's finish/async/promise/forasync semantics on the host
+before the TPU device path re-implements them on-chip (see ../device/).
+"""
+
+from .deque import WSDeque
+from .finish import Finish
+from .forasync import FLAT, RECURSIVE, forasync, forasync_future, register_dist_func
+from .locality import (
+    Locale,
+    LocalityGraph,
+    generate_default_graph,
+    load_locality_file,
+)
+from .mem import allocate_at, async_copy, free_at, memset_at
+from .module import Module, register_module, unregister_all_modules
+from .promise import Future, Promise, PromiseError
+from .reducers import MaxReducer, OrReducer, Reducer, SumReducer
+from .scheduler import (
+    Runtime,
+    async_,
+    async_future,
+    current_finish,
+    current_runtime,
+    current_worker,
+    end_finish,
+    end_finish_nonblocking,
+    finish,
+    launch,
+    num_workers,
+    start_finish,
+    yield_,
+)
+from .task import Task

@@ -1,0 +1,51 @@
+"""Work-stealing deque.
+
+The reference uses a fixed-capacity Chase-Lev-style circular deque with CAS
+steals (src/hclib-deque.c:75-139, src/inc/hclib-deque.h). Under CPython the
+GIL serializes bytecode anyway, so this host-side deque keeps the same *API
+shape* (owner pushes/pops at the tail, thieves take from the head) over a
+lock-protected ring; the lock-free protocol lives where it matters - in the
+device queues (device/queue.py) and the C++ native runtime (native/).
+
+Unlike the reference, which statically allocates 2^20 slots and asserts on
+overflow (src/hclib-runtime.c:520-524), this deque grows on demand.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque as _pydeque
+from typing import Any, Optional
+
+__all__ = ["WSDeque"]
+
+
+class WSDeque:
+    __slots__ = ("_lock", "_items")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._items: _pydeque = _pydeque()
+
+    def push(self, item: Any) -> bool:
+        """Owner-side push at the tail."""
+        with self._lock:
+            self._items.append(item)
+        return True
+
+    def pop(self) -> Optional[Any]:
+        """Owner-side pop at the tail (LIFO: depth-first own work)."""
+        with self._lock:
+            if self._items:
+                return self._items.pop()
+        return None
+
+    def steal(self) -> Optional[Any]:
+        """Thief-side take from the head (FIFO: steal the oldest/biggest)."""
+        with self._lock:
+            if self._items:
+                return self._items.popleft()
+        return None
+
+    def __len__(self) -> int:
+        return len(self._items)

@@ -1,0 +1,71 @@
+"""Finish scopes.
+
+The reference tracks a finish as {parent, counter, finish_dep} where the
+counter counts outstanding child tasks plus one for the spawning task
+(src/inc/hclib-finish.h:6-10, src/hclib-runtime.c:1219-1247). Here the lock
+makes the +1 trick unnecessary: ``counter`` counts outstanding children only,
+and reaching zero fires the completion promise / parked-context event
+(reference equivalent: promise-put on finish_dep at src/hclib-runtime.c:437-446).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from .promise import Promise
+
+__all__ = ["Finish"]
+
+
+class Finish:
+    __slots__ = ("parent", "_lock", "counter", "on_zero", "_zero_event")
+
+    def __init__(self, parent: Optional["Finish"] = None) -> None:
+        self.parent = parent
+        self._lock = threading.Lock()
+        self.counter = 0
+        # Promise satisfied when the scope drains (nonblocking finish /
+        # escaping continuation), cf. finish_dep.
+        self.on_zero: Optional[Promise] = None
+        self._zero_event: Optional[threading.Event] = None
+
+    def check_in(self) -> None:
+        """A child task is spawned under this scope (check_in_finish)."""
+        with self._lock:
+            self.counter += 1
+
+    def check_out(self) -> None:
+        """A child task completed (check_out_finish)."""
+        with self._lock:
+            self.counter -= 1
+            if self.counter != 0:
+                return
+            on_zero, event = self.on_zero, self._zero_event
+            self.on_zero, self._zero_event = None, None
+        if on_zero is not None:
+            on_zero.put(None)
+        if event is not None:
+            event.set()
+
+    def quiesced(self) -> bool:
+        return self.counter == 0
+
+    def arm_event(self) -> Optional[threading.Event]:
+        """Arm a parked-context event; returns None if already quiescent."""
+        with self._lock:
+            if self.counter == 0:
+                return None
+            if self._zero_event is None:
+                self._zero_event = threading.Event()
+            return self._zero_event
+
+    def arm_promise(self) -> Optional[Promise]:
+        """Attach a completion promise; returns None if already quiescent
+        (caller should treat the scope as complete immediately)."""
+        with self._lock:
+            if self.counter == 0:
+                return None
+            if self.on_zero is None:
+                self.on_zero = Promise()
+            return self.on_zero

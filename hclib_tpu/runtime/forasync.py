@@ -1,0 +1,181 @@
+"""Parallel loops: forasync 1D/2D/3D in flat and recursive modes.
+
+Mirrors the reference semantics (src/hclib.c:158-473, inc/hclib-forasync.h):
+
+- FLAT mode tiles the iteration space and spawns one task per tile; each tile
+  task runs the body over its indices (src/hclib.c:316-416).
+- RECURSIVE mode binary-splits the largest dimension until every piece is at
+  most one tile, spawning a task per split (src/hclib.c:158-314).
+- Auto-tile picks ``ceil(N / nworkers)`` per dimension (src/hclib.c:452-464).
+- ``forasync_future`` wraps the loop in a non-blocking finish and returns its
+  completion future (src/hclib.c:466-473).
+- A registered *distribution function* maps each flat tile to a locale
+  (hclib_register_dist_func / loop_dist_func, src/hclib.c:19-30,
+  inc/hclib-forasync.h:349-380); the default places tiles at the central
+  locale.
+
+On the device path, flat forasync tiles become task descriptors executed by
+the Pallas megakernel grid; see device/.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from .promise import Future
+from .scheduler import (
+    async_,
+    current_runtime,
+    end_finish_nonblocking,
+    finish,
+    start_finish,
+)
+
+__all__ = ["forasync", "forasync_future", "FLAT", "RECURSIVE", "register_dist_func"]
+
+FLAT = "flat"
+RECURSIVE = "recursive"
+
+_dist_funcs: dict = {}
+
+
+def register_dist_func(name: str, fn: Callable[..., Any]) -> None:
+    """Register a tile->locale distribution function by name."""
+    _dist_funcs[name] = fn
+
+
+def lookup_dist_func(name: str) -> Callable[..., Any]:
+    return _dist_funcs[name]
+
+
+def _normalize(bounds: Sequence, tile: Optional[Sequence], nworkers: int):
+    dims = []
+    for b in bounds:
+        if isinstance(b, int):
+            dims.append((0, b))
+        else:
+            lo, hi = b
+            dims.append((int(lo), int(hi)))
+    if tile is None:
+        tile_dims = [max(1, math.ceil((hi - lo) / nworkers)) for lo, hi in dims]
+    elif isinstance(tile, int):
+        tile_dims = [tile] * len(dims)
+    else:
+        tile_dims = [int(t) for t in tile]
+    if len(tile_dims) != len(dims):
+        raise ValueError("tile rank must match loop rank")
+    return dims, tile_dims
+
+
+def _run_tile(fn: Callable, ranges: Tuple[Tuple[int, int], ...]) -> None:
+    ndim = len(ranges)
+    if ndim == 1:
+        (lo0, hi0), = ranges
+        for i in range(lo0, hi0):
+            fn(i)
+    elif ndim == 2:
+        (lo0, hi0), (lo1, hi1) = ranges
+        for i in range(lo0, hi0):
+            for j in range(lo1, hi1):
+                fn(i, j)
+    else:
+        (lo0, hi0), (lo1, hi1), (lo2, hi2) = ranges
+        for i in range(lo0, hi0):
+            for j in range(lo1, hi1):
+                for k in range(lo2, hi2):
+                    fn(i, j, k)
+
+
+def _spawn_flat(fn, dims, tile_dims, dist_func) -> None:
+    ndim = len(dims)
+    tile_counts = [math.ceil((hi - lo) / t) for (lo, hi), t in zip(dims, tile_dims)]
+    total = math.prod(tile_counts)
+    for flat in range(total):
+        idx = []
+        rem = flat
+        for c in reversed(tile_counts):
+            idx.append(rem % c)
+            rem //= c
+        idx.reverse()
+        ranges = tuple(
+            (lo + i * t, min(hi, lo + (i + 1) * t))
+            for (lo, hi), t, i in zip(dims, tile_dims, idx)
+        )
+        locale = dist_func(ndim, flat, total) if dist_func else None
+        async_(_run_tile, fn, ranges, at=locale)
+
+
+def _spawn_recursive(fn, ranges, tile_dims) -> None:
+    # Split the largest over-tile dimension in half; recurse via new tasks
+    # (reference: src/hclib.c:158-314).
+    widest, wdim = -1, -1
+    for d, ((lo, hi), t) in enumerate(zip(ranges, tile_dims)):
+        if hi - lo > t and hi - lo > widest:
+            widest, wdim = hi - lo, d
+    if wdim < 0:
+        _run_tile(fn, tuple(ranges))
+        return
+    lo, hi = ranges[wdim]
+    mid = (lo + hi) // 2
+    left = list(ranges)
+    right = list(ranges)
+    left[wdim] = (lo, mid)
+    right[wdim] = (mid, hi)
+    async_(_spawn_recursive, fn, left, tile_dims)
+    _spawn_recursive(fn, right, tile_dims)
+
+
+def forasync(
+    fn: Callable[..., Any],
+    bounds: Sequence,
+    tile: Optional[Sequence] = None,
+    mode: str = FLAT,
+    dist_func: Optional[Callable[[int, int, int], Any]] = None,
+    blocking: bool = True,
+) -> None:
+    """Parallel loop over a 1-3D iteration space.
+
+    ``bounds`` is a sequence of ``int`` (upper bound, from 0) or ``(lo, hi)``
+    pairs, one per dimension. ``fn`` receives one index per dimension.
+    """
+    if not 1 <= len(bounds) <= 3:
+        raise ValueError("forasync supports 1-3 dimensions")
+    rt = current_runtime()
+    dims, tile_dims = _normalize(bounds, tile, rt.nworkers)
+    if blocking:
+        with finish():
+            if mode == FLAT:
+                _spawn_flat(fn, dims, tile_dims, dist_func)
+            elif mode == RECURSIVE:
+                _spawn_recursive(fn, dims, tile_dims)
+            else:
+                raise ValueError(f"unknown forasync mode {mode!r}")
+    else:
+        if mode == FLAT:
+            _spawn_flat(fn, dims, tile_dims, dist_func)
+        elif mode == RECURSIVE:
+            _spawn_recursive(fn, dims, tile_dims)
+        else:
+            raise ValueError(f"unknown forasync mode {mode!r}")
+
+
+def forasync_future(
+    fn: Callable[..., Any],
+    bounds: Sequence,
+    tile: Optional[Sequence] = None,
+    mode: str = FLAT,
+    dist_func: Optional[Callable[[int, int, int], Any]] = None,
+) -> Future:
+    """Non-blocking forasync; returns a future satisfied when every tile has
+    completed (hclib_forasync_future: src/hclib.c:466-473)."""
+    rt = current_runtime()
+    dims, tile_dims = _normalize(bounds, tile, rt.nworkers)
+    fin = start_finish()
+    if mode == FLAT:
+        _spawn_flat(fn, dims, tile_dims, dist_func)
+    elif mode == RECURSIVE:
+        _spawn_recursive(fn, dims, tile_dims)
+    else:
+        raise ValueError(f"unknown forasync mode {mode!r}")
+    return end_finish_nonblocking(fin)

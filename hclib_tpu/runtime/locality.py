@@ -1,0 +1,192 @@
+"""Locality graph: locales, reachability, and per-worker pop/steal paths.
+
+Core idea (reference: inc/hclib-locality-graph.h:9-50): every *locale* (a
+hardware component - cache slice, sysmem, TPU core, host, NIC) owns one deque
+per worker. A worker has a *pop path* (locales it drains its own deques from,
+in order) and a *steal path* (locales where it scans all workers' deques).
+"Comm worker" and "device worker" are not special mechanisms - they are
+workers whose paths include the NIC/TPU locale.
+
+The machine description is a JSON document compatible with the reference
+schema (locality_graphs/*.json; parser src/hclib-locality-graph.c:372-566):
+``nworkers``, ``declarations`` (locale names; the prefix before the first
+``_`` or digit is the locale *type*), ``reachability`` edges, and
+``pop_paths``/``steal_paths`` keyed per-worker-index or ``default``, with
+``$(id / k)`` / ``$(id % k)`` arithmetic interpolation.
+
+When no file is given, a default star graph is generated - sysmem plus one L1
+per worker (reference: src/hclib-locality-graph.c:581-643). For TPU meshes,
+parallel/mesh.py synthesizes a graph with one ``tpu`` locale per device plus
+``hbm`` and ``host`` locales.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Locale", "LocalityGraph", "generate_default_graph", "load_locality_file"]
+
+
+@dataclass
+class Locale:
+    id: int
+    name: str
+    type: str
+    reachable: List[int] = field(default_factory=list)
+    # Mark-special labels, e.g. "COMM" for the NIC locale
+    # (hclib_locale_mark_special, src/hclib-locality-graph.c:829-837).
+    special: Dict[str, bool] = field(default_factory=dict)
+    # Backend payload (e.g. device ordinal for tpu locales).
+    metadata: Dict[str, object] = field(default_factory=dict)
+
+    def mark_special(self, label: str) -> None:
+        self.special[label] = True
+
+    def is_special(self, label: str) -> bool:
+        return self.special.get(label, False)
+
+
+def _locale_type(name: str) -> str:
+    """Type is the leading alpha prefix of the name: L2_0_3 -> L2, GPU0 -> GPU."""
+    m = re.match(r"[A-Za-z]+[0-9]*?(?=_|\d|$)", name)
+    if not m:
+        return name
+    # Strip trailing digits only when followed by nothing (GPU0 -> GPU).
+    t = m.group(0)
+    return t.rstrip("0123456789") or t
+
+
+class LocalityGraph:
+    def __init__(self, nworkers: int, locales: Sequence[Locale],
+                 pop_paths: Sequence[Sequence[int]],
+                 steal_paths: Sequence[Sequence[int]]) -> None:
+        if len(pop_paths) != nworkers or len(steal_paths) != nworkers:
+            raise ValueError("need one pop/steal path per worker")
+        self.nworkers = nworkers
+        self.locales: List[Locale] = list(locales)
+        self.by_name: Dict[str, Locale] = {l.name: l for l in self.locales}
+        self.pop_paths: List[List[int]] = [list(p) for p in pop_paths]
+        self.steal_paths: List[List[int]] = [list(p) for p in steal_paths]
+
+    # -- queries (reference: inc/hclib-locality-graph.h:111-121) --
+
+    def locale(self, locale_id: int) -> Locale:
+        return self.locales[locale_id]
+
+    def locales_of_type(self, type_: str) -> List[Locale]:
+        return [l for l in self.locales if l.type == type_]
+
+    def central_locale(self) -> Locale:
+        """The locale reachable on every worker's pop path (sysmem in the
+        default graph); falls back to the most common path member
+        (cf. thread-private/central place computation,
+        src/hclib-locality-graph.c:917-1093)."""
+        common = set(self.pop_paths[0])
+        for p in self.pop_paths[1:]:
+            common &= set(p)
+        if common:
+            # Deepest common = last on worker 0's path that is common.
+            for lid in reversed(self.pop_paths[0]):
+                if lid in common:
+                    return self.locales[lid]
+        return self.locales[0]
+
+    def closest_locale(self, worker_id: int) -> Locale:
+        """First locale on the worker's pop path."""
+        return self.locales[self.pop_paths[worker_id][0]]
+
+    def closest_of_type(self, worker_id: int, type_: str) -> Optional[Locale]:
+        """BFS from the worker's closest locale over reachability edges
+        (reference: src/hclib-locality-graph.c:1136-1164)."""
+        start = self.closest_locale(worker_id)
+        seen = {start.id}
+        frontier = [start]
+        while frontier:
+            nxt: List[Locale] = []
+            for loc in frontier:
+                if loc.type == type_:
+                    return loc
+                for nid in loc.reachable:
+                    if nid not in seen:
+                        seen.add(nid)
+                        nxt.append(self.locales[nid])
+            frontier = nxt
+        return None
+
+
+def generate_default_graph(nworkers: int) -> LocalityGraph:
+    """Star graph: one sysmem root plus one L1 per worker
+    (reference fallback: src/hclib-locality-graph.c:581-643)."""
+    sysmem = Locale(0, "sysmem", "sysmem")
+    locales = [sysmem]
+    for w in range(nworkers):
+        l1 = Locale(1 + w, f"L1{w}", "L1")
+        l1.reachable.append(0)
+        sysmem.reachable.append(l1.id)
+        locales.append(l1)
+    pop_paths = [[1 + w, 0] for w in range(nworkers)]
+    # Steal path covers every worker's L1 so all work is globally stealable
+    # (tasks default to the spawner's closest locale, i.e. its L1).
+    steal_paths = [
+        [0] + [1 + v for v in range(nworkers) if v != w] for w in range(nworkers)
+    ]
+    return LocalityGraph(nworkers, locales, pop_paths, steal_paths)
+
+
+_INTERP = re.compile(r"\$\(\s*id\s*([/%+*-])\s*(\d+)\s*\)")
+
+
+def _interpolate(name: str, worker_id: int) -> str:
+    """Evaluate ``$(id OP k)`` arithmetic in path entries
+    (reference: src/hclib-locality-graph.c:196-237)."""
+
+    def repl(m: re.Match) -> str:
+        op, k = m.group(1), int(m.group(2))
+        if op == "/":
+            return str(worker_id // k)
+        if op == "%":
+            return str(worker_id % k)
+        if op == "+":
+            return str(worker_id + k)
+        if op == "-":
+            return str(worker_id - k)
+        return str(worker_id * k)
+
+    return _INTERP.sub(repl, name)
+
+
+def graph_from_dict(doc: dict, nworkers: Optional[int] = None) -> LocalityGraph:
+    n = int(nworkers if nworkers is not None else doc.get("nworkers", 1))
+    names = list(doc["declarations"])
+    locales = [Locale(i, name, _locale_type(name)) for i, name in enumerate(names)]
+    by_name = {l.name: l for l in locales}
+    for a, b in doc.get("reachability", []):
+        la, lb = by_name[a], by_name[b]
+        la.reachable.append(lb.id)
+        lb.reachable.append(la.id)
+
+    def paths_for(key: str) -> List[List[int]]:
+        spec = doc.get(key, {})
+        out: List[List[int]] = []
+        for w in range(n):
+            entries = spec.get(str(w), spec.get("default", []))
+            path = []
+            for e in entries:
+                nm = _interpolate(e, w)
+                if nm not in by_name:
+                    raise ValueError(f"unknown locale {nm!r} in {key}[{w}]")
+                path.append(by_name[nm].id)
+            if not path:
+                raise ValueError(f"empty {key} for worker {w}")
+            out.append(path)
+        return out
+
+    return LocalityGraph(n, locales, paths_for("pop_paths"), paths_for("steal_paths"))
+
+
+def load_locality_file(path: str, nworkers: Optional[int] = None) -> LocalityGraph:
+    with open(path) as f:
+        return graph_from_dict(json.load(f), nworkers)

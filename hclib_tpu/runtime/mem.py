@@ -1,0 +1,74 @@
+"""Locale-aware memory operations.
+
+Each operation spawns an async *at* the target locale and returns a future -
+the allocation/copy physically happens on whichever worker services that
+locale (reference: src/hclib-mem.c:59-79 alloc, :175-241 copy). Handler
+resolution between source and destination modules follows the reference's
+MUST_USE/MAY_USE priority rule (src/hclib-mem.c:198-221): a MUST_USE handler
+on either side wins; ties go to the destination.
+
+On TPU the interesting instances are host<->device transfers: the ``tpu``
+module registers copy handlers that call ``jax.device_put`` / ``device_get``
+from a host worker servicing the TPU locale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .locality import Locale
+from .module import MAY_USE, mem_fns_for
+from .promise import Future
+from .scheduler import async_future
+
+__all__ = ["allocate_at", "free_at", "memset_at", "async_copy"]
+
+
+def _handler(locale: Locale, op: str):
+    ent = mem_fns_for(locale.type).get(op)
+    if ent is None:
+        raise ValueError(f"no {op!r} handler registered for locale type {locale.type!r}")
+    return ent
+
+
+def allocate_at(nbytes_or_shape: Any, locale: Locale, **kw: Any) -> Future:
+    prio, fn = _handler(locale, "alloc")
+    return async_future(fn, nbytes_or_shape, locale, at=locale, non_blocking=True, **kw)
+
+
+def free_at(buf: Any, locale: Locale) -> Future:
+    prio, fn = _handler(locale, "free")
+    return async_future(fn, buf, locale, at=locale, non_blocking=True)
+
+
+def memset_at(buf: Any, value: int, locale: Locale) -> Future:
+    prio, fn = _handler(locale, "memset")
+    return async_future(fn, buf, value, locale, at=locale, non_blocking=True)
+
+
+def async_copy(
+    dst: Any,
+    dst_locale: Locale,
+    src: Any,
+    src_locale: Locale,
+    nelems: Optional[int] = None,
+) -> Future:
+    """Copy src@src_locale -> dst@dst_locale; handler picked by priority
+    (reference: src/hclib-mem.c:198-221)."""
+    dst_ent = mem_fns_for(dst_locale.type).get("copy")
+    src_ent = mem_fns_for(src_locale.type).get("copy")
+    if dst_ent is None and src_ent is None:
+        raise ValueError(
+            f"no copy handler for locale types {dst_locale.type!r}/{src_locale.type!r}"
+        )
+    if dst_ent is None:
+        ent, at = src_ent, src_locale
+    elif src_ent is None:
+        ent, at = dst_ent, dst_locale
+    else:
+        # Higher priority wins; tie -> destination side.
+        ent, at = (src_ent, src_locale) if src_ent[0] > dst_ent[0] else (dst_ent, dst_locale)
+    _, fn = ent
+    return async_future(
+        fn, dst, dst_locale, src, src_locale, nelems, at=at, non_blocking=True
+    )

@@ -1,0 +1,120 @@
+"""Module/plugin registry.
+
+The reference's modules are dlopen'd shared libraries that register lifecycle
+hooks and locale-type handlers via static initializers
+(HCLIB_REGISTER_MODULE, inc/hclib-module.h:64; src/hclib_module.c:49-152).
+Here a module is a Python object (or entry-point) registered before launch:
+
+- ``pre_init(runtime)`` runs before workers start - register locale types.
+- ``post_init(runtime)`` runs after workers start - open device/comm state
+  (the reference initializes MPI / CUDA streams here).
+- ``finalize(runtime)`` runs at shutdown.
+- Locale-type memory handlers (alloc/free/memset/copy) are registered per
+  locale *type* with a MAY_USE/MUST_USE priority, resolved by mem.py
+  (reference: src/hclib-mem.c:16-50, 198-221).
+- Per-worker module state: ``add_per_worker_state`` returns a slot id; the
+  runtime materializes one value per worker (reference:
+  src/hclib_module.c:129-152) - used e.g. for per-worker comm contexts
+  (modules/sos pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Module",
+    "register_module",
+    "unregister_all_modules",
+    "MAY_USE",
+    "MUST_USE",
+    "register_mem_fns",
+    "mem_fns_for",
+]
+
+MAY_USE = 0
+MUST_USE = 1
+
+
+class Module:
+    """Base class; subclasses override any subset of the hooks."""
+
+    name = "module"
+
+    def pre_init(self, runtime) -> None:  # pragma: no cover - interface
+        pass
+
+    def post_init(self, runtime) -> None:  # pragma: no cover - interface
+        pass
+
+    def finalize(self, runtime) -> None:  # pragma: no cover - interface
+        pass
+
+
+_modules: List[Module] = []
+# locale type -> op name -> (priority, fn)
+_mem_fns: Dict[str, Dict[str, Tuple[int, Callable]]] = {}
+_per_worker_factories: List[Callable[[int], Any]] = []
+
+
+def register_module(mod: Module) -> Module:
+    if all(m is not mod for m in _modules):
+        _modules.append(mod)
+    return mod
+
+
+def unregister_all_modules() -> None:
+    _modules.clear()
+    _mem_fns.clear()
+    _per_worker_factories.clear()
+
+
+def registered_modules() -> List[Module]:
+    return list(_modules)
+
+
+def call_pre_init(runtime) -> None:
+    for m in _modules:
+        m.pre_init(runtime)
+
+
+def call_post_init(runtime) -> None:
+    runtime.per_worker_state = [
+        [f(w) for f in _per_worker_factories] for w in range(runtime.nworkers)
+    ]
+    for m in _modules:
+        m.post_init(runtime)
+
+
+def call_finalize(runtime) -> None:
+    for m in _modules:
+        m.finalize(runtime)
+
+
+def add_per_worker_state(factory: Callable[[int], Any]) -> int:
+    """Returns a slot id usable with ``get_per_worker_state``."""
+    _per_worker_factories.append(factory)
+    return len(_per_worker_factories) - 1
+
+
+def get_per_worker_state(runtime, worker_id: int, slot: int) -> Any:
+    return runtime.per_worker_state[worker_id][slot]
+
+
+def register_mem_fns(
+    locale_type: str,
+    *,
+    alloc: Optional[Callable] = None,
+    free: Optional[Callable] = None,
+    memset: Optional[Callable] = None,
+    copy: Optional[Callable] = None,
+    priority: int = MAY_USE,
+) -> None:
+    ops = _mem_fns.setdefault(locale_type, {})
+    for name, fn in (("alloc", alloc), ("free", free), ("memset", memset), ("copy", copy)):
+        if fn is not None:
+            ops[name] = (priority, fn)
+
+
+def mem_fns_for(locale_type: str) -> Dict[str, Tuple[int, Callable]]:
+    return _mem_fns.get(locale_type, {})

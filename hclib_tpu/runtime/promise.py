@@ -1,0 +1,133 @@
+"""Promises and futures (data-driven futures, DDFs).
+
+Semantics follow the reference runtime's single-assignment promise with a
+waiter list (reference: src/hclib-promise.c:132-245, inc/hclib-promise.h:76-90):
+
+- A promise is a single-assignment cell. ``put`` may be called exactly once.
+- Tasks waiting on multiple futures register on *at most one* unsatisfied
+  future at a time, walking their dependency list in order (reference:
+  src/hclib-promise.c:171-195). When that promise is satisfied, the put path
+  resumes the walk for each waiter and schedules tasks whose dependencies are
+  all satisfied (src/hclib-promise.c:203-245) - this is the only place blocked
+  tasks become runnable.
+- Blocked *execution contexts* (a thread inside ``Future.wait``) are
+  represented as event waiters rather than suspended fibers; the scheduler
+  parks the context and keeps the worker count constant
+  (see scheduler.py, replacing the reference's LiteCtx fiber swap).
+
+This host-side implementation is intentionally lock-based and simple: it pins
+the semantics that the TPU device path (device/) re-implements with on-device
+flag words and waiter queues.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+__all__ = ["Promise", "Future", "PromiseError"]
+
+_UNSET = object()
+
+
+class PromiseError(RuntimeError):
+    pass
+
+
+class Promise:
+    """Single-assignment cell with a waiter list."""
+
+    __slots__ = ("_lock", "_value", "_satisfied", "_task_waiters", "_ctx_waiters")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value: Any = _UNSET
+        self._satisfied = False
+        # Tasks blocked with this promise as their current registration point.
+        self._task_waiters: List[Any] = []
+        # Parked execution contexts (threading.Event) waiting on this promise.
+        self._ctx_waiters: List[threading.Event] = []
+
+    @property
+    def future(self) -> "Future":
+        return Future(self)
+
+    def satisfied(self) -> bool:
+        return self._satisfied
+
+    def put(self, value: Any = None) -> None:
+        """Satisfy the promise and wake every waiter.
+
+        Task waiters resume their dependency-registration walk; contexts are
+        simply unparked (they re-check their own wait condition).
+        """
+        with self._lock:
+            if self._satisfied:
+                raise PromiseError("promise put() called twice")
+            self._value = value
+            self._satisfied = True
+            task_waiters, self._task_waiters = self._task_waiters, []
+            ctx_waiters, self._ctx_waiters = self._ctx_waiters, []
+        # Outside the lock: schedule/resume waiters.
+        if task_waiters:
+            from . import scheduler
+
+            rt = scheduler.current_runtime()
+            for task in task_waiters:
+                rt.resume_registration(task)
+        for ev in ctx_waiters:
+            ev.set()
+
+    def _register_task(self, task: Any) -> bool:
+        """Try to add ``task`` as a waiter. Returns False when already
+        satisfied (caller should continue its registration walk)."""
+        with self._lock:
+            if self._satisfied:
+                return False
+            self._task_waiters.append(task)
+            return True
+
+    def _register_ctx(self, event: threading.Event) -> bool:
+        with self._lock:
+            if self._satisfied:
+                return False
+            self._ctx_waiters.append(event)
+            return True
+
+    def get(self) -> Any:
+        if not self._satisfied:
+            raise PromiseError("promise value read before put()")
+        return self._value
+
+
+class Future:
+    """Read handle on a promise (reference: inc/hclib_future.h)."""
+
+    __slots__ = ("promise",)
+
+    def __init__(self, promise: Promise) -> None:
+        self.promise = promise
+
+    def satisfied(self) -> bool:
+        return self.promise.satisfied()
+
+    def get(self) -> Any:
+        """Non-blocking read; requires the promise to be satisfied."""
+        return self.promise.get()
+
+    def wait(self) -> Any:
+        """Block the current execution context until satisfied.
+
+        Equivalent to hclib_future_wait (reference: src/hclib-runtime.c:983):
+        help-first runs other tasks inline, then parks the context.
+        """
+        if self.promise.satisfied():
+            return self.promise.get()
+        from . import scheduler
+
+        scheduler.current_runtime().wait_on(self.promise)
+        return self.promise.get()
+
+
+def make_promise_vector(n: int) -> List[Promise]:
+    return [Promise() for _ in range(n)]

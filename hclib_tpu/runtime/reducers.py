@@ -1,0 +1,60 @@
+"""Worker-local reducers ("atomics" in the reference).
+
+Per-worker padded slots updated without synchronization (worker-serial), then
+gathered at read time (reference: src/hclib_atomic.c, inc/hclib_atomic.h:
+82-186 - atomic_t<T>, atomic_sum_t/max_t/or_t). On device, the analogue is a
+per-core accumulator in VMEM reduced at kernel exit.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, List
+
+from . import scheduler
+
+__all__ = ["Reducer", "SumReducer", "MaxReducer", "OrReducer"]
+
+
+class Reducer:
+    def __init__(self, init: Any, gather: Callable[[Any, Any], Any]) -> None:
+        rt = scheduler.current_runtime()
+        self._init = init
+        self._gather = gather
+        self._vals: List[Any] = [init for _ in range(rt.nworkers)]
+
+    def update(self, fn: Callable[[Any], Any]) -> None:
+        w = scheduler.current_worker()
+        if w < 0:
+            w = 0
+        self._vals[w] = fn(self._vals[w])
+
+    def gather(self) -> Any:
+        acc = self._init
+        for v in self._vals:
+            acc = self._gather(acc, v)
+        return acc
+
+
+class SumReducer(Reducer):
+    def __init__(self, init: Any = 0) -> None:
+        super().__init__(init, operator.add)
+
+    def add(self, v: Any) -> None:
+        self.update(lambda x: x + v)
+
+
+class MaxReducer(Reducer):
+    def __init__(self, init: Any = float("-inf")) -> None:
+        super().__init__(init, max)
+
+    def put(self, v: Any) -> None:
+        self.update(lambda x: x if x >= v else v)
+
+
+class OrReducer(Reducer):
+    def __init__(self, init: int = 0) -> None:
+        super().__init__(init, operator.or_)
+
+    def put(self, v: int) -> None:
+        self.update(lambda x: x | v)

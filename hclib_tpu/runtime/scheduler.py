@@ -1,0 +1,651 @@
+"""Host-side work-stealing scheduler.
+
+Architecture (re-designed from the reference, not translated):
+
+The reference binds one pthread per worker and uses stackful fibers (LiteCtx)
+so a *context* that blocks in end-finish/future-wait can be swapped out while
+the worker keeps executing tasks (src/hclib-runtime.c:912-945, 1067-1119).
+Python has no cheap fibers, so this runtime inverts the binding: there are
+``nworkers`` fixed worker *identities* (each owning its deques, paths, and
+stats), and a dynamic pool of OS threads that bind to identities. When an
+execution context blocks, it releases its identity - a spare thread picks the
+identity up and keeps draining deques, so the effective worker count stays
+constant. When the context is resumed it re-acquires an identity, *possibly a
+different one* - mirroring the reference, where a resumed continuation may run
+on a different worker (src/hclib-runtime.c:1272-1275).
+
+Blocking follows the reference's help-first policy (src/hclib-runtime.c:
+646-694): before parking, a blocked context runs tasks inline when safe - a
+task is inline-safe if it is non-blocking or belongs to the finish scope being
+awaited. A popped task that is not inline-safe is pushed back and the context
+parks (the reference instead swaps to a fresh fiber seeded with that task -
+same effect: the task runs on another context, the blocked stack sleeps).
+
+This host runtime is the semantic model for the TPU device scheduler
+(device/megakernel.py), where worker identities become TPU cores, deques
+become HBM descriptor rings, and parked contexts become re-enqueued
+continuation descriptors.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque as _pydeque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .deque import WSDeque
+from .finish import Finish
+from .locality import Locale, LocalityGraph, generate_default_graph, load_locality_file
+from .promise import Future, Promise
+from .task import Task
+
+__all__ = [
+    "Runtime",
+    "current_runtime",
+    "launch",
+    "async_",
+    "async_future",
+    "finish",
+    "start_finish",
+    "end_finish",
+    "end_finish_nonblocking",
+    "yield_",
+    "current_worker",
+    "num_workers",
+]
+
+_THREAD_STACK = 1 << 19  # 512 KB, cf. LITECTX_SIZE 256 KB (src/inc/litectx.h:25)
+_MAX_THREADS = 4096
+
+
+class _Context(threading.local):
+    """Per-thread execution context (what the reference keeps in the worker
+    struct + current fiber: inc/hclib-rt.h:80-111)."""
+
+    identity: Optional[int] = None
+    current_finish: Optional[Finish] = None
+    current_task: Optional[Task] = None
+    runtime: Optional["Runtime"] = None
+
+
+_tls = _Context()
+_global_runtime: Optional["Runtime"] = None
+
+
+def current_runtime() -> "Runtime":
+    rt = _tls.runtime or _global_runtime
+    if rt is None:
+        raise RuntimeError("no active hclib_tpu runtime; call inside launch()")
+    return rt
+
+
+class _WorkerStats:
+    __slots__ = ("executed", "spawned", "steals", "parks", "yields", "stolen_from")
+
+    def __init__(self, nworkers: int) -> None:
+        self.executed = 0
+        self.spawned = 0
+        self.steals = 0
+        self.parks = 0
+        self.yields = 0
+        # steal matrix row (reference HCLIB_STATS: src/hclib-runtime.c:83-104)
+        self.stolen_from = [0] * nworkers
+
+
+class _IdentityManager:
+    """Hands worker identities to threads. Resumed contexts (priority) beat
+    generic pool threads so program state is never starved of a worker."""
+
+    def __init__(self, nworkers: int) -> None:
+        self._cv = threading.Condition()
+        self._free: List[int] = list(range(nworkers))
+        self._priority_waiters = 0
+        self._normal_waiters = 0
+        self._shutdown = False
+        self.has_priority_waiter = False  # racy read is fine; checked under lock on release
+
+    def acquire(self, priority: bool) -> Optional[int]:
+        with self._cv:
+            if priority:
+                self._priority_waiters += 1
+                self.has_priority_waiter = True
+            else:
+                self._normal_waiters += 1
+            try:
+                while True:
+                    if self._shutdown and not priority:
+                        return None
+                    if self._free and (priority or self._priority_waiters == 0):
+                        return self._free.pop()
+                    self._cv.wait(0.05)
+            finally:
+                if priority:
+                    self._priority_waiters -= 1
+                    self.has_priority_waiter = self._priority_waiters > 0
+                else:
+                    self._normal_waiters -= 1
+
+    def release(self, wid: int) -> bool:
+        """Returns True if a spare thread should be spawned to keep the
+        worker count constant (no thread is waiting to claim the identity)."""
+        with self._cv:
+            self._free.append(wid)
+            self._cv.notify_all()
+            return (
+                self._priority_waiters == 0
+                and self._normal_waiters == 0
+                and not self._shutdown
+            )
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+
+class Runtime:
+    def __init__(
+        self,
+        nworkers: Optional[int] = None,
+        locality_graph: Optional[LocalityGraph] = None,
+        stats: Optional[bool] = None,
+    ) -> None:
+        if nworkers is None:
+            env = os.environ.get("HCLIB_TPU_WORKERS") or os.environ.get("HCLIB_WORKERS")
+            nworkers = int(env) if env else (os.cpu_count() or 1)
+        if locality_graph is None:
+            path = os.environ.get("HCLIB_TPU_LOCALITY_FILE") or os.environ.get(
+                "HCLIB_LOCALITY_FILE"
+            )
+            locality_graph = (
+                load_locality_file(path, nworkers) if path else generate_default_graph(nworkers)
+            )
+        if locality_graph.nworkers != nworkers:
+            nworkers = locality_graph.nworkers
+        self.nworkers = nworkers
+        self.graph = locality_graph
+        self.stats_enabled = (
+            stats if stats is not None else bool(os.environ.get("HCLIB_TPU_STATS"))
+        )
+        # One deque per (locale, worker) - the core locality-graph invariant
+        # (inc/hclib-locality-graph.h:9-50).
+        self.deques: Dict[Tuple[int, int], WSDeque] = {
+            (loc.id, w): WSDeque()
+            for loc in self.graph.locales
+            for w in range(nworkers)
+        }
+        self.worker_stats = [_WorkerStats(nworkers) for _ in range(nworkers)]
+        self._last_steal = [0] * nworkers
+        self._idmgr = _IdentityManager(nworkers)
+        self._work_cv = threading.Condition()
+        self._pending = 0  # tasks in deques (approximate wakeup hint)
+        self._shutdown = False
+        self._threads: List[threading.Thread] = []
+        self._nthreads_lock = threading.Lock()
+        self._nthreads = 0
+        self.root_finish: Optional[Finish] = None
+        # First exception raised by any task; re-raised at launch exit.
+        self._first_error: Optional[BaseException] = None
+        self._first_error_lock = threading.Lock()
+        # Idle callbacks per locale (locale_register_idle_task,
+        # src/hclib-locality-graph.c:807-827) - used by comm backends to poll.
+        self._idle_fns: List[Callable[[int], bool]] = []
+
+    # ------------------------------------------------------------------ spawn
+
+    def spawn(
+        self,
+        fn: Callable[..., Any],
+        args: Sequence[Any] = (),
+        kwargs: Optional[dict] = None,
+        locale: Optional[Locale] = None,
+        waiting_on: Sequence[Future] = (),
+        non_blocking: bool = False,
+        escaping: bool = False,
+        result_promise: Optional[Promise] = None,
+    ) -> Task:
+        fin = None if escaping else _tls.current_finish
+        task = Task(
+            fn,
+            args,
+            kwargs,
+            finish=fin,
+            waiting_on=waiting_on,
+            locale=locale,
+            non_blocking=non_blocking,
+            result_promise=result_promise,
+        )
+        if fin is not None:
+            fin.check_in()
+        wid = _tls.identity
+        if wid is not None:
+            self.worker_stats[wid].spawned += 1
+        self._try_schedule(task)
+        return task
+
+    def _try_schedule(self, task: Task) -> None:
+        """Register on the first unsatisfied dependency, else enqueue
+        (try_schedule_async: src/hclib-runtime.c:558-570)."""
+        while task.wait_index < len(task.waiting_on):
+            fut = task.waiting_on[task.wait_index]
+            if fut.promise._register_task(task):
+                return  # parked on this promise; put() resumes the walk
+            task.wait_index += 1
+        self._enqueue(task)
+
+    def resume_registration(self, task: Task) -> None:
+        task.wait_index += 1
+        self._try_schedule(task)
+
+    def _enqueue(self, task: Task) -> None:
+        wid = _tls.identity
+        if wid is None:
+            wid = 0
+        locale = task.locale
+        if locale is None:
+            locale = self.graph.closest_locale(wid)
+            task.locale = locale
+        self.deques[(locale.id, wid)].push(task)
+        with self._work_cv:
+            self._pending += 1
+            self._work_cv.notify_all()
+
+    # ------------------------------------------------------------------ find
+
+    def _find_task(self, wid: int) -> Optional[Task]:
+        # Pop path: drain own deques, closest locale first
+        # (locale_pop_task: src/hclib-locality-graph.c:774-805).
+        for lid in self.graph.pop_paths[wid]:
+            t = self.deques[(lid, wid)].pop()
+            if t is not None:
+                with self._work_cv:
+                    self._pending -= 1
+                return t
+        # Steal path: scan every worker's deque at each locale, rotating the
+        # starting victim (locale_steal_task: src/hclib-locality-graph.c:843-888).
+        start = self._last_steal[wid]
+        for lid in self.graph.steal_paths[wid]:
+            for i in range(self.nworkers):
+                v = (start + i) % self.nworkers
+                if v == wid and lid in self.graph.pop_paths[wid]:
+                    continue
+                t = self.deques[(lid, v)].steal()
+                if t is not None:
+                    self._last_steal[wid] = v
+                    st = self.worker_stats[wid]
+                    st.steals += 1
+                    st.stolen_from[v] += 1
+                    with self._work_cv:
+                        self._pending -= 1
+                    return t
+        return None
+
+    # --------------------------------------------------------------- execute
+
+    def _execute(self, task: Task) -> None:
+        prev_finish, prev_task = _tls.current_finish, _tls.current_task
+        _tls.current_finish = task.finish
+        _tls.current_task = task
+        try:
+            task.run()
+        finally:
+            _tls.current_finish, _tls.current_task = prev_finish, prev_task
+            if task.finish is not None:
+                task.finish.check_out()
+            wid = _tls.identity
+            if wid is not None:
+                self.worker_stats[wid].executed += 1
+
+    # ------------------------------------------------------------- work loop
+
+    def _core_work_loop(self, wid: int) -> None:
+        """Drain/steal/execute until shutdown or a resumed context needs this
+        identity (core_work_loop: src/hclib-runtime.c:705-724)."""
+        _tls.identity = wid
+        while not self._shutdown:
+            if self._idmgr.has_priority_waiter:
+                break  # hand the identity to a resumed context
+            task = self._find_task(wid)
+            if task is not None:
+                try:
+                    self._execute(task)
+                except BaseException as e:
+                    # A task failing on a pool thread must not kill the
+                    # worker or vanish: record it for launch() to re-raise.
+                    self._record_error(e)
+                continue
+            if self._run_idle_fns(wid):
+                continue
+            with self._work_cv:
+                if self._pending == 0 and not self._shutdown:
+                    self._work_cv.wait(0.01)
+        _tls.identity = None
+
+    def _record_error(self, e: BaseException) -> None:
+        with self._first_error_lock:
+            if self._first_error is None:
+                self._first_error = e
+
+    def _run_idle_fns(self, wid: int) -> bool:
+        did = False
+        for fn in self._idle_fns:
+            try:
+                did = bool(fn(wid)) or did
+            except Exception:  # idle pollers must not kill workers
+                pass
+        return did
+
+    def register_idle_fn(self, fn: Callable[[int], bool]) -> None:
+        self._idle_fns.append(fn)
+
+    def _thread_main(self) -> None:
+        _tls.runtime = self
+        while True:
+            wid = self._idmgr.acquire(priority=False)
+            if wid is None:
+                return
+            self._core_work_loop(wid)
+            if self._shutdown:
+                self._idmgr.release(wid)
+                return
+            self._idmgr.release(wid)
+
+    def _spawn_thread(self) -> None:
+        with self._nthreads_lock:
+            if self._nthreads >= _MAX_THREADS:
+                return
+            self._nthreads += 1
+        t = threading.Thread(target=self._thread_main, daemon=True, name="hclib-worker")
+        self._threads.append(t)
+        t.start()
+
+    # ------------------------------------------------------------- blocking
+
+    def _inline_safe(self, task: Task, fin: Optional[Finish]) -> bool:
+        """Reference rule (src/hclib-runtime.c:673-689): run inline iff the
+        task can't block this stack indefinitely - it is declared non-blocking
+        or belongs to the very finish scope we are draining."""
+        return task.non_blocking or (fin is not None and task.finish is fin)
+
+    def _park(self, register: Callable[[threading.Event], Optional[threading.Event]]) -> None:
+        """Release identity, sleep until the event fires, re-bind an identity."""
+        ev = threading.Event()
+        armed = register(ev)
+        if armed is None:
+            return  # condition already satisfied
+        wid = _tls.identity
+        if wid is not None:
+            self.worker_stats[wid].parks += 1
+            _tls.identity = None
+            if self._idmgr.release(wid):
+                self._spawn_thread()
+        armed.wait()
+        _tls.identity = self._idmgr.acquire(priority=True)
+
+    def help_finish(self, fin: Finish) -> None:
+        """Help-first drain of a finish scope (help_finish:
+        src/hclib-runtime.c:1067-1119)."""
+        wid = _tls.identity
+        while not fin.quiesced():
+            task = self._find_task(wid) if wid is not None else None
+            if task is None:
+                self._park(lambda ev, f=fin: f.arm_event() if not f.quiesced() else None)
+                wid = _tls.identity
+                continue
+            if self._inline_safe(task, fin):
+                self._execute(task)
+            else:
+                # The reference swaps to a fresh fiber seeded with this task;
+                # we re-enqueue it and park - another thread runs it.
+                self._requeue_and_park(task, lambda ev, f=fin: _arm_finish(f, ev))
+                wid = _tls.identity
+
+    def wait_on(self, promise: Promise) -> None:
+        """Future-wait (hclib_future_wait: src/hclib-runtime.c:983-1025):
+        help with non-blocking tasks, else park on the promise."""
+        wid = _tls.identity
+        while not promise.satisfied():
+            task = self._find_task(wid) if wid is not None else None
+            if task is None:
+                self._park(lambda ev, p=promise: ev if p._register_ctx(ev) else None)
+                wid = _tls.identity
+                continue
+            if self._inline_safe(task, None):
+                self._execute(task)
+            else:
+                self._requeue_and_park(
+                    task, lambda ev, p=promise: ev if p._register_ctx(ev) else None
+                )
+                wid = _tls.identity
+
+    def _requeue_and_park(self, task: Task, register) -> None:
+        self._enqueue(task)
+        self._park(register)
+
+    def yield_(self, locale: Optional[Locale] = None) -> bool:
+        """Run at most one other task inline (hclib_yield:
+        src/hclib-runtime.c:1142-1217). Returns True if a task ran."""
+        wid = _tls.identity
+        if wid is None:
+            return False
+        self.worker_stats[wid].yields += 1
+        task = self._find_task(wid)
+        if task is None:
+            return False
+        if self._inline_safe(task, _tls.current_finish):
+            self._execute(task)
+            return True
+        self._enqueue(task)  # put it back; a blocking task can't run on this stack
+        return False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def run(self, fn: Callable[..., Any], *args: Any) -> Any:
+        """Launch: bind the caller as a worker, run ``fn`` under the root
+        finish, drain, shut down (hclib_launch: src/hclib-runtime.c:1460-1478)."""
+        global _global_runtime
+        if _global_runtime is not None:
+            raise RuntimeError("an hclib_tpu runtime is already active")
+        _global_runtime = self
+        _tls.runtime = self
+        from .module import call_pre_init, call_post_init, call_finalize
+
+        call_pre_init(self)
+        for _ in range(self.nworkers):
+            self._spawn_thread()
+        _tls.identity = self._idmgr.acquire(priority=True)
+        call_post_init(self)
+        self.root_finish = Finish()
+        prev_finish = _tls.current_finish
+        _tls.current_finish = self.root_finish
+        result: List[Any] = [None]
+        err: List[Optional[BaseException]] = [None]
+
+        def root() -> None:
+            try:
+                result[0] = fn(*args)
+            except BaseException as e:  # propagate to launcher
+                err[0] = e
+
+        try:
+            self.spawn(root)
+            self.help_finish(self.root_finish)
+        finally:
+            _tls.current_finish = prev_finish
+            self._shutdown = True
+            self._idmgr.shutdown()
+            with self._work_cv:
+                self._work_cv.notify_all()
+            for t in self._threads:
+                t.join(timeout=5.0)
+            call_finalize(self)
+            if _tls.identity is not None:
+                _tls.identity = None
+            _global_runtime = None
+            _tls.runtime = None
+            if self.stats_enabled:
+                self.print_stats()
+        if err[0] is not None:
+            raise err[0]
+        if self._first_error is not None:
+            raise self._first_error
+        return result[0]
+
+    # ----------------------------------------------------------------- misc
+
+    def backlog(self) -> int:
+        """Tasks currently enqueued (hclib_current_worker_backlog,
+        src/hclib-runtime.c:1365-1368)."""
+        return sum(len(d) for d in self.deques.values())
+
+    def print_stats(self) -> None:
+        print(self.format_stats())
+
+    def format_stats(self) -> str:
+        lines = ["hclib_tpu runtime stats:"]
+        for w, st in enumerate(self.worker_stats):
+            lines.append(
+                f"  worker {w}: executed={st.executed} spawned={st.spawned} "
+                f"steals={st.steals} parks={st.parks} yields={st.yields}"
+            )
+        return "\n".join(lines)
+
+
+def _arm_finish(fin: Finish, ev: threading.Event) -> Optional[threading.Event]:
+    armed = fin.arm_event()
+    return armed
+
+
+# ---------------------------------------------------------------- public API
+
+
+def launch(
+    fn: Callable[..., Any],
+    *args: Any,
+    nworkers: Optional[int] = None,
+    locality_graph: Optional[LocalityGraph] = None,
+    stats: Optional[bool] = None,
+) -> Any:
+    """Run ``fn`` inside a fresh runtime; returns its result."""
+    return Runtime(nworkers=nworkers, locality_graph=locality_graph, stats=stats).run(
+        fn, *args
+    )
+
+
+def async_(
+    fn: Callable[..., Any],
+    *args: Any,
+    at: Optional[Locale] = None,
+    await_: Sequence[Future] = (),
+    non_blocking: bool = False,
+    escaping: bool = False,
+    **kwargs: Any,
+) -> None:
+    """Spawn a task under the current finish scope (hclib::async family,
+    inc/hclib-async.h:162-547)."""
+    current_runtime().spawn(
+        fn,
+        args,
+        kwargs,
+        locale=at,
+        waiting_on=await_,
+        non_blocking=non_blocking,
+        escaping=escaping,
+    )
+
+
+def async_future(
+    fn: Callable[..., Any],
+    *args: Any,
+    at: Optional[Locale] = None,
+    await_: Sequence[Future] = (),
+    non_blocking: bool = False,
+    **kwargs: Any,
+) -> Future:
+    """Spawn and return a future satisfied with the task's return value
+    (hclib_async_future: src/hclib.c:59-81)."""
+    p = Promise()
+    current_runtime().spawn(
+        fn,
+        args,
+        kwargs,
+        locale=at,
+        waiting_on=await_,
+        non_blocking=non_blocking,
+        result_promise=p,
+    )
+    return p.future
+
+
+def start_finish() -> Finish:
+    fin = Finish(parent=_tls.current_finish)
+    _tls.current_finish = fin
+    return fin
+
+
+def end_finish(fin: Optional[Finish] = None) -> None:
+    cur = _tls.current_finish
+    if fin is None:
+        fin = cur
+    if fin is None:
+        raise RuntimeError("end_finish with no open finish scope")
+    current_runtime().help_finish(fin)
+    _tls.current_finish = fin.parent
+
+
+def end_finish_nonblocking(fin: Optional[Finish] = None) -> Future:
+    """Close the scope without blocking; the returned future is satisfied
+    when the scope drains (hclib_end_finish_nonblocking)."""
+    cur = _tls.current_finish
+    if fin is None:
+        fin = cur
+    if fin is None:
+        raise RuntimeError("end_finish_nonblocking with no open finish scope")
+    _tls.current_finish = fin.parent
+    p = fin.arm_promise()
+    if p is None:
+        p = Promise()
+        p.put(None)
+    return p.future
+
+
+class finish:
+    """``with hclib_tpu.finish():`` context manager (hclib::finish,
+    inc/hclib-async.h:550-563)."""
+
+    def __init__(self) -> None:
+        self._fin: Optional[Finish] = None
+
+    def __enter__(self) -> Finish:
+        self._fin = start_finish()
+        return self._fin
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            end_finish(self._fin)
+        else:
+            # Drain children even on error so state stays consistent.
+            try:
+                end_finish(self._fin)
+            except Exception:
+                pass
+        return False
+
+
+def yield_(at: Optional[Locale] = None) -> bool:
+    return current_runtime().yield_(at)
+
+
+def current_worker() -> int:
+    wid = _tls.identity
+    return -1 if wid is None else wid
+
+
+def num_workers() -> int:
+    return current_runtime().nworkers
+
+
+def current_finish() -> Optional[Finish]:
+    return _tls.current_finish

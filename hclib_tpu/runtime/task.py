@@ -1,0 +1,63 @@
+"""Task representation.
+
+Mirrors the reference task struct (inc/hclib-task.h:32-44): a function, its
+arguments, the owning finish scope, an ordered dependency list with a
+registration cursor, a target locale, and a ``non_blocking`` promise that the
+task never suspends (letting it run inline on any context -
+src/hclib-runtime.c:673-693).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+__all__ = ["Task"]
+
+
+class Task:
+    __slots__ = (
+        "fn",
+        "args",
+        "kwargs",
+        "finish",
+        "waiting_on",
+        "wait_index",
+        "locale",
+        "non_blocking",
+        "result_promise",
+    )
+
+    def __init__(
+        self,
+        fn: Callable[..., Any],
+        args: Sequence[Any] = (),
+        kwargs: Optional[dict] = None,
+        finish: Any = None,
+        waiting_on: Sequence[Any] = (),
+        locale: Any = None,
+        non_blocking: bool = False,
+        result_promise: Any = None,
+    ) -> None:
+        self.fn = fn
+        self.args = tuple(args)
+        self.kwargs = kwargs or {}
+        self.finish = finish
+        # Futures this task depends on; registration walks them in order,
+        # one unsatisfied promise at a time (src/hclib-promise.c:171-195).
+        self.waiting_on = list(waiting_on)
+        self.wait_index = 0
+        self.locale = locale
+        self.non_blocking = non_blocking
+        # When set, the task's return value is put() here on completion
+        # (hclib_async_future trampoline, src/hclib.c:59-81).
+        self.result_promise = result_promise
+
+    def run(self) -> Any:
+        result = self.fn(*self.args, **self.kwargs)
+        if self.result_promise is not None:
+            self.result_promise.put(result)
+        return result
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        name = getattr(self.fn, "__name__", repr(self.fn))
+        return f"<Task {name} deps={len(self.waiting_on)} locale={self.locale}>"

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Force the CPU backend with 8 virtual devices so multi-chip sharding paths are
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path); must be set before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_modules():
+    """Each test starts with an empty module registry."""
+    from hclib_tpu.runtime import module
+
+    saved = list(module._modules)
+    yield
+    module._modules[:] = saved

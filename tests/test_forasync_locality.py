@@ -1,0 +1,189 @@
+"""forasync (1D/2D/3D, flat + recursive) and locality-graph tests, mirroring
+test/c/forasync*{Ch,Rec} and the locality-graph loader."""
+
+import json
+import threading
+
+import pytest
+
+import hclib_tpu as hc
+from hclib_tpu.runtime.locality import graph_from_dict
+
+
+def _concurrent_marker(n):
+    lock = threading.Lock()
+    hits = set()
+
+    def fn(*idx):
+        with lock:
+            hits.add(idx if len(idx) > 1 else idx[0])
+
+    return fn, hits, lock
+
+
+def test_forasync_1d_flat():
+    fn, hits, _ = _concurrent_marker(100)
+
+    def main():
+        hc.forasync(fn, [100], tile=16, mode=hc.FLAT)
+
+    hc.launch(main, nworkers=3)
+    assert hits == set(range(100))
+
+
+def test_forasync_1d_recursive():
+    fn, hits, _ = _concurrent_marker(100)
+
+    def main():
+        hc.forasync(fn, [100], tile=8, mode=hc.RECURSIVE)
+
+    hc.launch(main, nworkers=3)
+    assert hits == set(range(100))
+
+
+def test_forasync_2d():
+    fn, hits, _ = _concurrent_marker(None)
+
+    def main():
+        hc.forasync(fn, [12, 9], tile=[4, 3], mode=hc.FLAT)
+
+    hc.launch(main, nworkers=2)
+    assert hits == {(i, j) for i in range(12) for j in range(9)}
+
+
+def test_forasync_3d_recursive():
+    fn, hits, _ = _concurrent_marker(None)
+
+    def main():
+        hc.forasync(fn, [4, 5, 6], tile=2, mode=hc.RECURSIVE)
+
+    hc.launch(main, nworkers=2)
+    assert hits == {(i, j, k) for i in range(4) for j in range(5) for k in range(6)}
+
+
+def test_forasync_bounds_pairs_and_autotile():
+    fn, hits, _ = _concurrent_marker(None)
+
+    def main():
+        hc.forasync(fn, [(10, 20)])
+
+    hc.launch(main, nworkers=2)
+    assert hits == set(range(10, 20))
+
+
+def test_forasync_future():
+    fn, hits, _ = _concurrent_marker(None)
+
+    def main():
+        fut = hc.forasync_future(fn, [50], tile=10)
+        fut.wait()
+        assert hits == set(range(50))
+
+    hc.launch(main, nworkers=2)
+
+
+def test_forasync_dist_func():
+    """Every tile routed to the central locale via a dist func
+    (reference: loop_dist_func, inc/hclib-forasync.h:349-380)."""
+    placed = []
+
+    def main():
+        rt = hc.current_runtime()
+        central = rt.graph.central_locale()
+
+        def dist(ndim, tile, total):
+            placed.append(tile)
+            return central
+
+        hc.forasync(lambda i: None, [40], tile=10, dist_func=dist)
+
+    hc.launch(main, nworkers=2)
+    assert sorted(placed) == [0, 1, 2, 3]
+
+
+def test_arrayadd_forasync():
+    """Reference: test/forasync/arrayadd - c = a + b elementwise."""
+    n = 1000
+    a = list(range(n))
+    b = list(range(0, 2 * n, 2))
+    c = [0] * n
+
+    def main():
+        def body(i):
+            c[i] = a[i] + b[i]
+
+        hc.forasync(body, [n], tile=64)
+
+    hc.launch(main, nworkers=4)
+    assert c == [3 * i for i in range(n)]
+
+
+# ---------------------------------------------------------------- locality
+
+
+def test_default_graph_shape():
+    g = hc.generate_default_graph(4)
+    assert g.nworkers == 4
+    assert g.central_locale().type == "sysmem"
+    assert len(g.locales_of_type("L1")) == 4
+    for w in range(4):
+        assert g.closest_locale(w).name == f"L1{w}"
+
+
+def test_reference_schema_load():
+    """Parse a reference-format locality JSON with $(id) interpolation
+    (schema: locality_graphs/davinci.json)."""
+    doc = {
+        "nworkers": 4,
+        "declarations": ["sysmem", "L2_0", "L2_1", "GPU0", "Interconnect"],
+        "reachability": [
+            ["sysmem", "L2_0"],
+            ["sysmem", "L2_1"],
+            ["sysmem", "GPU0"],
+            ["sysmem", "Interconnect"],
+        ],
+        "pop_paths": {"default": ["L2_$(id / 2)", "sysmem"]},
+        "steal_paths": {"default": ["L2_$(id % 2)", "sysmem"]},
+    }
+    g = graph_from_dict(doc)
+    assert g.nworkers == 4
+    assert [g.locale(i).name for i in g.pop_paths[3]] == ["L2_1", "sysmem"]
+    assert [g.locale(i).name for i in g.steal_paths[3]] == ["L2_1", "sysmem"]
+    assert g.locale(g.pop_paths[0][0]).name == "L2_0"
+    gpu = g.locales_of_type("GPU")
+    assert len(gpu) == 1
+    assert g.closest_of_type(0, "GPU").name == "GPU0"
+    nic = g.by_name["Interconnect"]
+    nic.mark_special("COMM")
+    assert nic.is_special("COMM")
+
+
+def test_run_with_custom_graph():
+    doc = {
+        "nworkers": 2,
+        "declarations": ["sysmem", "L1_0", "L1_1"],
+        "reachability": [["sysmem", "L1_0"], ["sysmem", "L1_1"]],
+        "pop_paths": {"default": ["L1_$(id % 2)", "sysmem"]},
+        "steal_paths": {"default": ["sysmem", "L1_0", "L1_1"]},
+    }
+    g = graph_from_dict(doc)
+    hits = []
+
+    def main():
+        with hc.finish():
+            for i in range(20):
+                hc.async_(hits.append, i)
+
+    hc.launch(main, locality_graph=g)
+    assert len(hits) == 20
+
+
+def test_reducers():
+    def main():
+        s = hc.SumReducer()
+        m = hc.MaxReducer()
+        hc.forasync(lambda i: (s.add(i), m.put(i)), [100], tile=10)
+        assert s.gather() == sum(range(100))
+        assert m.gather() == 99
+
+    hc.launch(main, nworkers=3)

@@ -1,0 +1,276 @@
+"""Host runtime core tests, mirroring the reference's test/c and test/cpp
+feature suites (async0/1, finish0/1/2, future0-3, asyncAwait, yield,
+nested_finish, future_wait_in_finish; see SURVEY.md section 4)."""
+
+import threading
+
+import pytest
+
+import hclib_tpu as hc
+
+
+def test_async_runs_before_finish_exits():
+    hit = []
+
+    def main():
+        with hc.finish():
+            hc.async_(lambda: hit.append(1))
+            hc.async_(lambda: hit.append(2))
+        assert sorted(hit) == [1, 2]
+
+    hc.launch(main, nworkers=2)
+
+
+def test_launch_returns_value():
+    assert hc.launch(lambda: 42, nworkers=1) == 42
+
+
+def test_launch_propagates_exceptions():
+    def main():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        hc.launch(main, nworkers=2)
+
+
+def test_nested_finish():
+    order = []
+
+    def main():
+        with hc.finish():
+            def outer():
+                with hc.finish():
+                    hc.async_(lambda: order.append("inner"))
+                order.append("after-inner")
+
+            hc.async_(outer)
+        order.append("after-outer")
+
+    hc.launch(main, nworkers=2)
+    assert order == ["inner", "after-inner", "after-outer"]
+
+
+def test_many_asyncs_single_worker():
+    n = 2000
+    counter = []
+
+    def main():
+        with hc.finish():
+            for i in range(n):
+                hc.async_(counter.append, i)
+
+    hc.launch(main, nworkers=1)
+    assert len(counter) == n
+
+
+def test_many_asyncs_multi_worker():
+    n = 2000
+    lock = threading.Lock()
+    box = [0]
+
+    def bump():
+        with lock:
+            box[0] += 1
+
+    def main():
+        with hc.finish():
+            for _ in range(n):
+                hc.async_(bump)
+
+    hc.launch(main, nworkers=4)
+    assert box[0] == n
+
+
+def test_promise_put_get():
+    def main():
+        p = hc.Promise()
+        f = p.future
+        assert not f.satisfied()
+        p.put(99)
+        assert f.satisfied()
+        assert f.get() == 99
+        assert f.wait() == 99
+
+    hc.launch(main, nworkers=1)
+
+
+def test_promise_double_put_raises():
+    def main():
+        p = hc.Promise()
+        p.put(1)
+        with pytest.raises(hc.PromiseError):
+            p.put(2)
+
+    hc.launch(main, nworkers=1)
+
+
+def test_future_wait_blocks_until_put():
+    def main():
+        p = hc.Promise()
+        with hc.finish():
+            hc.async_(lambda: p.put("val"))
+            assert p.future.wait() == "val"
+
+    hc.launch(main, nworkers=2)
+
+
+def test_future_wait_single_worker():
+    """A blocked context must release its worker so the producer task runs
+    (the reference's fiber-swap; here, identity hand-off)."""
+
+    def main():
+        p = hc.Promise()
+        with hc.finish():
+            hc.async_(lambda: p.put(7))
+            assert p.future.wait() == 7
+
+    hc.launch(main, nworkers=1)
+
+
+def test_async_await_dependency_order():
+    log = []
+
+    def main():
+        a = hc.Promise()
+        b = hc.Promise()
+        with hc.finish():
+            hc.async_(lambda: log.append("dep-task"), await_=[a.future, b.future])
+            hc.async_(lambda: (log.append("put-a"), a.put(None)))
+            hc.async_(lambda: (log.append("put-b"), b.put(None)))
+        assert log[-1] == "dep-task"
+        assert set(log[:2]) == {"put-a", "put-b"}
+
+    hc.launch(main, nworkers=2)
+
+
+def test_async_await_many_deps():
+    """More than 4 dependencies (past the reference's inline cap)."""
+    n = 16
+
+    def main():
+        ps = [hc.Promise() for _ in range(n)]
+        done = []
+        with hc.finish():
+            hc.async_(lambda: done.append(True), await_=[p.future for p in ps])
+            for p in ps:
+                hc.async_(p.put, None)
+        assert done == [True]
+
+    hc.launch(main, nworkers=3)
+
+
+def test_async_future_returns_value():
+    def main():
+        f = hc.async_future(lambda: 10)
+        g = hc.async_future(lambda x: x.get() + 5, f, await_=[f])
+        assert g.wait() == 15
+
+    hc.launch(main, nworkers=2)
+
+
+def test_ddf_chain():
+    """Chain of 100 data-driven tasks."""
+
+    def main():
+        prev = hc.async_future(lambda: 0)
+        for _ in range(100):
+            prev = hc.async_future(lambda p=prev: p.get() + 1, await_=[prev])
+        assert prev.wait() == 100
+
+    hc.launch(main, nworkers=2)
+
+
+def test_end_finish_nonblocking():
+    def main():
+        hit = []
+        fin = hc.start_finish()
+        hc.async_(lambda: hit.append(1))
+        fut = hc.end_finish_nonblocking(fin)
+        fut.wait()
+        assert hit == [1]
+
+    hc.launch(main, nworkers=2)
+
+
+def test_yield_runs_other_task():
+    def main():
+        hit = []
+        with hc.finish():
+            hc.async_(lambda: hit.append(1))
+            hc.yield_()
+
+    hc.launch(main, nworkers=1)
+
+
+def test_future_wait_in_finish():
+    """Reference: test/cpp/future_wait_in_finish.cpp."""
+
+    def main():
+        p = hc.Promise()
+        out = []
+        with hc.finish():
+            def waiter():
+                out.append(p.future.wait())
+
+            hc.async_(waiter)
+            hc.async_(lambda: p.put(3))
+        assert out == [3]
+
+    hc.launch(main, nworkers=2)
+
+
+def test_async_at_locale():
+    def main():
+        rt = hc.current_runtime()
+        central = rt.graph.central_locale()
+        seen = []
+        with hc.finish():
+            hc.async_(lambda: seen.append(hc.current_worker()), at=central)
+        assert len(seen) == 1
+
+    hc.launch(main, nworkers=2)
+
+
+def test_current_worker_and_num_workers():
+    def main():
+        assert hc.num_workers() == 3
+        assert 0 <= hc.current_worker() < 3
+
+    hc.launch(main, nworkers=3)
+
+
+def test_remote_task_exception_propagates():
+    """An exception in a task executed by a pool worker (not inline in the
+    awaiting context) must surface at launch(), not vanish."""
+    import time
+
+    def main():
+        with hc.finish():
+            for _ in range(50):
+                hc.async_(lambda: None)
+            hc.async_(lambda: 1 / 0)
+            time.sleep(0.05)  # give another worker time to steal it
+
+    with pytest.raises(ZeroDivisionError):
+        hc.launch(main, nworkers=4)
+
+
+def test_recursive_spawn_tree():
+    """Binary task tree, depth 10 -> 2^10 leaves."""
+    lock = threading.Lock()
+    box = [0]
+
+    def node(d):
+        if d == 0:
+            with lock:
+                box[0] += 1
+            return
+        hc.async_(node, d - 1)
+        hc.async_(node, d - 1)
+
+    def main():
+        with hc.finish():
+            node(10)
+
+    hc.launch(main, nworkers=4)
+    assert box[0] == 1024
