@@ -1,0 +1,12 @@
+"""Benchmark workloads (the reference's acceptance suite, SURVEY.md section 4/6):
+
+- fib: finish/async recursion + DDF variant (reference: test/fib/fib.c)
+- uts: unbalanced tree search, canonical trees (reference: test/uts)
+- cholesky: tiled Cholesky with promise/future tile deps (reference: test/cholesky)
+- smithwaterman: 2D wavefront DP over per-tile promises (reference:
+  test/smithwaterman/smith_waterman.cpp:77-180)
+- arrayadd: flat forasync loops (reference: test/forasync/arrayadd)
+
+Each model runs on the host runtime (CPU baseline) and, where implemented, on
+the device megakernel (hclib_tpu.device).
+"""

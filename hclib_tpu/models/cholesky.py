@@ -1,0 +1,137 @@
+"""Tiled Cholesky factorization as a promise/future dataflow DAG.
+
+The reference version (test/cholesky/cholesky.cpp) expresses the classic
+right-looking tiled algorithm as data-driven tasks. This rebuild uses the
+same dependency structure with tiles updated in place:
+
+Let U[i,j,k] be the completion future of tile (i,j) after applying the rank-k
+update (U[i,j,-1] = initial tile ready):
+
+- potrf(k):   awaits U[k,k,k-1]                -> L[k,k]   (future P[k])
+- trsm(i,k):  awaits U[i,k,k-1], P[k]          -> L[i,k]   (future S[i,k])
+- syrk(i,k):  awaits U[i,i,k-1], S[i,k]        -> U[i,i,k]
+- gemm(i,j,k):awaits U[i,j,k-1], S[i,k], S[j,k]-> U[i,j,k]   (i > j > k)
+
+Every tile's update chain is serialized through U, so in-place numpy tile
+mutation is race-free. The device variant (device/workloads.py) runs the same
+DAG inside the Pallas megakernel with MXU tile kernels.
+
+Self-check: reconstructed L L^T must match the input (the reference diffs
+against a golden file, test/cholesky/run.sh:1-8).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+import numpy as np
+
+import hclib_tpu as hc
+
+__all__ = ["cholesky_tiled", "run", "make_spd"]
+
+
+def make_spd(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n))
+    return a @ a.T + n * np.eye(n)
+
+
+def cholesky_tiled(a: np.ndarray, tile: int) -> np.ndarray:
+    """Factor SPD ``a`` (n x n, n % tile == 0) into lower-triangular L using
+    the DDF task graph; returns L."""
+    n = a.shape[0]
+    if n % tile != 0:
+        raise ValueError("matrix size must be a multiple of the tile size")
+    nt = n // tile
+    # Tile views; tasks mutate tiles of `w` in place.
+    w = a.copy()
+
+    def T(i: int, j: int) -> np.ndarray:
+        return w[i * tile : (i + 1) * tile, j * tile : (j + 1) * tile]
+
+    def main() -> None:
+        # U[(i, j)] = future of the most recent update of tile (i, j);
+        # rebound as the DAG is built (build order follows k).
+        U: Dict[Tuple[int, int], hc.Future] = {}
+        P: Dict[int, hc.Future] = {}
+        S: Dict[Tuple[int, int], hc.Future] = {}
+
+        def deps(*futs) -> list:
+            return [f for f in futs if f is not None]
+
+        def potrf(k: int) -> None:
+            t = T(k, k)
+            np.copyto(t, np.linalg.cholesky(t))
+
+        def trsm(i: int, k: int) -> None:
+            # Solve X L[k,k]^T = A[i,k]  ->  X = A[i,k] L[k,k]^-T
+            lkk = T(k, k)
+            t = T(i, k)
+            np.copyto(t, np.linalg.solve(lkk, t.T).T)
+
+        def syrk(i: int, k: int) -> None:
+            lik = T(i, k)
+            t = T(i, i)
+            t -= lik @ lik.T
+
+        def gemm(i: int, j: int, k: int) -> None:
+            t = T(i, j)
+            t -= T(i, k) @ T(j, k).T
+
+        with hc.finish():
+            for k in range(nt):
+                P[k] = hc.async_future(
+                    potrf, k, await_=deps(U.get((k, k))), non_blocking=True
+                )
+                for i in range(k + 1, nt):
+                    S[(i, k)] = hc.async_future(
+                        trsm, i, k,
+                        await_=deps(U.get((i, k)), P[k]),
+                        non_blocking=True,
+                    )
+                for i in range(k + 1, nt):
+                    U[(i, i)] = hc.async_future(
+                        syrk, i, k,
+                        await_=deps(U.get((i, i)), S[(i, k)]),
+                        non_blocking=True,
+                    )
+                    for j in range(k + 1, i):
+                        U[(i, j)] = hc.async_future(
+                            gemm, i, j, k,
+                            await_=deps(U.get((i, j)), S[(i, k)], S[(j, k)]),
+                            non_blocking=True,
+                        )
+
+    hc.launch(main)
+    return np.tril(w)
+
+
+def run(n: int = 512, tile: int = 64, nworkers=None) -> dict:
+    a = make_spd(n)
+    t0 = time.perf_counter()
+    L = cholesky_tiled(a, tile)
+    dt = time.perf_counter() - t0
+    err = float(np.max(np.abs(L @ L.T - a)))
+    nt = n // tile
+    # nt potrf + nt(nt-1)/2 trsm + nt(nt-1)(nt+1)/6 syrk/gemm
+    ntasks = nt + nt * (nt - 1) // 2 + nt * (nt - 1) * (nt + 1) // 6
+    gflops = (n**3 / 3.0) / dt / 1e9
+    return {
+        "n": n,
+        "tile": tile,
+        "max_error": err,
+        "seconds": dt,
+        "gflops": gflops,
+        "tasks": ntasks,
+        "ok": err < 1e-6 * n,
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    tile = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    print(run(n, tile))

@@ -1,0 +1,93 @@
+"""Recursive Fibonacci - the canonical finish/async microbenchmark.
+
+Two variants, as in the reference (test/fib/fib.c and test/misc fib-ddt):
+- ``fib_finish``: nested finish + async pairs (blocking joins).
+- ``fib_ddf``: data-driven futures, no blocking anywhere.
+
+The metric is tasks/sec: fib(n) spawns ~2*F(n+1)-1 tasks
+(fib prints "Throughput (op/s)", reference test/fib/fib.c:29-33).
+"""
+
+from __future__ import annotations
+
+import time
+
+import hclib_tpu as hc
+
+__all__ = ["fib_finish", "fib_ddf", "run", "fib_seq", "task_count"]
+
+
+def fib_seq(n: int) -> int:
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def task_count(n: int) -> int:
+    """Number of recursive calls in naive fib(n): 2*F(n+1) - 1."""
+    return 2 * fib_seq(n + 1) - 1
+
+
+def fib_finish(n: int, cutoff: int = 2) -> int:
+    """fib via nested finish/async. ``cutoff`` switches to sequential
+    recursion below the threshold (the reference's PR1 config uses none)."""
+    if n < cutoff:
+        return fib_seq(n)
+    out = [0, 0]
+
+    def child(m: int, slot: int) -> None:
+        out[slot] = fib_finish(m, cutoff)
+
+    with hc.finish():
+        hc.async_(child, n - 1, 0)
+        hc.async_(child, n - 2, 1)
+    return out[0] + out[1]
+
+
+def fib_ddf(n: int, cutoff: int = 2) -> hc.Future:
+    """fib via futures: each node is a non-blocking task awaiting its two
+    children's futures."""
+    if n < cutoff:
+        return hc.async_future(fib_seq, n, non_blocking=True)
+    a = fib_ddf(n - 1, cutoff)
+    b = fib_ddf(n - 2, cutoff)
+    return hc.async_future(
+        lambda: a.get() + b.get(), await_=[a, b], non_blocking=True
+    )
+
+
+def run(n: int = 25, variant: str = "finish", nworkers=None, cutoff: int = 2) -> dict:
+    """Launch, compute fib(n), return {value, tasks, seconds, tasks_per_sec}."""
+    t0 = time.perf_counter()
+    if variant == "finish":
+        value = hc.launch(fib_finish, n, cutoff, nworkers=nworkers)
+    elif variant == "ddf":
+        value = hc.launch(lambda: fib_ddf(n, cutoff).wait(), nworkers=nworkers)
+    else:
+        raise ValueError(f"unknown fib variant {variant!r}")
+    dt = time.perf_counter() - t0
+    expected = fib_seq(n)
+    if value != expected:
+        raise AssertionError(f"fib({n}) = {value}, expected {expected}")
+    # Task count for the throughput metric: nodes(m) = 1 + nodes(m-1) +
+    # nodes(m-2) with nodes(m<cutoff) = 1, computed iteratively.
+    lo = max(cutoff, 2)
+    counts = [1] * lo
+    for m in range(lo, n + 1):
+        counts.append(1 + counts[m - 1] + counts[m - 2])
+    tasks = counts[n]
+    return {
+        "value": value,
+        "tasks": tasks,
+        "seconds": dt,
+        "tasks_per_sec": tasks / dt if dt > 0 else float("inf"),
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    variant = sys.argv[2] if len(sys.argv) > 2 else "finish"
+    print(run(n, variant))

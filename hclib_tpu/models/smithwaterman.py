@@ -1,0 +1,107 @@
+"""Smith-Waterman local alignment as a 2D wavefront of tile tasks.
+
+The reference (test/smithwaterman/smith_waterman.cpp:77-180) tiles the DP
+matrix and gives every tile a promise; tile (i,j) awaits its left, upper, and
+diagonal neighbors' promises, fills its block of the score matrix, then puts
+its own promise - a 2D data-driven wavefront. Same structure here; the score
+recurrence is the classic affine-free SW:
+
+    H[i,j] = max(0, H[i-1,j-1] + sub(a_i, b_j), H[i-1,j] - gap, H[i,j-1] - gap)
+
+Self-check: the task-parallel tiled result must equal the sequential DP.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+import numpy as np
+
+import hclib_tpu as hc
+
+__all__ = ["sw_seq", "sw_tiled", "run", "random_seq"]
+
+MATCH = 2
+MISMATCH = -1
+GAP = 1
+
+
+def random_seq(n: int, seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 4, size=n, dtype=np.int32)
+
+
+def sw_seq(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sequential reference DP; returns the full (len(a)+1, len(b)+1) H."""
+    h = np.zeros((len(a) + 1, len(b) + 1), dtype=np.int32)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            sub = MATCH if a[i - 1] == b[j - 1] else MISMATCH
+            h[i, j] = max(
+                0, h[i - 1, j - 1] + sub, h[i - 1, j] - GAP, h[i, j - 1] - GAP
+            )
+    return h
+
+
+def _fill_tile(h: np.ndarray, a: np.ndarray, b: np.ndarray,
+               i0: int, i1: int, j0: int, j1: int) -> None:
+    for i in range(i0, i1):
+        ai = a[i - 1]
+        for j in range(j0, j1):
+            sub = MATCH if ai == b[j - 1] else MISMATCH
+            v = h[i - 1, j - 1] + sub
+            u = h[i - 1, j] - GAP
+            l = h[i, j - 1] - GAP
+            m = v if v > u else u
+            if l > m:
+                m = l
+            h[i, j] = m if m > 0 else 0
+
+
+def sw_tiled(a: np.ndarray, b: np.ndarray, tile: int) -> np.ndarray:
+    """Task-parallel tiled SW over the wavefront DAG; returns H."""
+    n, m = len(a), len(b)
+    h = np.zeros((n + 1, m + 1), dtype=np.int32)
+    nt_i = (n + tile - 1) // tile
+    nt_j = (m + tile - 1) // tile
+
+    def main() -> None:
+        done: Dict[Tuple[int, int], hc.Future] = {}
+        with hc.finish():
+            for ti in range(nt_i):
+                for tj in range(nt_j):
+                    deps = [
+                        done[key]
+                        for key in ((ti - 1, tj), (ti, tj - 1), (ti - 1, tj - 1))
+                        if key in done
+                    ]
+                    i0, i1 = ti * tile + 1, min((ti + 1) * tile, n) + 1
+                    j0, j1 = tj * tile + 1, min((tj + 1) * tile, m) + 1
+                    done[(ti, tj)] = hc.async_future(
+                        _fill_tile, h, a, b, i0, i1, j0, j1,
+                        await_=deps, non_blocking=True,
+                    )
+
+    hc.launch(main)
+    return h
+
+
+def run(n: int = 512, m: int = 512, tile: int = 64) -> dict:
+    a, b = random_seq(n, 1), random_seq(m, 2)
+    t0 = time.perf_counter()
+    h = sw_tiled(a, b, tile)
+    dt = time.perf_counter() - t0
+    nt = ((n + tile - 1) // tile) * ((m + tile - 1) // tile)
+    return {
+        "n": n,
+        "m": m,
+        "tile": tile,
+        "score": int(h.max()),
+        "seconds": dt,
+        "tiles": nt,
+        "tasks_per_sec": nt / dt if dt > 0 else float("inf"),
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover
+    print(run())

@@ -1,0 +1,166 @@
+"""UTS - Unbalanced Tree Search.
+
+Re-implementation of the UTS benchmark tree specification (reference:
+test/uts/uts.c, test/uts/rng/brg_sha1.c) from its published algorithm:
+
+- Node state: 20-byte SHA-1 digest. Root: SHA1(16 zero bytes || BE32(seed))
+  (rng_init, test/uts/rng/brg_sha1.c:49-65). Child i of a node:
+  SHA1(parent_state || BE32(i)) (rng_spawn, :67-81).
+- rng_rand: last 4 state bytes, big-endian, masked positive
+  (:83-93); toProb = r / 2^31 (test/uts/uts.c:143-148).
+- GEO child count (test/uts/uts.c:171-221): target branching b_i from the
+  shape function - LINEAR: b0*(1 - d/gen_mx); EXPDEC: b0*d^(-ln b0/ln gen_mx);
+  CYCLIC; FIXED: b0 while d < gen_mx else 0 - then p = 1/(1+b_i) and
+  numChildren = floor(log(1-u)/log(1-p)), capped at 100 (uts.h:31).
+
+Canonical trees (test/uts/sample_trees.sh): T1 = GEO/FIXED d=10 b=4 r=19
+(4,130,071 nodes); T1L = GEO/FIXED d=13 b=4 r=29 (102,181,082 nodes).
+
+The parallel traversal spawns one task per node (work-stealing stress). The
+device path (device/) runs the same tree with an on-chip SHA-1 in the
+megakernel.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+import time
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import hclib_tpu as hc
+
+__all__ = ["UTSParams", "T1", "T1L", "T3", "count_seq", "count_parallel", "run"]
+
+MAX_CHILDREN = 100  # MAXNUMCHILDREN (reference: test/uts/uts.h:31)
+
+LINEAR, EXPDEC, CYCLIC, FIXED = 0, 1, 2, 3  # geoshape enum (uts.h:65)
+
+
+@dataclass(frozen=True)
+class UTSParams:
+    shape: int = FIXED  # -a
+    gen_mx: int = 10  # -d (tree depth)
+    b0: float = 4.0  # -b (branching factor)
+    root_seed: int = 19  # -r
+
+
+# Canonical trees (reference: test/uts/sample_trees.sh:18,37)
+T1 = UTSParams(shape=FIXED, gen_mx=10, b0=4.0, root_seed=19)  # 4,130,071 nodes
+T1L = UTSParams(shape=FIXED, gen_mx=13, b0=4.0, root_seed=29)  # 102,181,082 nodes
+T3 = UTSParams(shape=FIXED, gen_mx=5, b0=4.0, root_seed=42)  # small, for tests
+
+
+def root_state(seed: int) -> bytes:
+    return hashlib.sha1(b"\x00" * 16 + struct.pack(">i", seed)).digest()
+
+
+def spawn_state(parent: bytes, i: int) -> bytes:
+    return hashlib.sha1(parent + struct.pack(">i", i)).digest()
+
+
+def rng_rand(state: bytes) -> int:
+    return struct.unpack(">I", state[16:20])[0] & 0x7FFFFFFF
+
+
+def _branching(params: UTSParams, depth: int) -> float:
+    if depth <= 0:
+        return params.b0
+    if params.shape == LINEAR:
+        return params.b0 * (1.0 - depth / params.gen_mx)
+    if params.shape == EXPDEC:
+        return params.b0 * depth ** (-math.log(params.b0) / math.log(params.gen_mx))
+    if params.shape == CYCLIC:
+        if depth > 5 * params.gen_mx:
+            return 0.0
+        return params.b0 ** math.sin(2.0 * math.pi * depth / params.gen_mx)
+    if params.shape == FIXED:
+        return params.b0 if depth < params.gen_mx else 0.0
+    raise ValueError(f"unknown shape {params.shape}")
+
+
+def num_children(params: UTSParams, state: bytes, depth: int) -> int:
+    b_i = _branching(params, depth)
+    if b_i <= 0.0:
+        return 0
+    p = 1.0 / (1.0 + b_i)
+    u = rng_rand(state) / 2147483648.0
+    n = int(math.floor(math.log(1.0 - u) / math.log(1.0 - p)))
+    return min(n, MAX_CHILDREN)
+
+
+def count_seq(params: UTSParams) -> Tuple[int, int, int]:
+    """Sequential traversal; returns (nodes, leaves, max_depth)."""
+    nodes = leaves = max_depth = 0
+    stack = [(root_state(params.root_seed), 0)]
+    while stack:
+        state, depth = stack.pop()
+        nodes += 1
+        max_depth = max(max_depth, depth)
+        nc = num_children(params, state, depth)
+        if nc == 0:
+            leaves += 1
+        for i in range(nc):
+            stack.append((spawn_state(state, i), depth + 1))
+    return nodes, leaves, max_depth
+
+
+def count_parallel(params: UTSParams, nworkers=None, grain: int = 1) -> Tuple[int, int, int]:
+    """Task-parallel traversal. grain=1 spawns one async per node (the
+    reference's per-node tasking); grain>1 makes each task expand up to
+    ``grain`` nodes depth-first locally before spawning the rest of its
+    frontier as new tasks (amortizes task overhead, keeps stealable slack)."""
+
+    def main():
+        nodes = hc.SumReducer()
+        leaves = hc.SumReducer()
+        depth_r = hc.MaxReducer(0)
+
+        def visit(state: bytes, depth: int) -> None:
+            stack: List[Tuple[bytes, int]] = [(state, depth)]
+            processed = 0
+            while stack:
+                if processed >= grain:
+                    # Hand the remaining frontier to new tasks.
+                    for s, d in stack:
+                        hc.async_(visit, s, d)
+                    return
+                s, d = stack.pop()
+                processed += 1
+                nodes.add(1)
+                depth_r.put(d)
+                nc = num_children(params, s, d)
+                if nc == 0:
+                    leaves.add(1)
+                    continue
+                for i in range(nc):
+                    stack.append((spawn_state(s, i), d + 1))
+
+        with hc.finish():
+            hc.async_(visit, root_state(params.root_seed), 0)
+        return nodes.gather(), leaves.gather(), depth_r.gather()
+
+    return hc.launch(main, nworkers=nworkers)
+
+
+def run(params: UTSParams = T3, nworkers=None) -> dict:
+    t0 = time.perf_counter()
+    nodes, leaves, max_depth = count_parallel(params, nworkers=nworkers)
+    dt = time.perf_counter() - t0
+    return {
+        "nodes": nodes,
+        "leaves": leaves,
+        "max_depth": max_depth,
+        "seconds": dt,
+        "tasks_per_sec": nodes / dt if dt > 0 else float("inf"),
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    name = sys.argv[1] if len(sys.argv) > 1 else "T3"
+    params = {"T1": T1, "T1L": T1L, "T3": T3}[name]
+    print(run(params))
