@@ -1,0 +1,143 @@
+"""Task-descriptor ABI and host-side task-graph builder.
+
+A task is a fixed row of 16 int32 words - the device replacement for the
+reference's heap task struct + promise waiter lists (inc/hclib-task.h:32-44,
+inc/hclib-promise.h:76-90). Dependencies are inverted relative to the
+reference: instead of tasks registering on promises, each task carries a
+*dependency counter* and every task lists its *successors*; completing a task
+decrements each successor's counter and pushes those that reach zero onto the
+ready ring. (The reference's one-at-a-time registration walk exists to avoid
+locks on the waiter list; on-device, the scheduler loop is single-threaded
+per core, so plain counters are the natural design.)
+
+Word layout (all int32):
+
+    0  F_FN       kernel-table index (what to run)
+    1  F_DEP      remaining unsatisfied dependencies (runnable at 0)
+    2  F_SUCC0    inline successor task index, or NO_TASK
+    3  F_SUCC1    inline successor task index, or NO_TASK
+    4  F_CSR_OFF  offset into the successor-CSR array (extra successors)
+    5  F_CSR_N    number of CSR successors
+    6..11 F_A0+i  six argument words (meaning defined by the kernel)
+    12 F_OUT      output value slot (index into the int32 value buffer)
+    13..15        reserved
+
+Static DAGs (Cholesky, Smith-Waterman) are built host-side with
+``TaskGraphBuilder``; dynamic tasks (fib, UTS) are allocated on-device by
+kernels via ``KernelContext.spawn``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "DESC_WORDS",
+    "NO_TASK",
+    "F_FN",
+    "F_DEP",
+    "F_SUCC0",
+    "F_SUCC1",
+    "F_CSR_OFF",
+    "F_CSR_N",
+    "F_A0",
+    "F_OUT",
+    "TaskGraphBuilder",
+]
+
+DESC_WORDS = 16
+NO_TASK = -1
+
+F_FN = 0
+F_DEP = 1
+F_SUCC0 = 2
+F_SUCC1 = 3
+F_CSR_OFF = 4
+F_CSR_N = 5
+F_A0 = 6  # args occupy words 6..11
+F_OUT = 12
+NUM_ARGS = 6
+
+
+class TaskGraphBuilder:
+    """Builds the host-side arrays for a static task DAG.
+
+    ``add(fn, args, deps=[...])`` returns the new task's index; ``deps`` are
+    indices of tasks that must complete first (the builder fills dep counters
+    and successor lists - inline first, CSR overflow after).
+    """
+
+    def __init__(self) -> None:
+        self._rows: List[List[int]] = []
+        self._succs: List[List[int]] = []  # successor indices per task
+
+    def add(
+        self,
+        fn: int,
+        args: Sequence[int] = (),
+        deps: Sequence[int] = (),
+        out: int = 0,
+    ) -> int:
+        if len(args) > NUM_ARGS:
+            raise ValueError(f"at most {NUM_ARGS} args per task, got {len(args)}")
+        idx = len(self._rows)
+        row = [0] * DESC_WORDS
+        row[F_FN] = int(fn)
+        row[F_DEP] = len(deps)
+        row[F_SUCC0] = NO_TASK
+        row[F_SUCC1] = NO_TASK
+        for i, a in enumerate(args):
+            row[F_A0 + i] = int(a)
+        row[F_OUT] = int(out)
+        self._rows.append(row)
+        self._succs.append([])
+        for d in deps:
+            self._succs[d].append(idx)
+        return idx
+
+    @property
+    def num_tasks(self) -> int:
+        return len(self._rows)
+
+    def finalize(self, capacity: Optional[int] = None, succ_capacity: Optional[int] = None):
+        """Returns (tasks, succ_csr, ready, counts0) numpy arrays sized to
+        ``capacity`` tasks (extra rows are free slots for on-device spawns).
+
+        counts0 = [head, tail, alloc, pending, value_alloc, 0, 0, 0].
+        """
+        n = len(self._rows)
+        capacity = capacity or max(64, n)
+        if n > capacity:
+            raise ValueError(f"{n} tasks exceed capacity {capacity}")
+        tasks = np.zeros((capacity, DESC_WORDS), dtype=np.int32)
+        csr: List[int] = []
+        for idx, row in enumerate(self._rows):
+            succ = self._succs[idx]
+            r = list(row)
+            if len(succ) > 0:
+                r[F_SUCC0] = succ[0]
+            if len(succ) > 1:
+                r[F_SUCC1] = succ[1]
+            extra = succ[2:]
+            r[F_CSR_OFF] = len(csr)
+            r[F_CSR_N] = len(extra)
+            csr.extend(extra)
+            tasks[idx] = r
+        succ_capacity = succ_capacity or max(64, len(csr))
+        if len(csr) > succ_capacity:
+            raise ValueError("successor CSR overflow")
+        succ_arr = np.full(succ_capacity, NO_TASK, dtype=np.int32)
+        if csr:
+            succ_arr[: len(csr)] = csr
+        # Ready ring: initially-runnable tasks in index order.
+        ready0 = [i for i, row in enumerate(self._rows) if row[F_DEP] == 0]
+        ring = np.full(capacity, NO_TASK, dtype=np.int32)
+        ring[: len(ready0)] = ready0
+        counts = np.zeros(8, dtype=np.int32)
+        counts[0] = 0  # head
+        counts[1] = len(ready0)  # tail
+        counts[2] = n  # alloc cursor (next free descriptor row)
+        counts[3] = n  # pending (tasks not yet executed)
+        return tasks, succ_arr, ring, counts

@@ -1,0 +1,402 @@
+"""The persistent Pallas megakernel: a resident scheduler loop on a TPU core.
+
+This is the TPU-first re-design of the reference's worker loop
+(core_work_loop/find_and_run_task, src/hclib-runtime.c:646-724):
+
+- worker pthread        -> one long-running ``pallas_call`` on the core
+- Chase-Lev deque       -> SMEM ready ring (head/tail counters in SMEM)
+- function-pointer call -> ``lax.switch`` over a static kernel table
+  (TPU has no function pointers; tasks name kernels by table index)
+- promise waiter walk   -> successor dep-counter decrement + ready push
+- fiber swap            -> none: tasks are descriptors, not stacks; blocking
+  is expressed as dependency edges, so "waiting" tasks simply aren't ready
+- pthread join/done flag-> loop exits when the pending counter reaches zero
+
+Control state (task table, ready ring, counters, scalar values) lives in
+SMEM, where the scalar unit can do random access; bulk tensor data stays in
+HBM/VMEM and is touched by tile kernels via DMA + MXU/VPU ops. Kernels may
+spawn new tasks dynamically (fib/UTS-style recursion) through
+``KernelContext.spawn``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .descriptor import (
+    DESC_WORDS,
+    F_A0,
+    F_CSR_N,
+    F_CSR_OFF,
+    F_DEP,
+    F_FN,
+    F_OUT,
+    F_SUCC0,
+    F_SUCC1,
+    NO_TASK,
+    TaskGraphBuilder,
+)
+
+__all__ = ["KernelContext", "Megakernel"]
+
+# counts[] slots
+C_HEAD = 0
+C_TAIL = 1
+C_ALLOC = 2
+C_PENDING = 3
+C_VALLOC = 4
+C_EXECUTED = 5
+C_OVERFLOW = 6
+
+
+class KernelContext:
+    """Facilities exposed to device task kernels (the device analogue of the
+    worker-state + spawn API the reference hands to tasks)."""
+
+    def __init__(self, idx, tasks, succ, ready, counts, ivalues, data, scratch, capacity):
+        self.idx = idx  # this task's descriptor index
+        self._tasks = tasks
+        self._succ = succ
+        self._ready = ready
+        self._counts = counts
+        self.ivalues = ivalues
+        self.data = data  # name -> ref (HBM/VMEM tensor buffers)
+        self.scratch = scratch  # name -> scratch ref (VMEM buffers, DMA sems)
+        self._capacity = capacity
+
+    # -- descriptor access --
+
+    def arg(self, i: int):
+        return self._tasks[self.idx, F_A0 + i]
+
+    @property
+    def out_slot(self):
+        return self._tasks[self.idx, F_OUT]
+
+    def value(self, slot):
+        return self.ivalues[slot]
+
+    def set_value(self, slot, v) -> None:
+        self.ivalues[slot] = v
+
+    def set_out(self, v) -> None:
+        self.ivalues[self.out_slot] = v
+
+    # -- dynamic task creation --
+
+    def alloc_values(self, k: int):
+        """Reserve k consecutive scalar value slots; returns the base slot."""
+        base = self._counts[C_VALLOC]
+        self._counts[C_VALLOC] = base + k
+        return base
+
+    def push_ready(self, t) -> None:
+        tail = self._counts[C_TAIL]
+        self._ready[tail % self._capacity] = t
+        self._counts[C_TAIL] = tail + 1
+
+    def take_continuation(self, new_idx) -> None:
+        """Transfer this task's successors to ``new_idx`` - the descriptor
+        equivalent of the reference turning a blocked stack into a
+        continuation task (_help_finish_ctx, src/hclib-runtime.c:1032-1065):
+        the spawned task becomes the continuation that fires our successors."""
+        t = self._tasks
+        t[new_idx, F_SUCC0] = t[self.idx, F_SUCC0]
+        t[new_idx, F_SUCC1] = t[self.idx, F_SUCC1]
+        t[new_idx, F_CSR_OFF] = t[self.idx, F_CSR_OFF]
+        t[new_idx, F_CSR_N] = t[self.idx, F_CSR_N]
+        t[self.idx, F_SUCC0] = jnp.int32(NO_TASK)
+        t[self.idx, F_SUCC1] = jnp.int32(NO_TASK)
+        t[self.idx, F_CSR_N] = 0
+
+    def spawn(
+        self,
+        fn: int,
+        args: Sequence = (),
+        dep_count=0,
+        succ0=NO_TASK,
+        succ1=NO_TASK,
+        out=0,
+    ):
+        """Allocate + enqueue a new task descriptor; returns its index.
+
+        On table overflow the task is dropped and counts[C_OVERFLOW] is set
+        (the reference asserts on deque overflow, src/hclib-runtime.c:520-524;
+        here the host checks the flag after the kernel returns).
+        """
+        a = self._counts[C_ALLOC]
+        ok = a < self._capacity
+        a_clamped = jnp.where(ok, a, self._capacity - 1)
+
+        @pl.when(ok)
+        def _():
+            self._counts[C_ALLOC] = a + 1
+            self._counts[C_PENDING] = self._counts[C_PENDING] + 1
+            self._tasks[a_clamped, F_FN] = jnp.int32(fn)
+            self._tasks[a_clamped, F_DEP] = jnp.int32(dep_count)
+            self._tasks[a_clamped, F_SUCC0] = jnp.int32(succ0)
+            self._tasks[a_clamped, F_SUCC1] = jnp.int32(succ1)
+            self._tasks[a_clamped, F_CSR_OFF] = 0
+            self._tasks[a_clamped, F_CSR_N] = 0
+            for i in range(6):
+                self._tasks[a_clamped, F_A0 + i] = (
+                    jnp.int32(args[i]) if i < len(args) else 0
+                )
+            self._tasks[a_clamped, F_OUT] = jnp.int32(out)
+
+        @pl.when(ok & (jnp.int32(dep_count) == 0))
+        def _():
+            self.push_ready(a_clamped)
+
+        @pl.when(jnp.logical_not(ok))
+        def _():
+            self._counts[C_OVERFLOW] = 1
+
+        return a_clamped
+
+
+class Megakernel:
+    """Builds and runs the single-core scheduler kernel over a task DAG.
+
+    ``kernels`` is an ordered list of ``(name, fn)`` where ``fn(ctx)`` emits
+    the device code for that kernel-table entry; a task's F_FN word indexes
+    this table. ``data_specs`` declares named tensor buffers (passed to
+    ``run`` and updated in place); ``scratch_specs`` declares named VMEM /
+    semaphore scratch allocations available to kernels via ``ctx.scratch``.
+    """
+
+    def __init__(
+        self,
+        kernels: Sequence[Tuple[str, Callable[[KernelContext], None]]],
+        data_specs: Optional[Dict[str, jax.ShapeDtypeStruct]] = None,
+        scratch_specs: Optional[Dict[str, Any]] = None,
+        capacity: int = 4096,
+        num_values: int = 4096,
+        succ_capacity: int = 4096,
+        interpret: Optional[bool] = None,
+    ) -> None:
+        self.kernel_names = [name for name, _ in kernels]
+        self.kernel_fns = [fn for _, fn in kernels]
+        self.fn_id = {name: i for i, name in enumerate(self.kernel_names)}
+        self.data_specs = dict(data_specs or {})
+        self.scratch_specs = dict(scratch_specs or {})
+        self.capacity = capacity
+        self.num_values = num_values
+        self.succ_capacity = succ_capacity
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        self.interpret = interpret
+        self._jitted = None
+        # Packs counts + ivalues into one array so the host needs a single
+        # device->host fetch (transfers are ~67ms each through the axon
+        # tunnel; on a directly-attached TPU VM this matters far less).
+        self._packer = jax.jit(lambda c, v: jnp.concatenate([c, v]))
+
+    # -- the kernel body --
+
+    def _kernel(self, fuel: int, *refs) -> None:
+        ndata = len(self.data_specs)
+        nscratch = len(self.scratch_specs)
+        n_in = 5 + ndata
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + 4 + ndata]
+        scratch_refs = refs[n_in + 4 + ndata :]
+        succ = in_refs[1]
+        tasks, ready, counts, ivalues = out_refs[:4]
+        data = dict(zip(self.data_specs.keys(), out_refs[4:]))
+        scratch = dict(zip(self.scratch_specs.keys(), scratch_refs))
+
+        capacity = self.capacity
+
+        # On TPU, SMEM output windows do NOT start with the aliased input's
+        # contents (unlike interpret mode) - stage the initial scheduler
+        # state into the mutable output windows explicitly.
+        tasks_in, _, ready_in, counts_in, ivalues_in = in_refs[:5]
+
+        def copy_in(i, _):
+            ready[i] = ready_in[i]
+            for w in range(DESC_WORDS):
+                tasks[i, w] = tasks_in[i, w]
+            return 0
+
+        jax.lax.fori_loop(0, capacity, copy_in, 0)
+
+        def copy_vals(i, _):
+            ivalues[i] = ivalues_in[i]
+            return 0
+
+        jax.lax.fori_loop(0, self.num_values, copy_vals, 0)
+        for i in range(8):
+            counts[i] = counts_in[i]
+
+        def push_ready(t) -> None:
+            tail = counts[C_TAIL]
+            ready[tail % capacity] = t
+            counts[C_TAIL] = tail + 1
+
+        def complete(idx) -> None:
+            """Decrement successors' dep counters; push newly-ready tasks
+            (device analogue of hclib_promise_put waking the waiter list,
+            src/hclib-promise.c:203-245)."""
+
+            def dec(s) -> None:
+                @pl.when(s != NO_TASK)
+                def _():
+                    d = tasks[s, F_DEP] - 1
+                    tasks[s, F_DEP] = d
+
+                    @pl.when(d == 0)
+                    def _():
+                        push_ready(s)
+
+            dec(tasks[idx, F_SUCC0])
+            dec(tasks[idx, F_SUCC1])
+            n = tasks[idx, F_CSR_N]
+            off = tasks[idx, F_CSR_OFF]
+
+            def body(i, _):
+                dec(succ[off + i])
+                return 0
+
+            jax.lax.fori_loop(0, n, body, 0)
+            counts[C_PENDING] = counts[C_PENDING] - 1
+            counts[C_EXECUTED] = counts[C_EXECUTED] + 1
+
+        def step(idx) -> None:
+            ctx = KernelContext(
+                idx, tasks, succ, ready, counts, ivalues, data, scratch, capacity
+            )
+            branches = [functools.partial(fn, ctx) for fn in self.kernel_fns]
+            jax.lax.switch(tasks[idx, F_FN], branches)
+            complete(idx)
+
+        def cond(carry):
+            pending, executed, stuck = carry
+            return (pending > 0) & (executed < fuel) & jnp.logical_not(stuck)
+
+        def body(carry):
+            head = counts[C_HEAD]
+            tail = counts[C_TAIL]
+            has_work = head < tail
+
+            @pl.when(has_work)
+            def _():
+                idx = ready[head % capacity]
+                counts[C_HEAD] = head + 1
+                step(idx)
+
+            # pending > 0 with an empty ring means a dependency cycle or a
+            # lost wakeup - a bug; bail out so the host can inspect state.
+            return (counts[C_PENDING], counts[C_EXECUTED], jnp.logical_not(has_work))
+
+        jax.lax.while_loop(
+            cond, body, (counts[C_PENDING], counts[C_EXECUTED], jnp.bool_(False))
+        )
+
+    # -- host entry --
+
+    def _build(self, fuel: int):
+        ndata = len(self.data_specs)
+        smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+        anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
+        in_specs = [smem(), smem(), smem(), smem(), smem()] + [
+            anyspace() for _ in range(ndata)
+        ]
+        out_specs = tuple([smem(), smem(), smem(), smem()] + [anyspace() for _ in range(ndata)])
+        data_shapes = [
+            jax.ShapeDtypeStruct(s.shape, s.dtype) for s in self.data_specs.values()
+        ]
+        out_shape = tuple(
+            [
+                jax.ShapeDtypeStruct((self.capacity, DESC_WORDS), jnp.int32),
+                jax.ShapeDtypeStruct((self.capacity,), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),
+                jax.ShapeDtypeStruct((self.num_values,), jnp.int32),
+            ]
+            + data_shapes
+        )
+        # inputs: tasks(0) succ(1) ready(2) counts(3) ivalues(4) data(5..)
+        # outputs: tasks(0) ready(1) counts(2) ivalues(3) data(4..)
+        aliases = {0: 0, 2: 1, 3: 2, 4: 3}
+        for i in range(ndata):
+            aliases[5 + i] = 4 + i
+        call = pl.pallas_call(
+            functools.partial(self._kernel, fuel),
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=list(self.scratch_specs.values()),
+            input_output_aliases=aliases,
+            interpret=self.interpret,
+        )
+        return jax.jit(call)
+
+    def run(
+        self,
+        builder: TaskGraphBuilder,
+        data: Optional[Dict[str, Any]] = None,
+        ivalues: Optional[np.ndarray] = None,
+        fuel: int = 1 << 22,
+    ):
+        """Execute the task graph to completion; returns
+        (ivalues, data_dict, info_dict)."""
+        tasks, succ, ring, counts = builder.finalize(
+            capacity=self.capacity, succ_capacity=self.succ_capacity
+        )
+        if ivalues is None:
+            ivalues = np.zeros(self.num_values, dtype=np.int32)
+        data = dict(data or {})
+        if set(data.keys()) != set(self.data_specs.keys()):
+            raise ValueError(
+                f"data buffers {sorted(data)} != declared {sorted(self.data_specs)}"
+            )
+        if self._jitted is None:
+            self._jitted = self._build(fuel)
+        import contextlib
+
+        # Interpret mode runs as plain JAX ops; pin them to the host CPU
+        # backend so tests stay local (the axon TPU platform ignores
+        # JAX_PLATFORMS, so this must be an explicit device choice).
+        cm = (
+            jax.default_device(jax.devices("cpu")[0])
+            if self.interpret
+            else contextlib.nullcontext()
+        )
+        with cm:
+            outs = self._jitted(
+                jnp.asarray(tasks),
+                jnp.asarray(succ),
+                jnp.asarray(ring),
+                jnp.asarray(counts),
+                jnp.asarray(ivalues),
+                *[jnp.asarray(data[k]) for k in self.data_specs.keys()],
+            )
+        tasks_out, ready_out, counts_out, ivalues_out = outs[:4]
+        data_out = dict(zip(self.data_specs.keys(), outs[4:]))
+        packed = np.asarray(self._packer(counts_out, ivalues_out))
+        counts_np, ivalues_np = packed[:8], packed[8:]
+        info = {
+            "executed": int(counts_np[C_EXECUTED]),
+            "pending": int(counts_np[C_PENDING]),
+            "allocated": int(counts_np[C_ALLOC]),
+            "overflow": bool(counts_np[C_OVERFLOW]),
+        }
+        if info["overflow"]:
+            raise RuntimeError(
+                f"megakernel task-table overflow (capacity={self.capacity}); "
+                "raise capacity or coarsen tasks"
+            )
+        if info["pending"] != 0:
+            raise RuntimeError(
+                f"megakernel stalled with {info['pending']} pending tasks "
+                f"after {info['executed']} executed (dependency cycle or fuel "
+                f"{fuel} exhausted)"
+            )
+        return ivalues_np, data_out, info

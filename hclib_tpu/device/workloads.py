@@ -1,0 +1,121 @@
+"""Device task kernels for the benchmark workloads (scalar + tile kernels).
+
+These run inside the megakernel's ``lax.switch`` table. fib demonstrates
+dynamic on-device spawning with continuation passing; arrayadd demonstrates
+tile tasks that DMA HBM data through VMEM and use the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .descriptor import NO_TASK, TaskGraphBuilder
+from .megakernel import KernelContext, Megakernel
+
+__all__ = ["device_fib", "device_arrayadd", "make_fib_megakernel"]
+
+
+# ------------------------------------------------------------------- fib
+
+FIB = 0
+SUM = 1
+
+
+def _fib_kernel(ctx: KernelContext) -> None:
+    n = ctx.arg(0)
+
+    @pl.when(n < 2)
+    def _():
+        ctx.set_out(n)
+
+    @pl.when(n >= 2)
+    def _():
+        base = ctx.alloc_values(2)
+        # The SUM task is this task's continuation: it inherits our
+        # successors and produces our output slot.
+        sum_idx = ctx.spawn(
+            SUM, args=[base, base + 1], dep_count=2, out=ctx.out_slot
+        )
+        ctx.take_continuation(sum_idx)
+        ctx.spawn(FIB, [n - 1], succ0=sum_idx, out=base)
+        ctx.spawn(FIB, [n - 2], succ0=sum_idx, out=base + 1)
+
+
+def _sum_kernel(ctx: KernelContext) -> None:
+    ctx.set_out(ctx.value(ctx.arg(0)) + ctx.value(ctx.arg(1)))
+
+
+def make_fib_megakernel(capacity: int = 8192, interpret: Optional[bool] = None) -> Megakernel:
+    return Megakernel(
+        kernels=[("fib", _fib_kernel), ("sum", _sum_kernel)],
+        capacity=capacity,
+        num_values=capacity,
+        succ_capacity=64,
+        interpret=interpret,
+    )
+
+
+def device_fib(n: int, capacity: int = 8192, interpret: Optional[bool] = None) -> Tuple[int, dict]:
+    """Compute fib(n) entirely on-device via dynamic task spawning."""
+    mk = make_fib_megakernel(capacity, interpret)
+    b = TaskGraphBuilder()
+    b.add(FIB, args=[n], out=0)
+    ivalues, _, info = mk.run(b)
+    return int(ivalues[0]), info
+
+
+# --------------------------------------------------------------- arrayadd
+
+ADD_TILE = 0
+_TILE = (8, 128)  # f32 min tile
+
+
+def _addtile_kernel(ctx: KernelContext) -> None:
+    t = ctx.arg(0)
+    a, b_, c = ctx.data["a"], ctx.data["b"], ctx.data["c"]
+    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    sems = ctx.scratch["sems"]
+    in_a = pltpu.make_async_copy(a.at[t], va, sems.at[0])
+    in_b = pltpu.make_async_copy(b_.at[t], vb, sems.at[1])
+    in_a.start()
+    in_b.start()
+    in_a.wait()
+    in_b.wait()
+    va[:] = va[:] + vb[:]
+    out = pltpu.make_async_copy(va, c.at[t], sems.at[2])
+    out.start()
+    out.wait()
+
+
+def device_arrayadd(ntiles: int = 16, interpret: Optional[bool] = None):
+    """c = a + b over (ntiles, 8, 128) f32 blocks, one tile task per block."""
+    shape = (ntiles,) + _TILE
+    spec = jax.ShapeDtypeStruct(shape, jnp.float32)
+    mk = Megakernel(
+        kernels=[("add_tile", _addtile_kernel)],
+        data_specs={"a": spec, "b": spec, "c": spec},
+        scratch_specs={
+            "va": pltpu.VMEM(_TILE, jnp.float32),
+            "vb": pltpu.VMEM(_TILE, jnp.float32),
+            "sems": pltpu.SemaphoreType.DMA((3,)),
+        },
+        capacity=max(64, ntiles),
+        num_values=8,
+        succ_capacity=8,
+        interpret=interpret,
+    )
+    b = TaskGraphBuilder()
+    for t in range(ntiles):
+        b.add(ADD_TILE, args=[t])
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(shape).astype(np.float32)
+    bb = rng.standard_normal(shape).astype(np.float32)
+    c = np.zeros(shape, dtype=np.float32)
+    _, data, info = mk.run(b, data={"a": a, "b": bb, "c": c})
+    return a, bb, np.asarray(data["c"]), info

@@ -38,7 +38,7 @@ def make_spd(n: int, seed: int = 0) -> np.ndarray:
     return a @ a.T + n * np.eye(n)
 
 
-def cholesky_tiled(a: np.ndarray, tile: int) -> np.ndarray:
+def cholesky_tiled(a: np.ndarray, tile: int, nworkers=None) -> np.ndarray:
     """Factor SPD ``a`` (n x n, n % tile == 0) into lower-triangular L using
     the DDF task graph; returns L."""
     n = a.shape[0]
@@ -104,14 +104,14 @@ def cholesky_tiled(a: np.ndarray, tile: int) -> np.ndarray:
                             non_blocking=True,
                         )
 
-    hc.launch(main)
+    hc.launch(main, nworkers=nworkers)
     return np.tril(w)
 
 
 def run(n: int = 512, tile: int = 64, nworkers=None) -> dict:
     a = make_spd(n)
     t0 = time.perf_counter()
-    L = cholesky_tiled(a, tile)
+    L = cholesky_tiled(a, tile, nworkers=nworkers)
     dt = time.perf_counter() - t0
     err = float(np.max(np.abs(L @ L.T - a)))
     nt = n // tile

@@ -141,23 +141,22 @@ def forasync(
     """
     if not 1 <= len(bounds) <= 3:
         raise ValueError("forasync supports 1-3 dimensions")
+    if mode not in (FLAT, RECURSIVE):
+        raise ValueError(f"unknown forasync mode {mode!r}")
     rt = current_runtime()
     dims, tile_dims = _normalize(bounds, tile, rt.nworkers)
-    if blocking:
-        with finish():
-            if mode == FLAT:
-                _spawn_flat(fn, dims, tile_dims, dist_func)
-            elif mode == RECURSIVE:
-                _spawn_recursive(fn, dims, tile_dims)
-            else:
-                raise ValueError(f"unknown forasync mode {mode!r}")
-    else:
+
+    def spawn_all() -> None:
         if mode == FLAT:
             _spawn_flat(fn, dims, tile_dims, dist_func)
-        elif mode == RECURSIVE:
-            _spawn_recursive(fn, dims, tile_dims)
         else:
-            raise ValueError(f"unknown forasync mode {mode!r}")
+            _spawn_recursive(fn, dims, tile_dims)
+
+    if blocking:
+        with finish():
+            spawn_all()
+    else:
+        spawn_all()
 
 
 def forasync_future(
@@ -169,13 +168,13 @@ def forasync_future(
 ) -> Future:
     """Non-blocking forasync; returns a future satisfied when every tile has
     completed (hclib_forasync_future: src/hclib.c:466-473)."""
+    if mode not in (FLAT, RECURSIVE):
+        raise ValueError(f"unknown forasync mode {mode!r}")
     rt = current_runtime()
     dims, tile_dims = _normalize(bounds, tile, rt.nworkers)
     fin = start_finish()
     if mode == FLAT:
         _spawn_flat(fn, dims, tile_dims, dist_func)
-    elif mode == RECURSIVE:
-        _spawn_recursive(fn, dims, tile_dims)
     else:
-        raise ValueError(f"unknown forasync mode {mode!r}")
+        _spawn_recursive(fn, dims, tile_dims)
     return end_finish_nonblocking(fin)

@@ -50,13 +50,15 @@ class Locale:
 
 
 def _locale_type(name: str) -> str:
-    """Type is the leading alpha prefix of the name: L2_0_3 -> L2, GPU0 -> GPU."""
-    m = re.match(r"[A-Za-z]+[0-9]*?(?=_|\d|$)", name)
-    if not m:
-        return name
-    # Strip trailing digits only when followed by nothing (GPU0 -> GPU).
-    t = m.group(0)
-    return t.rstrip("0123456789") or t
+    """Type is the segment before the first underscore (L2_0_3 -> L2,
+    L1_0 -> L1); names without one drop a trailing ordinal (GPU0 -> GPU).
+    Mirrors the reference's prefix-matching of declared labels against
+    registered type names (src/hclib-locality-graph.c:322-331)."""
+    head = name.split("_", 1)[0]
+    if "_" in name:
+        return head
+    stripped = head.rstrip("0123456789")
+    return stripped or head
 
 
 class LocalityGraph:
@@ -123,7 +125,7 @@ def generate_default_graph(nworkers: int) -> LocalityGraph:
     sysmem = Locale(0, "sysmem", "sysmem")
     locales = [sysmem]
     for w in range(nworkers):
-        l1 = Locale(1 + w, f"L1{w}", "L1")
+        l1 = Locale(1 + w, f"L1_{w}", "L1")
         l1.reachable.append(0)
         sysmem.reachable.append(l1.id)
         locales.append(l1)

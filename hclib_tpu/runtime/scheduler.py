@@ -31,8 +31,6 @@ from __future__ import annotations
 
 import os
 import threading
-import time
-from collections import deque as _pydeque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .deque import WSDeque
@@ -56,7 +54,7 @@ __all__ = [
     "num_workers",
 ]
 
-_THREAD_STACK = 1 << 19  # 512 KB, cf. LITECTX_SIZE 256 KB (src/inc/litectx.h:25)
+_THREAD_STACK = 1 << 21  # 2 MB: room for deep inline help recursion
 _MAX_THREADS = 4096
 
 
@@ -355,11 +353,30 @@ class Runtime:
     def _spawn_thread(self) -> None:
         with self._nthreads_lock:
             if self._nthreads >= _MAX_THREADS:
-                return
+                # A parked context released its identity expecting a spare to
+                # pick it up; failing silently here would deadlock the
+                # program, so fail loudly instead.
+                raise RuntimeError(
+                    f"worker thread cap ({_MAX_THREADS}) reached: too many "
+                    "simultaneously blocked contexts; restructure with "
+                    "data-driven tasks (async_future/await_) or raise the cap"
+                )
             self._nthreads += 1
-        t = threading.Thread(target=self._thread_main, daemon=True, name="hclib-worker")
+        # Bounded stacks keep thousands of blocked contexts affordable
+        # (cf. the reference's 256 KB fiber stacks, src/inc/litectx.h:25).
+        try:
+            prev = threading.stack_size(_THREAD_STACK)
+        except (ValueError, RuntimeError):
+            prev = None
+        try:
+            t = threading.Thread(
+                target=self._thread_main, daemon=True, name="hclib-worker"
+            )
+            t.start()
+        finally:
+            if prev is not None:
+                threading.stack_size(prev)
         self._threads.append(t)
-        t.start()
 
     # ------------------------------------------------------------- blocking
 
@@ -384,6 +401,15 @@ class Runtime:
         armed.wait()
         _tls.identity = self._idmgr.acquire(priority=True)
 
+    def _execute_recording(self, task: Task) -> None:
+        """Execute a task, converting its exception into a recorded error
+        (re-raised at launch exit) - the same policy pool workers follow, so
+        task failures behave identically whether run inline or stolen."""
+        try:
+            self._execute(task)
+        except BaseException as e:
+            self._record_error(e)
+
     def help_finish(self, fin: Finish) -> None:
         """Help-first drain of a finish scope (help_finish:
         src/hclib-runtime.c:1067-1119)."""
@@ -395,7 +421,7 @@ class Runtime:
                 wid = _tls.identity
                 continue
             if self._inline_safe(task, fin):
-                self._execute(task)
+                self._execute_recording(task)
             else:
                 # The reference swaps to a fresh fiber seeded with this task;
                 # we re-enqueue it and park - another thread runs it.
@@ -413,7 +439,7 @@ class Runtime:
                 wid = _tls.identity
                 continue
             if self._inline_safe(task, None):
-                self._execute(task)
+                self._execute_recording(task)
             else:
                 self._requeue_and_park(
                     task, lambda ev, p=promise: ev if p._register_ctx(ev) else None
@@ -424,6 +450,22 @@ class Runtime:
         self._enqueue(task)
         self._park(register)
 
+    def _find_task_at(self, wid: int, locale: Locale) -> Optional[Task]:
+        """Pop/steal only at one locale (yield_at semantics: a comm worker
+        polling the NIC locale must not pick up arbitrary compute tasks)."""
+        t = self.deques[(locale.id, wid)].pop()
+        if t is None:
+            for v in range(self.nworkers):
+                if v == wid:
+                    continue
+                t = self.deques[(locale.id, v)].steal()
+                if t is not None:
+                    break
+        if t is not None:
+            with self._work_cv:
+                self._pending -= 1
+        return t
+
     def yield_(self, locale: Optional[Locale] = None) -> bool:
         """Run at most one other task inline (hclib_yield:
         src/hclib-runtime.c:1142-1217). Returns True if a task ran."""
@@ -431,11 +473,11 @@ class Runtime:
         if wid is None:
             return False
         self.worker_stats[wid].yields += 1
-        task = self._find_task(wid)
+        task = self._find_task_at(wid, locale) if locale is not None else self._find_task(wid)
         if task is None:
             return False
         if self._inline_safe(task, _tls.current_finish):
-            self._execute(task)
+            self._execute_recording(task)
             return True
         self._enqueue(task)  # put it back; a blocking task can't run on this stack
         return False
@@ -591,8 +633,12 @@ def end_finish(fin: Optional[Finish] = None) -> None:
         fin = cur
     if fin is None:
         raise RuntimeError("end_finish with no open finish scope")
-    current_runtime().help_finish(fin)
-    _tls.current_finish = fin.parent
+    try:
+        current_runtime().help_finish(fin)
+    finally:
+        # Pop the scope even if draining failed, so later spawns don't check
+        # into a dead finish.
+        _tls.current_finish = fin.parent
 
 
 def end_finish_nonblocking(fin: Optional[Finish] = None) -> Future:
@@ -623,14 +669,10 @@ class finish:
         return self._fin
 
     def __exit__(self, exc_type, exc, tb) -> bool:
-        if exc_type is None:
-            end_finish(self._fin)
-        else:
-            # Drain children even on error so state stays consistent.
-            try:
-                end_finish(self._fin)
-            except Exception:
-                pass
+        # Drain children even when the body raised, so the scope's tasks are
+        # not left running; task failures during the drain are recorded by
+        # the runtime and re-raised at launch exit, never swallowed.
+        end_finish(self._fin)
         return False
 
 

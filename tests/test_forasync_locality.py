@@ -127,7 +127,7 @@ def test_default_graph_shape():
     assert g.central_locale().type == "sysmem"
     assert len(g.locales_of_type("L1")) == 4
     for w in range(4):
-        assert g.closest_locale(w).name == f"L1{w}"
+        assert g.closest_locale(w).name == f"L1_{w}"
 
 
 def test_reference_schema_load():
