@@ -140,4 +140,7 @@ class TaskGraphBuilder:
         counts[1] = len(ready0)  # tail
         counts[2] = n  # alloc cursor (next free descriptor row)
         counts[3] = n  # pending (tasks not yet executed)
+        # Start on-device value allocation past every host-assigned out slot
+        # so alloc_values never aliases a host task's output.
+        counts[4] = 1 + max((row[F_OUT] for row in self._rows), default=-1)
         return tasks, succ_arr, ring, counts

@@ -193,7 +193,7 @@ class Megakernel:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
         self.interpret = interpret
-        self._jitted = None
+        self._jitted: Dict[int, Any] = {}  # fuel -> compiled call
         # Packs counts + ivalues into one array so the host needs a single
         # device->host fetch (transfers are ~67ms each through the axon
         # tunnel; on a directly-attached TPU VM this matters far less).
@@ -357,8 +357,9 @@ class Megakernel:
             raise ValueError(
                 f"data buffers {sorted(data)} != declared {sorted(self.data_specs)}"
             )
-        if self._jitted is None:
-            self._jitted = self._build(fuel)
+        if fuel not in self._jitted:
+            self._jitted[fuel] = self._build(fuel)
+        jitted = self._jitted[fuel]
         import contextlib
 
         # Interpret mode runs as plain JAX ops; pin them to the host CPU
@@ -370,7 +371,7 @@ class Megakernel:
             else contextlib.nullcontext()
         )
         with cm:
-            outs = self._jitted(
+            outs = jitted(
                 jnp.asarray(tasks),
                 jnp.asarray(succ),
                 jnp.asarray(ring),

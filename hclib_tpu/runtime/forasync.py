@@ -89,6 +89,13 @@ def _run_tile(fn: Callable, ranges: Tuple[Tuple[int, int], ...]) -> None:
 
 def _spawn_flat(fn, dims, tile_dims, dist_func) -> None:
     ndim = len(dims)
+    if isinstance(dist_func, str):
+        dist_func = lookup_dist_func(dist_func)
+    if dist_func is None:
+        # Reference default: flat tiles are routed to the central place
+        # (hclib's default loop_dist_func, src/hclib-runtime.c:231-239).
+        central = current_runtime().graph.central_locale()
+        dist_func = lambda ndim_, tile_, total_: central  # noqa: E731
     tile_counts = [math.ceil((hi - lo) / t) for (lo, hi), t in zip(dims, tile_dims)]
     total = math.prod(tile_counts)
     for flat in range(total):
@@ -102,8 +109,7 @@ def _spawn_flat(fn, dims, tile_dims, dist_func) -> None:
             (lo + i * t, min(hi, lo + (i + 1) * t))
             for (lo, hi), t, i in zip(dims, tile_dims, idx)
         )
-        locale = dist_func(ndim, flat, total) if dist_func else None
-        async_(_run_tile, fn, ranges, at=locale)
+        async_(_run_tile, fn, ranges, at=dist_func(ndim, flat, total))
 
 
 def _spawn_recursive(fn, ranges, tile_dims) -> None:

@@ -37,12 +37,20 @@ class PromiseError(RuntimeError):
 class Promise:
     """Single-assignment cell with a waiter list."""
 
-    __slots__ = ("_lock", "_value", "_satisfied", "_task_waiters", "_ctx_waiters")
+    __slots__ = (
+        "_lock",
+        "_value",
+        "_satisfied",
+        "_error",
+        "_task_waiters",
+        "_ctx_waiters",
+    )
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._value: Any = _UNSET
         self._satisfied = False
+        self._error: Optional[BaseException] = None
         # Tasks blocked with this promise as their current registration point.
         self._task_waiters: List[Any] = []
         # Parked execution contexts (threading.Event) waiting on this promise.
@@ -61,10 +69,21 @@ class Promise:
         Task waiters resume their dependency-registration walk; contexts are
         simply unparked (they re-check their own wait condition).
         """
+        self._satisfy(value, None)
+
+    def poison(self, error: BaseException) -> None:
+        """Satisfy the promise with a failure: waiters become runnable, and
+        any ``get()`` raises. Producers that die must poison rather than
+        leave dependents parked forever (no reference analogue - C tasks
+        abort the process; a Python framework must propagate)."""
+        self._satisfy(_UNSET, error)
+
+    def _satisfy(self, value: Any, error: Optional[BaseException]) -> None:
         with self._lock:
             if self._satisfied:
                 raise PromiseError("promise put() called twice")
             self._value = value
+            self._error = error
             self._satisfied = True
             task_waiters, self._task_waiters = self._task_waiters, []
             ctx_waiters, self._ctx_waiters = self._ctx_waiters, []
@@ -97,6 +116,8 @@ class Promise:
     def get(self) -> Any:
         if not self._satisfied:
             raise PromiseError("promise value read before put()")
+        if self._error is not None:
+            raise PromiseError("producer task failed") from self._error
         return self._value
 
 

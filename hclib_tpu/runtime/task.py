@@ -53,7 +53,14 @@ class Task:
         self.result_promise = result_promise
 
     def run(self) -> Any:
-        result = self.fn(*self.args, **self.kwargs)
+        try:
+            result = self.fn(*self.args, **self.kwargs)
+        except BaseException as e:
+            if self.result_promise is not None:
+                # Wake dependents with a failure instead of stranding them
+                # on a never-satisfied promise (which would hang the finish).
+                self.result_promise.poison(e)
+            raise
         if self.result_promise is not None:
             self.result_promise.put(result)
         return result

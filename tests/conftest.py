@@ -19,9 +19,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _clean_modules():
-    """Each test starts with an empty module registry."""
+    """Each test sees the module registries as it found them."""
     from hclib_tpu.runtime import module
 
-    saved = list(module._modules)
+    saved_modules = list(module._modules)
+    saved_mem = {k: dict(v) for k, v in module._mem_fns.items()}
+    saved_factories = list(module._per_worker_factories)
     yield
-    module._modules[:] = saved
+    module._modules[:] = saved_modules
+    module._mem_fns.clear()
+    module._mem_fns.update(saved_mem)
+    module._per_worker_factories[:] = saved_factories

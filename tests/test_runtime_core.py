@@ -255,6 +255,28 @@ def test_remote_task_exception_propagates():
         hc.launch(main, nworkers=4)
 
 
+def test_failed_producer_poisons_dependents():
+    """A failing async_future must not strand dependents: they run, see the
+    poisoned promise on get(), and the error surfaces at launch()."""
+
+    def main():
+        f = hc.async_future(lambda: 1 / 0)
+        hc.async_(lambda: f.get(), await_=[f])
+
+    with pytest.raises((ZeroDivisionError, hc.PromiseError)):
+        hc.launch(main, nworkers=2)
+
+
+def test_failed_producer_future_wait():
+    def main():
+        f = hc.async_future(lambda: 1 / 0)
+        with pytest.raises(hc.PromiseError):
+            f.wait()
+
+    with pytest.raises(ZeroDivisionError):
+        hc.launch(main, nworkers=2)
+
+
 def test_recursive_spawn_tree():
     """Binary task tree, depth 10 -> 2^10 leaves."""
     lock = threading.Lock()
