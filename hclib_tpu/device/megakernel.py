@@ -302,7 +302,8 @@ class Megakernel:
 
     # -- host entry --
 
-    def _build(self, fuel: int):
+    def _build_raw(self, fuel: int):
+        """The bare pallas_call (for embedding under shard_map)."""
         ndata = len(self.data_specs)
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
@@ -327,7 +328,7 @@ class Megakernel:
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
             aliases[5 + i] = 4 + i
-        call = pl.pallas_call(
+        return pl.pallas_call(
             functools.partial(self._kernel, fuel),
             out_shape=out_shape,
             in_specs=in_specs,
@@ -336,7 +337,9 @@ class Megakernel:
             input_output_aliases=aliases,
             interpret=self.interpret,
         )
-        return jax.jit(call)
+
+    def _build(self, fuel: int):
+        return jax.jit(self._build_raw(fuel))
 
     def run(
         self,

@@ -1,0 +1,22 @@
+"""Multi-device layer: meshes, shardings, collectives, sharded execution.
+
+The reference scales across nodes with MPI/OpenSHMEM modules servicing a NIC
+locale (modules/mpi, modules/openshmem). TPU-first, the equivalents are:
+- intra-slice: XLA collectives over ICI (psum/all_gather/ppermute/...)
+  and Pallas remote DMA between cores,
+- inter-host: jax.distributed + the same collectives over DCN,
+with the device mesh replacing the locality-graph's machine JSON.
+"""
+
+from .collectives import all_gather, all_to_all, psum, reduce_scatter, ring_permute
+from .mesh import make_mesh, mesh_locality_graph
+
+__all__ = [
+    "make_mesh",
+    "mesh_locality_graph",
+    "psum",
+    "all_gather",
+    "reduce_scatter",
+    "all_to_all",
+    "ring_permute",
+]

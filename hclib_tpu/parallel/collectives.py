@@ -1,0 +1,47 @@
+"""Collective operations over mesh axes.
+
+The reference's distributed layer is MPI collectives serviced by a NIC-locale
+worker (modules/mpi/src/hclib_mpi.cpp:220-286: Allreduce/Bcast/Barrier as
+finish{async_nb_at(nic)}). TPU-first these are XLA collectives compiled into
+the program and riding ICI/DCN - thin named wrappers so framework code reads
+the same on host and device (usable inside jit/shard_map/pallas):
+
+    MPI_Allreduce(SUM)  -> psum(x, axis)
+    MPI_Allgather       -> all_gather(x, axis)
+    MPI_Reduce_scatter  -> reduce_scatter(x, axis)
+    MPI_Alltoall        -> all_to_all(x, axis, ...)
+    SHMEM put-to-right  -> ring_permute(x, axis, shift)
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["psum", "all_gather", "reduce_scatter", "all_to_all", "ring_permute"]
+
+
+def psum(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = False):
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dimension: int = 0):
+    return jax.lax.psum_scatter(
+        x, axis, scatter_dimension=scatter_dimension, tiled=True
+    )
+
+
+def all_to_all(x, axis: str, *, split_axis: int = 0, concat_axis: int = 0):
+    return jax.lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def ring_permute(x, axis: str, shift: int = 1):
+    """Rotate shards around the mesh axis (one-sided neighbor exchange)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)

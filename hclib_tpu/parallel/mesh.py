@@ -1,0 +1,94 @@
+"""Device meshes and the mesh-derived locality graph.
+
+The reference describes the machine as a locality-graph JSON (sysmem, cache
+slices, GPUs, NIC - locality_graphs/*.json); workers get pop/steal paths over
+it. On TPU the machine shape *is* the device mesh, so the locality graph is
+synthesized from it: one ``tpu`` locale per device (metadata carries the mesh
+coordinates and jax device), an ``hbm`` locale per device, one ``host``
+locale, and an ``ici`` locale marked "COMM" standing in for the interconnect
+(the reference marks its NIC locale special "COMM",
+modules/mpi/src/hclib_mpi.cpp:92). Host workers whose paths include a tpu
+locale play the role of the reference's GPU/communication workers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..runtime.locality import Locale, LocalityGraph
+
+__all__ = ["make_mesh", "mesh_locality_graph", "cpu_mesh"]
+
+
+def make_mesh(
+    axis_shapes: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over the given devices (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(axis_shapes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(axis_shapes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def cpu_mesh(n: int, axis_name: str = "d") -> Mesh:
+    """n-device mesh over host-platform CPU devices (virtual devices when
+    --xla_force_host_platform_device_count is set). Used for sharding tests
+    and multi-chip dry runs without TPU hardware."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise ValueError(
+            f"need {n} cpu devices, have {len(cpus)}; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    return Mesh(np.array(cpus[:n]), (axis_name,))
+
+
+def mesh_locality_graph(mesh: Mesh, nworkers: Optional[int] = None) -> LocalityGraph:
+    """Locality graph for host workers driving a device mesh.
+
+    Layout: host -- ici(COMM) -- tpu_i -- hbm_i. Worker w's pop path is
+    [tpu_(w%ndev), host]; steal paths span every tpu locale then host, so any
+    worker can service any device queue.
+    """
+    devices = list(mesh.devices.flat)
+    ndev = len(devices)
+    if nworkers is None:
+        nworkers = ndev
+    locales = []
+    host = Locale(0, "host", "host")
+    locales.append(host)
+    ici = Locale(1, "ici", "ici")
+    ici.mark_special("COMM")
+    ici.reachable.append(0)
+    host.reachable.append(1)
+    locales.append(ici)
+    tpu_ids = []
+    for i, dev in enumerate(devices):
+        t = Locale(2 + 2 * i, f"tpu_{i}", "tpu")
+        t.metadata["device"] = dev
+        t.metadata["ordinal"] = i
+        t.metadata["coords"] = tuple(
+            int(c) for c in np.argwhere(mesh.devices == dev)[0]
+        )
+        h = Locale(3 + 2 * i, f"hbm_{i}", "hbm")
+        h.metadata["ordinal"] = i
+        t.reachable.extend([1, h.id])
+        h.reachable.append(t.id)
+        ici.reachable.append(t.id)
+        locales.extend([t, h])
+        tpu_ids.append(t.id)
+    pop_paths = [[tpu_ids[w % ndev], 0] for w in range(nworkers)]
+    steal_paths = [
+        [tpu_ids[(w + k) % ndev] for k in range(1, ndev + 1)] + [0]
+        for w in range(nworkers)
+    ]
+    return LocalityGraph(nworkers, locales, pop_paths, steal_paths)
