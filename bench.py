@@ -1,0 +1,142 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline: dynamic-task throughput (tasks/sec) of the on-device megakernel
+running the fib task graph (dynamic spawning + joins - the reference's
+flagship finish/async microbenchmark, test/fib), compared against this
+repo's host work-stealing runtime on the local CPU (the measured baseline
+BASELINE.md calls for; the reference publishes no reusable numbers).
+
+Secondary numbers (Cholesky GFLOP/s, SW cells/s, per-workload details) go to
+stderr so the stdout contract stays a single JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_device_fib():
+    """Steady-state megakernel throughput: the fib(12) task graph (697
+    dynamic tasks: spawns, joins, continuation passing) is re-run R times
+    *inside one kernel launch* (the resident scheduler never exits), and the
+    per-task cost is the slope between two R values - this cancels launch +
+    host<->device transfer overhead, which on this tunnel setup is ~75 ms
+    and would otherwise swamp the measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.megakernel import C_EXECUTED
+    from hclib_tpu.device.workloads import FIB, make_fib_megakernel
+
+    interpret = jax.default_backend() != "tpu"
+    cap = 768
+    r_lo, r_hi = (100, 2000) if not interpret else (1, 3)
+    mk = make_fib_megakernel(cap, interpret=interpret)
+    b = TaskGraphBuilder()
+    b.add(FIB, args=[12], out=0)  # 697 tasks, fits the SMEM table
+    tasks, succ, ring, counts = b.finalize(capacity=cap, succ_capacity=64)
+
+    def fresh():
+        return [
+            jax.device_put(jnp.asarray(x))
+            for x in (tasks, succ, ring, counts, np.zeros(cap, np.int32))
+        ]
+
+    points = []
+    for reps in (r_lo, r_hi):
+        jitted = mk._build(1 << 22, reps=reps)
+        outs = jitted(*fresh())
+        assert int(np.asarray(outs[3])[0]) == 144, "device fib wrong"
+        t0 = time.perf_counter()
+        outs = jitted(*fresh())
+        n = int(np.asarray(outs[2])[C_EXECUTED])  # d2h read = true sync
+        dt = time.perf_counter() - t0
+        points.append((dt, n))
+        log(f"device fib reps={reps}: {n} tasks in {dt*1000:.1f} ms (incl overhead)")
+    (d1, n1), (d2, n2) = points
+    slope = (d2 - d1) / (n2 - n1)
+    rate = 1.0 / slope
+    log(f"device fib steady-state: {slope*1e9:.0f} ns/task -> "
+        f"{rate:,.0f} tasks/s ({'interpret' if interpret else 'tpu'})")
+    return rate
+
+
+def bench_host_fib(n: int = 20):
+    from hclib_tpu.models import fib
+
+    r = fib.run(n, variant="finish")
+    log(f"host fib({n}): {r['tasks']} tasks in {r['seconds']*1000:.0f} ms "
+        f"-> {r['tasks_per_sec']:,.0f} tasks/s")
+    return r["tasks_per_sec"]
+
+
+def bench_device_cholesky():
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return None
+    from hclib_tpu.device.cholesky import (
+        T,
+        _to_tiles,
+        build_cholesky_graph,
+        make_cholesky_megakernel,
+    )
+    from hclib_tpu.models.cholesky import make_spd
+
+    n = 1536
+    nt = n // T
+    mk = make_cholesky_megakernel(nt, interpret=False)
+    jitted = mk._build(1 << 22)
+    b = build_cholesky_graph(nt)
+    tasks, succ, ring, counts = b.finalize(
+        capacity=mk.capacity, succ_capacity=mk.succ_capacity
+    )
+    a = make_spd(n).astype(np.float32)
+    args = [
+        jax.device_put(jnp.asarray(x))
+        for x in (
+            tasks, succ, ring, counts, np.zeros(8, np.int32),
+            _to_tiles(a, nt), np.zeros((nt, T, T), np.float32),
+        )
+    ]
+    jax.block_until_ready(jitted(*args))
+    t0 = time.perf_counter()
+    outs = jitted(*args)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    gflops = n**3 / 3.0 / dt / 1e9
+    log(f"device cholesky n={n}: {dt*1000:.1f} ms -> {gflops:.1f} GFLOP/s")
+    return gflops
+
+
+def main() -> None:
+    host_rate = bench_host_fib()
+    device_rate = bench_device_fib()
+    try:
+        bench_device_cholesky()
+    except Exception as e:  # secondary metric must not break the contract
+        log(f"cholesky bench failed: {e}")
+    print(
+        json.dumps(
+            {
+                "metric": "megakernel dynamic-task throughput (fib task graph)",
+                "value": round(device_rate),
+                "unit": "tasks/sec",
+                "vs_baseline": round(device_rate / host_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
