@@ -79,6 +79,25 @@ def bench_host_fib(n: int = 20):
     return r["tasks_per_sec"]
 
 
+def bench_native_fib(n: int = 27):
+    """The strongest CPU baseline: this repo's C++ work-stealing runtime."""
+    try:
+        from hclib_tpu.native import NativeRuntime
+
+        with NativeRuntime() as rt:
+            t0 = time.perf_counter()
+            v = rt.fib(n)
+            dt = time.perf_counter() - t0
+            tasks = rt.executed
+        rate = tasks / dt
+        log(f"native C++ fib({n}) = {v}: {tasks} tasks in {dt*1000:.0f} ms "
+            f"-> {rate:,.0f} tasks/s ({rt.nworkers} workers)")
+        return rate
+    except Exception as e:
+        log(f"native baseline unavailable: {e}")
+        return None
+
+
 def bench_device_cholesky():
     import jax
     import jax.numpy as jnp
@@ -121,6 +140,7 @@ def bench_device_cholesky():
 
 def main() -> None:
     host_rate = bench_host_fib()
+    bench_native_fib()  # reported to stderr; the scalar-core comparison point
     device_rate = bench_device_fib()
     try:
         bench_device_cholesky()

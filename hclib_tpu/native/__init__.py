@@ -1,0 +1,151 @@
+"""ctypes bindings for the native host runtime (built on demand).
+
+The C++ core (src/) is the fast host-side work-stealing engine: Chase-Lev
+deques with C++11 atomics, pthread workers, help-first finish joins, and
+native implementations of the benchmark workloads (fib, UTS with an in-house
+FIPS-180-1 SHA-1, arrayadd). It provides the compiled CPU baseline the
+device megakernel is measured against, and the host-side queue engine for
+feeding device work.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libhclib_native.so")
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_DIR, check=True, capture_output=True, text=True
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise NativeBuildError(f"native runtime build failed: {detail}") from e
+
+
+def load() -> ctypes.CDLL:
+    """Build (if needed) and load the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    src_newer = not os.path.exists(_LIB_PATH) or any(
+        os.path.getmtime(os.path.join(_DIR, "src", f)) > os.path.getmtime(_LIB_PATH)
+        for f in os.listdir(os.path.join(_DIR, "src"))
+    )
+    if src_newer:
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hcn_create.restype = ctypes.c_void_p
+    lib.hcn_create.argtypes = [ctypes.c_int]
+    lib.hcn_destroy.argtypes = [ctypes.c_void_p]
+    lib.hcn_nworkers.restype = ctypes.c_int
+    lib.hcn_nworkers.argtypes = [ctypes.c_void_p]
+    lib.hcn_executed.restype = ctypes.c_ulonglong
+    lib.hcn_executed.argtypes = [ctypes.c_void_p]
+    lib.hcn_steals.restype = ctypes.c_ulonglong
+    lib.hcn_steals.argtypes = [ctypes.c_void_p]
+    lib.hcn_fib.restype = ctypes.c_longlong
+    lib.hcn_fib.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.hcn_uts.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_double,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_ulonglong),
+        ctypes.POINTER(ctypes.c_ulonglong),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.hcn_arrayadd.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_long,
+        ctypes.c_long,
+    ]
+    _lib = lib
+    return lib
+
+
+class NativeRuntime:
+    """RAII wrapper over the native scheduler."""
+
+    def __init__(self, nworkers: Optional[int] = None) -> None:
+        self._lib = load()
+        if nworkers is None:
+            nworkers = os.cpu_count() or 1
+        self._rt = self._lib.hcn_create(nworkers)
+        self.nworkers = nworkers
+
+    def close(self) -> None:
+        if self._rt is not None:
+            self._lib.hcn_destroy(self._rt)
+            self._rt = None
+
+    def __enter__(self) -> "NativeRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def _handle(self):
+        if self._rt is None:
+            raise RuntimeError("NativeRuntime used after close()")
+        return self._rt
+
+    @property
+    def executed(self) -> int:
+        return int(self._lib.hcn_executed(self._handle))
+
+    @property
+    def steals(self) -> int:
+        return int(self._lib.hcn_steals(self._handle))
+
+    def fib(self, n: int) -> int:
+        return int(self._lib.hcn_fib(self._handle, n))
+
+    def uts(self, shape: int, gen_mx: int, b0: float, seed: int) -> Tuple[int, int, int]:
+        nodes = ctypes.c_ulonglong()
+        leaves = ctypes.c_ulonglong()
+        depth = ctypes.c_int()
+        self._lib.hcn_uts(
+            self._handle, shape, gen_mx, b0, seed,
+            ctypes.byref(nodes), ctypes.byref(leaves), ctypes.byref(depth),
+        )
+        return int(nodes.value), int(leaves.value), int(depth.value)
+
+    def arrayadd(self, a, b, c, tile: int = 4096) -> None:
+        import numpy as np
+
+        for name, arr in (("a", a), ("b", b), ("c", c)):
+            if not isinstance(arr, np.ndarray) or arr.dtype != np.float64:
+                raise TypeError(f"{name} must be a float64 ndarray")
+            if not arr.flags["C_CONTIGUOUS"]:
+                raise ValueError(f"{name} must be C-contiguous")
+        n = len(a)
+        if len(b) != n or len(c) != n:
+            raise ValueError("a, b, c must have equal length")
+        if tile <= 0:
+            raise ValueError("tile must be positive")
+        pd = ctypes.POINTER(ctypes.c_double)
+        self._lib.hcn_arrayadd(
+            self._handle,
+            a.ctypes.data_as(pd),
+            b.ctypes.data_as(pd),
+            c.ctypes.data_as(pd),
+            n,
+            tile,
+        )

@@ -1,0 +1,215 @@
+// extern "C" API surface (ctypes boundary) + native benchmark workloads.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#include "runtime.hpp"
+#include "sha1.hpp"
+
+using hcn::Finish;
+using hcn::Runtime;
+using hcn::Task;
+
+extern "C" {
+
+void* hcn_create(int nworkers) { return new Runtime(nworkers); }
+void hcn_destroy(void* rt) { delete static_cast<Runtime*>(rt); }
+int hcn_nworkers(void* rt) { return static_cast<Runtime*>(rt)->nworkers(); }
+unsigned long long hcn_executed(void* rt) {
+  return static_cast<Runtime*>(rt)->total_executed();
+}
+unsigned long long hcn_steals(void* rt) {
+  return static_cast<Runtime*>(rt)->total_steals();
+}
+
+// Generic task API for foreign (e.g. Python-callback) tasks.
+void hcn_run_root(void* rt, void (*fn)(void*), void* env) {
+  static_cast<Runtime*>(rt)->run_root(fn, env);
+}
+
+// ------------------------------------------------------------------ fib
+
+namespace {
+struct FibEnv {
+  Runtime* rt;
+  int n;
+  long long* out;
+};
+
+void fib_task(void* p) {
+  FibEnv* e = static_cast<FibEnv*>(p);
+  if (e->n < 2) {
+    *e->out = e->n;
+    delete e;
+    return;
+  }
+  long long a = 0, b = 0;
+  Finish f;
+  f.check_in();
+  e->rt->spawn({fib_task, new FibEnv{e->rt, e->n - 1, &a}, &f.counter});
+  f.check_in();
+  e->rt->spawn({fib_task, new FibEnv{e->rt, e->n - 2, &b}, &f.counter});
+  e->rt->help_until_zero(&f.counter);
+  *e->out = a + b;
+  delete e;
+}
+}  // namespace
+
+long long hcn_fib(void* rtp, int n) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  long long result = 0;
+  FibEnv* root = new FibEnv{rt, n, &result};
+  rt->run_root(fib_task, root);
+  return result;
+}
+
+// ------------------------------------------------------------------ UTS
+// Tree spec re-implemented from the published UTS algorithm (see
+// hclib_tpu/models/uts.py for the parameter citations).
+
+namespace {
+struct UtsCounters {
+  std::atomic<uint64_t> nodes{0};
+  std::atomic<uint64_t> leaves{0};
+  std::atomic<int> max_depth{0};
+};
+
+struct UtsParams {
+  int shape;  // 0=LINEAR 1=EXPDEC 2=CYCLIC 3=FIXED
+  int gen_mx;
+  double b0;
+};
+
+struct UtsEnv {
+  Runtime* rt;
+  const UtsParams* params;
+  UtsCounters* counters;
+  uint8_t state[20];
+  int depth;
+  Finish* finish;  // tree-wide finish
+};
+
+int uts_num_children(const UtsParams& p, const uint8_t state[20], int depth) {
+  double b_i = p.b0;
+  if (depth > 0) {
+    switch (p.shape) {
+      case 0:
+        b_i = p.b0 * (1.0 - double(depth) / double(p.gen_mx));
+        break;
+      case 1:
+        b_i = p.b0 * std::pow(double(depth),
+                              -std::log(p.b0) / std::log(double(p.gen_mx)));
+        break;
+      case 2:
+        if (depth > 5 * p.gen_mx)
+          b_i = 0.0;
+        else
+          b_i = std::pow(p.b0, std::sin(2.0 * M_PI * depth / p.gen_mx));
+        break;
+      case 3:
+        b_i = depth < p.gen_mx ? p.b0 : 0.0;
+        break;
+    }
+  }
+  if (b_i <= 0.0) return 0;
+  uint32_t r = (uint32_t(state[16]) << 24) | (uint32_t(state[17]) << 16) |
+               (uint32_t(state[18]) << 8) | uint32_t(state[19]);
+  r &= 0x7FFFFFFF;
+  double u = double(r) / 2147483648.0;
+  double pgeo = 1.0 / (1.0 + b_i);
+  int n = int(std::floor(std::log(1.0 - u) / std::log(1.0 - pgeo)));
+  return n > 100 ? 100 : n;  // MAXNUMCHILDREN cap
+}
+
+void uts_task(void* pv) {
+  UtsEnv* e = static_cast<UtsEnv*>(pv);
+  e->counters->nodes.fetch_add(1, std::memory_order_relaxed);
+  int md = e->counters->max_depth.load(std::memory_order_relaxed);
+  while (e->depth > md &&
+         !e->counters->max_depth.compare_exchange_weak(md, e->depth)) {
+  }
+  int nc = uts_num_children(*e->params, e->state, e->depth);
+  if (nc == 0) {
+    e->counters->leaves.fetch_add(1, std::memory_order_relaxed);
+  }
+  for (int i = 0; i < nc; ++i) {
+    UtsEnv* c = new UtsEnv;
+    c->rt = e->rt;
+    c->params = e->params;
+    c->counters = e->counters;
+    c->depth = e->depth + 1;
+    c->finish = e->finish;
+    uint8_t msg[24];
+    std::memcpy(msg, e->state, 20);
+    msg[20] = (i >> 24) & 0xff;
+    msg[21] = (i >> 16) & 0xff;
+    msg[22] = (i >> 8) & 0xff;
+    msg[23] = i & 0xff;
+    hcn::sha1_single_block(msg, 24, c->state);
+    e->finish->check_in();
+    e->rt->spawn({uts_task, c, &e->finish->counter});
+  }
+  delete e;
+}
+}  // namespace
+
+void hcn_uts(void* rtp, int shape, int gen_mx, double b0, int seed,
+             unsigned long long* nodes, unsigned long long* leaves,
+             int* max_depth) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  UtsParams params{shape, gen_mx, b0};
+  UtsCounters counters;
+  Finish finish;
+  UtsEnv* root = new UtsEnv;
+  root->rt = rt;
+  root->params = &params;
+  root->counters = &counters;
+  root->depth = 0;
+  root->finish = &finish;
+  uint8_t msg[20] = {0};
+  msg[16] = (seed >> 24) & 0xff;
+  msg[17] = (seed >> 16) & 0xff;
+  msg[18] = (seed >> 8) & 0xff;
+  msg[19] = seed & 0xff;
+  hcn::sha1_single_block(msg, 20, root->state);
+  finish.check_in();
+  rt->spawn({uts_task, root, &finish.counter});
+  rt->help_until_zero(&finish.counter);
+  *nodes = counters.nodes.load();
+  *leaves = counters.leaves.load();
+  *max_depth = counters.max_depth.load();
+}
+
+// -------------------------------------------------------------- arrayadd
+
+namespace {
+struct AddEnv {
+  const double* a;
+  const double* b;
+  double* c;
+  long lo, hi;
+};
+
+void add_task(void* pv) {
+  AddEnv* e = static_cast<AddEnv*>(pv);
+  for (long i = e->lo; i < e->hi; ++i) e->c[i] = e->a[i] + e->b[i];
+  delete e;
+}
+}  // namespace
+
+void hcn_arrayadd(void* rtp, const double* a, const double* b, double* c,
+                  long n, long tile) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  if (tile <= 0) tile = n > 0 ? n : 1;
+  Finish f;
+  for (long lo = 0; lo < n; lo += tile) {
+    long hi = lo + tile < n ? lo + tile : n;
+    f.check_in();
+    rt->spawn({add_task, new AddEnv{a, b, c, lo, hi}, &f.counter});
+  }
+  rt->help_until_zero(&f.counter);
+}
+
+}  // extern "C"

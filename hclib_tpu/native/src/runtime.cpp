@@ -1,0 +1,110 @@
+#include "runtime.hpp"
+
+#include <chrono>
+
+namespace hcn {
+
+namespace {
+thread_local Runtime* g_runtime = nullptr;
+thread_local int g_worker = -1;
+}  // namespace
+
+Runtime::Runtime(int nworkers)
+    : nworkers_(nworkers < 1 ? 1 : nworkers),
+      deques_(nworkers_),
+      stats_(nworkers_) {
+  g_runtime = this;
+  g_worker = 0;
+  threads_.reserve(nworkers_ - 1);
+  for (int w = 1; w < nworkers_; ++w) {
+    threads_.emplace_back([this, w] {
+      g_runtime = this;
+      g_worker = w;
+      worker_loop(w);
+    });
+  }
+}
+
+Runtime::~Runtime() {
+  shutdown_.store(true, std::memory_order_release);
+  for (auto& t : threads_) t.join();
+  g_runtime = nullptr;
+  g_worker = -1;
+}
+
+void Runtime::spawn(Task t) {
+  int w = g_worker >= 0 ? g_worker : 0;
+  if (!deques_[w].push(t)) {
+    // Deque full: run inline (the reference aborts,
+    // src/hclib-runtime.c:520-524; degrading to inline execution keeps
+    // deep spawn trees correct at some parallelism cost).
+    execute(t);
+  }
+}
+
+bool Runtime::find_task(int wid, Task* out) {
+  if (deques_[wid].pop(out)) return true;
+  for (int i = 1; i <= nworkers_; ++i) {
+    int v = (wid + i) % nworkers_;
+    if (v == wid) continue;
+    if (deques_[v].steal(out)) {
+      ++stats_[wid].steals;
+      return true;
+    }
+  }
+  return false;
+}
+
+void Runtime::execute(const Task& t) {
+  t.fn(t.env);
+  if (t.finish_counter)
+    t.finish_counter->fetch_sub(1, std::memory_order_release);
+  int w = g_worker >= 0 ? g_worker : 0;
+  ++stats_[w].executed;
+}
+
+void Runtime::worker_loop(int wid) {
+  Task t;
+  int idle_spins = 0;
+  while (!shutdown_.load(std::memory_order_acquire)) {
+    if (find_task(wid, &t)) {
+      execute(t);
+      idle_spins = 0;
+    } else if (++idle_spins > 1024) {
+      std::this_thread::yield();
+    }
+  }
+}
+
+void Runtime::help_until_zero(std::atomic<int64_t>* counter) {
+  int wid = g_worker >= 0 ? g_worker : 0;
+  Task t;
+  while (counter->load(std::memory_order_acquire) != 0) {
+    if (find_task(wid, &t)) {
+      execute(t);
+    } else {
+      std::this_thread::yield();
+    }
+  }
+}
+
+void Runtime::run_root(void (*fn)(void*), void* env) {
+  root_counter_.store(1, std::memory_order_relaxed);
+  Task t{fn, env, &root_counter_};
+  execute(t);
+  help_until_zero(&root_counter_);
+}
+
+uint64_t Runtime::total_executed() const {
+  uint64_t n = 0;
+  for (auto& s : stats_) n += s.executed;
+  return n;
+}
+
+uint64_t Runtime::total_steals() const {
+  uint64_t n = 0;
+  for (auto& s : stats_) n += s.steals;
+  return n;
+}
+
+}  // namespace hcn

@@ -1,0 +1,146 @@
+// Native host runtime: work-stealing scheduler core.
+//
+// A fresh C++17 implementation of the reference's scheduling model
+// (finish/async over per-worker Chase-Lev deques, help-first joins -
+// src/hclib-runtime.c, src/hclib-deque.c), designed for the role it plays in
+// this framework: the fast *host-side* execution engine that feeds/drains
+// TPU device queues and provides the measured CPU baseline. Differences from
+// the reference are deliberate:
+//  - no stackful fibers: a blocked finish help-first executes other tasks on
+//    the same stack (work-shift). All framework workloads are fork-join, so
+//    bounded stack growth is guaranteed by the spawn tree depth.
+//  - deques are bounded lock-free Chase-Lev rings with C++11 atomics
+//    (acquire/release instead of x86-TSO assumptions + __sync builtins).
+//  - tasks are {function pointer, void* env} pairs; closures are arena-free
+//    (caller owns env lifetime until execution).
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace hcn {
+
+struct Task {
+  void (*fn)(void*) = nullptr;
+  void* env = nullptr;
+  std::atomic<int64_t>* finish_counter = nullptr;
+};
+
+// Chase-Lev work-stealing deque (bounded ring). Owner pushes/pops at the
+// bottom; thieves CAS the top.
+class Deque {
+ public:
+  static constexpr size_t kCapacity = 1 << 16;
+
+  bool push(const Task& t) {
+    int64_t b = bottom_.load(std::memory_order_relaxed);
+    int64_t tp = top_.load(std::memory_order_acquire);
+    if (b - tp >= static_cast<int64_t>(kCapacity)) return false;  // full
+    buf_[b & kMask] = t;
+    std::atomic_thread_fence(std::memory_order_release);
+    bottom_.store(b + 1, std::memory_order_relaxed);
+    return true;
+  }
+
+  bool pop(Task* out) {
+    int64_t b = bottom_.load(std::memory_order_relaxed) - 1;
+    bottom_.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t tp = top_.load(std::memory_order_relaxed);
+    if (tp > b) {  // empty
+      bottom_.store(b + 1, std::memory_order_relaxed);
+      return false;
+    }
+    *out = buf_[b & kMask];
+    if (tp == b) {  // last element: race with thieves
+      if (!top_.compare_exchange_strong(tp, tp + 1,
+                                        std::memory_order_seq_cst,
+                                        std::memory_order_relaxed)) {
+        bottom_.store(b + 1, std::memory_order_relaxed);
+        return false;
+      }
+      bottom_.store(b + 1, std::memory_order_relaxed);
+    }
+    return true;
+  }
+
+  bool steal(Task* out) {
+    int64_t tp = top_.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t b = bottom_.load(std::memory_order_acquire);
+    if (tp >= b) return false;  // empty
+    Task t = buf_[tp & kMask];
+    if (!top_.compare_exchange_strong(tp, tp + 1, std::memory_order_seq_cst,
+                                      std::memory_order_relaxed)) {
+      return false;  // lost the race
+    }
+    *out = t;
+    return true;
+  }
+
+  size_t size() const {
+    int64_t b = bottom_.load(std::memory_order_relaxed);
+    int64_t tp = top_.load(std::memory_order_relaxed);
+    return b > tp ? static_cast<size_t>(b - tp) : 0;
+  }
+
+ private:
+  static constexpr size_t kMask = kCapacity - 1;
+  alignas(64) std::atomic<int64_t> top_{0};
+  alignas(64) std::atomic<int64_t> bottom_{0};
+  std::vector<Task> buf_{kCapacity};
+};
+
+struct WorkerStats {
+  uint64_t executed = 0;
+  uint64_t steals = 0;
+  char pad[48];
+};
+
+class Runtime {
+ public:
+  explicit Runtime(int nworkers);
+  ~Runtime();
+
+  int nworkers() const { return nworkers_; }
+
+  // Spawn a task under the given finish counter (counter is pre-incremented
+  // by the caller via Finish::check_in).
+  void spawn(Task t);
+
+  // Help-first drain: execute tasks until *counter reaches zero
+  // (help_finish, src/hclib-runtime.c:1067-1119 - minus the fiber swap).
+  void help_until_zero(std::atomic<int64_t>* counter);
+
+  // Run fn(env) as the root task on the calling thread and drain everything.
+  void run_root(void (*fn)(void*), void* env);
+
+  uint64_t total_executed() const;
+  uint64_t total_steals() const;
+
+ private:
+  friend struct WorkerMain;
+  void worker_loop(int wid);
+  bool find_task(int wid, Task* out);
+  void execute(const Task& t);
+
+  int nworkers_;
+  std::vector<Deque> deques_;
+  std::vector<WorkerStats> stats_;
+  std::vector<std::thread> threads_;
+  std::atomic<bool> shutdown_{false};
+  std::atomic<int64_t> root_counter_{0};
+};
+
+// Finish scope: atomic counter of outstanding children. Spawners check_in
+// before spawn; the runtime decrements when the task completes (execute()),
+// so there is deliberately no public check_out.
+struct Finish {
+  std::atomic<int64_t> counter{0};
+  void check_in() { counter.fetch_add(1, std::memory_order_relaxed); }
+};
+
+}  // namespace hcn

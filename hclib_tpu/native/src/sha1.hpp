@@ -1,0 +1,64 @@
+// Minimal SHA-1 (FIPS 180-1) for fixed-size small messages - implemented
+// from the published specification for the UTS splittable RNG (the tree spec
+// hashes 20-byte states || 4-byte spawn ids; messages are always < 56 bytes,
+// so single-block processing suffices).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace hcn {
+
+inline void sha1_single_block(const uint8_t* msg, size_t len, uint8_t out[20]) {
+  // len must be < 56 (fits one 64-byte block with padding + length).
+  uint8_t block[64] = {0};
+  std::memcpy(block, msg, len);
+  block[len] = 0x80;
+  uint64_t bits = static_cast<uint64_t>(len) * 8;
+  for (int i = 0; i < 8; ++i) block[63 - i] = (bits >> (8 * i)) & 0xff;
+
+  uint32_t w[80];
+  for (int i = 0; i < 16; ++i) {
+    w[i] = (uint32_t(block[4 * i]) << 24) | (uint32_t(block[4 * i + 1]) << 16) |
+           (uint32_t(block[4 * i + 2]) << 8) | uint32_t(block[4 * i + 3]);
+  }
+  auto rol = [](uint32_t x, int s) { return (x << s) | (x >> (32 - s)); };
+  for (int i = 16; i < 80; ++i)
+    w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+
+  uint32_t a = 0x67452301, b = 0xEFCDAB89, c = 0x98BADCFE, d = 0x10325476,
+           e = 0xC3D2E1F0;
+  for (int i = 0; i < 80; ++i) {
+    uint32_t f, k;
+    if (i < 20) {
+      f = (b & c) | ((~b) & d);
+      k = 0x5A827999;
+    } else if (i < 40) {
+      f = b ^ c ^ d;
+      k = 0x6ED9EBA1;
+    } else if (i < 60) {
+      f = (b & c) | (b & d) | (c & d);
+      k = 0x8F1BBCDC;
+    } else {
+      f = b ^ c ^ d;
+      k = 0xCA62C1D6;
+    }
+    uint32_t tmp = rol(a, 5) + f + e + k + w[i];
+    e = d;
+    d = c;
+    c = rol(b, 30);
+    b = a;
+    a = tmp;
+  }
+  uint32_t h[5] = {0x67452301 + a, 0xEFCDAB89 + b, 0x98BADCFE + c,
+                   0x10325476 + d, 0xC3D2E1F0 + e};
+  for (int i = 0; i < 5; ++i) {
+    out[4 * i] = (h[i] >> 24) & 0xff;
+    out[4 * i + 1] = (h[i] >> 16) & 0xff;
+    out[4 * i + 2] = (h[i] >> 8) & 0xff;
+    out[4 * i + 3] = h[i] & 0xff;
+  }
+}
+
+}  // namespace hcn

@@ -1,0 +1,56 @@
+"""C++ native runtime tests (built on demand via make; skipped without g++)."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+# Match the Makefile's default compiler (CXX ?= g++, overridable via env).
+import os
+
+_cxx = os.environ.get("CXX", "g++")
+pytestmark = pytest.mark.skipif(
+    shutil.which(_cxx) is None, reason=f"no C++ compiler ({_cxx})"
+)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    from hclib_tpu.native import NativeRuntime
+
+    with NativeRuntime(2) as r:
+        yield r
+
+
+def test_native_fib(rt):
+    assert rt.fib(20) == 6765
+    assert rt.fib(1) == 1
+    assert rt.fib(0) == 0
+
+
+def test_native_uts_t3(rt):
+    # T3: FIXED shape, depth 5, b0=4, seed 42 (pinned in models/uts.py)
+    assert rt.uts(3, 5, 4.0, 42) == (1279, 1018, 5)
+
+
+def test_native_uts_matches_python_spec(rt):
+    from hclib_tpu.models import uts
+
+    params = uts.UTSParams(shape=uts.FIXED, gen_mx=4, b0=3.0, root_seed=7)
+    seq = uts.count_seq(params)
+    assert rt.uts(3, 4, 3.0, 7) == seq
+
+
+def test_native_arrayadd(rt):
+    n = 10_000
+    a = np.arange(n, dtype=np.float64)
+    b = 2.0 * np.arange(n, dtype=np.float64)
+    c = np.zeros(n)
+    rt.arrayadd(a, b, c, tile=512)
+    assert np.array_equal(c, a + b)
+
+
+def test_native_stats(rt):
+    before = rt.executed
+    rt.fib(15)
+    assert rt.executed > before
