@@ -1,0 +1,353 @@
+"""Vectorized UTS: data-parallel tree search on the VPU.
+
+The TPU-first re-design of UTS (reference workload: test/uts): instead of
+one task per node (scalar megakernel) or one pthread per worker (C++ core),
+1024 SIMD lanes each run an independent DFS over their own subtrees, with
+every per-node operation vectorized across the (8, 128) VPU shape:
+
+- SHA-1 (the UTS splittable RNG) is computed for all lanes' current children
+  simultaneously - ~1.3k u32 plane-ops per step hash up to 1024 nodes.
+- Each lane's DFS stack is a set of (state, next-child, count, depth) planes
+  indexed by a per-lane stack pointer; stack reads/writes are select loops
+  over the (small, static) stack height - no gathers, no dynamic indexing.
+- Child counts are *exact*: the host binary-searches (in f64, matching the
+  scalar implementations bit-for-bit) the integer thresholds t_k = min{r :
+  floor(log(1-r/2^31)/log(1-p)) >= k}, and the device counts children as
+  #(r >= t_k) with pure int32 compares. Leaf children are counted without
+  being pushed (80% of canonical-tree nodes are leaves).
+- The host seeds the lanes by BFS-ing the tree top (hashlib) to >= the
+  requested root count, then deals shuffled subtree roots round-robin.
+
+Supports the GEO/FIXED shape (all canonical T1/T1L/T1XL/T3 trees); the
+depth-varying shapes would need per-depth threshold tables.
+
+This is pure JAX (jnp + while_loop) - XLA maps it onto the VPU without a
+hand-written kernel; it also runs on the CPU backend for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.uts import FIXED, UTSParams, num_children, root_state, spawn_state
+
+__all__ = ["uts_vec", "child_thresholds"]
+
+LANES = (8, 128)
+NLANES = LANES[0] * LANES[1]
+MAX_CHILDREN = 100
+
+
+def child_thresholds(b0: float) -> np.ndarray:
+    """Integer thresholds for the geometric child count at branching b0:
+    count(r) = #{k : r >= t_k}. Exact w.r.t. the f64 scalar formula."""
+    p = 1.0 / (1.0 + b0)
+    logq = math.log(1.0 - p)
+
+    def count_of(r: int) -> int:
+        u = r / 2147483648.0
+        if u >= 1.0:
+            return MAX_CHILDREN
+        return min(MAX_CHILDREN, int(math.floor(math.log(1.0 - u) / logq)))
+
+    ts: List[int] = []
+    rmax = (1 << 31) - 1
+    for k in range(1, MAX_CHILDREN + 1):
+        if count_of(rmax) < k:
+            break  # k unreachable for any r
+        lo, hi = 0, rmax  # invariant: count(hi) >= k
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if count_of(mid) >= k:
+                hi = mid
+            else:
+                lo = mid + 1
+        ts.append(lo)
+    return np.asarray(ts, dtype=np.int32)
+
+
+def _rotl(x, s: int):
+    return (x << jnp.uint32(s)) | (x >> jnp.uint32(32 - s))
+
+
+def _sha1_block(w16: List):
+    """SHA-1 compression of one 16-word block, vectorized over planes."""
+    K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+    H = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+    w = list(w16)
+    a = jnp.full(LANES, H[0], jnp.uint32)
+    b = jnp.full(LANES, H[1], jnp.uint32)
+    c = jnp.full(LANES, H[2], jnp.uint32)
+    d = jnp.full(LANES, H[3], jnp.uint32)
+    e = jnp.full(LANES, H[4], jnp.uint32)
+    for i in range(80):
+        if i >= 16:
+            nw = _rotl(w[(i - 3) % 16] ^ w[(i - 8) % 16] ^ w[(i - 14) % 16]
+                       ^ w[i % 16], 1)
+            w[i % 16] = nw
+        wi = w[i % 16]
+        if i < 20:
+            f = (b & c) | (~b & d)
+            k = K[0]
+        elif i < 40:
+            f = b ^ c ^ d
+            k = K[1]
+        elif i < 60:
+            f = (b & c) | (b & d) | (c & d)
+            k = K[2]
+        else:
+            f = b ^ c ^ d
+            k = K[3]
+        tmp = _rotl(a, 5) + f + e + jnp.uint32(k) + wi
+        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+    return (
+        a + jnp.uint32(H[0]),
+        b + jnp.uint32(H[1]),
+        c + jnp.uint32(H[2]),
+        d + jnp.uint32(H[3]),
+        e + jnp.uint32(H[4]),
+    )
+
+
+def _sha1_child(state5, child_idx):
+    """SHA1(parent_state(20B) || BE32(child)) for 24-byte messages."""
+    zero = jnp.zeros(LANES, jnp.uint32)
+    w16 = [
+        state5[0], state5[1], state5[2], state5[3], state5[4],
+        child_idx.astype(jnp.uint32),
+        jnp.full(LANES, 0x80000000, jnp.uint32),
+        zero, zero, zero, zero, zero, zero, zero, zero,
+        jnp.full(LANES, 24 * 8, jnp.uint32),
+    ]
+    return _sha1_block(w16)
+
+
+def _level_select(stack, sp):
+    """Read a per-lane level from a tuple-of-planes stack via selects.
+
+    The stack is a Python tuple (one plane per level), NOT a stacked array:
+    functional updates then leave untouched levels as the same arrays, so
+    XLA's while-loop carry aliasing avoids whole-stack copies (a stacked
+    (S, ...) array with .at[].set() costs a full copy per write and made the
+    DFS step ~300x slower than its op count).
+    """
+    out = jnp.zeros_like(stack[0])
+    for L, plane in enumerate(stack):
+        out = jnp.where(sp == L, plane, out)
+    return out
+
+
+def _level_store(stack, sp, value, mask):
+    """Write value at per-lane level sp where mask; returns a new tuple."""
+    return tuple(
+        jnp.where(mask & (sp == L), value, plane)
+        for L, plane in enumerate(stack)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("stack_size", "gen_mx", "thresholds", "max_steps"),
+)
+def _uts_dfs(
+    stack_state,  # (S, 5, 8, 128) u32
+    stack_child,  # (S, 8, 128) i32
+    stack_count,  # (S, 8, 128) i32
+    stack_depth,  # (S, 8, 128) i32
+    sp0,  # (8, 128) i32; -1 = done
+    stack_size: int,
+    gen_mx: int,
+    thresholds: tuple,  # static ints: compiled as immediates, not memory reads
+    max_steps: int,
+):
+    nthresh = len(thresholds)
+    S = stack_size
+    # Unstack into tuples of planes (see _level_select for why).
+    st = tuple(
+        tuple(stack_state[L, i] for i in range(5)) for L in range(S)
+    )
+    ch = tuple(stack_child[L] for L in range(S))
+    cn = tuple(stack_count[L] for L in range(S))
+    dp = tuple(stack_depth[L] for L in range(S))
+
+    def count_children(r, depth):
+        cnt = jnp.zeros(LANES, jnp.int32)
+        for k in range(nthresh):
+            cnt = cnt + (r >= jnp.int32(thresholds[k])).astype(jnp.int32)
+        return jnp.where(depth < gen_mx, cnt, 0)
+
+    def cond(carry):
+        sp, nodes, leaves, maxd, st, ch, cn, dp, steps = carry
+        return jnp.any(sp >= 0) & (steps < max_steps)
+
+    def body(carry):
+        sp, nodes, leaves, maxd, st, ch, cn, dp, steps = carry
+        active = sp >= 0
+        # Top frame.
+        child = _level_select(ch, sp)
+        count = _level_select(cn, sp)
+        depth = _level_select(dp, sp)
+        state = [
+            _level_select(tuple(st[L][i] for L in range(S)), sp)
+            for i in range(5)
+        ]
+        expand = active & (child < count)
+        # Hash the next child for every lane (masked lanes pay, SIMD-style).
+        cstate = _sha1_child(state, child)
+        r = (cstate[4] & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+        cdepth = depth + 1
+        ccount = count_children(r, cdepth)
+        is_leaf = ccount == 0
+        nodes = nodes + expand.astype(jnp.int32)
+        leaves = leaves + (expand & is_leaf).astype(jnp.int32)
+        maxd = jnp.maximum(maxd, jnp.where(expand, cdepth, 0))
+        # Parent consumed one child.
+        ch = _level_store(ch, sp, child + 1, expand)
+        # Push non-leaf children.
+        push = expand & ~is_leaf
+        spp = sp + 1
+        st = tuple(
+            tuple(
+                jnp.where(push & (spp == L), cstate[i], st[L][i])
+                for i in range(5)
+            )
+            for L in range(S)
+        )
+        ch = _level_store(ch, spp, jnp.zeros(LANES, jnp.int32), push)
+        cn = _level_store(cn, spp, ccount, push)
+        dp = _level_store(dp, spp, cdepth, push)
+        # Pop exhausted frames; advance pushed frames.
+        sp = jnp.where(push, spp, jnp.where(active & ~expand, sp - 1, sp))
+        return sp, nodes, leaves, maxd, st, ch, cn, dp, steps + 1
+
+    zeros = jnp.zeros(LANES, jnp.int32)
+    carry = (sp0, zeros, zeros, zeros, st, ch, cn, dp, jnp.int32(0))
+    sp, nodes, leaves, maxd, *_rest, steps = jax.lax.while_loop(cond, body, carry)
+    # int32 totals: fine up to 2^31 device-side nodes (T1L is 102M; the 4.2B
+    # T1XXL tree would need per-lane int64 counters or periodic draining).
+    return (
+        jnp.sum(nodes),
+        jnp.sum(leaves),
+        jnp.max(maxd),
+        steps,
+        jnp.any(sp >= 0),
+    )
+
+
+def uts_vec(
+    params: UTSParams,
+    target_roots: int = 4 * NLANES,
+    max_steps: Optional[int] = None,
+    device=None,
+) -> dict:
+    """Run UTS with the vectorized DFS engine; returns counts + timing info.
+
+    The host BFS-expands the tree top until >= target_roots frontier nodes
+    (counting that part itself), then the device traverses the subtrees.
+    """
+    if params.shape != FIXED:
+        raise NotImplementedError("uts_vec supports the GEO/FIXED shape")
+    # Host BFS seed.
+    host_nodes = host_leaves = 0
+    host_maxd = 0
+    frontier: List[Tuple[bytes, int]] = [(root_state(params.root_seed), 0)]
+    while frontier and len(frontier) < target_roots:
+        nxt: List[Tuple[bytes, int]] = []
+        for state, depth in frontier:
+            host_nodes += 1
+            host_maxd = max(host_maxd, depth)
+            nc = num_children(params, state, depth)
+            if nc == 0:
+                host_leaves += 1
+                continue
+            for i in range(nc):
+                nxt.append((spawn_state(state, i), depth + 1))
+        frontier = nxt
+    result = {
+        "host_seed_nodes": host_nodes,
+        "roots": len(frontier),
+    }
+    if not frontier:
+        result.update(
+            nodes=host_nodes, leaves=host_leaves, max_depth=host_maxd, steps=0
+        )
+        return result
+    d0 = frontier[0][1]
+    # Roots count as nodes; leaf roots as leaves (the device counts children
+    # at expansion time, so roots must be accounted here).
+    thresholds = child_thresholds(params.b0)
+    root_counts = []
+    for state, depth in frontier:
+        host_nodes += 1
+        host_maxd = max(host_maxd, depth)
+        c = num_children(params, state, depth)
+        root_counts.append(c)
+        if c == 0:
+            host_leaves += 1
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(frontier))
+    rpl = (len(frontier) + NLANES - 1) // NLANES
+    S = rpl + (params.gen_mx - d0) + 1
+    st = np.zeros((S, 5) + LANES, np.uint32)
+    ch = np.zeros((S,) + LANES, np.int32)
+    cn = np.zeros((S,) + LANES, np.int32)
+    dp = np.zeros((S,) + LANES, np.int32)
+    for slot, j in enumerate(order):
+        state, _ = frontier[j]
+        level, lane = divmod(slot, NLANES)
+        r, c = divmod(lane, LANES[1])
+        words = np.frombuffer(state, dtype=">u4").astype(np.uint32)
+        st[level, :, r, c] = words
+        cn[level, r, c] = root_counts[j]
+        dp[level, r, c] = d0
+    # Lanes with fewer roots: the unused bottom frames have count 0 and pop
+    # straight through.
+    sp0 = np.full(LANES, rpl - 1, np.int32)
+    if max_steps is None:
+        max_steps = 1 << 31 - 1
+    import time
+
+    args = (
+        jnp.asarray(st), jnp.asarray(ch), jnp.asarray(cn), jnp.asarray(dp),
+        jnp.asarray(sp0),
+    )
+    kw = dict(
+        stack_size=S, gen_mx=params.gen_mx,
+        thresholds=tuple(int(t) for t in thresholds),
+        max_steps=max_steps,
+    )
+    if device is not None:
+        args = tuple(jax.device_put(a, device) for a in args)
+    nodes, leaves, maxd, steps, unfinished = _uts_dfs(*args, **kw)
+    t0 = time.perf_counter()
+    nodes, leaves, maxd, steps, unfinished = _uts_dfs(*args, **kw)
+    dev_nodes = int(nodes)
+    dt = time.perf_counter() - t0
+    if bool(unfinished):
+        raise RuntimeError(f"uts_vec ran out of steps ({max_steps})")
+    result.update(
+        nodes=host_nodes + dev_nodes,
+        leaves=host_leaves + int(leaves),
+        max_depth=max(host_maxd, int(maxd)),
+        steps=int(steps),
+        device_nodes=dev_nodes,
+        device_seconds=dt,
+        nodes_per_sec=dev_nodes / dt if dt > 0 else float("inf"),
+    )
+    return result
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    from ..models.uts import T1, T1L, T3
+
+    name = sys.argv[1] if len(sys.argv) > 1 else "T3"
+    params = {"T1": T1, "T1L": T1L, "T3": T3}[name]
+    print(uts_vec(params))

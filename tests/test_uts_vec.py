@@ -1,0 +1,50 @@
+"""Vectorized UTS tests (CPU backend; exactness vs the sequential spec)."""
+
+import jax
+import pytest
+
+from hclib_tpu.device.uts_vec import child_thresholds, uts_vec
+from hclib_tpu.models.uts import FIXED, LINEAR, T3, UTSParams, count_seq, num_children, root_state
+
+
+def _cpu():
+    return jax.devices("cpu")[0]
+
+
+def test_thresholds_exact_against_scalar_formula():
+    """count(r) = #{k: r >= t_k} must reproduce num_children for many r."""
+    b0 = 4.0
+    ts = child_thresholds(b0)
+    params = UTSParams(shape=FIXED, gen_mx=100, b0=b0, root_seed=1)
+    import struct
+
+    for r in [0, 1, 429496729, 1073741824, 1717986918, 2147483646,
+              2147483647, 214748364, 2100000000]:
+        state = b"\x00" * 16 + struct.pack(">I", r)
+        want = num_children(params, state, 1)
+        got = int((r >= ts).sum())
+        assert got == want, (r, got, want)
+
+
+def test_uts_vec_t3_exact():
+    r = uts_vec(T3, target_roots=64, device=_cpu())
+    assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(T3)
+
+
+def test_uts_vec_deeper_tree_exact():
+    p = UTSParams(shape=FIXED, gen_mx=7, b0=4.0, root_seed=19)
+    r = uts_vec(p, target_roots=256, device=_cpu())
+    assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
+
+
+def test_uts_vec_tiny_tree_host_only():
+    """A tree smaller than target_roots is fully consumed by the host BFS."""
+    p = UTSParams(shape=FIXED, gen_mx=2, b0=1.0, root_seed=3)
+    r = uts_vec(p, target_roots=10_000, device=_cpu())
+    assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
+
+
+def test_uts_vec_rejects_non_fixed_shape():
+    p = UTSParams(shape=LINEAR, gen_mx=5, b0=4.0, root_seed=1)
+    with pytest.raises(NotImplementedError):
+        uts_vec(p, device=_cpu())
