@@ -1,0 +1,34 @@
+"""Backend modules (the reference's modules/ layer, re-designed for TPU).
+
+The reference extends its core runtime through dlopen'd modules that register
+locale types, memory handlers, and communication backends (modules/{system,
+cuda,mpi,openshmem,sos,openshmem-am,upcxx}, ~2.9 kLoC). This package rebuilds
+that layer for the JAX single-controller model:
+
+- ``common``  - pending-op completion-polling harness shared by all comm
+  backends (reference: modules/common/hclib-module-common.h).
+- ``system``  - host locale types + malloc-family memory handlers
+  (reference: modules/system/).
+- ``tpu``     - the accelerator module: TPU locales, device memory handlers,
+  stream-ordered async offload (reference: modules/cuda/).
+- ``comm``    - two-sided messaging + collectives between ranks
+  (reference: modules/mpi/).
+- ``oneside`` - symmetric heap, one-sided put/get, atomics, wait-sets,
+  distributed locks, per-worker comm contexts (reference:
+  modules/openshmem/ + modules/sos/).
+- ``am``      - active messages: run a function on a remote rank
+  (reference: modules/openshmem-am/).
+- ``pgas``    - global pointers, shared arrays, dependency-chained asyncs
+  (reference: modules/upcxx/).
+
+Key re-interpretation: the reference's PE (an MPI/SHMEM process) becomes a
+*rank* bound to a mesh device under JAX's single-controller model. One Python
+process drives every device; "remote" data movement is a device-to-device
+transfer over ICI (multi-host: DCN via jax.distributed, same addressing).
+See ``world.py``.
+"""
+
+from .world import World, current_world, set_world  # noqa: F401
+from .common import PendingList, PendingOp  # noqa: F401
+from .system import SystemModule, get_closest_cpu_locale  # noqa: F401
+from .tpu import TpuModule, get_closest_tpu_locale  # noqa: F401

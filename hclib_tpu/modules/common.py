@@ -1,0 +1,120 @@
+"""Shared async-completion-polling harness for comm/device backends.
+
+Reference design (modules/common/hclib-module-common.h:10-115): each backend
+keeps a lock-free list of pending operations; ``append_to_pending`` pushes an
+op and, if the list was empty, spawns a poller task at the module's locale.
+The poller tests every op via a callback, fulfills the op's promise (or spawns
+its task) on completion, then yields at the locale and sweeps again until the
+list drains.
+
+Here the poller is an *escaping* task (it must not prolong unrelated finish
+scopes - user code is gated on the op promises, not on the poller), and a
+backend may alternatively register the sweep as a runtime idle function
+(the reference's per-locale idle tasks, src/hclib-locality-graph.c:807-827).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from ..runtime.locality import Locale
+from ..runtime.promise import Promise
+from ..runtime.scheduler import current_runtime, yield_
+
+__all__ = ["PendingOp", "PendingList"]
+
+
+class PendingOp:
+    """One in-flight operation: ``test()`` returns (done, result)."""
+
+    __slots__ = ("test", "promise", "data")
+
+    def __init__(
+        self,
+        test: Callable[["PendingOp"], Any],
+        promise: Optional[Promise] = None,
+        data: Any = None,
+    ) -> None:
+        self.test = test
+        self.promise = promise
+        self.data = data
+
+
+class PendingList:
+    """Pending-op list + self-terminating poller task.
+
+    ``append`` returns the op's promise's future when one exists, so callers
+    can write ``PendingList.append(op).wait()``.
+    """
+
+    def __init__(self, locale: Optional[Locale] = None, use_idle_fn: bool = False) -> None:
+        self.locale = locale
+        self._lock = threading.Lock()
+        self._ops: List[PendingOp] = []
+        self._poller_live = False
+        self._use_idle_fn = use_idle_fn
+        self._idle_registered = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ops)
+
+    def append(self, op: PendingOp):
+        """Add an op; ensure a poller is draining the list
+        (append_to_pending, modules/common/hclib-module-common.h:92-115)."""
+        rt = current_runtime()
+        with self._lock:
+            self._ops.append(op)
+            spawn_poller = not self._poller_live and not self._use_idle_fn
+            if spawn_poller:
+                self._poller_live = True
+            if self._use_idle_fn and not self._idle_registered:
+                self._idle_registered = True
+                rt.register_idle_fn(lambda wid: self.sweep())
+        if spawn_poller:
+            # Escaping: the poller's lifetime is governed by the ops, not by
+            # whatever finish scope happened to issue the first op.
+            rt.spawn(self._poll_loop, locale=self.locale, escaping=True)
+        return op.promise.future if op.promise is not None else None
+
+    def sweep(self) -> bool:
+        """Test every pending op once; returns True if any completed."""
+        with self._lock:
+            ops = list(self._ops)
+        completed = []
+        for op in ops:
+            try:
+                done, result = op.test(op)
+            except BaseException as e:
+                done, result = True, e
+                if op.promise is not None:
+                    with self._lock:
+                        self._ops.remove(op)
+                    completed.append(op)
+                    op.promise.poison(e)
+                    continue
+            if done:
+                with self._lock:
+                    self._ops.remove(op)
+                completed.append(op)
+                if op.promise is not None:
+                    op.promise.put(result)
+        return bool(completed)
+
+    def _poll_loop(self) -> None:
+        """Poller body (poll_on_pending, modules/common/
+        hclib-module-common.h:10-90): sweep, yield at the locale, repeat;
+        exit when the list drains (re-spawned by the next append)."""
+        while True:
+            progressed = self.sweep()
+            with self._lock:
+                if not self._ops:
+                    self._poller_live = False
+                    return
+            ran = yield_(at=self.locale)
+            if not progressed and not ran:
+                # Nothing moved: back off briefly instead of burning a worker
+                # (the reference busy-yields; host Python should not).
+                time.sleep(0.0002)

@@ -1,0 +1,88 @@
+"""System module: host-memory locale types and handlers.
+
+Reference (modules/system/src/hclib_system.cpp:50-82): pre-init registers the
+CPU locale types (L1, L2, L3, sysmem); post-init registers malloc/free/
+memset/memcpy handlers for each so ``allocate_at``/``async_copy`` work on CPU
+locales; exposes ``get_closest_cpu_locale``.
+
+Host buffers are numpy arrays. ``alloc`` accepts either a byte count (the
+reference's malloc shape) or a (shape, dtype) pair, returning an array the
+caller mutates in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.locality import Locale
+from ..runtime.module import MAY_USE, Module, register_mem_fns
+from ..runtime.scheduler import current_runtime, current_worker
+
+__all__ = ["SystemModule", "get_closest_cpu_locale", "CPU_LOCALE_TYPES"]
+
+CPU_LOCALE_TYPES = ("L1", "L2", "L3", "sysmem", "host")
+
+
+def _host_alloc(spec: Any, locale: Locale, *, dtype=None) -> np.ndarray:
+    if isinstance(spec, (int, np.integer)):
+        return np.empty(int(spec), dtype=np.uint8 if dtype is None else dtype)
+    if isinstance(spec, tuple) and len(spec) == 2 and not isinstance(spec[0], int):
+        shape, dt = spec
+        return np.empty(shape, dtype=dt)
+    return np.empty(spec, dtype=np.float32 if dtype is None else dtype)
+
+
+def _host_free(buf: Any, locale: Locale) -> None:
+    return None  # numpy frees on GC; parity op so free_at() resolves
+
+
+def _host_memset(buf: np.ndarray, value: int, locale: Locale) -> np.ndarray:
+    buf.view(np.uint8).fill(value)
+    return buf
+
+
+def _host_copy(
+    dst: np.ndarray,
+    dst_locale: Locale,
+    src: Any,
+    src_locale: Locale,
+    nelems: Optional[int] = None,
+) -> np.ndarray:
+    s = np.asarray(src)
+    if nelems is None:
+        np.copyto(dst.reshape(-1), s.reshape(-1))
+    else:
+        dst.reshape(-1)[:nelems] = s.reshape(-1)[:nelems]
+    return dst
+
+
+class SystemModule(Module):
+    """Registers host locale types' memory handlers at post-init
+    (reference: modules/system/src/hclib_system.cpp:57-82)."""
+
+    name = "system"
+
+    def post_init(self, runtime) -> None:
+        for t in CPU_LOCALE_TYPES:
+            register_mem_fns(
+                t,
+                alloc=_host_alloc,
+                free=_host_free,
+                memset=_host_memset,
+                copy=_host_copy,
+                priority=MAY_USE,
+            )
+
+
+def get_closest_cpu_locale() -> Locale:
+    """Closest host-memory locale to the calling worker
+    (hclib::get_closest_cpu_locale)."""
+    rt = current_runtime()
+    w = max(current_worker(), 0)
+    for t in CPU_LOCALE_TYPES:
+        loc = rt.graph.closest_of_type(w, t)
+        if loc is not None:
+            return loc
+    return rt.graph.closest_locale(w)

@@ -1,0 +1,271 @@
+"""TPU module: device locales, memory handlers, stream-ordered async offload.
+
+This is the accelerator module - the role modules/cuda/ plays in the
+reference, re-designed for JAX:
+
+- Reference GPU locale metadata = device id + 64 round-robin streams
+  (modules/cuda/src/hclib_cuda.cpp:44-62,141-154). JAX dispatch is already
+  asynchronous, so a *stream* here is a sequencing token: ops issued on the
+  same stream are chained (each waits on the predecessor's completion future)
+  while different streams overlap. Each tpu locale gets a round-robin pool.
+- Reference memory handlers: cudaMalloc/cudaFree/cudaMemset + a MUST_USE copy
+  whose cudaMemcpyKind is chosen from the src/dst locale types
+  (modules/cuda/src/hclib_cuda.cpp:103-139,169-174). Here: device buffers are
+  jax.Arrays committed to the locale's device; copy direction resolves to
+  jax.device_put / np.asarray(device->host) / device-to-device device_put
+  (the ICI path between chips).
+- Reference kernel launch ``forasync_cuda`` = async at the GPU locale ->
+  launch on a stream -> cudaEvent completion poll -> future
+  (modules/cuda/inc/hclib_cuda.h:9-74). Here ``async_device`` runs a jitted
+  function on the locale's device; completion is polled via
+  jax.Array.is_ready() through the shared pending-op harness - the worker
+  never blocks in the dispatch task.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.locality import Locale
+from ..runtime.module import MUST_USE, Module, register_mem_fns
+from ..runtime.promise import Future, Promise
+from ..runtime.scheduler import async_, current_runtime, current_worker
+from .common import PendingList, PendingOp
+
+__all__ = [
+    "TpuModule",
+    "get_closest_tpu_locale",
+    "async_device",
+    "forasync_device",
+    "device_stream",
+    "NUM_STREAMS",
+]
+
+NUM_STREAMS = 64  # per-locale pool size, matching the reference's stream pool
+_DEVICE_TYPES = ("tpu", "hbm")
+
+
+class _Stream:
+    """Sequencing token: ops on one stream serialize, streams overlap."""
+
+    __slots__ = ("locale", "index", "_tail", "_lock")
+
+    def __init__(self, locale: Locale, index: int) -> None:
+        self.locale = locale
+        self.index = index
+        self._tail: Optional[Future] = None
+        self._lock = threading.Lock()
+
+    def chain(self) -> Tuple[Optional[Future], Promise]:
+        """Returns (predecessor future, this op's completion promise)."""
+        p = Promise()
+        with self._lock:
+            prev, self._tail = self._tail, p.future
+        return prev, p
+
+
+def _streams_for(locale: Locale) -> list:
+    pool = locale.metadata.get("streams")
+    if pool is None:
+        pool = [_Stream(locale, i) for i in range(NUM_STREAMS)]
+        locale.metadata["streams"] = pool
+        locale.metadata["next_stream"] = 0
+    return pool
+
+
+def device_stream(locale: Locale) -> _Stream:
+    """Round-robin stream from the locale's pool
+    (get_stream, modules/cuda/src/hclib_cuda.cpp:141-154)."""
+    pool = _streams_for(locale)
+    i = locale.metadata["next_stream"]
+    locale.metadata["next_stream"] = (i + 1) % len(pool)
+    return pool[i]
+
+
+def _device_of(locale: Locale):
+    dev = locale.metadata.get("device")
+    if dev is None:
+        raise ValueError(f"locale {locale.name!r} has no bound jax device")
+    return dev
+
+
+def _tpu_alloc(spec: Any, locale: Locale, *, dtype=None) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(spec, (int, np.integer)):
+        arr = jnp.zeros(int(spec), dtype=jnp.uint8 if dtype is None else dtype)
+    elif isinstance(spec, tuple) and len(spec) == 2 and not isinstance(spec[0], int):
+        shape, dt = spec
+        arr = jnp.zeros(shape, dtype=dt)
+    else:
+        arr = jnp.zeros(spec, dtype=jnp.float32 if dtype is None else dtype)
+    return jax.device_put(arr, _device_of(locale))
+
+
+def _tpu_free(buf: Any, locale: Locale) -> None:
+    try:
+        buf.delete()
+    except Exception:
+        pass
+
+
+def _tpu_memset(buf: Any, value: int, locale: Locale) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    flat = jnp.full(buf.shape, value, dtype=buf.dtype)
+    return jax.device_put(flat, _device_of(locale))
+
+
+def _is_device_type(t: str) -> bool:
+    return t in _DEVICE_TYPES
+
+
+def _tpu_copy(
+    dst: Any,
+    dst_locale: Locale,
+    src: Any,
+    src_locale: Locale,
+    nelems: Optional[int] = None,
+) -> Any:
+    """Direction chosen from locale types, the reference's cudaMemcpyKind
+    selection (modules/cuda/src/hclib_cuda.cpp:103-139). Device copies are
+    functional: the handler returns the new dst value (host numpy dsts are
+    mutated in place for parity with the system module)."""
+    import jax
+
+    s_dev = _is_device_type(src_locale.type)
+    d_dev = _is_device_type(dst_locale.type)
+    if d_dev:
+        # host->device or device->device (ICI when the devices differ).
+        out = jax.device_put(src, _device_of(dst_locale))
+        if nelems is not None:
+            out = out.reshape(-1)[:nelems]
+        return out
+    if s_dev:
+        host = np.asarray(src)  # device->host
+        if isinstance(dst, np.ndarray):
+            if nelems is None:
+                np.copyto(dst.reshape(-1), host.reshape(-1))
+            else:
+                dst.reshape(-1)[:nelems] = host.reshape(-1)[:nelems]
+            return dst
+        return host
+    raise ValueError("tpu copy handler invoked with no device-side locale")
+
+
+class TpuModule(Module):
+    """Binds jax devices to ``tpu`` locales and registers device memory
+    handlers (MUST_USE, so mixed host/device copies resolve to this module -
+    the reference registers its GPU copy MUST_USE for the same reason)."""
+
+    name = "tpu"
+
+    def __init__(self, devices: Optional[Sequence] = None) -> None:
+        self._devices = devices
+        self.pending = PendingList()
+
+    def pre_init(self, runtime) -> None:
+        import jax
+
+        devices = list(self._devices) if self._devices else jax.devices()
+        tpu_locales = runtime.graph.locales_of_type("tpu")
+        for i, loc in enumerate(tpu_locales):
+            if "device" not in loc.metadata:
+                loc.metadata["device"] = devices[i % len(devices)]
+        self.pending.locale = tpu_locales[0] if tpu_locales else None
+
+    def post_init(self, runtime) -> None:
+        for t in _DEVICE_TYPES:
+            register_mem_fns(
+                t,
+                alloc=_tpu_alloc,
+                free=_tpu_free,
+                memset=_tpu_memset,
+                copy=_tpu_copy,
+                priority=MUST_USE,
+            )
+
+
+def _active_module() -> TpuModule:
+    from ..runtime.module import registered_modules
+
+    for m in registered_modules():
+        if isinstance(m, TpuModule):
+            return m
+    raise RuntimeError("no TpuModule registered")
+
+
+def get_closest_tpu_locale() -> Locale:
+    """Closest tpu locale to the calling worker
+    (hclib::get_closest_gpu_locale, modules/cuda/inc/hclib_cuda.h)."""
+    rt = current_runtime()
+    loc = rt.graph.closest_of_type(max(current_worker(), 0), "tpu")
+    if loc is None:
+        raise RuntimeError("locality graph has no tpu locale (use mesh_locality_graph)")
+    return loc
+
+
+def async_device(
+    fn: Callable[..., Any],
+    *args: Any,
+    locale: Optional[Locale] = None,
+    stream: Optional[_Stream] = None,
+) -> Future:
+    """Dispatch ``fn(*args)`` on the locale's device; returns a future
+    satisfied with the result once the device computation lands
+    (forasync_cuda shape: async at locale -> launch on stream -> completion
+    poll -> future; modules/cuda/inc/hclib_cuda.h:9-74)."""
+    import jax
+
+    loc = locale if locale is not None else get_closest_tpu_locale()
+    st = stream if stream is not None else device_stream(loc)
+    prev, done = st.chain()
+    mod = _active_module()
+
+    def dispatch() -> None:
+        dev = _device_of(loc)
+        placed = [
+            jax.device_put(a, dev) if isinstance(a, (np.ndarray, jax.Array)) else a
+            for a in args
+        ]
+        out = fn(*placed)
+
+        def ready(op: PendingOp) -> Tuple[bool, Any]:
+            leaves = jax.tree_util.tree_leaves(op.data)
+            if all(l.is_ready() for l in leaves if hasattr(l, "is_ready")):
+                return True, op.data
+            return False, None
+
+        mod.pending.append(PendingOp(ready, promise=done, data=out))
+
+    async_(
+        dispatch,
+        at=loc,
+        await_=(prev,) if prev is not None else (),
+        non_blocking=True,
+    )
+    return done.future
+
+
+def forasync_device(
+    fn: Callable[..., Any],
+    n: int,
+    *args: Any,
+    locale: Optional[Locale] = None,
+) -> Future:
+    """Data-parallel device loop: one fused dispatch of ``vmap(fn)`` over
+    ``iota(n)`` - the reference launches a CUDA grid over the iteration space
+    (driver_kernel, modules/cuda/inc/hclib_cuda.h:76-127); on TPU the grid is
+    a vectorized program the XLA compiler tiles onto the VPU/MXU."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.arange(n)
+    return async_device(
+        lambda i, *rest: jax.vmap(lambda j: fn(j, *rest))(i), idx, *args, locale=locale
+    )

@@ -1,0 +1,92 @@
+"""Ranks: the TPU-native reinterpretation of the reference's PEs.
+
+The reference's comm modules address *processing elements* - MPI/SHMEM
+processes launched by mpirun, each owning its memory (modules/openshmem/src/
+hclib_openshmem.cpp:218-231 maps PEs to locales). JAX is single-controller:
+one Python process drives every device, across hosts when jax.distributed is
+initialized. So a *rank* here is a logical endpoint bound to (a) a mesh
+device when one is available - data lives in that device's HBM and "remote"
+access is a device-to-device ICI/DCN transfer - and (b) a locale in the
+runtime's locality graph, so tasks can be placed "at rank r" and serviced by
+the workers whose paths cover that locale.
+
+``World`` is the shared rank table used by the comm/oneside/am/pgas modules.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from ..runtime.locality import Locale
+from ..runtime.scheduler import current_runtime
+
+__all__ = ["World", "current_world", "set_world"]
+
+
+class World:
+    def __init__(
+        self,
+        n_ranks: int,
+        devices: Optional[Sequence] = None,
+        locales: Optional[Sequence[Locale]] = None,
+    ) -> None:
+        if n_ranks <= 0:
+            raise ValueError("world needs at least one rank")
+        self.size = n_ranks
+        self.devices: List = list(devices) if devices else []
+        if self.devices and len(self.devices) < n_ranks:
+            raise ValueError(f"world of {n_ranks} ranks given {len(self.devices)} devices")
+        self.locales: List[Optional[Locale]] = (
+            list(locales) if locales else [None] * n_ranks
+        )
+        if len(self.locales) < n_ranks:
+            raise ValueError("need one locale (or None) per rank")
+
+    def device_for(self, rank: int):
+        self._check(rank)
+        return self.devices[rank] if self.devices else None
+
+    def locale_for(self, rank: int) -> Optional[Locale]:
+        self._check(rank)
+        return self.locales[rank]
+
+    def _check(self, rank: int) -> None:
+        if not (0 <= rank < self.size):
+            raise ValueError(f"rank {rank} out of range [0, {self.size})")
+
+    @staticmethod
+    def from_runtime(runtime=None, devices: Optional[Sequence] = None) -> "World":
+        """Derive a world from the active runtime's locality graph: one rank
+        per ``tpu`` locale when the graph has them (mesh graphs,
+        parallel/mesh.py), else one rank per worker bound to its closest
+        locale (the default star graph)."""
+        rt = runtime if runtime is not None else current_runtime()
+        tpu_locales = rt.graph.locales_of_type("tpu")
+        if tpu_locales:
+            devs = devices or [l.metadata.get("device") for l in tpu_locales]
+            if any(d is None for d in devs):
+                devs = None
+            return World(len(tpu_locales), devs, tpu_locales)
+        locales = [rt.graph.closest_locale(w) for w in range(rt.nworkers)]
+        return World(rt.nworkers, devices, locales)
+
+
+_lock = threading.Lock()
+_world: Optional[World] = None
+
+
+def set_world(world: Optional[World]) -> Optional[World]:
+    global _world
+    with _lock:
+        prev, _world = _world, world
+    return prev
+
+
+def current_world() -> World:
+    """The active world; lazily derived from the runtime if unset."""
+    global _world
+    with _lock:
+        if _world is None:
+            _world = World.from_runtime()
+        return _world

@@ -1,0 +1,205 @@
+"""Module layer: pending-op harness, system module, tpu module.
+
+Mirrors the reference's module tests (modules/cuda/test/{kernel,allocate}.cu,
+modules/system usage in test/cpp/copies0.cpp) against the new API.
+"""
+
+import numpy as np
+import pytest
+
+import hclib_tpu as hc
+from hclib_tpu.modules import (
+    PendingList,
+    PendingOp,
+    SystemModule,
+    TpuModule,
+    World,
+    get_closest_cpu_locale,
+    get_closest_tpu_locale,
+    set_world,
+)
+from hclib_tpu.modules.tpu import async_device, device_stream, forasync_device
+from hclib_tpu.parallel.mesh import cpu_mesh, mesh_locality_graph
+
+
+@pytest.fixture(autouse=True)
+def _reset_world():
+    set_world(None)
+    yield
+    set_world(None)
+
+
+def test_pending_list_completion_polling():
+    """Ops complete when their test fires; promises deliver results."""
+
+    def body():
+        counters = {"a": 0, "b": 0}
+        pending = PendingList()
+
+        def make_test(key, threshold):
+            def test(op):
+                counters[key] += 1
+                if counters[key] >= threshold:
+                    return True, key.upper()
+                return False, None
+
+            return test
+
+        from hclib_tpu.runtime.promise import Promise
+
+        fa = pending.append(PendingOp(make_test("a", 3), promise=Promise()))
+        fb = pending.append(PendingOp(make_test("b", 5), promise=Promise()))
+        assert fa.wait() == "A"
+        assert fb.wait() == "B"
+        assert len(pending) == 0
+
+    hc.launch(body, nworkers=2)
+
+
+def test_pending_list_poison_propagates():
+    def body():
+        from hclib_tpu.runtime.promise import Promise, PromiseError
+
+        pending = PendingList()
+
+        def test(op):
+            raise ValueError("transport died")
+
+        f = pending.append(PendingOp(test, promise=Promise()))
+        with pytest.raises(PromiseError):
+            f.wait()
+
+    hc.launch(body, nworkers=2)
+
+
+def test_system_module_alloc_memset_copy():
+    hc.register_module(SystemModule())
+
+    def body():
+        loc = get_closest_cpu_locale()
+        buf = hc.allocate_at(((8,), np.float64), loc).wait()
+        assert buf.shape == (8,)
+        hc.memset_at(buf, 0, loc).wait()
+        assert np.all(buf == 0.0)
+        src = np.arange(8, dtype=np.float64)
+        hc.async_copy(buf, loc, src, loc).wait()
+        np.testing.assert_array_equal(buf, src)
+        hc.free_at(buf, loc).wait()
+
+    hc.launch(body, nworkers=2)
+
+
+def test_system_module_alloc_bytes():
+    hc.register_module(SystemModule())
+
+    def body():
+        loc = get_closest_cpu_locale()
+        buf = hc.allocate_at(64, loc).wait()
+        assert buf.nbytes == 64
+
+    hc.launch(body, nworkers=1)
+
+
+def _mesh_runtime_args(ndev=2, nworkers=2):
+    mesh = cpu_mesh(ndev)
+    return {"locality_graph": mesh_locality_graph(mesh, nworkers=nworkers)}
+
+
+def test_tpu_module_device_alloc_and_copies():
+    hc.register_module(SystemModule())
+    hc.register_module(TpuModule())
+
+    def body():
+        import jax
+
+        tloc = get_closest_tpu_locale()
+        hloc = get_closest_cpu_locale()
+        dbuf = hc.allocate_at(((4, 4), np.float32), tloc).wait()
+        assert isinstance(dbuf, jax.Array)
+        # host->device (MUST_USE beats the system handler)
+        src = np.full((4, 4), 3.0, dtype=np.float32)
+        dbuf = hc.async_copy(dbuf, tloc, src, hloc).wait()
+        # device->host
+        out = np.zeros((4, 4), dtype=np.float32)
+        hc.async_copy(out, hloc, dbuf, tloc).wait()
+        np.testing.assert_array_equal(out, src)
+
+    hc.launch(body, **_mesh_runtime_args())
+
+
+def test_tpu_module_device_to_device_copy():
+    hc.register_module(TpuModule())
+
+    def body():
+        rt = hc.current_runtime()
+        t0, t1 = rt.graph.locales_of_type("tpu")[:2]
+        a = hc.allocate_at(((8,), np.float32), t0).wait()
+        b = hc.async_copy(a, t1, a, t0).wait()
+        assert b.devices() == {t1.metadata["device"]}
+
+    hc.launch(body, **_mesh_runtime_args())
+
+
+def test_async_device_runs_on_locale_device():
+    hc.register_module(TpuModule())
+
+    def body():
+        import jax.numpy as jnp
+
+        tloc = get_closest_tpu_locale()
+        f = async_device(lambda x: jnp.sum(x * 2), np.arange(16, dtype=np.float32),
+                         locale=tloc)
+        assert float(f.wait()) == 240.0
+
+    hc.launch(body, **_mesh_runtime_args())
+
+
+def test_async_device_stream_ordering():
+    """Ops on one stream serialize; results observe program order."""
+    hc.register_module(TpuModule())
+
+    def body():
+        import jax.numpy as jnp
+
+        tloc = get_closest_tpu_locale()
+        st = device_stream(tloc)
+        futs = [
+            async_device(lambda x, k=k: x + k, np.zeros(4, np.float32),
+                         locale=tloc, stream=st)
+            for k in range(5)
+        ]
+        outs = [np.asarray(f.wait()) for f in futs]
+        for k, o in enumerate(outs):
+            np.testing.assert_array_equal(o, np.full(4, k, np.float32))
+
+    hc.launch(body, **_mesh_runtime_args())
+
+
+def test_forasync_device_vectorizes():
+    hc.register_module(TpuModule())
+
+    def body():
+        out = forasync_device(lambda i: i * i, 16).wait()
+        np.testing.assert_array_equal(np.asarray(out), np.arange(16) ** 2)
+
+    hc.launch(body, **_mesh_runtime_args())
+
+
+def test_world_from_mesh_graph():
+    def body():
+        w = World.from_runtime()
+        assert w.size == 2
+        assert w.locale_for(0).type == "tpu"
+        assert w.device_for(1) is not None
+
+    hc.launch(body, **_mesh_runtime_args())
+
+
+def test_world_from_default_graph():
+    def body():
+        w = World.from_runtime()
+        assert w.size == 3
+        assert w.device_for(0) is None
+        assert w.locale_for(2) is not None
+
+    hc.launch(body, nworkers=3)
