@@ -32,3 +32,7 @@ from .world import World, current_world, set_world  # noqa: F401
 from .common import PendingList, PendingOp  # noqa: F401
 from .system import SystemModule, get_closest_cpu_locale  # noqa: F401
 from .tpu import TpuModule, get_closest_tpu_locale  # noqa: F401
+from .comm import CommModule  # noqa: F401
+from .oneside import DistLock, OneSidedModule, SymArray, symm_array  # noqa: F401
+from .am import async_remote  # noqa: F401
+from .pgas import GlobalRef, SharedArray, async_after, remote_finish  # noqa: F401

@@ -1,0 +1,381 @@
+"""One-sided communication: symmetric heap, put/get, atomics, wait-sets,
+distributed locks, per-worker comm contexts.
+
+This module covers the roles of the reference's OpenSHMEM modules:
+
+- modules/openshmem/ - ~30 one-sided ops (put/get/AMO/collectives/locks)
+  wrapped as tasks at the NIC locale; **wait-sets**: shmem_int_wait_until
+  [_any] / async_when[_any] enqueue {var, cmp, value} sets onto a list polled
+  by a self-re-spawning task at the NIC locale
+  (modules/openshmem/src/hclib_openshmem.cpp:755-920); distributed locks
+  chained through promises per lock address (:124-134, 383-439).
+- modules/sos/ - per-worker communication *contexts* so puts/gets issue on
+  the calling worker's own channel instead of funneling through one NIC
+  worker (modules/sos/src/hclib_sos.cpp:156-255); quiet/barrier flush them.
+
+TPU-native redesign: the symmetric heap is a table of per-rank buffers -
+device-committed when the rank is device-bound (HBM; remote access = ICI
+transfer, the role SHMEM's RDMA plays), host numpy otherwise. Signal-driven
+tasks (wait_until/async_when) poll through the shared pending-op harness,
+which is exactly the reference's poll_on_waits loop; inside the device
+megakernel the same feature is the DDF flag-wait in the scheduler loop
+(device/megakernel.py). Atomics serialize through a per-variable host lock -
+the single-controller equivalent of the NIC's atomic engine.
+"""
+
+from __future__ import annotations
+
+import operator
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.locality import Locale
+from ..runtime.module import Module, add_per_worker_state, get_per_worker_state
+from ..runtime.promise import Future, Promise
+from ..runtime.scheduler import async_, current_runtime, current_worker
+from .common import PendingList, PendingOp
+from .world import World, current_world
+
+__all__ = [
+    "OneSidedModule",
+    "SymArray",
+    "symm_array",
+    "put",
+    "get",
+    "iput",
+    "iget",
+    "fetch_add",
+    "compare_swap",
+    "wait_until",
+    "wait_until_any",
+    "async_when",
+    "async_when_any",
+    "DistLock",
+    "quiet",
+    "my_context",
+]
+
+_CMP = {
+    "eq": operator.eq,
+    "ne": operator.ne,
+    "gt": operator.gt,
+    "ge": operator.ge,
+    "lt": operator.lt,
+    "le": operator.le,
+}
+
+
+class OneSidedModule(Module):
+    name = "oneside"
+
+    def __init__(self, world: Optional[World] = None) -> None:
+        self._world = world
+        self.locale: Optional[Locale] = None
+        self.pending = PendingList()
+        # Wait-sets are polled from the runtime idle loop as well as the
+        # poller task, so a fully busy machine still observes flag writes
+        # (reference: poll_on_waits re-spawns itself at the NIC locale).
+        self._ctx_slot: Optional[int] = None
+
+    def pre_init(self, runtime) -> None:
+        ici = runtime.graph.locales_of_type("ici")
+        self.locale = ici[0] if ici else runtime.graph.central_locale()
+        self.locale.mark_special("COMM")
+        self.pending.locale = self.locale
+        # Per-worker comm contexts (modules/sos/src/hclib_sos.cpp:156-255).
+        self._ctx_slot = add_per_worker_state(lambda wid: _CommContext(wid))
+
+    def world(self) -> World:
+        return self._world if self._world is not None else current_world()
+
+
+class _CommContext:
+    """Per-worker channel: tracks this worker's outstanding one-sided ops so
+    ``quiet()`` flushes only the caller's traffic (the sos contexts' point -
+    comm concurrency without funneling through one worker)."""
+
+    __slots__ = ("worker_id", "_lock", "outstanding")
+
+    def __init__(self, worker_id: int) -> None:
+        self.worker_id = worker_id
+        self._lock = threading.Lock()
+        self.outstanding: List[Future] = []
+
+    def track(self, fut: Future) -> Future:
+        with self._lock:
+            self.outstanding = [f for f in self.outstanding if not f.satisfied()]
+            self.outstanding.append(fut)
+        return fut
+
+    def drain(self) -> None:
+        with self._lock:
+            pending, self.outstanding = self.outstanding, []
+        for f in pending:
+            f.wait()
+
+
+def _active() -> OneSidedModule:
+    from ..runtime.module import registered_modules
+
+    for m in registered_modules():
+        if isinstance(m, OneSidedModule):
+            return m
+    raise RuntimeError("no OneSidedModule registered")
+
+
+def my_context() -> _CommContext:
+    """The calling worker's comm context (shmemx_ctx_t analogue,
+    modules/sos/src/hclib_sos.cpp:425-435)."""
+    mod = _active()
+    rt = current_runtime()
+    wid = max(current_worker(), 0)
+    return get_per_worker_state(rt, wid, mod._ctx_slot)
+
+
+def quiet() -> None:
+    """Flush the calling worker's outstanding one-sided ops
+    (shmem_quiet on the worker's context, modules/sos/src/hclib_sos.cpp:299-314)."""
+    my_context().drain()
+
+
+# ------------------------------------------------------------ symmetric heap
+
+
+class SymArray:
+    """A symmetric allocation: one buffer per rank, same shape/dtype.
+
+    Device-bound ranks hold committed jax arrays (HBM); host ranks hold
+    numpy. Mutation is serialized per (rank, array) through a lock - the
+    atomicity domain SHMEM gives AMOs on symmetric variables.
+    """
+
+    def __init__(self, world: World, shape, dtype, fill: Any = 0) -> None:
+        self.world = world
+        self.shape = tuple(np.atleast_1d(np.asarray(shape)).tolist()) if not isinstance(
+            shape, tuple
+        ) else shape
+        self.dtype = np.dtype(dtype)
+        self._locks = [threading.Lock() for _ in range(world.size)]
+        self._bufs: List[Any] = []
+        for r in range(world.size):
+            host = np.full(self.shape, fill, dtype=self.dtype)
+            self._bufs.append(self._commit(host, r))
+
+    def _commit(self, host: np.ndarray, rank: int) -> Any:
+        dev = self.world.device_for(rank)
+        if dev is None:
+            return host
+        import jax
+
+        return jax.device_put(host, dev)
+
+    def _read_host(self, rank: int) -> np.ndarray:
+        return np.asarray(self._bufs[rank])
+
+    def read(self, rank: int, index: Any = None) -> Any:
+        with self._locks[rank]:
+            h = self._read_host(rank)
+        return h if index is None else h[index]
+
+    def write(self, rank: int, value: Any, index: Any = None) -> None:
+        with self._locks[rank]:
+            h = self._read_host(rank).copy()
+            if index is None:
+                h[...] = value
+            else:
+                h[index] = value
+            self._bufs[rank] = self._commit(h, rank)
+
+    def rmw(self, rank: int, fn: Callable[[np.ndarray], Tuple[np.ndarray, Any]]) -> Any:
+        """Atomic read-modify-write on rank's buffer; fn returns (new, ret)."""
+        with self._locks[rank]:
+            h = self._read_host(rank).copy()
+            new, ret = fn(h)
+            self._bufs[rank] = self._commit(new, rank)
+        return ret
+
+    def buffer(self, rank: int) -> Any:
+        """The rank's current buffer (device array for device ranks)."""
+        return self._bufs[rank]
+
+
+def symm_array(shape, dtype=np.int32, fill: Any = 0, world: Optional[World] = None) -> SymArray:
+    """shmem_malloc analogue: symmetric across all ranks."""
+    w = world if world is not None else _active().world()
+    return SymArray(w, shape if isinstance(shape, tuple) else (int(shape),), dtype, fill)
+
+
+# ------------------------------------------------------------------- put/get
+
+
+def iput(arr: SymArray, rank: int, value: Any, index: Any = None) -> Future:
+    """Nonblocking put to ``rank``'s copy; future satisfied when committed
+    (shmem_putmem shape, modules/openshmem/src/hclib_openshmem.cpp:136-200)."""
+    mod = _active()
+    p = Promise()
+
+    def issue() -> None:
+        try:
+            arr.write(rank, value, index)
+            p.put(None)
+        except BaseException as e:
+            p.poison(e)
+
+    async_(issue, at=mod.locale, non_blocking=True, escaping=True)
+    return my_context().track(p.future)
+
+
+def iget(arr: SymArray, rank: int, index: Any = None) -> Future:
+    mod = _active()
+    p = Promise()
+
+    def issue() -> None:
+        try:
+            p.put(arr.read(rank, index))
+        except BaseException as e:
+            p.poison(e)
+
+    async_(issue, at=mod.locale, non_blocking=True, escaping=True)
+    return my_context().track(p.future)
+
+
+def put(arr: SymArray, rank: int, value: Any, index: Any = None) -> None:
+    iput(arr, rank, value, index).wait()
+
+
+def get(arr: SymArray, rank: int, index: Any = None) -> Any:
+    return iget(arr, rank, index).wait()
+
+
+# ------------------------------------------------------------------- atomics
+
+
+def fetch_add(arr: SymArray, rank: int, delta: Any, index: Any = 0) -> Any:
+    """shmem_int_fadd (modules/openshmem/src/hclib_openshmem.cpp AMO family):
+    returns the pre-add value."""
+
+    def fn(h: np.ndarray) -> Tuple[np.ndarray, Any]:
+        old = h[index].copy() if h.ndim else h.copy()
+        if h.ndim:
+            h[index] += delta
+        else:
+            h += delta
+        return h, old
+
+    return arr.rmw(rank, fn)
+
+
+def compare_swap(arr: SymArray, rank: int, expected: Any, desired: Any, index: Any = 0) -> Any:
+    """shmem_int_cswap: returns the observed value."""
+
+    def fn(h: np.ndarray) -> Tuple[np.ndarray, Any]:
+        old = h[index].copy()
+        if old == expected:
+            h[index] = desired
+        return h, old
+
+    return arr.rmw(rank, fn)
+
+
+# ----------------------------------------------------------------- wait-sets
+
+
+def _make_wait_test(
+    sets: Sequence[Tuple[SymArray, int, str, Any, Any]]
+) -> Callable[[PendingOp], Tuple[bool, Any]]:
+    """A wait-set entry is (arr, rank, cmp, value, index); satisfied when any
+    entry's comparison holds. Mirrors the reference's {var, cmp, value}[]
+    wait-sets (modules/openshmem/inc/hclib_openshmem-internal.h:109-167)."""
+
+    def test(op: PendingOp) -> Tuple[bool, Any]:
+        for i, (arr, rank, cmp, value, index) in enumerate(sets):
+            if _CMP[cmp](arr.read(rank, index), value):
+                return True, i
+        return False, None
+
+    return test
+
+
+def async_when(
+    arr: SymArray, cmp: str, value: Any, *, rank: int = 0, index: Any = 0
+) -> Future:
+    """Future satisfied when ``arr[rank][index] cmp value`` holds
+    (shmem_int_async_when, modules/openshmem/src/hclib_openshmem.cpp:895-920)."""
+    return async_when_any([(arr, rank, cmp, value, index)])
+
+
+def async_when_any(sets: Sequence[Tuple[SymArray, int, str, Any, Any]]) -> Future:
+    """Future satisfied with the index of the first matching entry."""
+    mod = _active()
+    p = Promise()
+    mod.pending.append(PendingOp(_make_wait_test(sets), promise=p))
+    return p.future
+
+
+def wait_until(arr: SymArray, cmp: str, value: Any, *, rank: int = 0, index: Any = 0) -> None:
+    """Blocking wait (shmem_int_wait_until): parks the calling context; the
+    polling happens at the COMM locale, not on this worker."""
+    async_when(arr, cmp, value, rank=rank, index=index).wait()
+
+
+def wait_until_any(sets: Sequence[Tuple[SymArray, int, str, Any, Any]]) -> int:
+    return async_when_any(sets).wait()
+
+
+# ---------------------------------------------------------------------- locks
+
+
+class DistLock:
+    """Distributed lock chained through promises.
+
+    Reference (modules/openshmem/src/hclib_openshmem.cpp:124-134, 383-439):
+    each lock address maps to a chain - an acquirer atomically swaps itself
+    in as the tail and waits on the previous holder's release promise; unlock
+    satisfies it. FIFO, no spinning.
+    """
+
+    _registry_lock = threading.Lock()
+    _registry: Dict[str, "DistLock"] = {}
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._tail: Optional[Promise] = None
+        self._holder_release: Optional[Promise] = None
+
+    @classmethod
+    def named(cls, name: str) -> "DistLock":
+        """Locks are identified by address in the reference; by name here."""
+        with cls._registry_lock:
+            lk = cls._registry.get(name)
+            if lk is None:
+                lk = cls._registry[name] = DistLock(name)
+            return lk
+
+    def lock(self) -> None:
+        my_release = Promise()
+        with self._lock:
+            prev, self._tail = self._tail, my_release
+        if prev is not None:
+            prev.future.wait()
+        self._holder_release = my_release
+
+    def unlock(self) -> None:
+        rel = self._holder_release
+        if rel is None:
+            raise RuntimeError("unlock without holding the lock")
+        self._holder_release = None
+        with self._lock:
+            if self._tail is rel:
+                self._tail = None  # no waiters: reset the chain
+        rel.put(None)
+
+    def __enter__(self) -> "DistLock":
+        self.lock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.unlock()
+        return False

@@ -86,9 +86,12 @@ def mesh_locality_graph(mesh: Mesh, nworkers: Optional[int] = None) -> LocalityG
         ici.reachable.append(t.id)
         locales.extend([t, h])
         tpu_ids.append(t.id)
-    pop_paths = [[tpu_ids[w % ndev], 0] for w in range(nworkers)]
+    # Every worker's paths cover the ici(COMM) locale so comm tasks are
+    # always serviced (the reference routes comm through workers whose paths
+    # include the NIC locale, modules/mpi/src/hclib_mpi.cpp:92).
+    pop_paths = [[tpu_ids[w % ndev], ici.id, 0] for w in range(nworkers)]
     steal_paths = [
-        [tpu_ids[(w + k) % ndev] for k in range(1, ndev + 1)] + [0]
+        [tpu_ids[(w + k) % ndev] for k in range(1, ndev + 1)] + [ici.id, 0]
         for w in range(nworkers)
     ]
     return LocalityGraph(nworkers, locales, pop_paths, steal_paths)
