@@ -13,6 +13,7 @@ from .locality import (
     generate_default_graph,
     load_locality_file,
 )
+from .instrument import EventLog, load_dump, register_event_type
 from .mem import allocate_at, async_copy, free_at, memset_at
 from .module import Module, register_module, unregister_all_modules
 from .promise import Future, Promise, PromiseError
@@ -33,3 +34,4 @@ from .scheduler import (
     yield_,
 )
 from .task import Task
+from .timer import IDLE, OVH, SEARCH, WORK, StateTimer

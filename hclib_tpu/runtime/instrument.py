@@ -1,0 +1,142 @@
+"""Binary event instrumentation.
+
+Reference design (src/hclib-instrument.c): per-thread double-buffered event
+arrays flushed via POSIX AIO to ``$HCLIB_DUMP_DIR/hclib.<ts>.dump/<tid>``;
+an event is {timestamp_ns, event_type, START/END transition, id}
+(inc/hclib-instrument.h:20-33); event types are registered by name and
+written to an ``event_types`` manifest. Notably the reference's recorder is
+stubbed out (src/hclib-instrument.c:211-252 returns -1) - scaffolding only.
+This implementation is live.
+
+Events are fixed-width records in a per-worker numpy ring (the double buffer:
+when a ring fills it is handed to a writer and a fresh one continues
+recording), dumped as raw little-endian binary plus a JSON manifest, with a
+reader (`load_dump`) so traces are usable in-process.
+
+Enable via ``Runtime(instrument=True)`` or env ``HCLIB_TPU_INSTRUMENT=1``;
+dump dir from ``HCLIB_TPU_DUMP_DIR`` (default ``.``), mirroring the
+reference's HCLIB_INSTRUMENT / HCLIB_DUMP_DIR envs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "EventLog",
+    "register_event_type",
+    "event_type_id",
+    "START",
+    "END",
+    "SINGLE",
+    "load_dump",
+]
+
+START = 0
+END = 1
+SINGLE = 2
+
+_EVENT_DTYPE = np.dtype(
+    [("ts_ns", "<u8"), ("type", "<u4"), ("transition", "<u4"), ("id", "<u8")]
+)
+
+_type_lock = threading.Lock()
+_type_names: List[str] = []
+_type_ids: Dict[str, int] = {}
+
+
+def register_event_type(name: str) -> int:
+    """Register (or look up) an event type by name; returns its id
+    (register_event_type, inc/hclib-instrument.h:53)."""
+    with _type_lock:
+        if name in _type_ids:
+            return _type_ids[name]
+        tid = len(_type_names)
+        _type_names.append(name)
+        _type_ids[name] = tid
+        return tid
+
+
+def event_type_id(name: str) -> Optional[int]:
+    with _type_lock:
+        return _type_ids.get(name)
+
+
+class _WorkerBuffer:
+    """Double-buffered event ring for one worker."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self.buf = np.zeros(capacity, dtype=_EVENT_DTYPE)
+        self.n = 0
+        self.full: List[np.ndarray] = []
+
+    def record(self, ts: int, type_: int, transition: int, eid: int) -> None:
+        if self.n == self.capacity:
+            self.full.append(self.buf)
+            self.buf = np.zeros(self.capacity, dtype=_EVENT_DTYPE)
+            self.n = 0
+        self.buf[self.n] = (ts, type_, transition, eid)
+        self.n += 1
+
+    def drain(self) -> np.ndarray:
+        parts = self.full + [self.buf[: self.n]]
+        self.full = []
+        self.n = 0
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+class EventLog:
+    """Per-worker event recording + binary dump."""
+
+    def __init__(self, nworkers: int, capacity: int = 1 << 16) -> None:
+        self.nworkers = nworkers
+        self._buffers = [_WorkerBuffer(capacity) for _ in range(nworkers)]
+        self._id_lock = threading.Lock()
+        self._next_id = 0
+
+    def new_id(self) -> int:
+        """Fresh correlation id for a START/END pair."""
+        with self._id_lock:
+            self._next_id += 1
+            return self._next_id
+
+    def record(self, worker_id: int, type_: int, transition: int = SINGLE,
+               eid: int = 0) -> None:
+        if 0 <= worker_id < self.nworkers:
+            self._buffers[worker_id].record(
+                time.monotonic_ns(), type_, transition, eid
+            )
+
+    def dump(self, directory: Optional[str] = None) -> str:
+        """Write ``hclib.<ts>.dump/<worker>`` binary files + manifest
+        (layout parity: src/hclib-instrument.c:50-83)."""
+        base = directory or os.environ.get("HCLIB_TPU_DUMP_DIR", ".")
+        path = os.path.join(base, f"hclib.{int(time.time() * 1000)}.dump")
+        os.makedirs(path, exist_ok=True)
+        with _type_lock:
+            names = list(_type_names)
+        with open(os.path.join(path, "event_types.json"), "w") as f:
+            json.dump({"event_types": names, "dtype": _EVENT_DTYPE.descr}, f)
+        for w, b in enumerate(self._buffers):
+            b.drain().tofile(os.path.join(path, str(w)))
+        return path
+
+
+def load_dump(path: str) -> Tuple[List[str], Dict[int, np.ndarray]]:
+    """Read a dump directory back: (event type names, worker -> events)."""
+    with open(os.path.join(path, "event_types.json")) as f:
+        manifest = json.load(f)
+    out: Dict[int, np.ndarray] = {}
+    for entry in os.listdir(path):
+        if entry.isdigit():
+            out[int(entry)] = np.fromfile(
+                os.path.join(path, entry), dtype=_EVENT_DTYPE
+            )
+    return manifest["event_types"], out

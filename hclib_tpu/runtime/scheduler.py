@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .deque import WSDeque
@@ -149,6 +150,9 @@ class Runtime:
         nworkers: Optional[int] = None,
         locality_graph: Optional[LocalityGraph] = None,
         stats: Optional[bool] = None,
+        instrument: Optional[bool] = None,
+        timer: Optional[bool] = None,
+        watchdog_s: Optional[float] = None,
     ) -> None:
         if nworkers is None:
             env = os.environ.get("HCLIB_TPU_WORKERS") or os.environ.get("HCLIB_WORKERS")
@@ -190,6 +194,32 @@ class Runtime:
         # Idle callbacks per locale (locale_register_idle_task,
         # src/hclib-locality-graph.c:807-827) - used by comm backends to poll.
         self._idle_fns: List[Callable[[int], bool]] = []
+        # Observability (SURVEY §5): event log, state timer, stall watchdog.
+        if instrument is None:
+            instrument = bool(
+                os.environ.get("HCLIB_TPU_INSTRUMENT")
+                or os.environ.get("HCLIB_INSTRUMENT")
+            )
+        if timer is None:
+            timer = bool(os.environ.get("HCLIB_TPU_TIMER"))
+        if watchdog_s is None:
+            env = os.environ.get("HCLIB_TPU_WATCHDOG_S")
+            watchdog_s = float(env) if env else 0.0
+        self.event_log = None
+        self._ev_task = None
+        if instrument:
+            from .instrument import EventLog, register_event_type
+
+            self.event_log = EventLog(nworkers)
+            self._ev_task = register_event_type("task")
+        self.state_timer = None
+        if timer:
+            from .timer import StateTimer
+
+            self.state_timer = StateTimer(nworkers)
+        self._watchdog_s = watchdog_s
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self.stall_reports = 0
 
     # ------------------------------------------------------------------ spawn
 
@@ -263,6 +293,11 @@ class Runtime:
                 return t
         # Steal path: scan every worker's deque at each locale, rotating the
         # starting victim (locale_steal_task: src/hclib-locality-graph.c:843-888).
+        st = self.state_timer
+        if st is not None:
+            from .timer import SEARCH
+
+            st.set_state(wid, SEARCH)
         start = self._last_steal[wid]
         for lid in self.graph.steal_paths[wid]:
             for i in range(self.nworkers):
@@ -286,6 +321,18 @@ class Runtime:
         prev_finish, prev_task = _tls.current_finish, _tls.current_task
         _tls.current_finish = task.finish
         _tls.current_task = task
+        wid = _tls.identity
+        ev, st = self.event_log, self.state_timer
+        eid = 0
+        if ev is not None and wid is not None:
+            from .instrument import START
+
+            eid = ev.new_id()
+            ev.record(wid, self._ev_task, START, eid)
+        if st is not None and wid is not None:
+            from .timer import WORK
+
+            st.set_state(wid, WORK)
         try:
             task.run()
         finally:
@@ -295,6 +342,14 @@ class Runtime:
             wid = _tls.identity
             if wid is not None:
                 self.worker_stats[wid].executed += 1
+                if ev is not None:
+                    from .instrument import END
+
+                    ev.record(wid, self._ev_task, END, eid)
+                if st is not None:
+                    from .timer import OVH
+
+                    st.set_state(wid, OVH)
 
     # ------------------------------------------------------------- work loop
 
@@ -393,13 +448,22 @@ class Runtime:
         if armed is None:
             return  # condition already satisfied
         wid = _tls.identity
+        st = self.state_timer
         if wid is not None:
             self.worker_stats[wid].parks += 1
+            if st is not None:
+                from .timer import IDLE
+
+                st.set_state(wid, IDLE)
             _tls.identity = None
             if self._idmgr.release(wid):
                 self._spawn_thread()
         armed.wait()
         _tls.identity = self._idmgr.acquire(priority=True)
+        if st is not None and _tls.identity is not None:
+            from .timer import OVH
+
+            st.set_state(_tls.identity, OVH)
 
     def _execute_recording(self, task: Task) -> None:
         """Execute a task, converting its exception into a recorded error
@@ -482,6 +546,38 @@ class Runtime:
         self._enqueue(task)  # put it back; a blocking task can't run on this stack
         return False
 
+    # ------------------------------------------------------------- watchdog
+
+    def _watchdog_main(self) -> None:
+        """Stall detector (SURVEY §5: the reference documents that help-first
+        blocking can deadlock, test/deadlock/README, but detects nothing).
+        If no task executes across a full interval while work is outstanding,
+        emit one diagnostic report per stall episode."""
+        import sys
+
+        last_executed = -1
+        reported = False
+        while not self._shutdown:
+            time.sleep(self._watchdog_s)
+            if self._shutdown:
+                return
+            executed = sum(st.executed for st in self.worker_stats)
+            outstanding = self.root_finish is not None and not self.root_finish.quiesced()
+            if executed == last_executed and outstanding:
+                if not reported:
+                    reported = True
+                    self.stall_reports += 1
+                    print(
+                        f"hclib_tpu watchdog: no task executed in "
+                        f"{self._watchdog_s:.1f}s with work outstanding "
+                        f"(executed={executed} backlog={self.backlog()} "
+                        f"pending={self._pending})\n{self.format_stats()}",
+                        file=sys.stderr,
+                    )
+            else:
+                reported = False
+            last_executed = executed
+
     # ------------------------------------------------------------ lifecycle
 
     def run(self, fn: Callable[..., Any], *args: Any) -> Any:
@@ -495,6 +591,11 @@ class Runtime:
         from .module import call_pre_init, call_post_init, call_finalize
 
         call_pre_init(self)
+        if self._watchdog_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_main, daemon=True, name="hclib-watchdog"
+            )
+            self._watchdog_thread.start()
         for _ in range(self.nworkers):
             self._spawn_thread()
         _tls.identity = self._idmgr.acquire(priority=True)
@@ -529,6 +630,16 @@ class Runtime:
             _tls.runtime = None
             if self.stats_enabled:
                 self.print_stats()
+            if self.state_timer is not None:
+                self.state_timer.finalize()
+            if self.event_log is not None and (
+                os.environ.get("HCLIB_TPU_INSTRUMENT")
+                or os.environ.get("HCLIB_INSTRUMENT")
+            ):
+                # Env-driven runs flush at finalize like the reference
+                # (src/hclib-runtime.c:1465); programmatic users call
+                # event_log.dump() with their own directory.
+                self.last_dump_path = self.event_log.dump()
         if err[0] is not None:
             raise err[0]
         if self._first_error is not None:
@@ -569,11 +680,19 @@ def launch(
     nworkers: Optional[int] = None,
     locality_graph: Optional[LocalityGraph] = None,
     stats: Optional[bool] = None,
+    instrument: Optional[bool] = None,
+    timer: Optional[bool] = None,
+    watchdog_s: Optional[float] = None,
 ) -> Any:
     """Run ``fn`` inside a fresh runtime; returns its result."""
-    return Runtime(nworkers=nworkers, locality_graph=locality_graph, stats=stats).run(
-        fn, *args
-    )
+    return Runtime(
+        nworkers=nworkers,
+        locality_graph=locality_graph,
+        stats=stats,
+        instrument=instrument,
+        timer=timer,
+        watchdog_s=watchdog_s,
+    ).run(fn, *args)
 
 
 def async_(

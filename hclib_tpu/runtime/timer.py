@@ -1,0 +1,71 @@
+"""Per-worker WORK/SEARCH/OVH/IDLE state timing.
+
+Reference (src/hclib-timer.c, inc/hclib-timer.h:17-37): a UTS-derived state
+machine, off by default (``_TIMER_ON_``); MARK_BUSY/OVH/SEARCH macros wrap
+the async path and the steal loop; ``hclib_get_avg_time`` reports per-state
+averages. Here states are recorded per worker with monotonic timestamps; the
+scheduler marks WORK around task execution, SEARCH around the steal scan,
+IDLE while parked/waiting, OVH otherwise.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+__all__ = ["StateTimer", "WORK", "SEARCH", "OVH", "IDLE", "STATE_NAMES"]
+
+WORK = 0
+SEARCH = 1
+OVH = 2
+IDLE = 3
+STATE_NAMES = ("WORK", "SEARCH", "OVH", "IDLE")
+
+
+class StateTimer:
+    """Accumulated nanoseconds per (worker, state)."""
+
+    def __init__(self, nworkers: int) -> None:
+        self.nworkers = nworkers
+        now = time.monotonic_ns()
+        self._state = [OVH] * nworkers
+        self._since = [now] * nworkers
+        self._accum = [[0] * len(STATE_NAMES) for _ in range(nworkers)]
+
+    def set_state(self, worker_id: int, state: int) -> int:
+        """Transition; returns the previous state (hclib_set_state,
+        inc/hclib-timer.h:31-37)."""
+        if not (0 <= worker_id < self.nworkers):
+            return OVH
+        now = time.monotonic_ns()
+        prev = self._state[worker_id]
+        self._accum[worker_id][prev] += now - self._since[worker_id]
+        self._state[worker_id] = state
+        self._since[worker_id] = now
+        return prev
+
+    def finalize(self) -> None:
+        for w in range(self.nworkers):
+            self.set_state(w, OVH)
+
+    def totals_ns(self) -> List[Dict[str, int]]:
+        return [
+            {STATE_NAMES[s]: acc[s] for s in range(len(STATE_NAMES))}
+            for acc in self._accum
+        ]
+
+    def avg_time_ns(self, state: int) -> float:
+        """Mean time in ``state`` across workers (hclib_get_avg_time)."""
+        tot = sum(acc[state] for acc in self._accum)
+        return tot / self.nworkers
+
+    def format(self) -> str:
+        lines = ["worker state times (ms):"]
+        for w, acc in enumerate(self._accum):
+            parts = " ".join(
+                f"{STATE_NAMES[s].lower()}={acc[s] / 1e6:.1f}"
+                for s in range(len(STATE_NAMES))
+            )
+            lines.append(f"  worker {w}: {parts}")
+        return "\n".join(lines)

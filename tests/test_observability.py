@@ -1,0 +1,125 @@
+"""Observability: event instrumentation, state timer, stats, watchdog.
+
+The reference's instrumentation recorder is stubbed (src/hclib-instrument.c:
+211-252); here it must actually record and round-trip.
+"""
+
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import hclib_tpu as hc
+from hclib_tpu.runtime.instrument import END, START, load_dump, register_event_type
+from hclib_tpu.runtime.timer import IDLE, SEARCH, WORK, StateTimer
+
+
+def test_event_log_records_and_dumps(tmp_path):
+    rt = hc.Runtime(nworkers=2, instrument=True)
+
+    def body():
+        with hc.finish():
+            for _ in range(10):
+                hc.async_(lambda: None)
+
+    rt.run(body)
+    path = rt.event_log.dump(str(tmp_path))
+    names, per_worker = load_dump(path)
+    assert "task" in names
+    events = np.concatenate(list(per_worker.values()))
+    starts = events[events["transition"] == START]
+    ends = events[events["transition"] == END]
+    # every executed task produced a START/END pair with matching ids
+    assert len(starts) >= 11 and len(ends) == len(starts)
+    assert set(starts["id"]) == set(ends["id"])
+    # timestamps are monotonic per worker
+    for w, ev in per_worker.items():
+        ts = ev["ts_ns"]
+        assert np.all(np.diff(ts) >= 0)
+
+
+def test_event_log_double_buffer_overflow(tmp_path):
+    from hclib_tpu.runtime.instrument import EventLog
+
+    log = EventLog(1, capacity=8)
+    t = register_event_type("x")
+    for i in range(30):
+        log.record(0, t, 2, i)
+    path = log.dump(str(tmp_path))
+    _, per_worker = load_dump(path)
+    assert len(per_worker[0]) == 30
+    assert list(per_worker[0]["id"]) == list(range(30))
+
+
+def test_custom_event_type_ids_stable():
+    a = register_event_type("my_phase")
+    b = register_event_type("my_phase")
+    assert a == b
+
+
+def test_state_timer_accumulates():
+    st = StateTimer(1)
+    st.set_state(0, WORK)
+    time.sleep(0.02)
+    st.set_state(0, IDLE)
+    time.sleep(0.01)
+    st.finalize()
+    totals = st.totals_ns()[0]
+    assert totals["WORK"] >= 15_000_000
+    assert totals["IDLE"] >= 5_000_000
+    assert st.avg_time_ns(WORK) == totals["WORK"]
+    assert "WORK".lower() in st.format().lower()
+
+
+def test_runtime_timer_marks_work_and_search():
+    rt = hc.Runtime(nworkers=2, timer=True)
+
+    def body():
+        with hc.finish():
+            for _ in range(20):
+                hc.async_(lambda: time.sleep(0.001))
+
+    rt.run(body)
+    totals = rt.state_timer.totals_ns()
+    assert sum(t["WORK"] for t in totals) > 0
+
+
+def test_watchdog_reports_stall(capsys):
+    """A task that sleeps while holding the only path to progress triggers
+    the stall report (the hazard test/deadlock/README documents)."""
+    rt = hc.Runtime(nworkers=1, watchdog_s=0.2)
+
+    def body():
+        time.sleep(0.7)  # outstanding work, no task transitions
+
+    rt.run(body)
+    assert rt.stall_reports >= 1
+    assert "watchdog" in capsys.readouterr().err
+
+
+def test_watchdog_quiet_on_healthy_run():
+    rt = hc.Runtime(nworkers=2, watchdog_s=5.0)
+
+    def body():
+        with hc.finish():
+            for _ in range(5):
+                hc.async_(lambda: None)
+
+    rt.run(body)
+    assert rt.stall_reports == 0
+
+
+def test_stats_format_contains_steals():
+    rt = hc.Runtime(nworkers=2, stats=False)
+
+    def body():
+        with hc.finish():
+            for _ in range(50):
+                hc.async_(lambda: time.sleep(0.0005))
+
+    rt.run(body)
+    text = rt.format_stats()
+    assert "executed=" in text and "steals=" in text
+    executed = sum(st.executed for st in rt.worker_stats)
+    assert executed >= 51
