@@ -1,0 +1,92 @@
+"""Task-parallel FFT (Cooley-Tukey).
+
+One of the reference's performance-regression apps (test/performance-
+regression/full-apps FFT; BASELINE.md row). Radix-2 decimation-in-time:
+each level spawns the even/odd half-transforms as tasks, switching to the
+vectorized leaf transform (np.fft) below a threshold; the butterfly combine
+is a vectorized twiddle multiply. Self-checks against np.fft.fft.
+
+The device-path analogue dispatches leaf transforms as tiles through
+``modules.tpu.async_device`` (XLA lowers jnp.fft.fft to the TPU's FFT
+fusion); ``run(device=True)`` exercises it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import hclib_tpu as hc
+
+__all__ = ["fft_par", "run"]
+
+
+def _fft_task(x: np.ndarray, out: np.ndarray, threshold: int) -> None:
+    n = len(x)
+    if n <= threshold:
+        out[:] = np.fft.fft(x)
+        return
+    half = n // 2
+    even_out = np.empty(half, dtype=np.complex128)
+    odd_out = np.empty(half, dtype=np.complex128)
+    with hc.finish():
+        hc.async_(_fft_task, x[0::2], even_out, threshold)
+        hc.async_(_fft_task, x[1::2], odd_out, threshold)
+    tw = np.exp(-2j * np.pi * np.arange(half) / n) * odd_out
+    out[:half] = even_out + tw
+    out[half:] = even_out - tw
+
+
+def fft_par(x: np.ndarray, threshold: int = 1 << 12) -> np.ndarray:
+    n = len(x)
+    if n & (n - 1):
+        raise ValueError("fft_par requires power-of-two length")
+    out = np.empty(n, dtype=np.complex128)
+    with hc.finish():
+        hc.async_(_fft_task, np.asarray(x, dtype=np.complex128), out, threshold)
+    return out
+
+
+def _fft_device(x: np.ndarray) -> np.ndarray:
+    """One fused device dispatch (jnp.fft.fft) via the tpu module."""
+    import jax.numpy as jnp
+
+    from ..modules.tpu import async_device
+
+    return np.asarray(async_device(jnp.fft.fft, x.astype(np.complex64)).wait())
+
+
+def run(n: int = 1 << 16, threshold: int = 1 << 12,
+        nworkers: Optional[int] = None, seed: int = 0,
+        device: bool = False) -> dict:
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    expect = np.fft.fft(x)
+    t0 = time.perf_counter()
+    if device:
+        from ..modules.tpu import TpuModule
+        from ..parallel.mesh import cpu_mesh, mesh_locality_graph
+        import jax
+
+        hc.register_module(TpuModule())
+        graph = mesh_locality_graph(cpu_mesh(len(jax.devices("cpu"))))
+        out = hc.launch(_fft_device, x, locality_graph=graph)
+        tol = 1e-2  # complex64 on device
+    else:
+        out = hc.launch(fft_par, x, threshold, nworkers=nworkers)
+        tol = 1e-8
+    dt = time.perf_counter() - t0
+    err = float(np.max(np.abs(out - expect)) / np.max(np.abs(expect)))
+    if err > tol:
+        raise AssertionError(f"fft mismatch: rel err {err}")
+    return {"n": n, "seconds": dt, "rel_err": err,
+            "points_per_sec": n / dt if dt > 0 else float("inf")}
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 16
+    print(run(n))

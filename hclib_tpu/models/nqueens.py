@@ -1,0 +1,73 @@
+"""N-Queens solution counting - irregular task recursion.
+
+One of the reference's performance-regression apps (test/performance-
+regression/full-apps, BOTS nqueens; baseline row in BASELINE.md). Each
+placement level spawns one task per safe column; counts accumulate through
+worker-local reducers (hclib_tpu.runtime.reducers - the reference's
+atomic_sum_t, inc/hclib_atomic.h:82-186) instead of a shared atomic.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+import hclib_tpu as hc
+
+__all__ = ["nqueens_count", "run", "KNOWN_COUNTS"]
+
+# Known solution counts for self-checking.
+KNOWN_COUNTS = {1: 1, 2: 0, 3: 0, 4: 2, 5: 10, 6: 4, 7: 40, 8: 92, 9: 352,
+                10: 724, 11: 2680, 12: 14200, 13: 73712}
+
+
+def _safe(cols: List[int], row: int, col: int) -> bool:
+    for r, c in enumerate(cols[:row]):
+        if c == col or abs(c - col) == row - r:
+            return False
+    return True
+
+
+def _count_seq(cols: List[int], row: int, n: int) -> int:
+    if row == n:
+        return 1
+    total = 0
+    for col in range(n):
+        if _safe(cols, row, col):
+            cols[row] = col
+            total += _count_seq(cols, row + 1, n)
+    return total
+
+
+def nqueens_count(n: int, cutoff: int = 3) -> int:
+    """Parallel count: spawn per safe column until ``cutoff`` levels deep,
+    then sequential search; sum via a worker-local reducer."""
+    total = hc.SumReducer(0)
+
+    def explore(cols: List[int], row: int) -> None:
+        if row >= cutoff:
+            total.add(_count_seq(list(cols), row, n))
+            return
+        for col in range(n):
+            if _safe(cols, row, col):
+                hc.async_(explore, cols[:row] + [col] + [0] * (n - row - 1), row + 1)
+
+    with hc.finish():
+        hc.async_(explore, [0] * n, 0)
+    return total.gather()
+
+
+def run(n: int = 8, cutoff: int = 3, nworkers=None) -> dict:
+    t0 = time.perf_counter()
+    value = hc.launch(nqueens_count, n, cutoff, nworkers=nworkers)
+    dt = time.perf_counter() - t0
+    if n in KNOWN_COUNTS and value != KNOWN_COUNTS[n]:
+        raise AssertionError(f"nqueens({n}) = {value}, expected {KNOWN_COUNTS[n]}")
+    return {"value": value, "seconds": dt, "n": n}
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    print(run(n))

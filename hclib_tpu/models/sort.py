@@ -1,0 +1,152 @@
+"""Parallel sorting: qsort and cilksort.
+
+Two of the reference's performance-regression apps (test/performance-
+regression/full-apps; BASELINE.md rows qsort/cilksort, BOTS-derived).
+
+- ``qsort_par``: quicksort - partition, spawn the two halves, sequential
+  (numpy introsort) below a threshold.
+- ``cilksort``: the classic cilksort - 4-way split mergesort whose merges
+  are themselves recursively parallel (binary-search split of the larger
+  run), so both the sort and the merge phases scale.
+
+Arrays are numpy; leaf sorts vectorize (np.sort is the "registered kernel"
+the tasks dispatch - the device analogue is a bitonic tile sort on the VPU).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import hclib_tpu as hc
+
+__all__ = ["qsort_par", "cilksort", "run"]
+
+
+# ---------------------------------------------------------------------- qsort
+
+
+def _qsort_range(a: np.ndarray, lo: int, hi: int, threshold: int) -> None:
+    while hi - lo > threshold:
+        # median-of-three pivot, Hoare partition
+        mid = (lo + hi) // 2
+        p = sorted((a[lo], a[mid], a[hi - 1]))[1]
+        i, j = lo, hi - 1
+        while i <= j:
+            while a[i] < p:
+                i += 1
+            while a[j] > p:
+                j -= 1
+            if i <= j:
+                a[i], a[j] = a[j], a[i]
+                i += 1
+                j -= 1
+        # Spawn the smaller side, iterate on the larger (bounded task depth).
+        if j + 1 - lo < hi - i:
+            hc.async_(_qsort_range, a, lo, j + 1, threshold)
+            lo = i
+        else:
+            hc.async_(_qsort_range, a, i, hi, threshold)
+            hi = j + 1
+    a[lo:hi] = np.sort(a[lo:hi], kind="quicksort")
+
+
+def qsort_par(a: np.ndarray, threshold: int = 4096) -> np.ndarray:
+    """In-place parallel quicksort under one finish scope."""
+    with hc.finish():
+        hc.async_(_qsort_range, a, 0, len(a), threshold)
+    return a
+
+
+# ------------------------------------------------------------------- cilksort
+
+
+def _merge_seq(src: np.ndarray, lo1: int, hi1: int, lo2: int, hi2: int,
+               dst: np.ndarray, out: int) -> None:
+    n1, n2 = hi1 - lo1, hi2 - lo2
+    merged = np.empty(n1 + n2, dtype=src.dtype)
+    a, b = src[lo1:hi1], src[lo2:hi2]
+    # vectorized two-run merge via searchsorted
+    pos_a = np.searchsorted(b, a, side="right") + np.arange(n1)
+    merged[pos_a] = a
+    mask = np.ones(n1 + n2, dtype=bool)
+    mask[pos_a] = False
+    merged[mask] = b
+    dst[out:out + n1 + n2] = merged
+
+
+def _merge_par(src: np.ndarray, lo1: int, hi1: int, lo2: int, hi2: int,
+               dst: np.ndarray, out: int, threshold: int) -> None:
+    """Parallel merge: split the larger run at its midpoint, binary-search
+    the split value in the other run, merge halves in parallel (cilksort's
+    cilkmerge shape)."""
+    if (hi1 - lo1) + (hi2 - lo2) <= threshold:
+        _merge_seq(src, lo1, hi1, lo2, hi2, dst, out)
+        return
+    if hi1 - lo1 < hi2 - lo2:
+        lo1, hi1, lo2, hi2 = lo2, hi2, lo1, hi1
+    mid1 = (lo1 + hi1) // 2
+    split = int(np.searchsorted(src[lo2:hi2], src[mid1])) + lo2
+    left_out = out
+    right_out = out + (mid1 - lo1) + (split - lo2)
+    hc.async_(_merge_par, src, lo1, mid1, lo2, split, dst, left_out, threshold)
+    hc.async_(_merge_par, src, mid1, hi1, split, hi2, dst, right_out, threshold)
+
+
+def _cilksort_range(a: np.ndarray, tmp: np.ndarray, lo: int, hi: int,
+                    threshold: int) -> None:
+    n = hi - lo
+    if n <= threshold:
+        a[lo:hi] = np.sort(a[lo:hi])
+        return
+    q = n // 4
+    cuts = [lo, lo + q, lo + 2 * q, lo + 3 * q, hi]
+    with hc.finish():
+        for i in range(4):
+            hc.async_(_cilksort_range, a, tmp, cuts[i], cuts[i + 1], threshold)
+    with hc.finish():
+        hc.async_(_merge_par, a, cuts[0], cuts[1], cuts[1], cuts[2], tmp, cuts[0],
+                  threshold)
+        hc.async_(_merge_par, a, cuts[2], cuts[3], cuts[3], cuts[4], tmp, cuts[2],
+                  threshold)
+    with hc.finish():
+        hc.async_(_merge_par, tmp, cuts[0], cuts[2], cuts[2], cuts[4], a, cuts[0],
+                  threshold)
+
+
+def cilksort(a: np.ndarray, threshold: int = 4096) -> np.ndarray:
+    tmp = np.empty_like(a)
+    with hc.finish():
+        hc.async_(_cilksort_range, a, tmp, 0, len(a), threshold)
+    return a
+
+
+# ----------------------------------------------------------------------- run
+
+
+def run(n: int = 1 << 20, variant: str = "cilksort", threshold: int = 4096,
+        nworkers: Optional[int] = None, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 1 << 30, size=n).astype(np.int64)
+    expect = np.sort(a.copy())
+    t0 = time.perf_counter()
+    if variant == "qsort":
+        hc.launch(qsort_par, a, threshold, nworkers=nworkers)
+    elif variant == "cilksort":
+        hc.launch(cilksort, a, threshold, nworkers=nworkers)
+    else:
+        raise ValueError(f"unknown sort variant {variant!r}")
+    dt = time.perf_counter() - t0
+    if not np.array_equal(a, expect):
+        raise AssertionError(f"{variant} produced an unsorted array")
+    return {"n": n, "seconds": dt, "keys_per_sec": n / dt if dt > 0 else float("inf")}
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    variant = sys.argv[2] if len(sys.argv) > 2 else "cilksort"
+    print(run(n, variant))

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Performance-regression harness.
+
+Reference design (test/performance-regression/full-apps/): driver scripts run
+each app N pinned trials with HCLIB_PROFILE_LAUNCH_BODY=1, record mean launch-
+body wall time per app into dated logs (regression-logs-*/<ts>.dat, one
+"<app> <mean ns>" line per app), and compare new runs against past logs.
+
+This harness runs the suite (fib, fib-ddt, nqueens, qsort, cilksort, FFT,
+UTS, Cholesky, Smith-Waterman - the BASELINE.md apps plus the BASELINE.json
+configs), writes ``perf-logs/<unix_ts>.json`` with per-app mean/min/std
+nanoseconds, and flags regressions against the most recent prior log.
+
+Usage:
+  python tools/perf_regression.py               # full sizes, 3 trials
+  python tools/perf_regression.py --quick       # tiny sizes (CI/smoke)
+  python tools/perf_regression.py --trials 5 --tolerance 0.2
+Exit code 1 if any app regressed beyond tolerance vs the previous log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _suite(quick: bool) -> List[Tuple[str, Callable[[], dict]]]:
+    from hclib_tpu.models import cholesky, fft, fib, nqueens, smithwaterman, sort, uts
+
+    if quick:
+        return [
+            ("fib", lambda: fib.run(18, "finish")),
+            ("fib-ddt", lambda: fib.run(18, "ddf")),
+            ("nqueens", lambda: nqueens.run(7)),
+            ("qsort", lambda: sort.run(1 << 14, "qsort")),
+            ("cilksort", lambda: sort.run(1 << 14, "cilksort")),
+            ("fft", lambda: fft.run(1 << 12, threshold=1 << 10)),
+            ("uts", lambda: uts.run(uts.T3)),
+            ("cholesky", lambda: cholesky.run(n=64, tile=32)),
+            ("smithwaterman", lambda: smithwaterman.run(m=128, n=128, tile=64)),
+        ]
+    return [
+        ("fib", lambda: fib.run(27, "finish")),
+        ("fib-ddt", lambda: fib.run(24, "ddf")),
+        ("nqueens", lambda: nqueens.run(11)),
+        ("qsort", lambda: sort.run(1 << 21, "qsort")),
+        ("cilksort", lambda: sort.run(1 << 21, "cilksort")),
+        ("fft", lambda: fft.run(1 << 18)),
+        ("uts", lambda: uts.run(uts.T1)),
+        ("cholesky", lambda: cholesky.run(n=512, tile=64)),
+        ("smithwaterman", lambda: smithwaterman.run(m=2048, n=2048, tile=256)),
+    ]
+
+
+def _latest_log(log_dir: str) -> Dict[str, dict]:
+    if not os.path.isdir(log_dir):
+        return {}
+    logs = sorted(f for f in os.listdir(log_dir) if f.endswith(".json"))
+    if not logs:
+        return {}
+    with open(os.path.join(log_dir, logs[-1])) as f:
+        return json.load(f).get("apps", {})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="tiny inputs (smoke)")
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed fractional slowdown vs previous log")
+    ap.add_argument("--log-dir", default=os.path.join(
+        os.path.dirname(__file__), "..", "perf-logs"))
+    ap.add_argument("--apps", default="", help="comma-separated subset")
+    args = ap.parse_args(argv)
+
+    wanted = {a for a in args.apps.split(",") if a}
+    prev = _latest_log(args.log_dir)
+    results: Dict[str, dict] = {}
+    failures: List[str] = []
+
+    for name, fn in _suite(args.quick):
+        if wanted and name not in wanted:
+            continue
+        times_ns = []
+        for _ in range(args.trials):
+            t0 = time.perf_counter_ns()
+            fn()  # each run() self-checks its result
+            times_ns.append(time.perf_counter_ns() - t0)
+        mean = sum(times_ns) / len(times_ns)
+        results[name] = {
+            "mean_ns": mean,
+            "min_ns": min(times_ns),
+            "trials": len(times_ns),
+        }
+        line = f"{name:15s} mean {mean / 1e6:10.2f} ms  min {min(times_ns) / 1e6:10.2f} ms"
+        if name in prev:
+            ratio = mean / prev[name]["mean_ns"]
+            line += f"  vs prev {ratio:5.2f}x"
+            if ratio > 1 + args.tolerance:
+                failures.append(f"{name}: {ratio:.2f}x slower than previous log")
+                line += "  REGRESSED"
+        print(line, flush=True)
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    out_path = os.path.join(args.log_dir, f"{int(time.time())}.json")
+    with open(out_path, "w") as f:
+        json.dump({"quick": args.quick, "apps": results}, f, indent=1)
+    print(f"log written: {out_path}")
+    if failures:
+        print("REGRESSIONS:\n  " + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
