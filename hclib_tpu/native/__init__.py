@@ -15,6 +15,16 @@ import os
 import subprocess
 from typing import Optional, Tuple
 
+def _csr(paths):
+    """Flatten per-worker locale paths to CSR (offsets, data) int arrays."""
+    off = [0]
+    data = []
+    for p in paths:
+        data.extend(int(x) for x in p)
+        off.append(len(data))
+    return (ctypes.c_int * len(off))(*off), (ctypes.c_int * max(1, len(data)))(*data)
+
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libhclib_native.so")
 _lib = None
@@ -22,6 +32,12 @@ _lib = None
 
 class NativeBuildError(RuntimeError):
     pass
+
+
+# Callback signatures crossing the ctypes boundary (tasks and loop bodies).
+TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+LOOP1_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_long)
+LOOP2_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_long, ctypes.c_long)
 
 
 def _build() -> None:
@@ -57,6 +73,54 @@ def load() -> ctypes.CDLL:
     lib.hcn_steals.argtypes = [ctypes.c_void_p]
     lib.hcn_fib.restype = ctypes.c_longlong
     lib.hcn_fib.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.hcn_fib_ddt.restype = ctypes.c_longlong
+    lib.hcn_fib_ddt.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.hcn_smithwaterman.restype = ctypes.c_int
+    lib.hcn_smithwaterman.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    pint = ctypes.POINTER(ctypes.c_int)
+    lib.hcn_create_graph.restype = ctypes.c_void_p
+    lib.hcn_create_graph.argtypes = [ctypes.c_int, ctypes.c_int, pint, pint, pint, pint]
+    lib.hcn_nlocales.restype = ctypes.c_int
+    lib.hcn_nlocales.argtypes = [ctypes.c_void_p]
+    lib.hcn_backlog.restype = ctypes.c_long
+    lib.hcn_backlog.argtypes = [ctypes.c_void_p]
+    lib.hcn_steal_matrix.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
+    ]
+    lib.hcn_format_stats.restype = ctypes.c_int
+    lib.hcn_format_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.hcn_finish_new.restype = ctypes.c_void_p
+    lib.hcn_finish_new.argtypes = [ctypes.c_void_p]
+    lib.hcn_finish_end.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.hcn_finish_end_nonblocking.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.hcn_finish_free.argtypes = [ctypes.c_void_p]
+    lib.hcn_async.argtypes = [
+        ctypes.c_void_p, TASK_FN, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+    ]
+    lib.hcn_yield.restype = ctypes.c_int
+    lib.hcn_yield.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.hcn_promise_new.restype = ctypes.c_void_p
+    lib.hcn_promise_new.argtypes = []
+    lib.hcn_promise_free.argtypes = [ctypes.c_void_p]
+    lib.hcn_promise_put.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.hcn_promise_get.restype = ctypes.c_void_p
+    lib.hcn_promise_get.argtypes = [ctypes.c_void_p]
+    lib.hcn_promise_satisfied.restype = ctypes.c_int
+    lib.hcn_promise_satisfied.argtypes = [ctypes.c_void_p]
+    lib.hcn_promise_wait.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.hcn_forasync1d.argtypes = [
+        ctypes.c_void_p, LOOP1_FN, ctypes.c_void_p,
+        ctypes.c_long, ctypes.c_long, ctypes.c_int,
+    ]
+    lib.hcn_forasync2d.argtypes = [
+        ctypes.c_void_p, LOOP2_FN, ctypes.c_void_p,
+        ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+    ]
     lib.hcn_uts.argtypes = [
         ctypes.c_void_p,
         ctypes.c_int,
@@ -79,14 +143,80 @@ def load() -> ctypes.CDLL:
     return lib
 
 
+class NativePromise:
+    """Handle to a native single-assignment promise. Values are machine
+    words (ints); the Python layer uses it for completion signalling and
+    small payloads."""
+
+    def __init__(self, rt: "NativeRuntime") -> None:
+        self._rt = rt
+        self._p = rt._lib.hcn_promise_new()
+
+    def put(self, value: int = 0) -> None:
+        self._rt._lib.hcn_promise_put(self._rt._handle, self._p, ctypes.c_void_p(value))
+
+    def get(self) -> int:
+        return int(self._rt._lib.hcn_promise_get(self._p) or 0)
+
+    @property
+    def satisfied(self) -> bool:
+        return bool(self._rt._lib.hcn_promise_satisfied(self._p))
+
+    def wait(self) -> int:
+        self._rt._lib.hcn_promise_wait(self._rt._handle, self._p)
+        return self.get()
+
+    def free(self) -> None:
+        if self._p is not None:
+            self._rt._lib.hcn_promise_free(self._p)
+            self._p = None
+
+
+class NativeFinish:
+    """Finish scope over the native runtime (blocking on exit)."""
+
+    def __init__(self, rt: "NativeRuntime") -> None:
+        self._rt = rt
+        self._f = rt._lib.hcn_finish_new(rt._handle)
+
+    def __enter__(self) -> "NativeFinish":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end()
+
+    def end(self) -> None:
+        if self._f is not None:
+            self._rt._lib.hcn_finish_end(self._rt._handle, self._f)
+            self._rt._lib.hcn_finish_free(self._f)
+            self._f = None
+
+    def end_nonblocking(self) -> NativePromise:
+        """Detach: returned promise is satisfied when the scope drains
+        (hclib_end_finish_nonblocking, src/hclib-runtime.c:1279-1313)."""
+        p = NativePromise(self._rt)
+        self._rt._lib.hcn_finish_end_nonblocking(self._rt._handle, self._f, p._p)
+        self._f = None  # detached; the runtime frees the scope on drain
+        return p
+
+
 class NativeRuntime:
     """RAII wrapper over the native scheduler."""
 
-    def __init__(self, nworkers: Optional[int] = None) -> None:
+    def __init__(self, nworkers: Optional[int] = None, graph=None) -> None:
         self._lib = load()
-        if nworkers is None:
-            nworkers = os.cpu_count() or 1
-        self._rt = self._lib.hcn_create(nworkers)
+        self._live: dict = {}  # id -> ctypes callback, kept alive until executed
+        if graph is not None:
+            nworkers = graph.nworkers
+            pop_off, pop_data = _csr([graph.pop_paths[w] for w in range(nworkers)])
+            st_off, st_data = _csr([graph.steal_paths[w] for w in range(nworkers)])
+            self._rt = self._lib.hcn_create_graph(
+                nworkers, len(graph.locales), pop_off, pop_data, st_off, st_data
+            )
+        else:
+            if nworkers is None:
+                nworkers = os.cpu_count() or 1
+            self._rt = self._lib.hcn_create(nworkers)
         self.nworkers = nworkers
 
     def close(self) -> None:
@@ -114,8 +244,101 @@ class NativeRuntime:
     def steals(self) -> int:
         return int(self._lib.hcn_steals(self._handle))
 
+    # -- tasking API ------------------------------------------------------
+
+    def promise(self) -> NativePromise:
+        return NativePromise(self)
+
+    def finish(self) -> NativeFinish:
+        return NativeFinish(self)
+
+    def async_(
+        self,
+        fn,
+        finish: Optional[NativeFinish] = None,
+        locale: int = 0,
+        deps=(),
+        non_blocking: bool = False,
+    ) -> None:
+        """Spawn a Python callable as a native task (worker threads call
+        back through ctypes, which re-acquires the GIL per task).
+
+        ``non_blocking`` is advisory parity metadata (reference async_nb):
+        this engine's work-shift model may inline any ready task, so the
+        flag does not change scheduling. Submissions from threads other
+        than runtime workers are routed through an injection queue; blocking
+        calls from such threads require nworkers >= 2 to make progress."""
+
+        cb_box = []
+
+        def tramp(_env):
+            try:
+                fn()
+            finally:
+                self._live.pop(id(cb_box[0]), None)
+
+        cb = TASK_FN(tramp)
+        cb_box.append(cb)
+        self._live[id(cb)] = cb
+        dep_arr = (
+            (ctypes.c_void_p * len(deps))(*[p._p for p in deps]) if deps else None
+        )
+        self._lib.hcn_async(
+            self._handle,
+            cb,
+            None,
+            finish._f if finish is not None else None,
+            locale,
+            dep_arr,
+            len(deps),
+            int(non_blocking),
+        )
+
+    def yield_(self, locale: int = -1) -> bool:
+        return bool(self._lib.hcn_yield(self._handle, locale))
+
+    def forasync1d(self, fn, n: int, tile: int = 0, recursive: bool = False) -> None:
+        cb = LOOP1_FN(lambda _env, i: fn(i))
+        self._lib.hcn_forasync1d(
+            self._handle, cb, None, n, tile, 1 if recursive else 0
+        )
+
+    def forasync2d(self, fn, n0: int, n1: int, tile0: int = 0, tile1: int = 0) -> None:
+        cb = LOOP2_FN(lambda _env, i, j: fn(i, j))
+        self._lib.hcn_forasync2d(self._handle, cb, None, n0, n1, tile0, tile1)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def nlocales(self) -> int:
+        return int(self._lib.hcn_nlocales(self._handle))
+
+    @property
+    def backlog(self) -> int:
+        return int(self._lib.hcn_backlog(self._handle))
+
+    def steal_matrix(self):
+        n = self.nworkers
+        buf = (ctypes.c_ulonglong * (n * n))()
+        self._lib.hcn_steal_matrix(self._handle, buf)
+        return [[int(buf[w * n + v]) for v in range(n)] for w in range(n)]
+
+    def format_stats(self) -> str:
+        n = self._lib.hcn_format_stats(self._handle, None, 0)
+        buf = ctypes.create_string_buffer(n + 1)
+        self._lib.hcn_format_stats(self._handle, buf, n + 1)
+        return buf.value.decode()
+
+    # -- native workloads -------------------------------------------------
+
     def fib(self, n: int) -> int:
         return int(self._lib.hcn_fib(self._handle, n))
+
+    def fib_ddt(self, n: int) -> int:
+        return int(self._lib.hcn_fib_ddt(self._handle, n))
+
+    def smithwaterman(self, nx: int, ny: int, ts: int, seed: int = 1) -> int:
+        return int(self._lib.hcn_smithwaterman(self._handle, nx, ny, ts, seed))
 
     def uts(self, shape: int, gen_mx: int, b0: float, seed: int) -> Tuple[int, int, int]:
         nodes = ctypes.c_ulonglong()

@@ -1,67 +1,274 @@
 // extern "C" API surface (ctypes boundary) + native benchmark workloads.
+//
+// The C ABI mirrors the reference's C API split (inc/hclib.h): runtime
+// lifecycle, async spawn with promise dependencies, finish scopes, promise
+// put/get/wait, forasync loops, yield, and stats introspection. Workloads
+// (fib, fib-ddt, UTS, arrayadd, Smith-Waterman wavefront) are the native
+// counterparts of the reference's test/ benchmark programs.
 
 #include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 
+#include "cppapi.hpp"
 #include "runtime.hpp"
 #include "sha1.hpp"
 
-using hcn::Finish;
+using hcn::FinishScope;
+using hcn::GraphSpec;
+using hcn::NPromise;
+using hcn::NTask;
 using hcn::Runtime;
-using hcn::Task;
 
 extern "C" {
 
+// ---------------------------------------------------------------- lifecycle
+
 void* hcn_create(int nworkers) { return new Runtime(nworkers); }
+
+// Locality-aware constructor: paths in CSR form (see GraphSpec).
+void* hcn_create_graph(int nworkers, int nlocales, const int* pop_off,
+                       const int* pop_data, const int* steal_off,
+                       const int* steal_data) {
+  GraphSpec g;
+  g.nlocales = nlocales;
+  g.pop_off.assign(pop_off, pop_off + nworkers + 1);
+  g.pop_data.assign(pop_data, pop_data + pop_off[nworkers]);
+  g.steal_off.assign(steal_off, steal_off + nworkers + 1);
+  g.steal_data.assign(steal_data, steal_data + steal_off[nworkers]);
+  return new Runtime(nworkers, std::move(g));
+}
+
 void hcn_destroy(void* rt) { delete static_cast<Runtime*>(rt); }
 int hcn_nworkers(void* rt) { return static_cast<Runtime*>(rt)->nworkers(); }
+int hcn_nlocales(void* rt) { return static_cast<Runtime*>(rt)->nlocales(); }
 unsigned long long hcn_executed(void* rt) {
   return static_cast<Runtime*>(rt)->total_executed();
 }
 unsigned long long hcn_steals(void* rt) {
   return static_cast<Runtime*>(rt)->total_steals();
 }
+long hcn_backlog(void* rt) {
+  return static_cast<long>(static_cast<Runtime*>(rt)->backlog());
+}
 
-// Generic task API for foreign (e.g. Python-callback) tasks.
+// Per-worker steal matrix: out[w * nworkers + v] = tasks w stole from v.
+void hcn_steal_matrix(void* rtp, unsigned long long* out) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  int n = rt->nworkers();
+  for (int w = 0; w < n; ++w) {
+    const auto& s = rt->worker_stats(w);
+    for (int v = 0; v < n; ++v) out[w * n + v] = s.stolen_from[v];
+  }
+}
+
+int hcn_format_stats(void* rtp, char* buf, int len) {
+  std::string s = static_cast<Runtime*>(rtp)->format_stats();
+  int n = static_cast<int>(s.size());
+  if (buf != nullptr && len > 0) {
+    int c = n < len - 1 ? n : len - 1;
+    std::memcpy(buf, s.data(), c);
+    buf[c] = '\0';
+  }
+  return n;
+}
+
+// ------------------------------------------------------------ task spawning
+
 void hcn_run_root(void* rt, void (*fn)(void*), void* env) {
   static_cast<Runtime*>(rt)->run_root(fn, env);
+}
+
+// Finish scope handles for foreign callers. Counter starts at 1 (owner token).
+void* hcn_finish_new(void* rtp) {
+  FinishScope* f = new FinishScope;
+  f->rt = static_cast<Runtime*>(rtp);
+  f->parent = f->rt->current_finish();
+  return f;
+}
+
+void hcn_finish_end(void* rtp, void* f) {
+  static_cast<Runtime*>(rtp)->end_finish(static_cast<FinishScope*>(f));
+}
+
+// Nonblocking end: promise `dep` is satisfied when the scope drains.
+void hcn_finish_end_nonblocking(void* rtp, void* f, void* dep) {
+  static_cast<Runtime*>(rtp)->end_finish_nonblocking(
+      static_cast<FinishScope*>(f), static_cast<NPromise*>(dep));
+}
+
+void hcn_finish_free(void* f) { delete static_cast<FinishScope*>(f); }
+
+// Spawn fn(env) under `finish` (nullable) at `locale`, blocked on `deps`.
+void hcn_async(void* rtp, void (*fn)(void*), void* env, void* finish,
+               int locale, void** deps, int ndeps, int non_blocking) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  NTask* t = new NTask;
+  t->fn = fn;
+  t->env = env;
+  t->finish = static_cast<FinishScope*>(finish);
+  t->locale = locale;
+  t->non_blocking = non_blocking != 0;
+  for (int i = 0; i < ndeps; ++i) {
+    t->add_dep(static_cast<NPromise*>(deps[i]));
+  }
+  rt->spawn(t);
+}
+
+int hcn_yield(void* rtp, int locale) {
+  return static_cast<Runtime*>(rtp)->yield(locale) ? 1 : 0;
+}
+
+// --------------------------------------------------------------- promises
+
+void* hcn_promise_new(void) { return new NPromise; }
+void hcn_promise_free(void* p) { delete static_cast<NPromise*>(p); }
+void hcn_promise_put(void* rtp, void* p, void* value) {
+  static_cast<Runtime*>(rtp)->promise_put(static_cast<NPromise*>(p), value);
+}
+void* hcn_promise_get(void* p) { return static_cast<NPromise*>(p)->get(); }
+int hcn_promise_satisfied(void* p) {
+  return static_cast<NPromise*>(p)->satisfied() ? 1 : 0;
+}
+void hcn_promise_wait(void* rtp, void* p) {
+  static_cast<Runtime*>(rtp)->future_wait(static_cast<NPromise*>(p));
+}
+
+// --------------------------------------------------------------- forasync
+// Blocking loop parallelism over an index callback; mode 0 = flat tiles,
+// 1 = recursive splitting (src/hclib.c:158-416).
+
+namespace {
+struct LoopRoot {
+  Runtime* rt;
+  void (*fn)(void*, long);
+  void* env;
+  long n, tile;
+  int mode;
+};
+
+void forasync1d_root(void* pv) {
+  LoopRoot* e = static_cast<LoopRoot*>(pv);
+  // Capture by value: spawned tiles run after this root task returns.
+  auto fn = e->fn;
+  auto env = e->env;
+  auto body = [fn, env](long i) { fn(env, i); };
+  hcn::forasync1d(e->n, body, e->tile,
+                  e->mode == 0 ? hcn::ForasyncMode::kFlat
+                               : hcn::ForasyncMode::kRecursive);
+  delete e;
+}
+
+struct Loop2Root {
+  Runtime* rt;
+  void (*fn)(void*, long, long);
+  void* env;
+  long n0, n1, tile0, tile1;
+};
+
+void forasync2d_root(void* pv) {
+  Loop2Root* e = static_cast<Loop2Root*>(pv);
+  auto fn = e->fn;
+  auto env = e->env;
+  auto body = [fn, env](long i, long j) { fn(env, i, j); };
+  hcn::forasync2d(e->n0, e->n1, body, e->tile0, e->tile1);
+  delete e;
+}
+}  // namespace
+
+void hcn_forasync1d(void* rtp, void (*fn)(void*, long), void* env, long n,
+                    long tile, int mode) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  rt->run_root(forasync1d_root, new LoopRoot{rt, fn, env, n, tile, mode});
+}
+
+void hcn_forasync2d(void* rtp, void (*fn)(void*, long, long), void* env,
+                    long n0, long n1, long tile0, long tile1) {
+  Runtime* rt = static_cast<Runtime*>(rtp);
+  rt->run_root(forasync2d_root,
+               new Loop2Root{rt, fn, env, n0, n1, tile0, tile1});
 }
 
 // ------------------------------------------------------------------ fib
 
 namespace {
-struct FibEnv {
-  Runtime* rt;
-  int n;
-  long long* out;
-};
-
-void fib_task(void* p) {
-  FibEnv* e = static_cast<FibEnv*>(p);
-  if (e->n < 2) {
-    *e->out = e->n;
-    delete e;
+void fib_rec(int n, long long* out) {
+  if (n < 2) {
+    *out = n;
     return;
   }
   long long a = 0, b = 0;
-  Finish f;
-  f.check_in();
-  e->rt->spawn({fib_task, new FibEnv{e->rt, e->n - 1, &a}, &f.counter});
-  f.check_in();
-  e->rt->spawn({fib_task, new FibEnv{e->rt, e->n - 2, &b}, &f.counter});
-  e->rt->help_until_zero(&f.counter);
-  *e->out = a + b;
+  hcn::finish([&] {
+    hcn::async([n, &a] { fib_rec(n - 1, &a); });
+    fib_rec(n - 2, &b);
+  });
+  *out = a + b;
+}
+
+struct FibRoot {
+  int n;
+  long long* out;
+};
+void fib_root(void* pv) {
+  FibRoot* e = static_cast<FibRoot*>(pv);
+  fib_rec(e->n, e->out);
   delete e;
 }
 }  // namespace
 
 long long hcn_fib(void* rtp, int n) {
-  Runtime* rt = static_cast<Runtime*>(rtp);
   long long result = 0;
-  FibEnv* root = new FibEnv{rt, n, &result};
-  rt->run_root(fib_task, root);
+  static_cast<Runtime*>(rtp)->run_root(fib_root, new FibRoot{n, &result});
+  return result;
+}
+
+// -------------------------------------------------------------- fib-ddt
+// Promise-based fib (reference workload test/misc/fib-ddt): every node puts
+// its value into a promise; join tasks await both child promises. Exercises
+// the DDF waiter-list machinery end to end.
+
+namespace {
+void fib_ddt_node(int n, NPromise* res) {
+  if (n < 2) {
+    Runtime::current()->promise_put(res, (void*)(intptr_t)n);
+    return;
+  }
+  NPromise* l = new NPromise;
+  NPromise* r = new NPromise;
+  hcn::async([n, l] { fib_ddt_node(n - 1, l); });
+  hcn::async([n, r] { fib_ddt_node(n - 2, r); });
+  hcn::async_await(
+      [l, r, res] {
+        intptr_t a = (intptr_t)l->get();
+        intptr_t b = (intptr_t)r->get();
+        Runtime::current()->promise_put(res, (void*)(a + b));
+        delete l;
+        delete r;
+      },
+      {l, r});
+}
+
+struct FibDdtRoot {
+  int n;
+  long long* out;
+};
+void fib_ddt_root(void* pv) {
+  FibDdtRoot* e = static_cast<FibDdtRoot*>(pv);
+  NPromise res;
+  fib_ddt_node(e->n, &res);
+  // The root finish drains every spawned task (including the final put)
+  // before run_root returns, so read after the implicit end-finish via a
+  // future-wait here (help-first inline execution).
+  Runtime::current()->future_wait(&res);
+  *e->out = (long long)(intptr_t)res.get();
+  delete e;
+}
+}  // namespace
+
+long long hcn_fib_ddt(void* rtp, int n) {
+  long long result = 0;
+  static_cast<Runtime*>(rtp)->run_root(fib_ddt_root, new FibDdtRoot{n, &result});
   return result;
 }
 
@@ -80,15 +287,6 @@ struct UtsParams {
   int shape;  // 0=LINEAR 1=EXPDEC 2=CYCLIC 3=FIXED
   int gen_mx;
   double b0;
-};
-
-struct UtsEnv {
-  Runtime* rt;
-  const UtsParams* params;
-  UtsCounters* counters;
-  uint8_t state[20];
-  int depth;
-  Finish* finish;  // tree-wide finish
 };
 
 int uts_num_children(const UtsParams& p, const uint8_t state[20], int depth) {
@@ -123,34 +321,46 @@ int uts_num_children(const UtsParams& p, const uint8_t state[20], int depth) {
   return n > 100 ? 100 : n;  // MAXNUMCHILDREN cap
 }
 
-void uts_task(void* pv) {
-  UtsEnv* e = static_cast<UtsEnv*>(pv);
-  e->counters->nodes.fetch_add(1, std::memory_order_relaxed);
-  int md = e->counters->max_depth.load(std::memory_order_relaxed);
-  while (e->depth > md &&
-         !e->counters->max_depth.compare_exchange_weak(md, e->depth)) {
+struct UtsNode {
+  const UtsParams* params;
+  UtsCounters* counters;
+  uint8_t state[20];
+  int depth;
+};
+
+void uts_visit(UtsNode node) {
+  node.counters->nodes.fetch_add(1, std::memory_order_relaxed);
+  int md = node.counters->max_depth.load(std::memory_order_relaxed);
+  while (node.depth > md &&
+         !node.counters->max_depth.compare_exchange_weak(md, node.depth)) {
   }
-  int nc = uts_num_children(*e->params, e->state, e->depth);
+  int nc = uts_num_children(*node.params, node.state, node.depth);
   if (nc == 0) {
-    e->counters->leaves.fetch_add(1, std::memory_order_relaxed);
+    node.counters->leaves.fetch_add(1, std::memory_order_relaxed);
+    return;
   }
   for (int i = 0; i < nc; ++i) {
-    UtsEnv* c = new UtsEnv;
-    c->rt = e->rt;
-    c->params = e->params;
-    c->counters = e->counters;
-    c->depth = e->depth + 1;
-    c->finish = e->finish;
+    UtsNode c;
+    c.params = node.params;
+    c.counters = node.counters;
+    c.depth = node.depth + 1;
     uint8_t msg[24];
-    std::memcpy(msg, e->state, 20);
+    std::memcpy(msg, node.state, 20);
     msg[20] = (i >> 24) & 0xff;
     msg[21] = (i >> 16) & 0xff;
     msg[22] = (i >> 8) & 0xff;
     msg[23] = i & 0xff;
-    hcn::sha1_single_block(msg, 24, c->state);
-    e->finish->check_in();
-    e->rt->spawn({uts_task, c, &e->finish->counter});
+    hcn::sha1_single_block(msg, 24, c.state);
+    hcn::async([c] { uts_visit(c); });
   }
+}
+
+struct UtsRoot {
+  UtsNode node;
+};
+void uts_root(void* pv) {
+  UtsRoot* e = static_cast<UtsRoot*>(pv);
+  uts_visit(e->node);
   delete e;
 }
 }  // namespace
@@ -161,22 +371,17 @@ void hcn_uts(void* rtp, int shape, int gen_mx, double b0, int seed,
   Runtime* rt = static_cast<Runtime*>(rtp);
   UtsParams params{shape, gen_mx, b0};
   UtsCounters counters;
-  Finish finish;
-  UtsEnv* root = new UtsEnv;
-  root->rt = rt;
-  root->params = &params;
-  root->counters = &counters;
-  root->depth = 0;
-  root->finish = &finish;
+  UtsRoot* root = new UtsRoot;
+  root->node.params = &params;
+  root->node.counters = &counters;
+  root->node.depth = 0;
   uint8_t msg[20] = {0};
   msg[16] = (seed >> 24) & 0xff;
   msg[17] = (seed >> 16) & 0xff;
   msg[18] = (seed >> 8) & 0xff;
   msg[19] = seed & 0xff;
-  hcn::sha1_single_block(msg, 20, root->state);
-  finish.check_in();
-  rt->spawn({uts_task, root, &finish.counter});
-  rt->help_until_zero(&finish.counter);
+  hcn::sha1_single_block(msg, 20, root->node.state);
+  rt->run_root(uts_root, root);
   *nodes = counters.nodes.load();
   *leaves = counters.leaves.load();
   *max_depth = counters.max_depth.load();
@@ -189,27 +394,114 @@ struct AddEnv {
   const double* a;
   const double* b;
   double* c;
-  long lo, hi;
+  long n, tile;
 };
 
-void add_task(void* pv) {
+void arrayadd_root(void* pv) {
   AddEnv* e = static_cast<AddEnv*>(pv);
-  for (long i = e->lo; i < e->hi; ++i) e->c[i] = e->a[i] + e->b[i];
+  const double* a = e->a;
+  const double* b = e->b;
+  double* c = e->c;
+  hcn::forasync1d(
+      e->n, [a, b, c](long i) { c[i] = a[i] + b[i]; }, e->tile);
   delete e;
 }
 }  // namespace
 
 void hcn_arrayadd(void* rtp, const double* a, const double* b, double* c,
                   long n, long tile) {
-  Runtime* rt = static_cast<Runtime*>(rtp);
   if (tile <= 0) tile = n > 0 ? n : 1;
-  Finish f;
-  for (long lo = 0; lo < n; lo += tile) {
-    long hi = lo + tile < n ? lo + tile : n;
-    f.check_in();
-    rt->spawn({add_task, new AddEnv{a, b, c, lo, hi}, &f.counter});
+  static_cast<Runtime*>(rtp)->run_root(arrayadd_root,
+                                       new AddEnv{a, b, c, n, tile});
+}
+
+// ------------------------------------------- Smith-Waterman tile wavefront
+// 2D DDF dependency grid: tile (i,j) awaits the promises of (i-1,j) and
+// (i,j-1) (the diagonal is transitively ordered), then fills its DP block
+// (reference workload: test/smithwaterman/smith_waterman.cpp:77-180).
+// Sequences are generated from a splitmix64 stream; affine-free scoring
+// (match +1 / mismatch -1 / gap -1), local alignment (floor at 0).
+
+namespace {
+struct SwGrid {
+  int nx, ny, ts;
+  std::vector<int32_t> h;     // (nx*ts+1) x (ny*ts+1) DP matrix
+  std::vector<uint8_t> seq_a;  // length nx*ts
+  std::vector<uint8_t> seq_b;  // length ny*ts
+  std::vector<NPromise> tile_done;  // nx*ny
+  std::atomic<int32_t> best{0};
+  int stride() const { return ny * ts + 1; }
+};
+
+uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+void sw_tile(SwGrid* g, int ti, int tj) {
+  const int ts = g->ts, stride = g->stride();
+  int32_t local_best = 0;
+  for (int i = ti * ts + 1; i <= (ti + 1) * ts; ++i) {
+    for (int j = tj * ts + 1; j <= (tj + 1) * ts; ++j) {
+      int s = g->seq_a[i - 1] == g->seq_b[j - 1] ? 1 : -1;
+      int32_t diag = g->h[(i - 1) * stride + (j - 1)] + s;
+      int32_t up = g->h[(i - 1) * stride + j] - 1;
+      int32_t left = g->h[i * stride + (j - 1)] - 1;
+      int32_t v = diag > up ? diag : up;
+      v = v > left ? v : left;
+      v = v > 0 ? v : 0;
+      g->h[i * stride + j] = v;
+      if (v > local_best) local_best = v;
+    }
   }
-  rt->help_until_zero(&f.counter);
+  int32_t cur = g->best.load(std::memory_order_relaxed);
+  while (local_best > cur &&
+         !g->best.compare_exchange_weak(cur, local_best)) {
+  }
+  Runtime::current()->promise_put(&g->tile_done[ti * g->ny + tj], nullptr);
+}
+
+struct SwRoot {
+  SwGrid* g;
+};
+
+void sw_root(void* pv) {
+  SwGrid* g = static_cast<SwRoot*>(pv)->g;
+  for (int i = 0; i < g->nx; ++i) {
+    for (int j = 0; j < g->ny; ++j) {
+      NPromise* up = i > 0 ? &g->tile_done[(i - 1) * g->ny + j] : nullptr;
+      NPromise* left = j > 0 ? &g->tile_done[i * g->ny + (j - 1)] : nullptr;
+      if (up != nullptr && left != nullptr) {
+        hcn::async_await([g, i, j] { sw_tile(g, i, j); }, {up, left});
+      } else if (up != nullptr) {
+        hcn::async_await([g, i, j] { sw_tile(g, i, j); }, {up});
+      } else if (left != nullptr) {
+        hcn::async_await([g, i, j] { sw_tile(g, i, j); }, {left});
+      } else {
+        hcn::async([g, i, j] { sw_tile(g, i, j); });
+      }
+    }
+  }
+  delete static_cast<SwRoot*>(pv);
+}
+}  // namespace
+
+int hcn_smithwaterman(void* rtp, int nx, int ny, int ts, int seed) {
+  SwGrid g;
+  g.nx = nx;
+  g.ny = ny;
+  g.ts = ts;
+  g.h.assign(size_t(nx * ts + 1) * (ny * ts + 1), 0);
+  g.seq_a.resize(size_t(nx) * ts);
+  g.seq_b.resize(size_t(ny) * ts);
+  g.tile_done = std::vector<NPromise>(size_t(nx) * ny);
+  uint64_t s = uint64_t(seed) * 2654435761ULL + 1;
+  for (auto& c : g.seq_a) c = uint8_t(splitmix64(s) & 3);
+  for (auto& c : g.seq_b) c = uint8_t(splitmix64(s) & 3);
+  static_cast<Runtime*>(rtp)->run_root(sw_root, new SwRoot{&g});
+  return int(g.best.load());
 }
 
 }  // extern "C"

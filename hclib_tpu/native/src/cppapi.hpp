@@ -1,0 +1,170 @@
+// C++ lambda API over the native runtime core - the analog of the
+// reference's header-template layer (inc/hclib-async.h lambda trampolines,
+// inc/hclib-forasync.h loop parallelism, inc/hclib_promise.h typed wrappers,
+// inc/hclib_cpp.h launch). Lambdas are heap-copied and dispatched through a
+// call-and-delete trampoline exactly as the reference's lambda_wrapper
+// (inc/hclib-async.h:64-149) - just with C++17 instead of C++11 idioms.
+
+#pragma once
+
+#include <algorithm>
+#include <utility>
+#include <vector>
+
+#include "runtime.hpp"
+
+namespace hcn {
+
+namespace detail {
+
+template <typename F>
+void call_lambda(void* env) {
+  F* f = static_cast<F*>(env);
+  (*f)();
+  delete f;
+}
+
+template <typename F>
+NTask* make_task(F&& body) {
+  using Fn = std::decay_t<F>;
+  NTask* t = new NTask;
+  t->fn = &call_lambda<Fn>;
+  t->env = new Fn(std::forward<F>(body));
+  return t;
+}
+
+}  // namespace detail
+
+// -- async variants (inc/hclib-async.h:162-547) ---------------------------
+
+template <typename F>
+void async(F&& body) {
+  Runtime* rt = Runtime::current();
+  NTask* t = detail::make_task(std::forward<F>(body));
+  t->finish = rt->current_finish();
+  rt->spawn(t);
+}
+
+template <typename F>
+void async_at(F&& body, int locale) {
+  Runtime* rt = Runtime::current();
+  NTask* t = detail::make_task(std::forward<F>(body));
+  t->finish = rt->current_finish();
+  t->locale = locale;
+  rt->spawn(t);
+}
+
+template <typename F>
+void async_await(F&& body, std::initializer_list<NPromise*> deps) {
+  Runtime* rt = Runtime::current();
+  NTask* t = detail::make_task(std::forward<F>(body));
+  t->finish = rt->current_finish();
+  for (NPromise* p : deps) t->add_dep(p);
+  rt->spawn(t);
+}
+
+// Wrap `body` in a promise-putting trampoline (hclib_async_future,
+// src/hclib.c:59-81). The caller owns the returned promise.
+template <typename F>
+NPromise* async_future(F&& body) {
+  NPromise* p = new NPromise;
+  async([p, b = std::decay_t<F>(std::forward<F>(body))]() mutable {
+    Runtime::current()->promise_put(p, b());
+  });
+  return p;
+}
+
+// -- finish (inc/hclib-async.h:550-563) -----------------------------------
+
+template <typename F>
+void finish(F&& body) {
+  Runtime* rt = Runtime::current();
+  FinishScope f;
+  f.rt = rt;
+  f.parent = rt->current_finish();
+  FinishScope* prev = rt->current_finish();
+  rt->set_current_finish(&f);
+  body();
+  rt->set_current_finish(prev);
+  rt->end_finish(&f);
+}
+
+// -- forasync (src/hclib.c:158-416, inc/hclib-forasync.h) -----------------
+// FLAT: one task per tile. RECURSIVE: binary splitting until <= tile.
+
+enum class ForasyncMode { kFlat, kRecursive };
+
+template <typename F>
+void forasync1d_flat(long n, long tile, F&& body) {
+  if (tile <= 0) tile = std::max<long>(1, n / Runtime::current()->nworkers());
+  for (long lo = 0; lo < n; lo += tile) {
+    long hi = std::min(lo + tile, n);
+    async([lo, hi, body]() {
+      for (long i = lo; i < hi; ++i) body(i);
+    });
+  }
+}
+
+template <typename F>
+void forasync1d_rec(long lo, long hi, long tile, const F& body) {
+  if (hi - lo <= tile) {
+    for (long i = lo; i < hi; ++i) body(i);
+    return;
+  }
+  long mid = lo + (hi - lo) / 2;
+  async([lo, mid, tile, body]() { forasync1d_rec(lo, mid, tile, body); });
+  forasync1d_rec(mid, hi, tile, body);
+}
+
+template <typename F>
+void forasync1d(long n, F&& body, long tile = 0,
+                ForasyncMode mode = ForasyncMode::kFlat) {
+  if (tile <= 0) tile = std::max<long>(1, n / Runtime::current()->nworkers());
+  if (mode == ForasyncMode::kFlat) {
+    forasync1d_flat(n, tile, std::forward<F>(body));
+  } else {
+    async([n, tile, b = std::decay_t<F>(std::forward<F>(body))]() {
+      forasync1d_rec(0, n, tile, b);
+    });
+  }
+}
+
+template <typename F>
+void forasync2d(long n0, long n1, F&& body, long tile0 = 0, long tile1 = 0) {
+  if (tile0 <= 0) tile0 = std::max<long>(1, n0 / Runtime::current()->nworkers());
+  if (tile1 <= 0) tile1 = n1;
+  for (long lo0 = 0; lo0 < n0; lo0 += tile0) {
+    long hi0 = std::min(lo0 + tile0, n0);
+    for (long lo1 = 0; lo1 < n1; lo1 += tile1) {
+      long hi1 = std::min(lo1 + tile1, n1);
+      async([lo0, hi0, lo1, hi1, body]() {
+        for (long i = lo0; i < hi0; ++i)
+          for (long j = lo1; j < hi1; ++j) body(i, j);
+      });
+    }
+  }
+}
+
+template <typename F>
+void forasync3d(long n0, long n1, long n2, F&& body, long tile0 = 0) {
+  if (tile0 <= 0) tile0 = std::max<long>(1, n0 / Runtime::current()->nworkers());
+  for (long lo0 = 0; lo0 < n0; lo0 += tile0) {
+    long hi0 = std::min(lo0 + tile0, n0);
+    async([hi0, lo0, n1, n2, body]() {
+      for (long i = lo0; i < hi0; ++i)
+        for (long j = 0; j < n1; ++j)
+          for (long k = 0; k < n2; ++k) body(i, j, k);
+    });
+  }
+}
+
+// -- launch (inc/hclib_cpp.h:29-47) ---------------------------------------
+
+template <typename F>
+void launch(Runtime* rt, F&& body) {
+  using Fn = std::decay_t<F>;
+  Fn* env = new Fn(std::forward<F>(body));
+  rt->run_root(&detail::call_lambda<Fn>, env);
+}
+
+}  // namespace hcn

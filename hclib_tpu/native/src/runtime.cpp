@@ -1,18 +1,38 @@
 #include "runtime.hpp"
 
-#include <chrono>
+#include <cstring>
+#include <sstream>
 
 namespace hcn {
 
 namespace {
 thread_local Runtime* g_runtime = nullptr;
 thread_local int g_worker = -1;
+thread_local FinishScope* g_finish = nullptr;
 }  // namespace
 
-Runtime::Runtime(int nworkers)
+Runtime* Runtime::current() { return g_runtime; }
+int Runtime::current_worker() { return g_worker >= 0 ? g_worker : 0; }
+FinishScope* Runtime::current_finish() { return g_finish; }
+void Runtime::set_current_finish(FinishScope* f) { g_finish = f; }
+
+void FinishScope::check_out() {
+  if (counter.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    NPromise* dep = finish_dep;
+    Runtime* r = rt;
+    if (self_delete) delete this;  // detached scope (end_finish_nonblocking)
+    if (dep != nullptr) r->promise_put(dep, nullptr);
+  }
+}
+
+Runtime::Runtime(int nworkers, GraphSpec graph)
     : nworkers_(nworkers < 1 ? 1 : nworkers),
-      deques_(nworkers_),
-      stats_(nworkers_) {
+      graph_(std::move(graph)),
+      last_steal_idx_(nworkers_, 0) {
+  if (graph_.pop_off.empty()) graph_ = GraphSpec::flat(nworkers_);
+  deques_ = std::vector<Deque>(size_t(graph_.nlocales) * nworkers_);
+  stats_ = std::vector<WorkerStats>(nworkers_);
+  for (auto& s : stats_) s.stolen_from.assign(nworkers_, 0);
   g_runtime = this;
   g_worker = 0;
   threads_.reserve(nworkers_ - 1);
@@ -30,41 +50,123 @@ Runtime::~Runtime() {
   for (auto& t : threads_) t.join();
   g_runtime = nullptr;
   g_worker = -1;
+  g_finish = nullptr;
 }
 
-void Runtime::spawn(Task t) {
-  int w = g_worker >= 0 ? g_worker : 0;
-  if (!deques_[w].push(t)) {
+// One-at-a-time dependency registration walk
+// (register_on_all_promise_dependencies, src/hclib-promise.c:171-195): park
+// on the *first* unsatisfied promise; its put() resumes the walk.
+bool Runtime::register_deps(NTask* t) {
+  while (t->dep_index < t->ndeps) {
+    NPromise* p = t->dep_at(t->dep_index);
+    t->dep_index += 1;
+    if (p != nullptr && p->register_waiter(t)) return false;  // parked
+  }
+  return true;
+}
+
+void Runtime::spawn(NTask* t) {
+  int w = current_worker();
+  ++stats_[w].spawned;
+  if (t->finish != nullptr) t->finish->check_in();
+  if (register_deps(t)) {
+    enqueue(t, w);
+  }
+}
+
+void Runtime::schedule(NTask* t) { enqueue(t, current_worker()); }
+
+void Runtime::enqueue(NTask* t, int wid) {
+  int locale = t->locale;
+  if (locale < 0 || locale >= graph_.nlocales) locale = 0;
+  // Owner-side Chase-Lev pushes are single-producer: submissions from
+  // foreign threads (not runtime workers) go through the injection queue.
+  if (g_runtime != this || g_worker < 0) {
+    std::lock_guard<std::mutex> lock(inject_mu_);
+    inject_.push_back(t);
+    inject_count_.fetch_add(1, std::memory_order_release);
+    return;
+  }
+  ++stats_[wid].scheduled;
+  if (!deque_at(locale, wid).push(t)) {
     // Deque full: run inline (the reference aborts,
-    // src/hclib-runtime.c:520-524; degrading to inline execution keeps
-    // deep spawn trees correct at some parallelism cost).
+    // src/hclib-runtime.c:520-524; degrading keeps deep trees correct).
     execute(t);
   }
 }
 
-bool Runtime::find_task(int wid, Task* out) {
-  if (deques_[wid].pop(out)) return true;
-  for (int i = 1; i <= nworkers_; ++i) {
-    int v = (wid + i) % nworkers_;
-    if (v == wid) continue;
-    if (deques_[v].steal(out)) {
-      ++stats_[wid].steals;
+void Runtime::promise_put(NPromise* p, void* value) {
+  p->datum.store(value, std::memory_order_release);
+  NTask* head = p->waiters.exchange(NPromise::closed_sentinel(),
+                                    std::memory_order_acq_rel);
+  // Publish `satisfied` only after the last touch of *p: a future_wait
+  // spinning on it may free the promise the moment this becomes true.
+  p->satisfied_.store(true, std::memory_order_release);
+  while (head != nullptr && head != NPromise::closed_sentinel()) {
+    NTask* next = head->next_waiter;
+    head->next_waiter = nullptr;
+    if (register_deps(head)) schedule(head);
+    head = next;
+  }
+}
+
+// Pop path over own deques, then steal path over victims' deques, rotating
+// the starting locale at the last successful steal and scanning victims
+// nearest-first (locale_pop_task / locale_steal_task,
+// src/hclib-locality-graph.c:774-805, :843-888).
+bool Runtime::find_task(int wid, NTask** out) {
+  for (int i = graph_.pop_off[wid]; i < graph_.pop_off[wid + 1]; ++i) {
+    if (deque_at(graph_.pop_data[i], wid).pop(out)) return true;
+  }
+  if (inject_count_.load(std::memory_order_acquire) > 0) {
+    std::lock_guard<std::mutex> lock(inject_mu_);
+    if (!inject_.empty()) {
+      *out = inject_.back();
+      inject_.pop_back();
+      inject_count_.fetch_sub(1, std::memory_order_release);
       return true;
+    }
+  }
+  int lo = graph_.steal_off[wid], hi = graph_.steal_off[wid + 1];
+  int n = hi - lo;
+  if (n <= 0) return false;
+  int start = last_steal_idx_[wid] % n;
+  for (int k = 0; k < n; ++k) {
+    int locale = graph_.steal_data[lo + (start + k) % n];
+    // Scan every worker's deque at this locale, own deque included: a
+    // steal-path locale may be outside this worker's pop path (e.g. a task
+    // pushed at a remote locale by this worker), and the reference's
+    // locale_steal_task likewise scans all deques of the locale
+    // (src/hclib-locality-graph.c:843-888).
+    for (int d = 0; d < nworkers_; ++d) {
+      int v = (wid + d) % nworkers_;
+      if (deque_at(locale, v).steal(out)) {
+        if (v != wid) {
+          ++stats_[wid].steals;
+          ++stats_[wid].stolen_from[v];
+        }
+        last_steal_idx_[wid] = (start + k) % n;
+        return true;
+      }
     }
   }
   return false;
 }
 
-void Runtime::execute(const Task& t) {
-  t.fn(t.env);
-  if (t.finish_counter)
-    t.finish_counter->fetch_sub(1, std::memory_order_release);
-  int w = g_worker >= 0 ? g_worker : 0;
+void Runtime::execute(NTask* t) {
+  int w = current_worker();
+  FinishScope* prev = g_finish;
+  g_finish = t->finish;
+  t->fn(t->env);
+  g_finish = prev;
+  if (t->finish != nullptr) t->finish->check_out();
   ++stats_[w].executed;
+  delete t->extra_deps;
+  delete t;
 }
 
 void Runtime::worker_loop(int wid) {
-  Task t;
+  NTask* t = nullptr;
   int idle_spins = 0;
   while (!shutdown_.load(std::memory_order_acquire)) {
     if (find_task(wid, &t)) {
@@ -76,10 +178,19 @@ void Runtime::worker_loop(int wid) {
   }
 }
 
-void Runtime::help_until_zero(std::atomic<int64_t>* counter) {
-  int wid = g_worker >= 0 ? g_worker : 0;
-  Task t;
-  while (counter->load(std::memory_order_acquire) != 0) {
+void Runtime::help_until(std::atomic<int64_t>* counter, int64_t target) {
+  // Foreign threads (not runtime workers) may not run find_task - the
+  // owner-side deque pop is single-consumer. They spin; the workers drain.
+  // (Requires nworkers >= 2 for foreign-thread blocking to make progress.)
+  if (g_runtime != this || g_worker < 0) {
+    while (counter->load(std::memory_order_acquire) != target) {
+      std::this_thread::yield();
+    }
+    return;
+  }
+  int wid = current_worker();
+  NTask* t = nullptr;
+  while (counter->load(std::memory_order_acquire) != target) {
     if (find_task(wid, &t)) {
       execute(t);
     } else {
@@ -88,11 +199,71 @@ void Runtime::help_until_zero(std::atomic<int64_t>* counter) {
   }
 }
 
-void Runtime::run_root(void (*fn)(void*), void* env) {
-  root_counter_.store(1, std::memory_order_relaxed);
-  Task t{fn, env, &root_counter_};
+// Help-first drain (help_finish, src/hclib-runtime.c:1067-1119, minus the
+// fiber swap): run ready tasks on this stack until only the owner's token
+// remains, then drop it.
+void Runtime::end_finish(FinishScope* f) {
+  ++stats_[current_worker()].end_finishes;
+  help_until(&f->counter, 1);
+  f->counter.store(0, std::memory_order_release);
+  if (f->finish_dep != nullptr) promise_put(f->finish_dep, nullptr);
+}
+
+void Runtime::end_finish_nonblocking(FinishScope* f, NPromise* dep) {
+  f->finish_dep = dep;
+  f->self_delete = true;  // detached: the final check_out frees the scope
+  f->check_out();         // drop the owner's token; last child (or this) puts
+}
+
+void Runtime::future_wait(NPromise* p) {
+  if (p->satisfied()) return;
+  if (g_runtime != this || g_worker < 0) {  // foreign thread: spin only
+    while (!p->satisfied()) std::this_thread::yield();
+    return;
+  }
+  ++stats_[current_worker()].future_waits;
+  int wid = current_worker();
+  NTask* t = nullptr;
+  while (!p->satisfied()) {
+    if (find_task(wid, &t)) {
+      execute(t);
+    } else {
+      std::this_thread::yield();
+    }
+  }
+}
+
+bool Runtime::yield(int locale) {
+  if (g_runtime != this || g_worker < 0) return false;  // foreign thread
+  int wid = current_worker();
+  ++stats_[wid].yields;
+  NTask* t = nullptr;
+  if (locale >= 0 && locale < graph_.nlocales) {
+    bool found = deque_at(locale, wid).pop(&t);
+    for (int d = 1; d <= nworkers_ && !found; ++d) {
+      int v = (wid + d) % nworkers_;
+      if (v != wid) found = deque_at(locale, v).steal(&t);
+    }
+    if (!found) t = nullptr;
+  } else if (!find_task(wid, &t)) {
+    t = nullptr;
+  }
+  if (t == nullptr) return false;
   execute(t);
-  help_until_zero(&root_counter_);
+  return true;
+}
+
+void Runtime::run_root(void (*fn)(void*), void* env) {
+  FinishScope root;
+  root.rt = this;
+  root.parent = nullptr;
+  NTask* t = new NTask;
+  t->fn = fn;
+  t->env = env;
+  t->finish = &root;
+  root.check_in();  // the root task itself
+  execute(t);
+  end_finish(&root);
 }
 
 uint64_t Runtime::total_executed() const {
@@ -105,6 +276,34 @@ uint64_t Runtime::total_steals() const {
   uint64_t n = 0;
   for (auto& s : stats_) n += s.steals;
   return n;
+}
+
+size_t Runtime::backlog() const {
+  size_t n = 0;
+  for (auto& d : deques_) n += d.size();
+  return n;
+}
+
+// Text dump in the spirit of hclib_print_runtime_stats
+// (src/hclib-runtime.c:1370-1410): per-worker counters + steal matrix.
+std::string Runtime::format_stats() const {
+  std::ostringstream os;
+  for (int w = 0; w < nworkers_; ++w) {
+    const WorkerStats& s = stats_[w];
+    os << "worker " << w << ": executed=" << s.executed
+       << " spawned=" << s.spawned << " scheduled=" << s.scheduled
+       << " steals=" << s.steals << " end_finishes=" << s.end_finishes
+       << " future_waits=" << s.future_waits << " yields=" << s.yields
+       << "\n";
+    if (s.steals > 0) {
+      os << "  stolen from:";
+      for (int v = 0; v < nworkers_; ++v) {
+        if (s.stolen_from[v] > 0) os << " w" << v << ":" << s.stolen_from[v];
+      }
+      os << "\n";
+    }
+  }
+  return os.str();
 }
 
 }  // namespace hcn

@@ -1,41 +1,131 @@
-// Native host runtime: work-stealing scheduler core.
+// Native host runtime: locality-aware work-stealing scheduler with
+// data-driven tasks (promises/futures) and finish scopes.
 //
 // A fresh C++17 implementation of the reference's scheduling model
-// (finish/async over per-worker Chase-Lev deques, help-first joins -
-// src/hclib-runtime.c, src/hclib-deque.c), designed for the role it plays in
-// this framework: the fast *host-side* execution engine that feeds/drains
-// TPU device queues and provides the measured CPU baseline. Differences from
-// the reference are deliberate:
-//  - no stackful fibers: a blocked finish help-first executes other tasks on
-//    the same stack (work-shift). All framework workloads are fork-join, so
-//    bounded stack growth is guaranteed by the spawn tree depth.
+// (finish/async over per-(locale,worker) Chase-Lev deques, help-first joins,
+// DDF promise waiter lists - src/hclib-runtime.c, src/hclib-deque.c,
+// src/hclib-promise.c, src/hclib-locality-graph.c), designed for the role it
+// plays in this framework: the fast *host-side* execution engine that feeds/
+// drains TPU device queues and provides the measured CPU baseline. Deliberate
+// differences from the reference:
+//  - no stackful fibers: a blocked end-finish / future-wait help-first
+//    executes other ready tasks on the same stack (work-shift), and
+//    dependency-blocked tasks are *descriptors* parked on promise waiter
+//    lists rather than suspended stacks. This is the same continuation model
+//    as the device megakernel (re-enqueueable descriptors), so host and
+//    device share one semantics.
 //  - deques are bounded lock-free Chase-Lev rings with C++11 atomics
 //    (acquire/release instead of x86-TSO assumptions + __sync builtins).
-//  - tasks are {function pointer, void* env} pairs; closures are arena-free
-//    (caller owns env lifetime until execution).
+//    On overflow the task runs inline (the reference aborts,
+//    src/hclib-runtime.c:520-524).
+//  - tasks are heap descriptors {fn, env, finish, deps[], locale}; the deque
+//    stores pointers.
 
 #pragma once
 
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
+#include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
 
 namespace hcn {
 
-struct Task {
+class Runtime;
+struct FinishScope;
+struct NPromise;
+
+// Task descriptor (reference: inc/hclib-task.h:32-44). `deps` mirrors
+// waiting_on[4] + waiting_on_extra; `dep_index` is the one-at-a-time
+// registration cursor (src/hclib-promise.c:171-195).
+struct NTask {
+  static constexpr int kInlineDeps = 4;
+
   void (*fn)(void*) = nullptr;
   void* env = nullptr;
-  std::atomic<int64_t>* finish_counter = nullptr;
+  FinishScope* finish = nullptr;
+  NPromise* deps[kInlineDeps] = {nullptr, nullptr, nullptr, nullptr};
+  std::vector<NPromise*>* extra_deps = nullptr;  // overflow beyond 4
+  uint32_t ndeps = 0;
+  uint32_t dep_index = 0;  // next unregistered dependency
+  int locale = 0;
+  // Advisory parity field (reference inc/hclib-task.h `non_blocking`): the
+  // reference uses it to allow inline execution on any context; this engine's
+  // work-shift model may inline any ready task, so the flag is metadata only.
+  bool non_blocking = false;
+  NTask* next_waiter = nullptr;  // promise waiter-list link
+
+  NPromise* dep_at(uint32_t i) const {
+    return i < kInlineDeps ? deps[i] : (*extra_deps)[i - kInlineDeps];
+  }
+
+  void add_dep(NPromise* p) {
+    if (ndeps < kInlineDeps) {
+      deps[ndeps] = p;
+    } else {
+      if (extra_deps == nullptr) extra_deps = new std::vector<NPromise*>;
+      extra_deps->push_back(p);
+    }
+    ++ndeps;
+  }
 };
 
-// Chase-Lev work-stealing deque (bounded ring). Owner pushes/pops at the
-// bottom; thieves CAS the top.
+// Single-assignment data-driven future (reference: inc/hclib-promise.h:76-90,
+// src/hclib-promise.c). `waiters` is a lock-free Treiber list of parked task
+// descriptors, closed with a sentinel by `put`.
+struct NPromise {
+  // Sentinel for "list closed, promise satisfied".
+  static NTask* closed_sentinel() {
+    return reinterpret_cast<NTask*>(uintptr_t(1));
+  }
+
+  std::atomic<void*> datum{nullptr};
+  std::atomic<bool> satisfied_{false};
+  std::atomic<NTask*> waiters{nullptr};
+
+  bool satisfied() const { return satisfied_.load(std::memory_order_acquire); }
+  void* get() const { return datum.load(std::memory_order_acquire); }
+
+  // CAS-push `t` onto the waiter list. Returns false if the promise was
+  // already satisfied (list closed) - the caller keeps walking its deps.
+  bool register_waiter(NTask* t) {
+    NTask* head = waiters.load(std::memory_order_acquire);
+    for (;;) {
+      if (head == closed_sentinel()) return false;
+      t->next_waiter = head;
+      if (waiters.compare_exchange_weak(head, t, std::memory_order_release,
+                                        std::memory_order_acquire)) {
+        return true;
+      }
+    }
+  }
+};
+
+// Finish scope (reference: src/inc/hclib-finish.h:6-10). Counter starts at 1
+// for the owning task (src/hclib-runtime.c:1219-1247); on reaching 0 the
+// optional `finish_dep` promise is satisfied, waking the continuation.
+struct FinishScope {
+  std::atomic<int64_t> counter{1};
+  FinishScope* parent = nullptr;
+  NPromise* finish_dep = nullptr;
+  Runtime* rt = nullptr;
+  // Set by end_finish_nonblocking: the scope outlives its creator, so the
+  // final check_out deletes it after satisfying finish_dep.
+  bool self_delete = false;
+
+  void check_in() { counter.fetch_add(1, std::memory_order_relaxed); }
+  void check_out();  // defined in runtime.cpp (needs Runtime::put)
+};
+
+// Chase-Lev work-stealing deque of task pointers (bounded ring). Owner
+// pushes/pops at the bottom; thieves CAS the top.
 class Deque {
  public:
-  static constexpr size_t kCapacity = 1 << 16;
+  static constexpr size_t kCapacity = 1 << 15;
 
-  bool push(const Task& t) {
+  bool push(NTask* t) {
     int64_t b = bottom_.load(std::memory_order_relaxed);
     int64_t tp = top_.load(std::memory_order_acquire);
     if (b - tp >= static_cast<int64_t>(kCapacity)) return false;  // full
@@ -45,7 +135,7 @@ class Deque {
     return true;
   }
 
-  bool pop(Task* out) {
+  bool pop(NTask** out) {
     int64_t b = bottom_.load(std::memory_order_relaxed) - 1;
     bottom_.store(b, std::memory_order_relaxed);
     std::atomic_thread_fence(std::memory_order_seq_cst);
@@ -56,8 +146,7 @@ class Deque {
     }
     *out = buf_[b & kMask];
     if (tp == b) {  // last element: race with thieves
-      if (!top_.compare_exchange_strong(tp, tp + 1,
-                                        std::memory_order_seq_cst,
+      if (!top_.compare_exchange_strong(tp, tp + 1, std::memory_order_seq_cst,
                                         std::memory_order_relaxed)) {
         bottom_.store(b + 1, std::memory_order_relaxed);
         return false;
@@ -67,12 +156,12 @@ class Deque {
     return true;
   }
 
-  bool steal(Task* out) {
+  bool steal(NTask** out) {
     int64_t tp = top_.load(std::memory_order_acquire);
     std::atomic_thread_fence(std::memory_order_seq_cst);
     int64_t b = bottom_.load(std::memory_order_acquire);
     if (tp >= b) return false;  // empty
-    Task t = buf_[tp & kMask];
+    NTask* t = buf_[tp & kMask];
     if (!top_.compare_exchange_strong(tp, tp + 1, std::memory_order_seq_cst,
                                       std::memory_order_relaxed)) {
       return false;  // lost the race
@@ -91,56 +180,125 @@ class Deque {
   static constexpr size_t kMask = kCapacity - 1;
   alignas(64) std::atomic<int64_t> top_{0};
   alignas(64) std::atomic<int64_t> bottom_{0};
-  std::vector<Task> buf_{kCapacity};
+  std::vector<NTask*> buf_{kCapacity};
 };
 
+// Flattened locality description (mirrors the Python LocalityGraph; see
+// runtime/locality.py and reference inc/hclib-locality-graph.h). Paths are
+// CSR-style: worker w's pop path is pop_data[pop_off[w] .. pop_off[w+1]).
+struct GraphSpec {
+  int nlocales = 1;
+  std::vector<int> pop_off, pop_data;      // own-deque drain order
+  std::vector<int> steal_off, steal_data;  // victim-scan order
+
+  static GraphSpec flat(int nworkers) {
+    GraphSpec g;
+    g.nlocales = 1;
+    for (int w = 0; w <= nworkers; ++w) {
+      g.pop_off.push_back(w);
+      g.steal_off.push_back(w);
+    }
+    g.pop_data.assign(nworkers, 0);
+    g.steal_data.assign(nworkers, 0);
+    return g;
+  }
+};
+
+// Per-worker counters (HCLIB_STATS analog, src/hclib-runtime.c:83-104),
+// including the per-victim steal matrix.
 struct WorkerStats {
   uint64_t executed = 0;
+  uint64_t spawned = 0;
+  uint64_t scheduled = 0;
   uint64_t steals = 0;
-  char pad[48];
+  uint64_t end_finishes = 0;
+  uint64_t future_waits = 0;
+  uint64_t yields = 0;
+  std::vector<uint64_t> stolen_from;  // [victim worker] -> count
+  char pad[64];
 };
 
 class Runtime {
  public:
-  explicit Runtime(int nworkers);
+  explicit Runtime(int nworkers, GraphSpec graph = GraphSpec{});
   ~Runtime();
 
   int nworkers() const { return nworkers_; }
+  int nlocales() const { return graph_.nlocales; }
 
-  // Spawn a task under the given finish counter (counter is pre-incremented
-  // by the caller via Finish::check_in).
-  void spawn(Task t);
+  // Thread-local context (reference: pthread_setspecific ws_key,
+  // src/hclib-runtime.c:151-193).
+  static Runtime* current();
+  static int current_worker();
+  FinishScope* current_finish();
+  void set_current_finish(FinishScope* f);
 
-  // Help-first drain: execute tasks until *counter reaches zero
-  // (help_finish, src/hclib-runtime.c:1067-1119 - minus the fiber swap).
-  void help_until_zero(std::atomic<int64_t>* counter);
+  // -- task creation ------------------------------------------------------
+  // Spawn under `t->finish` (check_in is done here). If the task has
+  // unsatisfied deps it parks on a promise waiter list; otherwise it is
+  // enqueued at its locale's deque for the calling worker.
+  void spawn(NTask* t);
+  // Make an eligible task runnable (promise put path; no check_in).
+  void schedule(NTask* t);
 
-  // Run fn(env) as the root task on the calling thread and drain everything.
+  // -- blocking operations (work-shift: execute other tasks inline) -------
+  void end_finish(FinishScope* f);
+  // Nonblocking end: attach `dep` as the finish continuation promise
+  // (hclib_end_finish_nonblocking, src/hclib-runtime.c:1279-1313).
+  void end_finish_nonblocking(FinishScope* f, NPromise* dep);
+  void future_wait(NPromise* p);
+  // Run up to one pending task inline and return (work-shift yield).
+  bool yield(int locale = -1);
+
+  // Run fn(env) as the root task on the calling thread under a fresh root
+  // finish, and drain it (hclib_launch shape, src/hclib-runtime.c:1460-1478).
   void run_root(void (*fn)(void*), void* env);
 
+  // Satisfy a promise: store datum, close the waiter list, re-run the
+  // registration walk for each parked task (src/hclib-promise.c:203-245).
+  void promise_put(NPromise* p, void* value);
+
+  // -- introspection ------------------------------------------------------
   uint64_t total_executed() const;
   uint64_t total_steals() const;
+  size_t backlog() const;
+  std::string format_stats() const;
+  const WorkerStats& worker_stats(int w) const { return stats_[w]; }
+
+  // Legacy simple-counter helpers (used by native workloads): drain tasks
+  // until *counter reaches `target`.
+  void help_until(std::atomic<int64_t>* counter, int64_t target);
 
  private:
-  friend struct WorkerMain;
+  friend struct FinishScope;
   void worker_loop(int wid);
-  bool find_task(int wid, Task* out);
-  void execute(const Task& t);
+  bool find_task(int wid, NTask** out);
+  void execute(NTask* t);
+  void enqueue(NTask* t, int wid);
+  // Resume the dependency-registration walk; returns true if the task is
+  // eligible to run (all deps satisfied), false if it parked on a promise.
+  bool register_deps(NTask* t);
+  Deque& deque_at(int locale, int worker) {
+    return deques_[size_t(locale) * nworkers_ + worker];
+  }
+  const Deque& deque_at(int locale, int worker) const {
+    return deques_[size_t(locale) * nworkers_ + worker];
+  }
 
   int nworkers_;
-  std::vector<Deque> deques_;
+  GraphSpec graph_;
+  std::vector<Deque> deques_;  // [locale][worker]
   std::vector<WorkerStats> stats_;
+  std::vector<int> last_steal_idx_;  // per-worker steal-path rotation
   std::vector<std::thread> threads_;
   std::atomic<bool> shutdown_{false};
-  std::atomic<int64_t> root_counter_{0};
-};
-
-// Finish scope: atomic counter of outstanding children. Spawners check_in
-// before spawn; the runtime decrements when the task completes (execute()),
-// so there is deliberately no public check_out.
-struct Finish {
-  std::atomic<int64_t> counter{0};
-  void check_in() { counter.fetch_add(1, std::memory_order_relaxed); }
+  // Injection queue for tasks submitted from threads that are not runtime
+  // workers (foreign Python threads): owner-side Chase-Lev pushes are
+  // single-producer, so foreign submissions go through this mutex-guarded
+  // queue, drained by workers in find_task.
+  std::mutex inject_mu_;
+  std::vector<NTask*> inject_;
+  std::atomic<size_t> inject_count_{0};
 };
 
 }  // namespace hcn

@@ -54,3 +54,136 @@ def test_native_stats(rt):
     before = rt.executed
     rt.fib(15)
     assert rt.executed > before
+
+
+def test_native_fib_ddt(rt):
+    # Promise-based fib (reference workload test/misc/fib-ddt): every join
+    # is an async_await on two child promises.
+    assert rt.fib_ddt(18) == 2584
+    assert rt.fib_ddt(2) == 1
+
+
+def _sw_python_reference(nx, ny, ts, seed):
+    """Replicates the native splitmix64 sequence generation + DP scoring."""
+    mask = (1 << 64) - 1
+
+    def gen(state, count):
+        out = []
+        s = state
+        for _ in range(count):
+            s = (s + 0x9E3779B97F4A7C15) & mask
+            z = s
+            z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+            z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+            out.append((z ^ (z >> 31)) & 3)
+        return out, s
+
+    s0 = (seed * 2654435761 + 1) & mask
+    a, s1 = gen(s0, nx * ts)
+    b, _ = gen(s1, ny * ts)
+    n, m = len(a), len(b)
+    prev = [0] * (m + 1)
+    best = 0
+    for i in range(1, n + 1):
+        cur = [0] * (m + 1)
+        for j in range(1, m + 1):
+            sc = 1 if a[i - 1] == b[j - 1] else -1
+            v = max(prev[j - 1] + sc, prev[j] - 1, cur[j - 1] - 1, 0)
+            cur[j] = v
+            if v > best:
+                best = v
+        prev = cur
+    return best
+
+
+def test_native_smithwaterman_matches_reference_dp(rt):
+    got = rt.smithwaterman(2, 2, 24, seed=5)
+    assert got == _sw_python_reference(2, 2, 24, 5)
+
+
+def test_native_smithwaterman_deterministic(rt):
+    a = rt.smithwaterman(4, 4, 32, seed=9)
+    b = rt.smithwaterman(4, 4, 32, seed=9)
+    assert a == b and a > 0
+
+
+def test_native_python_tasks_finish(rt):
+    import threading
+
+    hits = []
+    lock = threading.Lock()
+    with rt.finish() as f:
+        for i in range(50):
+            rt.async_(lambda i=i: (lock.acquire(), hits.append(i), lock.release()),
+                      finish=f)
+    assert sorted(hits) == list(range(50))
+
+
+def test_native_promise_dependencies(rt):
+    order = []
+    p1 = rt.promise()
+    p2 = rt.promise()
+    with rt.finish() as f:
+        rt.async_(lambda: order.append("dep"), finish=f, deps=(p1, p2))
+        rt.async_(lambda: (order.append("a"), p1.put(7)), finish=f)
+        rt.async_(lambda: (order.append("b"), p2.put(9)), finish=f)
+    assert order[-1] == "dep" and set(order) == {"a", "b", "dep"}
+    assert p1.wait() == 7 and p2.get() == 9
+    p1.free()
+    p2.free()
+
+
+def test_native_end_finish_nonblocking(rt):
+    import time
+
+    done = []
+    f = rt.finish()
+    rt.async_(lambda: (time.sleep(0.01), done.append(1)), finish=f)
+    p = f.end_nonblocking()
+    assert p.wait() == 0  # promise satisfied once the scope drains
+    assert done == [1]
+
+
+def test_native_forasync(rt):
+    n = 1000
+    out = [0] * n
+    rt.forasync1d(lambda i: out.__setitem__(i, i * 2), n, tile=64)
+    assert out == [2 * i for i in range(n)]
+    grid = [[0] * 8 for _ in range(8)]
+    rt.forasync2d(lambda i, j: grid[i].__setitem__(j, i + j), 8, 8, 2, 2)
+    assert grid == [[i + j for j in range(8)] for i in range(8)]
+
+
+def test_native_forasync_recursive(rt):
+    n = 513
+    out = [0] * n
+    rt.forasync1d(lambda i: out.__setitem__(i, i + 1), n, tile=32, recursive=True)
+    assert out == [i + 1 for i in range(n)]
+
+
+def test_native_locality_graph():
+    from hclib_tpu.native import NativeRuntime
+    from hclib_tpu.runtime.locality import generate_default_graph
+
+    g = generate_default_graph(2)
+    with NativeRuntime(graph=g) as rt:
+        assert rt.nlocales == len(g.locales)
+        assert rt.fib(15) == 610
+        # Spawn at a non-default locale; a worker whose steal path covers it
+        # must pick it up.
+        hits = []
+        with rt.finish() as f:
+            rt.async_(lambda: hits.append(1), finish=f, locale=2)
+        assert hits == [1]
+        sm = rt.steal_matrix()
+        assert len(sm) == 2 and len(sm[0]) == 2
+        assert "executed=" in rt.format_stats()
+
+
+def test_native_yield(rt):
+    ran = []
+    with rt.finish() as f:
+        rt.async_(lambda: ran.append(1), finish=f)
+        # Give the spawned task a chance to be picked up by the main thread.
+        rt.yield_()
+    assert ran == [1]
