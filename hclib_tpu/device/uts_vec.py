@@ -2,21 +2,35 @@
 
 The TPU-first re-design of UTS (reference workload: test/uts): instead of
 one task per node (scalar megakernel) or one pthread per worker (C++ core),
-1024 SIMD lanes each run an independent DFS over their own subtrees, with
-every per-node operation vectorized across the (8, 128) VPU shape:
+thousands of SIMD lanes each run an independent DFS, with every per-node
+operation vectorized across (rows, 128) VPU planes:
 
 - SHA-1 (the UTS splittable RNG) is computed for all lanes' current children
-  simultaneously - ~1.3k u32 plane-ops per step hash up to 1024 nodes.
+  simultaneously - ~1.3k u32 plane-ops per step hash one child per lane.
 - Each lane's DFS stack is a set of (state, next-child, count, depth) planes
   indexed by a per-lane stack pointer; stack reads/writes are select loops
   over the (small, static) stack height - no gathers, no dynamic indexing.
+  Tail-call scheduling (a frame expanding its last non-leaf child is
+  *replaced* by that child; a last leaf child pops immediately) keeps every
+  stack frame expandable, so every active step performs an expansion - the
+  classic DFS pop-the-exhausted-frame steps, ~20% of all steps on canonical
+  trees, are eliminated.
+- **Dynamic load balancing via a shared root queue**: the host seeds a flat
+  array of subtree roots (all at one BFS depth d0); every step, lanes whose
+  stack emptied claim the next unclaimed roots with a prefix-sum over the
+  done mask + a gather from the root arrays. Imbalance is therefore bounded
+  by the size of a single subtree instead of the sum of a lane's static
+  deal - this is the work-stealing idea of the reference scheduler
+  (src/hclib-deque.c) recast as a data-parallel claim, and it is what makes
+  lane efficiency scale.
 - Child counts are *exact*: the host binary-searches (in f64, matching the
   scalar implementations bit-for-bit) the integer thresholds t_k = min{r :
   floor(log(1-r/2^31)/log(1-p)) >= k}, and the device counts children as
   #(r >= t_k) with pure int32 compares. Leaf children are counted without
   being pushed (80% of canonical-tree nodes are leaves).
-- The host seeds the lanes by BFS-ing the tree top (hashlib) to >= the
-  requested root count, then deals shuffled subtree roots round-robin.
+- The host BFS seed is itself vectorized: the same SHA-1 block function runs
+  on numpy arrays over whole frontier levels, so seeding hundreds of
+  thousands of subtree roots costs well under a second.
 
 Supports the GEO/FIXED shape (all canonical T1/T1L/T1XL/T3 trees); the
 depth-varying shapes would need per-depth threshold tables.
@@ -35,9 +49,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.uts import FIXED, UTSParams, num_children, root_state, spawn_state
+from ..models.uts import FIXED, UTSParams
 
-__all__ = ["uts_vec", "child_thresholds"]
+__all__ = ["uts_vec", "child_thresholds", "LANES", "NLANES"]
 
 LANES = (8, 128)
 NLANES = LANES[0] * LANES[1]
@@ -73,19 +87,22 @@ def child_thresholds(b0: float) -> np.ndarray:
 
 
 def _rotl(x, s: int):
-    return (x << jnp.uint32(s)) | (x >> jnp.uint32(32 - s))
+    # Plain-int shift amounts keep u32 dtype under both numpy (NEP 50 weak
+    # scalars) and jnp weak typing.
+    return (x << s) | (x >> (32 - s))
 
 
-def _sha1_block(w16: List):
-    """SHA-1 compression of one 16-word block, vectorized over planes."""
+def _sha1_block(w16: List, xp):
+    """SHA-1 compression of one 16-word block, vectorized over arrays of any
+    shape. Works for both jnp (device planes) and numpy (host BFS seeding)."""
     K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
     H = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
     w = list(w16)
-    a = jnp.full(LANES, H[0], jnp.uint32)
-    b = jnp.full(LANES, H[1], jnp.uint32)
-    c = jnp.full(LANES, H[2], jnp.uint32)
-    d = jnp.full(LANES, H[3], jnp.uint32)
-    e = jnp.full(LANES, H[4], jnp.uint32)
+    a = xp.full_like(w[0], H[0])
+    b = xp.full_like(w[0], H[1])
+    c = xp.full_like(w[0], H[2])
+    d = xp.full_like(w[0], H[3])
+    e = xp.full_like(w[0], H[4])
     for i in range(80):
         if i >= 16:
             nw = _rotl(w[(i - 3) % 16] ^ w[(i - 8) % 16] ^ w[(i - 14) % 16]
@@ -104,28 +121,28 @@ def _sha1_block(w16: List):
         else:
             f = b ^ c ^ d
             k = K[3]
-        tmp = _rotl(a, 5) + f + e + jnp.uint32(k) + wi
+        tmp = _rotl(a, 5) + f + e + xp.uint32(k) + wi
         e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
     return (
-        a + jnp.uint32(H[0]),
-        b + jnp.uint32(H[1]),
-        c + jnp.uint32(H[2]),
-        d + jnp.uint32(H[3]),
-        e + jnp.uint32(H[4]),
+        a + xp.uint32(H[0]),
+        b + xp.uint32(H[1]),
+        c + xp.uint32(H[2]),
+        d + xp.uint32(H[3]),
+        e + xp.uint32(H[4]),
     )
 
 
-def _sha1_child(state5, child_idx):
+def _sha1_child(state5, child_idx, xp):
     """SHA1(parent_state(20B) || BE32(child)) for 24-byte messages."""
-    zero = jnp.zeros(LANES, jnp.uint32)
+    zero = xp.zeros_like(state5[0])
     w16 = [
         state5[0], state5[1], state5[2], state5[3], state5[4],
-        child_idx.astype(jnp.uint32),
-        jnp.full(LANES, 0x80000000, jnp.uint32),
+        child_idx.astype(xp.uint32),
+        xp.full_like(state5[0], 0x80000000),
         zero, zero, zero, zero, zero, zero, zero, zero,
-        jnp.full(LANES, 24 * 8, jnp.uint32),
+        xp.full_like(state5[0], 24 * 8),
     ]
-    return _sha1_block(w16)
+    return _sha1_block(w16, xp)
 
 
 def _level_select(stack, sp):
@@ -153,43 +170,81 @@ def _level_store(stack, sp, value, mask):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("stack_size", "gen_mx", "thresholds", "max_steps"),
+    static_argnames=(
+        "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
+    ),
 )
 def _uts_dfs(
-    stack_state,  # (S, 5, 8, 128) u32
-    stack_child,  # (S, 8, 128) i32
-    stack_count,  # (S, 8, 128) i32
-    stack_depth,  # (S, 8, 128) i32
-    sp0,  # (8, 128) i32; -1 = done
+    roots_state,  # (5, R) u32 - subtree roots, all at BFS depth d0
+    roots_count,  # (R,) i32 - exact child counts (all >= 1)
     stack_size: int,
     gen_mx: int,
-    thresholds: tuple,  # static ints: compiled as immediates, not memory reads
+    d0: int,
+    thresholds: tuple,  # static ints: compiled as immediates
     max_steps: int,
+    lanes: tuple,
 ):
     nthresh = len(thresholds)
     S = stack_size
-    # Unstack into tuples of planes (see _level_select for why).
-    st = tuple(
-        tuple(stack_state[L, i] for i in range(5)) for L in range(S)
-    )
-    ch = tuple(stack_child[L] for L in range(S))
-    cn = tuple(stack_count[L] for L in range(S))
-    dp = tuple(stack_depth[L] for L in range(S))
+    nlanes = lanes[0] * lanes[1]
+    # Root arrays arrive padded by nlanes (see uts_vec) so the refill window
+    # dynamic_slice below is always in bounds; R is the real root count.
+    R = roots_count.shape[0] - nlanes
 
     def count_children(r, depth):
-        cnt = jnp.zeros(LANES, jnp.int32)
+        cnt = jnp.zeros(lanes, jnp.int32)
         for k in range(nthresh):
             cnt = cnt + (r >= jnp.int32(thresholds[k])).astype(jnp.int32)
         return jnp.where(depth < gen_mx, cnt, 0)
 
-    def cond(carry):
-        sp, nodes, leaves, maxd, st, ch, cn, dp, steps = carry
-        return jnp.any(sp >= 0) & (steps < max_steps)
+    # Refill threshold: the gather+cumsum claim is much more expensive than
+    # one SHA-1 step, so the hot expansion loop runs refill-free (inner
+    # while) until this many lanes are idle; the outer loop then claims
+    # roots for all of them at once. Imbalance cost is bounded by
+    # min_idle/nlanes per refill round.
+    refill_min_idle = max(64, nlanes // 8)
 
-    def body(carry):
-        sp, nodes, leaves, maxd, st, ch, cn, dp, steps = carry
+    def refill(sp, next_root, st0, ch0, cn0, dp0):
+        done = sp < 0
+        rank = jnp.cumsum(done.reshape(-1).astype(jnp.int32)).reshape(lanes)
+        avail = R - next_root
+        claim = done & (rank <= avail)
+        # Claims are contiguous [next_root, next_root + nclaim): slice an
+        # nlanes-wide window once, then gather within it - a gather over a
+        # small VMEM-resident window instead of the whole HBM root array.
+        win = [
+            jax.lax.dynamic_slice(roots_state[i], (next_root,), (nlanes,))
+            for i in range(5)
+        ]
+        wcn = jax.lax.dynamic_slice(roots_count, (next_root,), (nlanes,))
+        idx = jnp.clip(rank - 1, 0, nlanes - 1)
+        rst = [jnp.take(win[i], idx, axis=0) for i in range(5)]
+        rcn = jnp.take(wcn, idx, axis=0)
+        st0 = tuple(jnp.where(claim, rst[i], st0[i]) for i in range(5))
+        ch0 = jnp.where(claim, 0, ch0)
+        cn0 = jnp.where(claim, rcn, cn0)
+        dp0 = jnp.where(claim, d0, dp0)
+        sp = jnp.where(claim, 0, sp)
+        next_root = next_root + jnp.minimum(
+            jnp.sum(done.astype(jnp.int32)), avail
+        )
+        return sp, next_root, st0, ch0, cn0, dp0
+
+    def inner_cond(carry):
+        sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
+        active = jnp.any(sp >= 0)
+        ndone = jnp.sum((sp < 0).astype(jnp.int32))
+        # Keep expanding while work remains and either too few lanes are
+        # idle to justify a refill, or there is nothing left to claim.
+        return (
+            active
+            & ((ndone < refill_min_idle) | (avail <= 0))
+            & (steps < max_steps)
+        )
+
+    def inner_body(carry):
+        sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
         active = sp >= 0
-        # Top frame.
         child = _level_select(ch, sp)
         count = _level_select(cn, sp)
         depth = _level_select(dp, sp)
@@ -198,8 +253,7 @@ def _uts_dfs(
             for i in range(5)
         ]
         expand = active & (child < count)
-        # Hash the next child for every lane (masked lanes pay, SIMD-style).
-        cstate = _sha1_child(state, child)
+        cstate = _sha1_child(state, child, jnp)
         r = (cstate[4] & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
         cdepth = depth + 1
         ccount = count_children(r, cdepth)
@@ -207,28 +261,68 @@ def _uts_dfs(
         nodes = nodes + expand.astype(jnp.int32)
         leaves = leaves + (expand & is_leaf).astype(jnp.int32)
         maxd = jnp.maximum(maxd, jnp.where(expand, cdepth, 0))
-        # Parent consumed one child.
-        ch = _level_store(ch, sp, child + 1, expand)
-        # Push non-leaf children.
-        push = expand & ~is_leaf
+        # Tail-call scheduling keeps every stack frame expandable (child <
+        # count), so every active step performs an expansion - no steps are
+        # wasted popping exhausted frames:
+        #  - last+leaf child: frame is done, pop now.
+        #  - last+non-leaf child: child *replaces* the parent frame (tail
+        #    call) - exhausted parents are never buried on the stack.
+        #  - otherwise: bump the cursor; push non-leaf children.
+        last = expand & (child + 1 >= count)
+        push = expand & ~is_leaf & ~last
+        tail = expand & ~is_leaf & last
+        pop = (expand & is_leaf & last) | (active & ~expand)
+        ch = _level_store(ch, sp, child + 1, expand & ~last)
+        # One store pass for both push (at sp+1) and tail-replace (at sp).
         spp = sp + 1
+        lvl = jnp.where(push, spp, sp)
+        newf = push | tail
         st = tuple(
             tuple(
-                jnp.where(push & (spp == L), cstate[i], st[L][i])
+                jnp.where(newf & (lvl == L), cstate[i], st[L][i])
                 for i in range(5)
             )
             for L in range(S)
         )
-        ch = _level_store(ch, spp, jnp.zeros(LANES, jnp.int32), push)
-        cn = _level_store(cn, spp, ccount, push)
-        dp = _level_store(dp, spp, cdepth, push)
-        # Pop exhausted frames; advance pushed frames.
-        sp = jnp.where(push, spp, jnp.where(active & ~expand, sp - 1, sp))
-        return sp, nodes, leaves, maxd, st, ch, cn, dp, steps + 1
+        ch = _level_store(ch, lvl, jnp.zeros(lanes, jnp.int32), newf)
+        cn = _level_store(cn, lvl, ccount, newf)
+        dp = _level_store(dp, lvl, cdepth, newf)
+        sp = jnp.where(push, spp, jnp.where(pop, sp - 1, sp))
+        return sp, nodes, leaves, maxd, st, ch, cn, dp, steps + 1, avail
 
-    zeros = jnp.zeros(LANES, jnp.int32)
-    carry = (sp0, zeros, zeros, zeros, st, ch, cn, dp, jnp.int32(0))
-    sp, nodes, leaves, maxd, *_rest, steps = jax.lax.while_loop(cond, body, carry)
+    def outer_cond(carry):
+        sp, next_root, nodes, leaves, maxd, st, ch, cn, dp, steps = carry
+        return (jnp.any(sp >= 0) | (next_root < R)) & (steps < max_steps)
+
+    def outer_body(carry):
+        sp, next_root, nodes, leaves, maxd, st, ch, cn, dp, steps = carry
+        sp, next_root, st0, ch0, cn0, dp0 = refill(
+            sp, next_root, st[0], ch[0], cn[0], dp[0]
+        )
+        st = (st0,) + st[1:]
+        ch = (ch0,) + ch[1:]
+        cn = (cn0,) + cn[1:]
+        dp = (dp0,) + dp[1:]
+        inner = (
+            sp, nodes, leaves, maxd, st, ch, cn, dp, steps, R - next_root,
+        )
+        (
+            sp, nodes, leaves, maxd, st, ch, cn, dp, steps, _,
+        ) = jax.lax.while_loop(inner_cond, inner_body, inner)
+        return sp, next_root, nodes, leaves, maxd, st, ch, cn, dp, steps
+
+    zeros = jnp.zeros(lanes, jnp.int32)
+    uzeros = jnp.zeros(lanes, jnp.uint32)
+    st0 = tuple(tuple(uzeros for _ in range(5)) for _ in range(S))
+    ch0 = tuple(zeros for _ in range(S))
+    cn0 = tuple(zeros for _ in range(S))
+    dp0 = tuple(zeros for _ in range(S))
+    sp0 = jnp.full(lanes, -1, jnp.int32)
+    carry = (sp0, jnp.int32(0), zeros, zeros, zeros, st0, ch0, cn0, dp0,
+             jnp.int32(0))
+    sp, next_root, nodes, leaves, maxd, *_rest, steps = jax.lax.while_loop(
+        outer_cond, outer_body, carry
+    )
     # int32 totals: fine up to 2^31 device-side nodes (T1L is 102M; the 4.2B
     # T1XXL tree would need per-lane int64 counters or periodic draining).
     return (
@@ -236,91 +330,116 @@ def _uts_dfs(
         jnp.sum(leaves),
         jnp.max(maxd),
         steps,
-        jnp.any(sp >= 0),
+        jnp.any(sp >= 0) | (next_root < R),
     )
+
+
+def _host_seed(params: UTSParams, target_roots: int):
+    """Vectorized BFS of the tree top with numpy SHA-1 over whole levels.
+
+    Returns (host_nodes, host_leaves, host_maxd, d0, roots_state (5,R) u32,
+    roots_count (R,) i32). Roots all sit at depth d0 and have count >= 1;
+    leaf frontier nodes are counted host-side.
+    """
+    thresholds = child_thresholds(params.b0)
+
+    def counts_of(state5, depth: int) -> np.ndarray:
+        if depth >= params.gen_mx:
+            return np.zeros(state5[0].shape, np.int32)
+        r = (state5[4] & np.uint32(0x7FFFFFFF)).astype(np.int32)
+        return (r[:, None] >= thresholds[None, :]).sum(axis=1, dtype=np.int32)
+
+    # Root state: SHA1(16 zero bytes || BE32(seed)) per the UTS spec
+    # (models/uts.py root_state).
+    seed_words = [np.zeros(1, np.uint32) for _ in range(4)]
+    seed_words.append(np.full(1, params.root_seed, np.uint32))
+    w16 = seed_words + [
+        np.full(1, 0x80000000, np.uint32),
+        *[np.zeros(1, np.uint32) for _ in range(9)],
+        np.full(1, 20 * 8, np.uint32),
+    ]
+    state5 = list(_sha1_block(w16, np))
+
+    host_nodes = 0
+    host_leaves = 0
+    host_maxd = 0
+    depth = 0
+    while True:
+        n = state5[0].shape[0]
+        counts = counts_of(state5, depth)
+        host_nodes += n
+        host_maxd = max(host_maxd, depth) if n else host_maxd
+        nonleaf = counts > 0
+        host_leaves += int((~nonleaf).sum())
+        total = int(counts.sum())
+        if total == 0:
+            return host_nodes, host_leaves, host_maxd, depth, None, None
+        if n >= target_roots:
+            # Hand the non-leaf frontier to the device. Frontier leaves were
+            # already counted above; roots themselves were counted as nodes.
+            rs = [s[nonleaf] for s in state5]
+            rc = counts[nonleaf]
+            return (
+                host_nodes, host_leaves, host_maxd, depth,
+                np.stack(rs).astype(np.uint32), rc.astype(np.int32),
+            )
+        # Expand the whole level at once.
+        parent = np.repeat(np.arange(n), counts)
+        starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        rank = (np.arange(total) - starts[parent]).astype(np.uint32)
+        state5 = list(
+            _sha1_child([s[parent] for s in state5], rank, np)
+        )
+        depth += 1
 
 
 def uts_vec(
     params: UTSParams,
-    target_roots: int = 4 * NLANES,
+    target_roots: int = 16 * NLANES,
     max_steps: Optional[int] = None,
     device=None,
+    lanes: Tuple[int, int] = LANES,
 ) -> dict:
     """Run UTS with the vectorized DFS engine; returns counts + timing info.
 
     The host BFS-expands the tree top until >= target_roots frontier nodes
-    (counting that part itself), then the device traverses the subtrees.
-    """
+    (counting that part itself), then the device traverses the subtrees,
+    lanes claiming roots from the shared queue as they drain."""
     if params.shape != FIXED:
         raise NotImplementedError("uts_vec supports the GEO/FIXED shape")
-    # Host BFS seed.
-    host_nodes = host_leaves = 0
-    host_maxd = 0
-    frontier: List[Tuple[bytes, int]] = [(root_state(params.root_seed), 0)]
-    while frontier and len(frontier) < target_roots:
-        nxt: List[Tuple[bytes, int]] = []
-        for state, depth in frontier:
-            host_nodes += 1
-            host_maxd = max(host_maxd, depth)
-            nc = num_children(params, state, depth)
-            if nc == 0:
-                host_leaves += 1
-                continue
-            for i in range(nc):
-                nxt.append((spawn_state(state, i), depth + 1))
-        frontier = nxt
+    import time
+
+    t_seed = time.perf_counter()
+    host_nodes, host_leaves, host_maxd, d0, roots_state, roots_count = (
+        _host_seed(params, target_roots)
+    )
+    seed_seconds = time.perf_counter() - t_seed
     result = {
         "host_seed_nodes": host_nodes,
-        "roots": len(frontier),
+        "roots": 0 if roots_count is None else int(roots_count.shape[0]),
+        "seed_seconds": seed_seconds,
     }
-    if not frontier:
+    if roots_count is None:
         result.update(
             nodes=host_nodes, leaves=host_leaves, max_depth=host_maxd, steps=0
         )
         return result
-    d0 = frontier[0][1]
-    # Roots count as nodes; leaf roots as leaves (the device counts children
-    # at expansion time, so roots must be accounted here).
-    thresholds = child_thresholds(params.b0)
-    root_counts = []
-    for state, depth in frontier:
-        host_nodes += 1
-        host_maxd = max(host_maxd, depth)
-        c = num_children(params, state, depth)
-        root_counts.append(c)
-        if c == 0:
-            host_leaves += 1
-    rng = np.random.default_rng(0)
-    order = rng.permutation(len(frontier))
-    rpl = (len(frontier) + NLANES - 1) // NLANES
-    S = rpl + (params.gen_mx - d0) + 1
-    st = np.zeros((S, 5) + LANES, np.uint32)
-    ch = np.zeros((S,) + LANES, np.int32)
-    cn = np.zeros((S,) + LANES, np.int32)
-    dp = np.zeros((S,) + LANES, np.int32)
-    for slot, j in enumerate(order):
-        state, _ = frontier[j]
-        level, lane = divmod(slot, NLANES)
-        r, c = divmod(lane, LANES[1])
-        words = np.frombuffer(state, dtype=">u4").astype(np.uint32)
-        st[level, :, r, c] = words
-        cn[level, r, c] = root_counts[j]
-        dp[level, r, c] = d0
-    # Lanes with fewer roots: the unused bottom frames have count 0 and pop
-    # straight through.
-    sp0 = np.full(LANES, rpl - 1, np.int32)
     if max_steps is None:
-        max_steps = 1 << 31 - 1
-    import time
-
-    args = (
-        jnp.asarray(st), jnp.asarray(ch), jnp.asarray(cn), jnp.asarray(dp),
-        jnp.asarray(sp0),
+        max_steps = (1 << 31) - 1
+    # Pad by nlanes so the device refill window never runs off the end.
+    nlanes = lanes[0] * lanes[1]
+    roots_state = np.concatenate(
+        [roots_state, np.zeros((5, nlanes), np.uint32)], axis=1
     )
+    roots_count = np.concatenate([roots_count, np.zeros(nlanes, np.int32)])
+    args = (jnp.asarray(roots_state), jnp.asarray(roots_count))
     kw = dict(
-        stack_size=S, gen_mx=params.gen_mx,
-        thresholds=tuple(int(t) for t in thresholds),
+        stack_size=max(1, params.gen_mx - d0),
+        gen_mx=params.gen_mx,
+        d0=d0,
+        thresholds=tuple(int(t) for t in child_thresholds(params.b0)),
         max_steps=max_steps,
+        lanes=tuple(lanes),
     )
     if device is not None:
         args = tuple(jax.device_put(a, device) for a in args)
@@ -331,6 +450,7 @@ def uts_vec(
     dt = time.perf_counter() - t0
     if bool(unfinished):
         raise RuntimeError(f"uts_vec ran out of steps ({max_steps})")
+    nlanes = lanes[0] * lanes[1]
     result.update(
         nodes=host_nodes + dev_nodes,
         leaves=host_leaves + int(leaves),
@@ -339,6 +459,7 @@ def uts_vec(
         device_nodes=dev_nodes,
         device_seconds=dt,
         nodes_per_sec=dev_nodes / dt if dt > 0 else float("inf"),
+        lane_efficiency=dev_nodes / (int(steps) * nlanes) if steps else 0.0,
     )
     return result
 
