@@ -101,42 +101,64 @@ def bench_native_fib(n: int = 27):
 
 
 def bench_device_cholesky():
+    """In-kernel tiled-Cholesky throughput: the full 816-task DDF DAG
+    (n=4096, 256x256 MXU tiles) is re-run R times inside one kernel launch
+    and the per-graph cost is the slope between two R values - the same
+    steady-state harness as the fib bench, since a single graph (a few ms)
+    would drown in the ~70 ms tunnel launch+transfer overhead. Correctness
+    of the factorization itself is asserted by tests/test_device_workloads
+    (residual vs numpy)."""
     import jax
     import jax.numpy as jnp
 
     if jax.default_backend() != "tpu":
         return None
     from hclib_tpu.device.cholesky import (
-        T,
         _to_tiles,
         build_cholesky_graph,
         make_cholesky_megakernel,
     )
     from hclib_tpu.models.cholesky import make_spd
 
-    n = 1536
-    nt = n // T
-    mk = make_cholesky_megakernel(nt, interpret=False)
-    jitted = mk._build(1 << 22)
+    n, tile = 4096, 256
+    nt = n // tile
+    mk = make_cholesky_megakernel(nt, interpret=False, tile=tile)
     b = build_cholesky_graph(nt)
     tasks, succ, ring, counts = b.finalize(
         capacity=mk.capacity, succ_capacity=mk.succ_capacity
     )
     a = make_spd(n).astype(np.float32)
-    args = [
-        jax.device_put(jnp.asarray(x))
-        for x in (
-            tasks, succ, ring, counts, np.zeros(8, np.int32),
-            _to_tiles(a, nt), np.zeros((nt, T, T), np.float32),
-        )
-    ]
-    jax.block_until_ready(jitted(*args))
-    t0 = time.perf_counter()
-    outs = jitted(*args)
-    jax.block_until_ready(outs)
-    dt = time.perf_counter() - t0
-    gflops = n**3 / 3.0 / dt / 1e9
-    log(f"device cholesky n={n}: {dt*1000:.1f} ms -> {gflops:.1f} GFLOP/s")
+    host = (
+        tasks, succ, ring, counts, np.zeros(8, np.int32),
+        _to_tiles(a, nt, tile), np.zeros((nt, tile, tile), np.float32),
+    )
+
+    def fresh():
+        # input_output_aliases donate the inputs; every call needs fresh
+        # device buffers.
+        return [jax.device_put(jnp.asarray(x)) for x in host]
+
+    times = {}
+    ntasks = 0
+    for reps in (10, 60):
+        jitted = mk._build(1 << 22, reps=reps)
+        np.asarray(jitted(*fresh())[2])  # compile + sync
+        best = 1e9
+        for _ in range(3):
+            args = fresh()
+            np.asarray(args[3])  # H2D done
+            t0 = time.perf_counter()
+            outs = jitted(*args)
+            # D2H of the counts word is the only reliable sync through the
+            # tunnel (block_until_ready returns early on remote arrays).
+            executed = int(np.asarray(outs[2])[5])
+            best = min(best, time.perf_counter() - t0)
+        ntasks = executed // reps
+        times[reps] = best
+    per_graph = (times[60] - times[10]) / 50.0
+    gflops = n**3 / 3.0 / per_graph / 1e9
+    log(f"device cholesky n={n} tile={tile}: {ntasks} tasks, "
+        f"{per_graph*1e3:.2f} ms/graph steady-state -> {gflops:.1f} GFLOP/s")
     return gflops
 
 

@@ -36,7 +36,7 @@ from .megakernel import KernelContext, Megakernel
 
 __all__ = ["device_cholesky", "build_cholesky_graph", "make_cholesky_megakernel"]
 
-T = 128  # tile edge (MXU-native)
+T = 128  # default tile edge (MXU-native); 256 amortizes scheduling
 
 POTRF = 0
 TRSM = 1
@@ -44,10 +44,10 @@ SYRK = 2
 GEMM = 3
 
 
-def _factor_tile(t):
-    """Lower-Cholesky a symmetric (T, T) tile with masked rank-1 updates."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+def _factor_tile(t, ts: int = T):
+    """Lower-Cholesky a symmetric (ts, ts) tile with masked rank-1 updates."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
 
     def body(j, carry):
         s, l = carry
@@ -61,17 +61,17 @@ def _factor_tile(t):
         s = jnp.where((rows > j) & (cols > j), s - upd, s)
         return s, l
 
-    _, l = jax.lax.fori_loop(0, T, body, (t, jnp.zeros_like(t)))
+    _, l = jax.lax.fori_loop(0, ts, body, (t, jnp.zeros_like(t)))
     return l
 
 
-def _tri_inverse(l):
-    """inv(L) for lower-triangular L via Newton-Schulz (exact in log2 T)."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+def _tri_inverse(l, ts: int = T):
+    """inv(L) for lower-triangular L via Newton-Schulz (exact in log2 ts)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
     dg = jnp.sum(jnp.where(rows == cols, l, 0.0), axis=1, keepdims=True)  # (T,1)
     x = jnp.where(rows == cols, 1.0 / dg, 0.0)
-    steps = max(1, int(np.ceil(np.log2(T))))
+    steps = max(1, int(np.ceil(np.log2(ts))))
     hi = jax.lax.Precision.HIGHEST
     for _ in range(steps):
         lx = jnp.dot(l, x, preferred_element_type=jnp.float32, precision=hi)
@@ -96,20 +96,20 @@ def _dma(src, dst, sem):
     cp.wait()
 
 
-def _potrf_kernel(ctx: KernelContext) -> None:
+def _potrf_kernel(ctx: KernelContext, ts: int = T) -> None:
     k = ctx.arg(0)
     tiles, linv = ctx.data["tiles"], ctx.data["linv"]
     va = ctx.scratch["va"]
     sem = ctx.scratch["sems"]
     _dma(tiles.at[k, k], va, sem.at[0])
-    l = _factor_tile(va[:])
+    l = _factor_tile(va[:], ts)
     va[:] = l
     _dma(va, tiles.at[k, k], sem.at[0])
-    va[:] = _tri_inverse(l)
+    va[:] = _tri_inverse(l, ts)
     _dma(va, linv.at[k], sem.at[0])
 
 
-def _trsm_kernel(ctx: KernelContext) -> None:
+def _trsm_kernel(ctx: KernelContext, ts: int = T) -> None:
     i, k = ctx.arg(0), ctx.arg(1)
     tiles, linv = ctx.data["tiles"], ctx.data["linv"]
     va, vb = ctx.scratch["va"], ctx.scratch["vb"]
@@ -120,7 +120,7 @@ def _trsm_kernel(ctx: KernelContext) -> None:
     _dma(va, tiles.at[i, k], sem.at[0])
 
 
-def _syrk_kernel(ctx: KernelContext) -> None:
+def _syrk_kernel(ctx: KernelContext, ts: int = T) -> None:
     i, k = ctx.arg(0), ctx.arg(1)
     tiles = ctx.data["tiles"]
     va, vb = ctx.scratch["va"], ctx.scratch["vb"]
@@ -131,7 +131,7 @@ def _syrk_kernel(ctx: KernelContext) -> None:
     _dma(va, tiles.at[i, i], sem.at[0])
 
 
-def _gemm_kernel(ctx: KernelContext) -> None:
+def _gemm_kernel(ctx: KernelContext, ts: int = T) -> None:
     i, j, k = ctx.arg(0), ctx.arg(1), ctx.arg(2)
     tiles = ctx.data["tiles"]
     va, vb, vc = ctx.scratch["va"], ctx.scratch["vb"], ctx.scratch["vc"]
@@ -167,23 +167,27 @@ def build_cholesky_graph(nt: int) -> TaskGraphBuilder:
     return b
 
 
-def make_cholesky_megakernel(nt: int, interpret: Optional[bool] = None) -> Megakernel:
-    tile_spec = jax.ShapeDtypeStruct((nt, nt, T, T), jnp.float32)
-    linv_spec = jax.ShapeDtypeStruct((nt, T, T), jnp.float32)
+def make_cholesky_megakernel(
+    nt: int, interpret: Optional[bool] = None, tile: int = T
+) -> Megakernel:
+    import functools as _ft
+
+    tile_spec = jax.ShapeDtypeStruct((nt, nt, tile, tile), jnp.float32)
+    linv_spec = jax.ShapeDtypeStruct((nt, tile, tile), jnp.float32)
     ntasks = nt + nt * (nt - 1) // 2 + nt * (nt - 1) * (nt + 1) // 6
     capacity = max(64, ntasks)
     return Megakernel(
         kernels=[
-            ("potrf", _potrf_kernel),
-            ("trsm", _trsm_kernel),
-            ("syrk", _syrk_kernel),
-            ("gemm", _gemm_kernel),
+            ("potrf", _ft.partial(_potrf_kernel, ts=tile)),
+            ("trsm", _ft.partial(_trsm_kernel, ts=tile)),
+            ("syrk", _ft.partial(_syrk_kernel, ts=tile)),
+            ("gemm", _ft.partial(_gemm_kernel, ts=tile)),
         ],
         data_specs={"tiles": tile_spec, "linv": linv_spec},
         scratch_specs={
-            "va": pltpu.VMEM((T, T), jnp.float32),
-            "vb": pltpu.VMEM((T, T), jnp.float32),
-            "vc": pltpu.VMEM((T, T), jnp.float32),
+            "va": pltpu.VMEM((tile, tile), jnp.float32),
+            "vb": pltpu.VMEM((tile, tile), jnp.float32),
+            "vc": pltpu.VMEM((tile, tile), jnp.float32),
             "sems": pltpu.SemaphoreType.DMA((3,)),
         },
         capacity=capacity,
@@ -193,33 +197,36 @@ def make_cholesky_megakernel(nt: int, interpret: Optional[bool] = None) -> Megak
     )
 
 
-def _to_tiles(a: np.ndarray, nt: int) -> np.ndarray:
+def _to_tiles(a: np.ndarray, nt: int, ts: int = T) -> np.ndarray:
     return (
-        a.reshape(nt, T, nt, T).swapaxes(1, 2).astype(np.float32).copy()
+        a.reshape(nt, ts, nt, ts).swapaxes(1, 2).astype(np.float32).copy()
     )
 
 
-def _from_tiles(tiles: np.ndarray, nt: int) -> np.ndarray:
-    return np.asarray(tiles).swapaxes(1, 2).reshape(nt * T, nt * T)
+def _from_tiles(tiles: np.ndarray, nt: int, ts: int = T) -> np.ndarray:
+    return np.asarray(tiles).swapaxes(1, 2).reshape(nt * ts, nt * ts)
 
 
 def device_cholesky(
-    a: np.ndarray, interpret: Optional[bool] = None, mk: Optional[Megakernel] = None
+    a: np.ndarray,
+    interpret: Optional[bool] = None,
+    mk: Optional[Megakernel] = None,
+    tile: int = T,
 ) -> Tuple[np.ndarray, dict]:
-    """Factor SPD ``a`` ((nt*128)^2) on-device; returns (L, info)."""
+    """Factor SPD ``a`` ((nt*tile)^2) on-device; returns (L, info)."""
     n = a.shape[0]
-    if n % T != 0:
-        raise ValueError(f"matrix size must be a multiple of {T}")
-    nt = n // T
+    if n % tile != 0:
+        raise ValueError(f"matrix size must be a multiple of {tile}")
+    nt = n // tile
     if mk is None:
-        mk = make_cholesky_megakernel(nt, interpret)
+        mk = make_cholesky_megakernel(nt, interpret, tile=tile)
     b = build_cholesky_graph(nt)
-    tiles = _to_tiles(a, nt)
-    linv = np.zeros((nt, T, T), dtype=np.float32)
+    tiles = _to_tiles(a, nt, tile)
+    linv = np.zeros((nt, tile, tile), dtype=np.float32)
     t0 = time.perf_counter()
     _, data, info = mk.run(b, data={"tiles": tiles, "linv": linv})
     dt = time.perf_counter() - t0
-    L = np.tril(_from_tiles(data["tiles"], nt))
+    L = np.tril(_from_tiles(data["tiles"], nt, tile))
     info = dict(info)
     info["seconds"] = dt
     info["gflops"] = (n**3 / 3.0) / dt / 1e9
