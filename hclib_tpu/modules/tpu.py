@@ -142,6 +142,20 @@ def _tpu_copy(
     d_dev = _is_device_type(dst_locale.type)
     if d_dev:
         # host->device or device->device (ICI when the devices differ).
+        # Host sources not registered in the pinned-buffer tree
+        # (runtime/memtree.py, the reference's hclib-tree.c role) get a
+        # defensive staging copy first: the caller may mutate or free the
+        # buffer while JAX's async dispatch still reads it. Pinned buffers
+        # are promised stable and transfer zero-copy.
+        if isinstance(src, np.ndarray) and not s_dev:
+            from ..runtime import memtree
+
+            try:
+                pinned = memtree.lookup(src) is not None
+            except ValueError:  # non-contiguous: never pinnable
+                pinned = False
+            if not pinned:
+                src = np.ascontiguousarray(src).copy()
         out = jax.device_put(src, _device_of(dst_locale))
         if nelems is not None:
             out = out.reshape(-1)[:nelems]

@@ -1,0 +1,127 @@
+"""Pinned host-buffer registry: interval map over host address ranges.
+
+The reference keeps an AVL tree of pinned host allocations so the CUDA
+module can answer "is this pointer inside a pinned buffer?" before choosing
+a fast DMA path (src/hclib-tree.c:8-11, hooked into the runtime context
+under HC_CUDA, src/inc/hclib-internal.h:101-104).
+
+The TPU analogue tracks host buffers registered for device transfer: a
+buffer registered here is promised stable (not resized/moved/freed) for the
+duration of its registration, so the tpu module's host->device copy handler
+may hand it to ``jax.device_put`` zero-copy instead of taking a defensive
+staging copy first.
+
+Python needs no AVL rebalancing story - a sorted start-address list with
+bisect gives O(log n) queries and O(n) inserts, and registrations are rare
+and coarse (whole arrays, not sub-ranges).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+__all__ = ["MemoryTree", "PinnedEntry", "pin", "unpin", "lookup", "global_tree"]
+
+
+@dataclass
+class PinnedEntry:
+    start: int
+    length: int
+    meta: Any = None
+
+    @property
+    def end(self) -> int:
+        return self.start + self.length
+
+    def contains(self, address: int) -> bool:
+        return self.start <= address < self.end
+
+
+class MemoryTree:
+    """Interval map keyed by start address (reference API:
+    hclib_memory_tree_insert/remove/contains)."""
+
+    def __init__(self) -> None:
+        self._starts: List[int] = []
+        self._entries: List[PinnedEntry] = []
+        self._lock = threading.Lock()
+
+    def insert(self, start: int, length: int, meta: Any = None) -> PinnedEntry:
+        if length <= 0:
+            raise ValueError("length must be positive")
+        e = PinnedEntry(start, length, meta)
+        with self._lock:
+            i = bisect.bisect_left(self._starts, start)
+            # Overlap with the previous or next interval is a registration
+            # bug (double pin / overlapping buffers) - reject loudly.
+            if i > 0 and self._entries[i - 1].end > start:
+                raise ValueError(f"overlaps existing range at {self._entries[i-1]}")
+            if i < len(self._starts) and e.end > self._starts[i]:
+                raise ValueError(f"overlaps existing range at {self._entries[i]}")
+            self._starts.insert(i, start)
+            self._entries.insert(i, e)
+        return e
+
+    def remove(self, start: int) -> PinnedEntry:
+        """Remove the interval containing ``start`` (the reference removes
+        by any interior address, src/hclib-tree.c remove)."""
+        with self._lock:
+            i = self._locate(start)
+            if i is None:
+                raise KeyError(f"no pinned range contains {start:#x}")
+            self._starts.pop(i)
+            return self._entries.pop(i)
+
+    def contains(self, address: int) -> bool:
+        return self.lookup(address) is not None
+
+    def lookup(self, address: int) -> Optional[PinnedEntry]:
+        with self._lock:
+            i = self._locate(address)
+            return self._entries[i] if i is not None else None
+
+    def _locate(self, address: int) -> Optional[int]:
+        i = bisect.bisect_right(self._starts, address) - 1
+        if i >= 0 and self._entries[i].contains(address):
+            return i
+        return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_GLOBAL = MemoryTree()
+
+
+def global_tree() -> MemoryTree:
+    return _GLOBAL
+
+
+def _addr_len(buf: Any) -> tuple:
+    """(address, nbytes) of a numpy array's backing store."""
+    import numpy as np
+
+    a = np.asarray(buf)
+    if not a.flags["C_CONTIGUOUS"]:
+        raise ValueError("only contiguous buffers can be pinned")
+    return a.ctypes.data, a.nbytes
+
+
+def pin(buf: Any, meta: Any = None) -> PinnedEntry:
+    """Register a host buffer as transfer-stable (zero-copy eligible)."""
+    addr, n = _addr_len(buf)
+    return _GLOBAL.insert(addr, n, meta if meta is not None else buf)
+
+
+def unpin(buf: Any) -> PinnedEntry:
+    addr, _ = _addr_len(buf)
+    return _GLOBAL.remove(addr)
+
+
+def lookup(buf: Any) -> Optional[PinnedEntry]:
+    addr, _ = _addr_len(buf)
+    return _GLOBAL.lookup(addr)

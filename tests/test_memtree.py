@@ -1,0 +1,91 @@
+"""Pinned host-buffer registry (runtime/memtree.py, the reference's
+hclib-tree.c role) + its hook in the tpu module copy handler."""
+
+import numpy as np
+import pytest
+
+from hclib_tpu.runtime.memtree import MemoryTree, global_tree, lookup, pin, unpin
+
+
+def test_insert_lookup_remove():
+    t = MemoryTree()
+    t.insert(0x1000, 0x100, meta="a")
+    t.insert(0x3000, 0x80, meta="b")
+    assert t.contains(0x1000)
+    assert t.contains(0x10FF)
+    assert not t.contains(0x1100)
+    assert t.lookup(0x3040).meta == "b"
+    assert len(t) == 2
+    removed = t.remove(0x1050)  # by interior address, like the reference
+    assert removed.meta == "a"
+    assert not t.contains(0x1000)
+    assert len(t) == 1
+
+
+def test_overlap_rejected():
+    t = MemoryTree()
+    t.insert(0x1000, 0x100)
+    with pytest.raises(ValueError):
+        t.insert(0x1080, 0x10)
+    with pytest.raises(ValueError):
+        t.insert(0x0F80, 0x100)
+    t.insert(0x1100, 0x10)  # adjacent is fine
+
+
+def test_remove_missing_raises():
+    t = MemoryTree()
+    with pytest.raises(KeyError):
+        t.remove(0x42)
+
+
+def test_pin_unpin_numpy():
+    a = np.arange(64, dtype=np.float32)
+    entry = pin(a)
+    try:
+        assert lookup(a) is entry
+        # A view starting at the same base address resolves to the entry.
+        assert global_tree().contains(a.ctypes.data)
+        assert global_tree().contains(a.ctypes.data + a.nbytes - 1)
+    finally:
+        unpin(a)
+    assert lookup(a) is None
+
+
+def test_noncontiguous_rejected():
+    a = np.arange(64, dtype=np.float32)[::2]
+    with pytest.raises(ValueError):
+        pin(a)
+
+
+def test_tpu_copy_stages_unpinned_and_not_pinned(monkeypatch):
+    """The h2d copy handler must defensively copy unpinned numpy sources
+    and pass pinned ones through zero-copy."""
+    import hclib_tpu.modules.tpu as tpu_mod
+    from hclib_tpu.runtime.locality import Locale
+
+    staged = []
+    put_srcs = []
+
+    class _FakeJax:
+        @staticmethod
+        def device_put(x, dev):
+            put_srcs.append(x)
+            return x
+
+    monkeypatch.setattr(tpu_mod, "_device_of", lambda loc: None)
+    monkeypatch.setitem(__import__("sys").modules, "jax", _FakeJax)
+
+    host = Locale(0, "sysmem", "sysmem")
+    dev = Locale(1, "tpu_0", "tpu")
+
+    a = np.arange(16, dtype=np.float32)
+    tpu_mod._tpu_copy(None, dev, a, host)
+    assert put_srcs[-1] is not a  # staged copy
+
+    b = np.arange(16, dtype=np.float32)
+    pin(b)
+    try:
+        tpu_mod._tpu_copy(None, dev, b, host)
+        assert put_srcs[-1] is b  # zero-copy
+    finally:
+        unpin(b)
