@@ -104,7 +104,7 @@ void hcn_finish_free(void* f) { delete static_cast<FinishScope*>(f); }
 void hcn_async(void* rtp, void (*fn)(void*), void* env, void* finish,
                int locale, void** deps, int ndeps, int non_blocking) {
   Runtime* rt = static_cast<Runtime*>(rtp);
-  NTask* t = new NTask;
+  NTask* t = hcn::task_alloc();
   t->fn = fn;
   t->env = env;
   t->finish = static_cast<FinishScope*>(finish);
@@ -199,9 +199,12 @@ void fib_rec(int n, long long* out) {
     return;
   }
   long long a = 0, b = 0;
+  // Both children spawn as tasks (one task per fib node), matching the
+  // device megakernel's fib graph so tasks/sec is comparable across
+  // engines.
   hcn::finish([&] {
     hcn::async([n, &a] { fib_rec(n - 1, &a); });
-    fib_rec(n - 2, &b);
+    hcn::async([n, &b] { fib_rec(n - 2, &b); });
   });
   *out = a + b;
 }

@@ -8,6 +8,7 @@
 #pragma once
 
 #include <algorithm>
+#include <new>
 #include <utility>
 #include <vector>
 
@@ -24,12 +25,27 @@ void call_lambda(void* env) {
   delete f;
 }
 
+// Small lambda environments live in pool chunks (see pool_alloc): one
+// recycled allocation instead of a malloc/free pair per task.
+template <typename F>
+void call_lambda_pooled(void* env) {
+  F* f = static_cast<F*>(env);
+  (*f)();
+  f->~F();
+  pool_free(env);
+}
+
 template <typename F>
 NTask* make_task(F&& body) {
   using Fn = std::decay_t<F>;
-  NTask* t = new NTask;
-  t->fn = &call_lambda<Fn>;
-  t->env = new Fn(std::forward<F>(body));
+  NTask* t = task_alloc();
+  if constexpr (sizeof(Fn) <= kPoolChunk) {
+    t->fn = &call_lambda_pooled<Fn>;
+    t->env = new (pool_alloc()) Fn(std::forward<F>(body));
+  } else {
+    t->fn = &call_lambda<Fn>;
+    t->env = new Fn(std::forward<F>(body));
+  }
   return t;
 }
 

@@ -1,6 +1,7 @@
 #include "runtime.hpp"
 
 #include <cstring>
+#include <new>
 #include <sstream>
 
 namespace hcn {
@@ -9,7 +10,36 @@ namespace {
 thread_local Runtime* g_runtime = nullptr;
 thread_local int g_worker = -1;
 thread_local FinishScope* g_finish = nullptr;
+thread_local std::vector<void*> g_pool;
+constexpr size_t kPoolMax = 8192;
 }  // namespace
+
+void* pool_alloc() {
+  if (!g_pool.empty()) {
+    void* p = g_pool.back();
+    g_pool.pop_back();
+    return p;
+  }
+  return ::operator new(kPoolChunk);
+}
+
+void pool_free(void* p) {
+  if (g_pool.size() < kPoolMax) {
+    g_pool.push_back(p);
+  } else {
+    ::operator delete(p);
+  }
+}
+
+static_assert(sizeof(NTask) <= kPoolChunk, "NTask must fit a pool chunk");
+
+NTask* task_alloc() { return new (pool_alloc()) NTask; }
+
+void task_free(NTask* t) {
+  delete t->extra_deps;
+  t->~NTask();
+  pool_free(t);
+}
 
 Runtime* Runtime::current() { return g_runtime; }
 int Runtime::current_worker() { return g_worker >= 0 ? g_worker : 0; }
@@ -161,8 +191,7 @@ void Runtime::execute(NTask* t) {
   g_finish = prev;
   if (t->finish != nullptr) t->finish->check_out();
   ++stats_[w].executed;
-  delete t->extra_deps;
-  delete t;
+  task_free(t);
 }
 
 void Runtime::worker_loop(int wid) {
@@ -257,7 +286,7 @@ void Runtime::run_root(void (*fn)(void*), void* env) {
   FinishScope root;
   root.rt = this;
   root.parent = nullptr;
-  NTask* t = new NTask;
+  NTask* t = task_alloc();
   t->fn = fn;
   t->env = env;
   t->finish = &root;
