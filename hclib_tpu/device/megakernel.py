@@ -292,10 +292,14 @@ class Megakernel:
             complete(idx)
 
         def cond(carry):
-            pending, executed, stuck = carry
-            return (pending > 0) & (executed < fuel) & jnp.logical_not(stuck)
+            # `fuel` budgets *this call*: compare against tasks executed
+            # since entry, not the all-time counter (which persists across
+            # steal rounds when the sharded runner re-enters the kernel).
+            pending, executed, e0, stuck = carry
+            return (pending > 0) & (executed - e0 < fuel) & jnp.logical_not(stuck)
 
         def body(carry):
+            _, _, e0, _ = carry
             head = counts[C_HEAD]
             tail = counts[C_TAIL]
             has_work = head < tail
@@ -306,14 +310,23 @@ class Megakernel:
                 counts[C_HEAD] = head + 1
                 step(idx)
 
-            # pending > 0 with an empty ring means a dependency cycle or a
-            # lost wakeup - a bug; bail out so the host can inspect state.
-            return (counts[C_PENDING], counts[C_EXECUTED], jnp.logical_not(has_work))
+            # pending > 0 with an empty ring means a dependency cycle, a
+            # lost wakeup, or (sharded) tasks parked on another device's
+            # queue; bail out so the caller can rebalance or inspect.
+            return (
+                counts[C_PENDING],
+                counts[C_EXECUTED],
+                e0,
+                jnp.logical_not(has_work),
+            )
 
         def one_rep(r, total_executed) -> jnp.int32:
             stage()
+            e0 = counts[C_EXECUTED]
             jax.lax.while_loop(
-                cond, body, (counts[C_PENDING], counts[C_EXECUTED], jnp.bool_(False))
+                cond,
+                body,
+                (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
             return total_executed + counts[C_EXECUTED]
 

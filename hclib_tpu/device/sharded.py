@@ -6,26 +6,49 @@ megakernel over its own queue partition under ``shard_map``, and global
 results/termination combine with XLA collectives (psum). This is the
 "locality graph over the mesh": locale i's deque is device i's task table.
 
-Work distribution is static in v1 - the host partitions the task graph
-round-robin across devices (each partition must be internally closed under
-dependencies, like the reference's per-locale task placement). Cross-device
-task stealing via Pallas remote DMA and cross-device dependency edges are the
-round-2 follow-ons; the partitioned form already covers data-parallel
-forasync grids and independent task trees.
+The host partitions the task graph round-robin across devices (each
+partition must be internally closed under dependencies, like the reference's
+per-locale task placement); optional **bulk-synchronous work stealing**
+rebalances load at runtime: each round, every device runs its resident
+scheduler for a bounded quantum, then surplus *migratable* ready tasks
+(successor-free descriptors whose kernel is whitelisted) hop to the next
+device over a ``ppermute`` ring, and a ``psum`` over the pending counters
+decides termination. This is the reference's work-stealing loop
+(src/hclib-deque.c steals, src/hclib-runtime.c:403-421 done-flag join)
+re-designed for XLA's SPMD model: instead of thieves CASing a victim's deque
+top, surplus diffuses over the ICI ring in bulk steps, and the pthread-join
+termination becomes a collective.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .descriptor import DESC_WORDS, TaskGraphBuilder
-from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, Megakernel
+from .descriptor import (
+    DESC_WORDS,
+    F_CSR_N,
+    F_FN,
+    F_SUCC0,
+    F_SUCC1,
+    NO_TASK,
+    TaskGraphBuilder,
+)
+from .megakernel import (
+    C_ALLOC,
+    C_EXECUTED,
+    C_HEAD,
+    C_OVERFLOW,
+    C_PENDING,
+    C_TAIL,
+    Megakernel,
+)
 
-__all__ = ["ShardedMegakernel"]
+__all__ = ["ShardedMegakernel", "round_robin_partition"]
 
 
 class ShardedMegakernel:
@@ -35,14 +58,24 @@ class ShardedMegakernel:
     data stacked on a leading mesh axis.
     """
 
-    def __init__(self, mk: Megakernel, mesh: Mesh) -> None:
+    def __init__(
+        self,
+        mk: Megakernel,
+        mesh: Mesh,
+        migratable_fns: Iterable[int] = (),
+    ) -> None:
         if len(mesh.axis_names) != 1:
             raise ValueError("ShardedMegakernel wants a 1D mesh (queue axis)")
         self.mk = mk
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.ndev = int(np.prod(mesh.devices.shape))
-        self._jitted: Dict[int, Any] = {}
+        # Kernel-table ids whose tasks may migrate between devices. A
+        # migratable kernel must be location-independent: it may only read
+        # its args and write accumulate-style value slots (the host combines
+        # per-device ivalues), like forasync tiles or UTS node counters.
+        self.migratable_fns = frozenset(int(f) for f in migratable_fns)
+        self._jitted: Dict[Any, Any] = {}
 
     def _build(self, fuel: int):
         inner = self.mk._build_raw(fuel)
@@ -58,6 +91,115 @@ class ShardedMegakernel:
             # Global termination/health: executed/pending/overflow summed
             # across the mesh (the reference's done-flag join becomes a
             # collective - src/hclib-runtime.c:403-421).
+            gcounts = jax.lax.psum(counts_o, axis)
+            return (
+                counts_o[None],
+                iv_o[None],
+                gcounts[None],
+                *[d[None] for d in data_o],
+            )
+
+        nin = 5 + ndata
+        f = jax.shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(self.axis),) * nin,
+            out_specs=(P(self.axis),) * (3 + ndata),
+            check_vma=False,
+        )
+        return jax.jit(f)
+
+    def _build_steal(self, quantum: int, window: int, max_rounds: int):
+        """Steal-round executor: run-for-quantum, migrate surplus over the
+        device ring, repeat until psum(pending) == 0."""
+        inner = self.mk._build_raw(quantum)
+        ndata = len(self.mk.data_specs)
+        axis = self.axis
+        ndev = self.ndev
+        cap = self.mk.capacity
+        K = window
+        wl_host = np.zeros(max(1, len(self.mk.kernel_fns)), bool)
+        for f in self.migratable_fns:
+            wl_host[f] = True
+        perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+
+        def step(tasks, succ, ring, counts, iv, *data):
+            succ0 = succ[0]
+            wl = jnp.asarray(wl_host)
+            j = jnp.arange(K)
+
+            def cond(carry):
+                tasks, ring_, counts, iv, data, rounds = carry
+                return (jax.lax.psum(counts[C_PENDING], axis) > 0) & (
+                    rounds < max_rounds
+                )
+
+            def body(carry):
+                tasks, ring_, counts, iv, data, rounds = carry
+                outs = inner(tasks, succ0, ring_, counts, iv, *data)
+                tasks, ring_, counts, iv = outs[:4]
+                data = tuple(outs[4:])
+                # ---- export: a prefix of my ready ring, oldest first (the
+                # Chase-Lev thief steals from the top; here the "thief" is
+                # the ring neighbor).
+                head, tail = counts[C_HEAD], counts[C_TAIL]
+                backlog = tail - head
+                gavg = jax.lax.psum(backlog, axis) // ndev
+                quota = jnp.clip(backlog - gavg, 0, K)
+                ring_idx = (head + j) % cap
+                cand = ring_[ring_idx]
+                desc = tasks[jnp.clip(cand, 0, cap - 1)]
+                elig = (
+                    (j < backlog)
+                    & (cand >= 0)
+                    & wl[jnp.clip(desc[:, F_FN], 0, wl.shape[0] - 1)]
+                    & (desc[:, F_SUCC0] == NO_TASK)
+                    & (desc[:, F_SUCC1] == NO_TASK)
+                    & (desc[:, F_CSR_N] == 0)
+                )
+                prefix = jnp.cumprod(elig.astype(jnp.int32)) == 1
+                nsend = jnp.minimum(
+                    jnp.sum(prefix.astype(jnp.int32)), quota
+                ).astype(jnp.int32)
+                sendmask = j < nsend
+                sendbuf = jnp.where(sendmask[:, None], desc, 0)
+                counts = counts.at[C_HEAD].add(nsend).at[C_PENDING].add(-nsend)
+                # ---- exchange: one hop around the ICI ring per round
+                # (surplus diffuses across rounds).
+                recvbuf = jax.lax.ppermute(sendbuf, axis, perm)
+                nrecv = jax.lax.ppermute(
+                    nsend.reshape(1), axis, perm
+                )[0]
+                # ---- import: allocate fresh rows + push to my ready ring.
+                alloc, tail = counts[C_ALLOC], counts[C_TAIL]
+                can = jnp.minimum(nrecv, cap - alloc)
+                take = j < can
+                rows = jnp.clip(alloc + j, 0, cap - 1)
+                tasks = tasks.at[rows].set(
+                    jnp.where(take[:, None], recvbuf, tasks[rows])
+                )
+                slot = (tail + j) % cap
+                ring_ = ring_.at[slot].set(
+                    jnp.where(take, alloc + j, ring_[slot])
+                )
+                counts = (
+                    counts.at[C_ALLOC].add(can)
+                    .at[C_TAIL].add(can)
+                    .at[C_PENDING].add(can)
+                    .at[C_OVERFLOW].max(
+                        jnp.where(nrecv > can, 1, 0).astype(jnp.int32)
+                    )
+                )
+                return (tasks, ring_, counts, iv, data, rounds + 1)
+
+            init = (
+                tasks[0], ring[0], counts[0], iv[0], tuple(d[0] for d in data),
+                jnp.int32(0),
+            )
+            tasks_o, ring_o, counts_o, iv_o, data_o, rounds = (
+                jax.lax.while_loop(cond, body, init)
+            )
+            counts_o = counts_o.at[7].set(rounds)  # steal rounds, for info
             gcounts = jax.lax.psum(counts_o, axis)
             return (
                 counts_o[None],
@@ -94,8 +236,16 @@ class ShardedMegakernel:
         data: Optional[Dict[str, np.ndarray]] = None,
         ivalues: Optional[np.ndarray] = None,
         fuel: int = 1 << 22,
+        steal: bool = False,
+        quantum: int = 256,
+        window: int = 32,
+        max_rounds: int = 1 << 16,
     ):
-        """Execute all partitions; returns (ivalues[ndev, V], data, info)."""
+        """Execute all partitions; returns (ivalues[ndev, V], data, info).
+
+        ``steal=True`` enables bulk-synchronous work stealing: devices run
+        ``quantum`` tasks per round, then up to ``window`` surplus migratable
+        ready tasks hop one device along the ring between rounds."""
         tasks, succ, ring, counts = self.partition(builders)
         if ivalues is None:
             ivalues = np.zeros((self.ndev, self.mk.num_values), np.int32)
@@ -104,11 +254,16 @@ class ShardedMegakernel:
             raise ValueError(
                 f"data buffers {sorted(data)} != declared {sorted(self.mk.data_specs)}"
             )
-        if fuel not in self._jitted:
-            self._jitted[fuel] = self._build(fuel)
+        key = (fuel, steal, quantum, window, max_rounds)
+        if key not in self._jitted:
+            self._jitted[key] = (
+                self._build_steal(quantum, window, max_rounds)
+                if steal
+                else self._build(fuel)
+            )
         sh = NamedSharding(self.mesh, P(self.axis))
         put = lambda x: jax.device_put(np.ascontiguousarray(x), sh)  # noqa: E731
-        outs = self._jitted[fuel](
+        outs = self._jitted[key](
             put(tasks),
             put(succ),
             put(ring),
@@ -125,6 +280,8 @@ class ShardedMegakernel:
             "overflow": bool(g[C_OVERFLOW]),
             "per_device_counts": np.asarray(counts_o),
         }
+        if steal:
+            info["steal_rounds"] = int(np.asarray(counts_o)[0][7])
         if info["overflow"]:
             raise RuntimeError("sharded megakernel task-table overflow")
         if info["pending"] != 0:
