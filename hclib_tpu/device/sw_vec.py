@@ -1,0 +1,69 @@
+"""Vectorized Smith-Waterman: batched row-sweep alignment on the VPU.
+
+The megakernel SW (device/smithwaterman.py) demonstrates wavefront *DDF
+scheduling* - tiles as tasks gated on neighbor promises, the reference
+workload's structure (test/smithwaterman/smith_waterman.cpp:77-180). A
+single scheduler core executes tiles one at a time, so it is latency-bound.
+This module is the *throughput* engine, designed for how the hardware wants
+to compute SW:
+
+- One alignment sweeps the DP matrix row by row (`lax.scan`); the in-row
+  horizontal-gap dependency h[j] = max(t[j], h[j-1]-1) is solved in log
+  depth with the decay-cummax identity
+
+      h[j] = max_{j' <= j} (t[j'] - (j - j')) = cummax(t + j)[j] - j
+
+  (an `associative_scan` of `maximum` - exact for the linear gap penalty
+  GAP=1 used by the reference workload's scoring).
+- Throughput comes from **batching**: `vmap` over B independent pairs makes
+  every row step a (B, m) plane op, which is the standard bioinformatics
+  shape (score one query against a database) and the shape the VPU wants.
+
+Exact versus the sequential reference DP (models/smithwaterman.py sw_seq)
+for the MATCH=2 / MISMATCH=-1 / GAP=1 scheme.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.smithwaterman import GAP, MATCH, MISMATCH
+
+__all__ = ["sw_scores", "sw_score_one"]
+
+assert GAP == 1, "decay-cummax form assumes unit linear gap"
+
+
+def _sw_one(a, b):
+    """Best local-alignment score for one pair; rows of `a` scanned, `b` is
+    the in-register row dimension."""
+    m = b.shape[0]
+    jidx = jnp.arange(m, dtype=jnp.int32)
+
+    def row(prev, ai):
+        s = jnp.where(b == ai, MATCH, MISMATCH).astype(jnp.int32)
+        diag = jnp.concatenate([jnp.zeros(1, jnp.int32), prev[:-1]])
+        t = jnp.maximum(jnp.maximum(diag + s, prev - GAP), 0)
+        c = jax.lax.associative_scan(jnp.maximum, t + jidx) - jidx
+        return c, jnp.max(c)
+
+    prev0 = jnp.zeros(m, jnp.int32)
+    _, row_best = jax.lax.scan(row, prev0, a)
+    return jnp.max(row_best)
+
+
+@jax.jit
+def sw_scores(a_batch, b_batch):
+    """Scores for B pairs: a_batch (B, n) vs b_batch (B, m) -> (B,) i32."""
+    return jax.vmap(_sw_one)(
+        jnp.asarray(a_batch, jnp.int32), jnp.asarray(b_batch, jnp.int32)
+    )
+
+
+def sw_score_one(a: np.ndarray, b: np.ndarray) -> int:
+    return int(sw_scores(np.asarray(a)[None], np.asarray(b)[None])[0])
