@@ -31,6 +31,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..ops.tiles import dma_copy as _dma, factor_tile, mm_nt as _mm_nt, tri_inverse
 from .descriptor import TaskGraphBuilder
 from .megakernel import KernelContext, Megakernel
 
@@ -44,68 +45,16 @@ SYRK = 2
 GEMM = 3
 
 
-def _factor_tile(t, ts: int = T):
-    """Lower-Cholesky a symmetric (ts, ts) tile with masked rank-1 updates."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
-
-    def body(j, carry):
-        s, l = carry
-        diag = jnp.sum(jnp.where((rows == j) & (cols == j), s, 0.0))
-        inv_sqrt = jax.lax.rsqrt(diag)
-        col = jnp.sum(jnp.where(cols == j, s, 0.0), axis=1, keepdims=True)  # (T,1)
-        row = jnp.sum(jnp.where(rows == j, s, 0.0), axis=0, keepdims=True)  # (1,T)
-        lcol = jnp.where(rows >= j, col * inv_sqrt, 0.0)
-        l = jnp.where(cols == j, lcol, l)
-        upd = (col * row) / diag
-        s = jnp.where((rows > j) & (cols > j), s - upd, s)
-        return s, l
-
-    _, l = jax.lax.fori_loop(0, ts, body, (t, jnp.zeros_like(t)))
-    return l
-
-
-def _tri_inverse(l, ts: int = T):
-    """inv(L) for lower-triangular L via Newton-Schulz (exact in log2 ts)."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
-    dg = jnp.sum(jnp.where(rows == cols, l, 0.0), axis=1, keepdims=True)  # (T,1)
-    x = jnp.where(rows == cols, 1.0 / dg, 0.0)
-    steps = max(1, int(np.ceil(np.log2(ts))))
-    hi = jax.lax.Precision.HIGHEST
-    for _ in range(steps):
-        lx = jnp.dot(l, x, preferred_element_type=jnp.float32, precision=hi)
-        x = 2.0 * x - jnp.dot(x, lx, preferred_element_type=jnp.float32, precision=hi)
-    return x
-
-
-def _mm_nt(a, b):
-    """a @ b^T without materializing the transpose. HIGHEST precision keeps
-    f32 inputs f32 on the MXU (default rounds through bf16 passes, costing
-    ~3 decimal digits on the factorization residual)."""
-    return jax.lax.dot_general(
-        a, b, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-
-
-def _dma(src, dst, sem):
-    cp = pltpu.make_async_copy(src, dst, sem)
-    cp.start()
-    cp.wait()
-
-
 def _potrf_kernel(ctx: KernelContext, ts: int = T) -> None:
     k = ctx.arg(0)
     tiles, linv = ctx.data["tiles"], ctx.data["linv"]
     va = ctx.scratch["va"]
     sem = ctx.scratch["sems"]
     _dma(tiles.at[k, k], va, sem.at[0])
-    l = _factor_tile(va[:], ts)
+    l = factor_tile(va[:], ts)
     va[:] = l
     _dma(va, tiles.at[k, k], sem.at[0])
-    va[:] = _tri_inverse(l, ts)
+    va[:] = tri_inverse(l, ts)
     _dma(va, linv.at[k], sem.at[0])
 
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.smithwaterman import GAP, MATCH, MISMATCH
+from ..ops.scan import decay_cummax
 
 __all__ = ["sw_scores", "sw_score_one"]
 
@@ -49,7 +50,7 @@ def _sw_one(a, b):
         s = jnp.where(b == ai, MATCH, MISMATCH).astype(jnp.int32)
         diag = jnp.concatenate([jnp.zeros(1, jnp.int32), prev[:-1]])
         t = jnp.maximum(jnp.maximum(diag + s, prev - GAP), 0)
-        c = jax.lax.associative_scan(jnp.maximum, t + jidx) - jidx
+        c = decay_cummax(t)
         return c, jnp.max(c)
 
     prev0 = jnp.zeros(m, jnp.int32)

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.uts import FIXED, UTSParams
+from ..ops.sha1 import sha1_block as _sha1_block, sha1_child as _sha1_child
 
 __all__ = ["uts_vec", "child_thresholds", "LANES", "NLANES"]
 
@@ -84,65 +85,6 @@ def child_thresholds(b0: float) -> np.ndarray:
                 lo = mid + 1
         ts.append(lo)
     return np.asarray(ts, dtype=np.int32)
-
-
-def _rotl(x, s: int):
-    # Plain-int shift amounts keep u32 dtype under both numpy (NEP 50 weak
-    # scalars) and jnp weak typing.
-    return (x << s) | (x >> (32 - s))
-
-
-def _sha1_block(w16: List, xp):
-    """SHA-1 compression of one 16-word block, vectorized over arrays of any
-    shape. Works for both jnp (device planes) and numpy (host BFS seeding)."""
-    K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
-    H = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
-    w = list(w16)
-    a = xp.full_like(w[0], H[0])
-    b = xp.full_like(w[0], H[1])
-    c = xp.full_like(w[0], H[2])
-    d = xp.full_like(w[0], H[3])
-    e = xp.full_like(w[0], H[4])
-    for i in range(80):
-        if i >= 16:
-            nw = _rotl(w[(i - 3) % 16] ^ w[(i - 8) % 16] ^ w[(i - 14) % 16]
-                       ^ w[i % 16], 1)
-            w[i % 16] = nw
-        wi = w[i % 16]
-        if i < 20:
-            f = (b & c) | (~b & d)
-            k = K[0]
-        elif i < 40:
-            f = b ^ c ^ d
-            k = K[1]
-        elif i < 60:
-            f = (b & c) | (b & d) | (c & d)
-            k = K[2]
-        else:
-            f = b ^ c ^ d
-            k = K[3]
-        tmp = _rotl(a, 5) + f + e + xp.uint32(k) + wi
-        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
-    return (
-        a + xp.uint32(H[0]),
-        b + xp.uint32(H[1]),
-        c + xp.uint32(H[2]),
-        d + xp.uint32(H[3]),
-        e + xp.uint32(H[4]),
-    )
-
-
-def _sha1_child(state5, child_idx, xp):
-    """SHA1(parent_state(20B) || BE32(child)) for 24-byte messages."""
-    zero = xp.zeros_like(state5[0])
-    w16 = [
-        state5[0], state5[1], state5[2], state5[3], state5[4],
-        child_idx.astype(xp.uint32),
-        xp.full_like(state5[0], 0x80000000),
-        zero, zero, zero, zero, zero, zero, zero, zero,
-        xp.full_like(state5[0], 24 * 8),
-    ]
-    return _sha1_block(w16, xp)
 
 
 def _level_select(stack, sp):

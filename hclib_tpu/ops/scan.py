@@ -1,0 +1,22 @@
+"""Scan-based recurrence solvers for the VPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decay_cummax"]
+
+
+def decay_cummax(t, axis: int = -1):
+    """Solve c[j] = max(t[j], c[j-1] - 1) in log depth.
+
+    Uses the identity c[j] = max_{j' <= j} (t[j'] - (j - j')) =
+    cummax(t + j)[j] - j. This is the in-row horizontal-gap chain of
+    Smith-Waterman with unit linear gap (hclib_tpu/device/sw_vec.py).
+    """
+    j = jnp.arange(t.shape[axis], dtype=t.dtype)
+    shape = [1] * t.ndim
+    shape[axis] = -1
+    j = j.reshape(shape)
+    return jax.lax.associative_scan(jnp.maximum, t + j, axis=axis) - j
