@@ -60,7 +60,8 @@ class KernelContext:
     """Facilities exposed to device task kernels (the device analogue of the
     worker-state + spawn API the reference hands to tasks)."""
 
-    def __init__(self, idx, tasks, succ, ready, counts, ivalues, data, scratch, capacity):
+    def __init__(self, idx, tasks, succ, ready, counts, ivalues, data,
+                 scratch, capacity, free, num_values):
         self.idx = idx  # this task's descriptor index
         self._tasks = tasks
         self._succ = succ
@@ -70,6 +71,11 @@ class KernelContext:
         self.data = data  # name -> ref (HBM/VMEM tensor buffers)
         self.scratch = scratch  # name -> scratch ref (VMEM buffers, DMA sems)
         self._capacity = capacity
+        self._num_values = num_values
+        # Free-stack of recycled descriptor rows: free[0] is the count,
+        # free[1..] the stack (completed rows are reclaimed, so a bounded
+        # table runs unbounded dynamic graphs whose *live* set fits).
+        self._free = free
 
     # -- descriptor access --
 
@@ -92,10 +98,20 @@ class KernelContext:
     # -- dynamic task creation --
 
     def alloc_values(self, k: int):
-        """Reserve k consecutive scalar value slots; returns the base slot."""
+        """Reserve k consecutive scalar value slots; returns the base slot.
+
+        Value slots are not recycled (unlike descriptor rows); exhaustion
+        sets the overflow flag and clamps so writes stay in bounds - the
+        host raises after the kernel returns."""
         base = self._counts[C_VALLOC]
-        self._counts[C_VALLOC] = base + k
-        return base
+        ok = base + k <= self._num_values
+        self._counts[C_VALLOC] = jnp.where(ok, base + k, base)
+
+        @pl.when(jnp.logical_not(ok))
+        def _():
+            self._counts[C_OVERFLOW] = 1
+
+        return jnp.where(ok, base, jnp.maximum(self._num_values - k, 0))
 
     def push_ready(self, t) -> None:
         tail = self._counts[C_TAIL]
@@ -131,13 +147,25 @@ class KernelContext:
         (the reference asserts on deque overflow, src/hclib-runtime.c:520-524;
         here the host checks the flag after the kernel returns).
         """
-        a = self._counts[C_ALLOC]
-        ok = a < self._capacity
-        a_clamped = jnp.where(ok, a, self._capacity - 1)
+        nfree = self._free[0]
+        use_free = nfree > 0
+        a_free = self._free[jnp.maximum(nfree, 1)]
+        a_new = self._counts[C_ALLOC]
+        ok = use_free | (a_new < self._capacity)
+        a_clamped = jnp.where(
+            use_free, a_free, jnp.minimum(a_new, self._capacity - 1)
+        )
+
+        @pl.when(use_free)
+        def _():
+            self._free[0] = nfree - 1
+
+        @pl.when(jnp.logical_not(use_free) & (a_new < self._capacity))
+        def _():
+            self._counts[C_ALLOC] = a_new + 1
 
         @pl.when(ok)
         def _():
-            self._counts[C_ALLOC] = a + 1
             self._counts[C_PENDING] = self._counts[C_PENDING] + 1
             self._tasks[a_clamped, F_FN] = jnp.int32(fn)
             self._tasks[a_clamped, F_DEP] = jnp.int32(dep_count)
@@ -207,7 +235,8 @@ class Megakernel:
         n_in = 5 + ndata
         in_refs = refs[:n_in]
         out_refs = refs[n_in : n_in + 4 + ndata]
-        scratch_refs = refs[n_in + 4 + ndata :]
+        scratch_refs = refs[n_in + 4 + ndata : -1]
+        free = refs[-1]  # internal free-stack: [0]=count, [1..]=rows
         succ = in_refs[1]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(self.data_specs.keys(), out_refs[4:]))
@@ -225,6 +254,7 @@ class Megakernel:
         tasks_in, _, ready_in, counts_in, ivalues_in = in_refs[:5]
 
         def stage() -> None:
+            free[0] = 0
             for i in range(8):
                 counts[i] = counts_in[i]
 
@@ -282,10 +312,19 @@ class Megakernel:
             jax.lax.fori_loop(0, n, body, 0)
             counts[C_PENDING] = counts[C_PENDING] - 1
             counts[C_EXECUTED] = counts[C_EXECUTED] + 1
+            # Reclaim the completed row: nothing references it anymore
+            # (predecessors completed earlier; successor lists only point
+            # forward), so it can back future spawns - a bounded table runs
+            # unbounded dynamic graphs whose live set fits (the reference
+            # frees tasks after execution, src/hclib-runtime.c:448-478).
+            nf = free[0] + 1
+            free[0] = nf
+            free[nf] = idx
 
         def step(idx) -> None:
             ctx = KernelContext(
-                idx, tasks, succ, ready, counts, ivalues, data, scratch, capacity
+                idx, tasks, succ, ready, counts, ivalues, data, scratch,
+                capacity, free, self.num_values
             )
             branches = [functools.partial(fn, ctx) for fn in self.kernel_fns]
             jax.lax.switch(tasks[idx, F_FN], branches)
@@ -306,8 +345,12 @@ class Megakernel:
 
             @pl.when(has_work)
             def _():
-                idx = ready[head % capacity]
-                counts[C_HEAD] = head + 1
+                # LIFO on the owner side (newest first, depth-first, small
+                # live sets); the head side is the steal/export side
+                # (device/sharded.py) - the Chase-Lev split of the reference
+                # deque (src/hclib-deque.c).
+                idx = ready[(tail - 1) % capacity]
+                counts[C_TAIL] = tail - 1
                 step(idx)
 
             # pending > 0 with an empty ring means a dependency cycle, a
@@ -370,7 +413,8 @@ class Megakernel:
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
-            scratch_shapes=list(self.scratch_specs.values()),
+            scratch_shapes=list(self.scratch_specs.values())
+            + [pltpu.SMEM((self.capacity + 1,), jnp.int32)],
             input_output_aliases=aliases,
             interpret=self.interpret,
         )
@@ -431,8 +475,10 @@ class Megakernel:
         }
         if info["overflow"]:
             raise RuntimeError(
-                f"megakernel task-table overflow (capacity={self.capacity}); "
-                "raise capacity or coarsen tasks"
+                f"megakernel overflow (task-table capacity={self.capacity}, "
+                f"live set exceeded it, or value slots num_values="
+                f"{self.num_values} exhausted); raise the limits or coarsen "
+                "tasks"
             )
         if info["pending"] != 0:
             raise RuntimeError(

@@ -114,6 +114,7 @@ def _level_store(stack, sp, value, mask):
     jax.jit,
     static_argnames=(
         "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
+        "min_idle_div",
     ),
 )
 def _uts_dfs(
@@ -125,6 +126,7 @@ def _uts_dfs(
     thresholds: tuple,  # static ints: compiled as immediates
     max_steps: int,
     lanes: tuple,
+    min_idle_div: int = 8,
 ):
     nthresh = len(thresholds)
     S = stack_size
@@ -143,8 +145,9 @@ def _uts_dfs(
     # one SHA-1 step, so the hot expansion loop runs refill-free (inner
     # while) until this many lanes are idle; the outer loop then claims
     # roots for all of them at once. Imbalance cost is bounded by
-    # min_idle/nlanes per refill round.
-    refill_min_idle = max(64, nlanes // 8)
+    # min_idle/nlanes per refill round; refill wall cost by R/min_idle
+    # rounds - min_idle_div trades the two.
+    refill_min_idle = max(64, nlanes // min_idle_div)
 
     def refill(sp, next_root, st0, ch0, cn0, dp0):
         done = sp < 0
@@ -341,6 +344,7 @@ def uts_vec(
     max_steps: Optional[int] = None,
     device=None,
     lanes: Tuple[int, int] = LANES,
+    min_idle_div: int = 8,
 ) -> dict:
     """Run UTS with the vectorized DFS engine; returns counts + timing info.
 
@@ -382,6 +386,7 @@ def uts_vec(
         thresholds=tuple(int(t) for t in child_thresholds(params.b0)),
         max_steps=max_steps,
         lanes=tuple(lanes),
+        min_idle_div=min_idle_div,
     )
     if device is not None:
         args = tuple(jax.device_put(a, device) for a in args)

@@ -51,19 +51,31 @@ def _sum_kernel(ctx: KernelContext) -> None:
     ctx.set_out(ctx.value(ctx.arg(0)) + ctx.value(ctx.arg(1)))
 
 
-def make_fib_megakernel(capacity: int = 8192, interpret: Optional[bool] = None) -> Megakernel:
+def make_fib_megakernel(
+    capacity: int = 8192,
+    interpret: Optional[bool] = None,
+    num_values: Optional[int] = None,
+) -> Megakernel:
+    # Descriptor rows recycle (live set = spawn-tree depth) but value slots
+    # do not: fib(n) burns ~2 slots per internal node, so the value buffer,
+    # not the task table, sizes the largest runnable graph.
     return Megakernel(
         kernels=[("fib", _fib_kernel), ("sum", _sum_kernel)],
         capacity=capacity,
-        num_values=capacity,
+        num_values=capacity if num_values is None else num_values,
         succ_capacity=64,
         interpret=interpret,
     )
 
 
-def device_fib(n: int, capacity: int = 8192, interpret: Optional[bool] = None) -> Tuple[int, dict]:
+def device_fib(
+    n: int,
+    capacity: int = 8192,
+    interpret: Optional[bool] = None,
+    num_values: Optional[int] = None,
+) -> Tuple[int, dict]:
     """Compute fib(n) entirely on-device via dynamic task spawning."""
-    mk = make_fib_megakernel(capacity, interpret)
+    mk = make_fib_megakernel(capacity, interpret, num_values=num_values)
     b = TaskGraphBuilder()
     b.add(FIB, args=[n], out=0)
     ivalues, _, info = mk.run(b)
