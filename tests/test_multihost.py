@@ -1,0 +1,91 @@
+"""Multi-host layer (parallel/multihost.py): single-process graceful path +
+global mesh over the virtual 8-device backend."""
+
+import numpy as np
+import pytest
+
+from hclib_tpu.parallel import multihost as mh
+
+
+_CLUSTER_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_STEP_NUM_TASKS",
+    "OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "TPU_WORKER_HOSTNAMES",
+)
+
+
+def test_single_process_degrades_gracefully(monkeypatch):
+    for k in _CLUSTER_VARS:
+        monkeypatch.delenv(k, raising=False)
+    mh.init_multihost()  # no cluster env: must be a no-op
+    assert mh.process_index() == 0
+    assert mh.process_count() == 1
+    assert not mh.is_multihost()
+
+
+def test_global_mesh_covers_all_devices():
+    import jax
+
+    cpus = jax.devices("cpu")
+    mesh = mh.global_mesh("dp", devices=cpus)
+    assert int(np.prod(mesh.devices.shape)) == len(cpus) == 8
+    mesh2 = mh.global_mesh("a", "b", axis_shape=(2, 4), devices=cpus)
+    assert mesh2.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        mh.global_mesh("a", "b", devices=cpus)  # multi-axis needs a shape
+    with pytest.raises(ValueError):
+        mh.global_mesh("a", "b", axis_shape=(3, 5), devices=cpus)
+    with pytest.raises(ValueError):
+        mh.global_mesh("a", axis_shape=(4,), devices=cpus)  # 4 != 8 devices
+
+
+def test_sync_global_runs():
+    mh.sync_global(tag=7)  # completes = every (single) participant arrived
+    mh.sync_global(tag=7)  # second call hits the cached compiled barrier
+    assert mh._local_barrier.cache_info().hits >= 1
+
+
+def test_cluster_env_detection(monkeypatch):
+    for k in _CLUSTER_VARS:
+        monkeypatch.delenv(k, raising=False)
+    assert not mh._cluster_env_present()
+    monkeypatch.setenv("SLURM_STEP_NUM_TASKS", "1")
+    assert not mh._cluster_env_present()  # single-task step: not a cluster
+    monkeypatch.setenv("SLURM_NTASKS", "4")  # sbatch leak, no srun step
+    assert not mh._cluster_env_present()
+    monkeypatch.setenv("SLURM_STEP_NUM_TASKS", "4")
+    assert mh._cluster_env_present()
+    monkeypatch.delenv("SLURM_STEP_NUM_TASKS")
+    monkeypatch.delenv("SLURM_NTASKS")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0")
+    assert not mh._cluster_env_present()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1")
+    assert mh._cluster_env_present()
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert mh._cluster_env_present()
+
+
+def test_sharded_megakernel_over_global_mesh():
+    """The same sharded scheduler code runs over the multihost-global mesh
+    (here: 8 virtual devices standing in for a pod's)."""
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.device.sharded import ShardedMegakernel
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    import jax
+
+    mesh = mh.global_mesh("queues", devices=jax.devices("cpu"))
+    ndev = int(np.prod(mesh.devices.shape))
+    mk = Megakernel(kernels=[("bump", bump)], capacity=64, num_values=4,
+                    succ_capacity=8, interpret=True)
+    smk = ShardedMegakernel(mk, mesh, migratable_fns=[0])
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    for i in range(4 * ndev):
+        builders[0].add(0, args=[1])
+    iv, _, info = smk.run(builders, steal=True, quantum=4, window=8)
+    assert info["pending"] == 0
+    assert int(iv[:, 0].sum()) == 4 * ndev
