@@ -322,8 +322,15 @@ def _host_seed(params: UTSParams, target_roots: int):
         if n >= target_roots:
             # Hand the non-leaf frontier to the device. Frontier leaves were
             # already counted above; roots themselves were counted as nodes.
+            # LPT order: biggest child counts first, so the large subtrees
+            # are claimed (and balanced over lanes) early and the drain tail
+            # is short - classic longest-processing-time scheduling. Totals
+            # are order-independent; only steps/lane-efficiency change.
             rs = [s[nonleaf] for s in state5]
             rc = counts[nonleaf]
+            order = np.argsort(-rc, kind="stable")
+            rs = [s[order] for s in rs]
+            rc = rc[order]
             return (
                 host_nodes, host_leaves, host_maxd, depth,
                 np.stack(rs).astype(np.uint32), rc.astype(np.int32),
