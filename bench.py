@@ -50,7 +50,8 @@ def bench_device_fib():
     def fresh():
         return [
             jax.device_put(jnp.asarray(x))
-            for x in (tasks, succ, ring, counts, np.zeros(cap, np.int32))
+            for x in (tasks, succ, ring, counts,
+                      np.zeros(mk.num_values, np.int32))
         ]
 
     points = []

@@ -72,6 +72,7 @@ class TaskGraphBuilder:
     def __init__(self) -> None:
         self._rows: List[List[int]] = []
         self._succs: List[List[int]] = []  # successor indices per task
+        self._reserved_values = 0
 
     def add(
         self,
@@ -100,6 +101,13 @@ class TaskGraphBuilder:
     @property
     def num_tasks(self) -> int:
         return len(self._rows)
+
+    def reserve_values(self, n: int) -> None:
+        """Declare slots [0, n) as host-owned: they are staged into the
+        kernel (even if preset to zero) and the device allocator/row blocks
+        start above them. Out slots already reserve themselves; use this for
+        input-only or deliberately-zero slots."""
+        self._reserved_values = max(self._reserved_values, int(n))
 
     def finalize(self, capacity: Optional[int] = None, succ_capacity: Optional[int] = None):
         """Returns (tasks, succ_csr, ready, counts0) numpy arrays sized to
@@ -141,6 +149,10 @@ class TaskGraphBuilder:
         counts[2] = n  # alloc cursor (next free descriptor row)
         counts[3] = n  # pending (tasks not yet executed)
         # Start on-device value allocation past every host-assigned out slot
-        # so alloc_values never aliases a host task's output.
-        counts[4] = 1 + max((row[F_OUT] for row in self._rows), default=-1)
+        # (and any reserve_values declaration) so alloc_values/row blocks
+        # never alias a host slot.
+        counts[4] = max(
+            1 + max((row[F_OUT] for row in self._rows), default=-1),
+            self._reserved_values,
+        )
         return tasks, succ_arr, ring, counts

@@ -44,7 +44,13 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 
-__all__ = ["KernelContext", "Megakernel"]
+__all__ = ["KernelContext", "Megakernel", "VBLOCK"]
+
+# Value slots are allocated in fixed blocks of this many words so freed
+# blocks are interchangeable (alloc_values' k is static per call site, so a
+# shared free stack must hand out uniform sizes). Allocations larger than
+# VBLOCK fall back to exact-size bump allocation without recycling.
+VBLOCK = 4
 
 # counts[] slots
 C_HEAD = 0
@@ -54,6 +60,10 @@ C_PENDING = 3
 C_VALLOC = 4
 C_EXECUTED = 5
 C_OVERFLOW = 6
+# First value slot above the host-preset range (set by stage() on every
+# kernel entry; meaningful in-kernel only - the sharded steal runner reuses
+# slot 7 to report its round count AFTER its loop finishes).
+C_VBASE = 7
 
 
 class KernelContext:
@@ -61,7 +71,8 @@ class KernelContext:
     worker-state + spawn API the reference hands to tasks)."""
 
     def __init__(self, idx, tasks, succ, ready, counts, ivalues, data,
-                 scratch, capacity, free, num_values):
+                 scratch, capacity, free, num_values, vfree,
+                 uses_row_values=False):
         self.idx = idx  # this task's descriptor index
         self._tasks = tasks
         self._succ = succ
@@ -76,11 +87,20 @@ class KernelContext:
         # free[1..] the stack (completed rows are reclaimed, so a bounded
         # table runs unbounded dynamic graphs whose *live* set fits).
         self._free = free
+        # Free-stack of recycled VBLOCK-word value blocks, same layout.
+        self._vfree = vfree
+        self._uses_row_values = uses_row_values
 
     # -- descriptor access --
 
     def arg(self, i: int):
         return self._tasks[self.idx, F_A0 + i]
+
+    def set_arg(self, idx, i: int, v) -> None:
+        """Write argument word i of descriptor ``idx`` (e.g. to point a
+        just-spawned join task at values whose location depends on its own
+        row, which is only known after the spawn)."""
+        self._tasks[idx, F_A0 + i] = v
 
     @property
     def out_slot(self):
@@ -100,18 +120,81 @@ class KernelContext:
     def alloc_values(self, k: int):
         """Reserve k consecutive scalar value slots; returns the base slot.
 
-        Value slots are not recycled (unlike descriptor rows); exhaustion
-        sets the overflow flag and clamps so writes stay in bounds - the
-        host raises after the kernel returns."""
-        base = self._counts[C_VALLOC]
-        ok = base + k <= self._num_values
-        self._counts[C_VALLOC] = jnp.where(ok, base + k, base)
+        k <= VBLOCK allocations consume one VBLOCK-word block, preferring a
+        recycled block from the free stack (see ``free_values``) over the
+        bump allocator - so graphs whose *live* value set fits run
+        unbounded, like descriptor rows. k > VBLOCK falls back to exact-size
+        bump allocation and is never recycled. Exhaustion sets the overflow
+        flag and clamps so writes stay in bounds - the host raises after
+        the kernel returns.
 
-        @pl.when(jnp.logical_not(ok))
-        def _():
-            self._counts[C_OVERFLOW] = 1
+        Re-entrant callers (the sharded steal round loop): both free stacks
+        are scratch, reset on every kernel entry, so blocks freed in an
+        earlier round are NOT reusable later - the bump cursor holds its
+        high-water mark and exhaustion is reported as overflow, never
+        corruption. Long-lived recycling under re-entry wants row-owned
+        blocks (``row_values``), which recycle with descriptor rows."""
+        if self._uses_row_values:
+            # Trace-time guard: the bump region starts exactly at the
+            # row-block base (C_VBASE == initial C_VALLOC), so any bump
+            # allocation would silently alias row 0's block.
+            raise ValueError(
+                "alloc_values cannot be mixed with row_values "
+                "(uses_row_values=True): the bump region overlaps the "
+                "row-owned blocks"
+            )
+        # Branch-free (unconditional SMEM read-modify-writes + selects):
+        # scalar-core conditionals cost more than the handful of extra SMEM
+        # ops they would save, and this runs on every dynamic spawn.
+        if k > VBLOCK:
+            base = self._counts[C_VALLOC]
+            ok = base + k <= self._num_values
+            self._counts[C_VALLOC] = jnp.where(ok, base + k, base)
+            self._counts[C_OVERFLOW] = jnp.where(
+                ok, self._counts[C_OVERFLOW], 1
+            )
+            return jnp.where(ok, base, jnp.maximum(self._num_values - k, 0))
+        nfree = self._vfree[0]
+        use_free = nfree > 0
+        b_free = self._vfree[jnp.maximum(nfree, 1)]
+        b_new = self._counts[C_VALLOC]
+        ok = use_free | (b_new + VBLOCK <= self._num_values)
+        self._vfree[0] = nfree - use_free.astype(jnp.int32)
+        self._counts[C_VALLOC] = jnp.where(
+            jnp.logical_not(use_free) & ok, b_new + VBLOCK, b_new
+        )
+        self._counts[C_OVERFLOW] = jnp.where(ok, self._counts[C_OVERFLOW], 1)
+        return jnp.where(
+            use_free,
+            b_free,
+            jnp.where(
+                ok, b_new, jnp.maximum(self._num_values - VBLOCK, 0)
+            ),
+        )
 
-        return jnp.where(ok, base, jnp.maximum(self._num_values - k, 0))
+    def row_values(self, idx):
+        """Base of the VBLOCK-word value block *owned by descriptor row*
+        ``idx`` - the zero-overhead alternative to alloc/free_values for
+        spawn/join patterns: the block's lifetime IS the row's lifetime
+        (rows recycle on completion, so the block recycles with them, no
+        allocator on the hot path). A join task derives its block from its
+        own row (``ctx.row_values(ctx.idx)``); its spawner points children's
+        out slots into it. Requires ``num_values >= host-preset slots +
+        VBLOCK * capacity`` (sized by the host; see Megakernel docs) and
+        must not be mixed with bump-side ``alloc_values`` in the same
+        megakernel (the bump region overlaps the row blocks)."""
+        return self._counts[C_VBASE] + idx * VBLOCK
+
+    def free_values(self, base) -> None:
+        """Return the VBLOCK-word block at ``base`` (from a k <= VBLOCK
+        ``alloc_values``) to the free stack. Call from the kernel that
+        consumes the block's values - after this, the slots may be handed to
+        any later allocation (the analogue of the reference freeing a task's
+        promise cells once its continuation has read them). Never free
+        host-preset slots or k > VBLOCK allocations."""
+        nf = self._vfree[0] + 1
+        self._vfree[0] = nf
+        self._vfree[nf] = base
 
     def push_ready(self, t) -> None:
         tail = self._counts[C_TAIL]
@@ -209,6 +292,7 @@ class Megakernel:
         num_values: int = 4096,
         succ_capacity: int = 4096,
         interpret: Optional[bool] = None,
+        uses_row_values: bool = False,
     ) -> None:
         self.kernel_names = [name for name, _ in kernels]
         self.kernel_fns = [fn for _, fn in kernels]
@@ -218,6 +302,10 @@ class Megakernel:
         self.capacity = capacity
         self.num_values = num_values
         self.succ_capacity = succ_capacity
+        # Declare when any kernel calls ctx.row_values: run() then verifies
+        # every row's block fits below num_values (the region starts at the
+        # runtime value_alloc, which out-slots and presets can push up).
+        self.uses_row_values = uses_row_values
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
         self.interpret = interpret
@@ -229,14 +317,17 @@ class Megakernel:
 
     # -- the kernel body --
 
-    def _kernel(self, fuel: int, reps: int, *refs) -> None:
+    def _kernel(
+        self, fuel: int, reps: int, stage_all_values: bool, *refs
+    ) -> None:
         ndata = len(self.data_specs)
         nscratch = len(self.scratch_specs)
         n_in = 5 + ndata
         in_refs = refs[:n_in]
         out_refs = refs[n_in : n_in + 4 + ndata]
-        scratch_refs = refs[n_in + 4 + ndata : -1]
-        free = refs[-1]  # internal free-stack: [0]=count, [1..]=rows
+        scratch_refs = refs[n_in + 4 + ndata : -2]
+        free = refs[-2]  # internal free-stack: [0]=count, [1..]=rows
+        vfree = refs[-1]  # value-block free-stack, same layout
         succ = in_refs[1]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(self.data_specs.keys(), out_refs[4:]))
@@ -255,8 +346,11 @@ class Megakernel:
 
         def stage() -> None:
             free[0] = 0
+            vfree[0] = 0
             for i in range(8):
                 counts[i] = counts_in[i]
+            # Row-owned value blocks sit directly above the host range.
+            counts[C_VBASE] = counts_in[C_VALLOC]
 
             def copy_task(i, _):
                 for w in range(DESC_WORDS):
@@ -275,10 +369,20 @@ class Megakernel:
                 ivalues[i] = ivalues_in[i]
                 return 0
 
-            # All value slots: the host may preset any slot via run(ivalues=)
-            # regardless of task out-slots, and unwritten slots must read
-            # back as their inputs, not uninitialized SMEM.
-            jax.lax.fori_loop(0, self.num_values, copy_vals, 0)
+            # stage_all_values=True (re-entrant callers like the sharded
+            # steal loop, where slots above value_alloc carry live results
+            # between kernel entries) copies every slot. Single-shot run()
+            # copies host slots only ([0, value_alloc), widened over any
+            # nonzero presets): slots above are device-owned temporaries
+            # nobody reads back, and staging all num_values slots cost ~3
+            # scalar copies per task on fib-sized graphs once row-owned
+            # blocks grew the buffer.
+            jax.lax.fori_loop(
+                0,
+                self.num_values if stage_all_values else counts_in[C_VALLOC],
+                copy_vals,
+                0,
+            )
 
         def push_ready(t) -> None:
             tail = counts[C_TAIL]
@@ -324,7 +428,8 @@ class Megakernel:
         def step(idx) -> None:
             ctx = KernelContext(
                 idx, tasks, succ, ready, counts, ivalues, data, scratch,
-                capacity, free, self.num_values
+                capacity, free, self.num_values, vfree,
+                self.uses_row_values,
             )
             branches = [functools.partial(fn, ctx) for fn in self.kernel_fns]
             jax.lax.switch(tasks[idx, F_FN], branches)
@@ -382,8 +487,40 @@ class Megakernel:
 
     # -- host entry --
 
-    def _build_raw(self, fuel: int, reps: int = 1):
-        """The bare pallas_call (for embedding under shard_map)."""
+    @staticmethod
+    def widen_value_alloc(counts_row, ivalues_row) -> None:
+        """Widen counts_row[C_VALLOC] over the highest nonzero preset in
+        ivalues_row (in place): presets are host slots, so staging must
+        cover them and the device bump/row-block regions must sit above.
+        Deliberate ZERO presets above value_alloc can't be detected here -
+        declare them with TaskGraphBuilder.reserve_values instead."""
+        nz = np.flatnonzero(np.asarray(ivalues_row))
+        if len(nz):
+            counts_row[C_VALLOC] = max(
+                counts_row[C_VALLOC], int(nz[-1]) + 1
+            )
+
+    def check_row_values(self, value_alloc: int) -> None:
+        """For uses_row_values kernels: every row's block ([value_alloc,
+        value_alloc + VBLOCK*capacity)) must fit in the value buffer, else
+        row_values writes would clamp and silently corrupt the top slots."""
+        if not self.uses_row_values:
+            return
+        need = value_alloc + VBLOCK * self.capacity
+        if need > self.num_values:
+            raise ValueError(
+                f"row-owned value blocks need num_values >= value_alloc"
+                f"({value_alloc}) + VBLOCK*capacity({VBLOCK * self.capacity})"
+                f" = {need}, got {self.num_values}; shrink out slots/presets "
+                "or grow num_values"
+            )
+
+    def _build_raw(
+        self, fuel: int, reps: int = 1, stage_all_values: bool = False
+    ):
+        """The bare pallas_call (for embedding under shard_map; re-entrant
+        callers must pass stage_all_values=True so value slots above
+        value_alloc survive between entries)."""
         ndata = len(self.data_specs)
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
@@ -409,12 +546,15 @@ class Megakernel:
         for i in range(ndata):
             aliases[5 + i] = 4 + i
         return pl.pallas_call(
-            functools.partial(self._kernel, fuel, reps),
+            functools.partial(self._kernel, fuel, reps, stage_all_values),
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
             scratch_shapes=list(self.scratch_specs.values())
-            + [pltpu.SMEM((self.capacity + 1,), jnp.int32)],
+            + [
+                pltpu.SMEM((self.capacity + 1,), jnp.int32),
+                pltpu.SMEM((self.num_values // VBLOCK + 1,), jnp.int32),
+            ],
             input_output_aliases=aliases,
             interpret=self.interpret,
         )
@@ -436,6 +576,10 @@ class Megakernel:
         )
         if ivalues is None:
             ivalues = np.zeros(self.num_values, dtype=np.int32)
+        else:
+            counts = counts.copy()
+            self.widen_value_alloc(counts, ivalues)
+        self.check_row_values(int(counts[C_VALLOC]))
         data = dict(data or {})
         if set(data.keys()) != set(self.data_specs.keys()):
             raise ValueError(
@@ -471,6 +615,7 @@ class Megakernel:
             "executed": int(counts_np[C_EXECUTED]),
             "pending": int(counts_np[C_PENDING]),
             "allocated": int(counts_np[C_ALLOC]),
+            "value_alloc": int(counts_np[C_VALLOC]),
             "overflow": bool(counts_np[C_OVERFLOW]),
         }
         if info["overflow"]:

@@ -45,6 +45,7 @@ from .megakernel import (
     C_OVERFLOW,
     C_PENDING,
     C_TAIL,
+    C_VALLOC,
     Megakernel,
 )
 
@@ -78,6 +79,8 @@ class ShardedMegakernel:
         self._jitted: Dict[Any, Any] = {}
 
     def _build(self, fuel: int):
+        # Single kernel entry per launch: lean value staging suffices (run()
+        # widens value_alloc over presets before the call).
         inner = self.mk._build_raw(fuel)
         ndata = len(self.mk.data_specs)
         axis = self.axis
@@ -112,7 +115,14 @@ class ShardedMegakernel:
     def _build_steal(self, quantum: int, window: int, max_rounds: int):
         """Steal-round executor: run-for-quantum, migrate surplus over the
         device ring, repeat until psum(pending) == 0."""
-        inner = self.mk._build_raw(quantum)
+        # Full value staging: the round loop re-enters the kernel, and value
+        # slots above value_alloc (row-owned blocks, bump allocations) carry
+        # live results between entries. Free stacks are scratch and reset
+        # per entry, so rows/blocks freed in one round are not reused in
+        # later rounds (alloc cursors ratchet; exhaustion raises overflow) -
+        # size capacity/num_values for the executed total, not the live set,
+        # when quantum splits a dynamic graph across rounds.
+        inner = self.mk._build_raw(quantum, stage_all_values=True)
         ndata = len(self.mk.data_specs)
         axis = self.axis
         ndev = self.ndev
@@ -249,6 +259,12 @@ class ShardedMegakernel:
         tasks, succ, ring, counts = self.partition(builders)
         if ivalues is None:
             ivalues = np.zeros((self.ndev, self.mk.num_values), np.int32)
+        else:
+            ivalues = np.asarray(ivalues)
+            for d in range(self.ndev):
+                self.mk.widen_value_alloc(counts[d], ivalues[d])
+        for c in counts:
+            self.mk.check_row_values(int(c[C_VALLOC]))
         data = dict(data or {})
         if set(data.keys()) != set(self.mk.data_specs.keys()):
             raise ValueError(

@@ -16,7 +16,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .descriptor import NO_TASK, TaskGraphBuilder
-from .megakernel import KernelContext, Megakernel
+from .megakernel import VBLOCK, KernelContext, Megakernel
 
 __all__ = ["device_fib", "device_arrayadd", "make_fib_megakernel"]
 
@@ -36,13 +36,16 @@ def _fib_kernel(ctx: KernelContext) -> None:
 
     @pl.when(n >= 2)
     def _():
-        base = ctx.alloc_values(2)
         # The SUM task is this task's continuation: it inherits our
-        # successors and produces our output slot.
-        sum_idx = ctx.spawn(
-            SUM, args=[base, base + 1], dep_count=2, out=ctx.out_slot
-        )
+        # successors and produces our output slot. The children write into
+        # the value block OWNED BY SUM'S ROW - no allocator call, and the
+        # block recycles with the row when SUM completes (by which point
+        # its result is already in the parent's block).
+        sum_idx = ctx.spawn(SUM, dep_count=2, out=ctx.out_slot)
         ctx.take_continuation(sum_idx)
+        base = ctx.row_values(sum_idx)
+        ctx.set_arg(sum_idx, 0, base)
+        ctx.set_arg(sum_idx, 1, base + 1)
         ctx.spawn(FIB, [n - 1], succ0=sum_idx, out=base)
         ctx.spawn(FIB, [n - 2], succ0=sum_idx, out=base + 1)
 
@@ -52,25 +55,36 @@ def _sum_kernel(ctx: KernelContext) -> None:
 
 
 def make_fib_megakernel(
-    capacity: int = 8192,
+    capacity: int = 768,  # SMEM windows pad scalars ~32B/word: ~800-row max
     interpret: Optional[bool] = None,
     num_values: Optional[int] = None,
 ) -> Megakernel:
-    # Descriptor rows recycle (live set = spawn-tree depth) but value slots
-    # do not: fib(n) burns ~2 slots per internal node, so the value buffer,
-    # not the task table, sizes the largest runnable graph.
+    # Descriptor rows recycle, and value blocks are row-owned (SUM reads
+    # its children's results out of its own row's block), so both live
+    # sets are ~ the spawn-tree depth and a small table runs arbitrarily
+    # deep fibs. The value buffer must cover every row's block plus the
+    # host slots.
+    need = VBLOCK * capacity + 16  # 16 host slots for presets/outputs
+    if num_values is None:
+        num_values = need
+    elif num_values < need:
+        raise ValueError(
+            f"fib uses row-owned value blocks: num_values must be >= "
+            f"VBLOCK*capacity+16 = {need}, got {num_values}"
+        )
     return Megakernel(
         kernels=[("fib", _fib_kernel), ("sum", _sum_kernel)],
         capacity=capacity,
-        num_values=capacity if num_values is None else num_values,
+        num_values=num_values,
         succ_capacity=64,
         interpret=interpret,
+        uses_row_values=True,
     )
 
 
 def device_fib(
     n: int,
-    capacity: int = 8192,
+    capacity: int = 768,
     interpret: Optional[bool] = None,
     num_values: Optional[int] = None,
 ) -> Tuple[int, dict]:

@@ -7,6 +7,7 @@ backend); one smoke test compiles on the real TPU when present.
 import jax
 import numpy as np
 import pytest
+from jax.experimental import pallas as pl
 
 from hclib_tpu.device.descriptor import NO_TASK, TaskGraphBuilder
 from hclib_tpu.device.workloads import (
@@ -58,7 +59,7 @@ def test_static_dag_with_csr_fanout_interpret():
     a = b.add(SUM, args=[0, 1], out=2)
     bs = [b.add(SUM, args=[2, 0], out=4 + i, deps=[a]) for i in range(5)]
     b.add(SUM, args=[4, 5], out=3, deps=bs)  # C: v3 = 4+4 = 8
-    iv0 = np.zeros(64, np.int32)
+    iv0 = np.zeros(mk.num_values, np.int32)
     iv0[0], iv0[1] = 1, 2
     iv, _, info = mk.run(b, ivalues=iv0)
     assert iv[2] == 3
@@ -84,17 +85,61 @@ def test_overflow_detection_interpret():
 
 
 def test_reclamation_runs_graphs_far_beyond_capacity_interpret():
-    """fib(14) executes 1828 tasks through a 64-row table (value slots are
-    the remaining bound - they do not recycle)."""
-    v, info = device_fib(14, capacity=64, interpret=True, num_values=2048)
+    """fib(14) executes 1828 tasks through a 64-row table: descriptor rows
+    recycle and value blocks are row-owned, so both bounds track the live
+    set (~tree depth), not the 1828-task total."""
+    v, info = device_fib(14, capacity=64, interpret=True)
     assert v == 377
     assert info["executed"] == 1828
     assert info["allocated"] <= 64
 
 
-def test_value_slot_exhaustion_raises_interpret():
+def test_fib_undersized_value_buffer_raises():
+    # Row-owned blocks need num_values >= VBLOCK*capacity + host slots.
+    with pytest.raises(ValueError, match="row-owned"):
+        device_fib(14, capacity=64, interpret=True, num_values=16)
+
+
+def _chain_kernel_free(ctx):
+    base = ctx.alloc_values(2)
+    ctx.set_value(base, ctx.arg(0))
+    ctx.free_values(base)
+    n = ctx.arg(0)
+
+    @pl.when(n > 0)
+    def _():
+        ctx.spawn(0, [n - 1])
+
+
+def _chain_kernel_leak(ctx):
+    base = ctx.alloc_values(2)
+    ctx.set_value(base, ctx.arg(0))
+    n = ctx.arg(0)
+
+    @pl.when(n > 0)
+    def _():
+        ctx.spawn(0, [n - 1])
+
+
+def test_alloc_free_values_recycles_interpret():
+    """200 chained alloc(2)/free rounds run through a 16-word value buffer
+    (3 recyclable blocks - the bump base starts at value_alloc=1); the
+    identical kernel without the free overflows on its 4th allocation."""
+    from hclib_tpu.device.megakernel import Megakernel
+
+    mk = Megakernel(kernels=[("chain", _chain_kernel_free)], capacity=16,
+                    num_values=16, succ_capacity=8, interpret=True)
+    b = TaskGraphBuilder()
+    b.add(0, args=[200])
+    _, _, info = mk.run(b)
+    assert info["executed"] == 201 and not info["overflow"]
+
+    mk2 = Megakernel(kernels=[("chain", _chain_kernel_leak)], capacity=16,
+                     num_values=16, succ_capacity=8, interpret=True)
+    b2 = TaskGraphBuilder()
+    b2.add(0, args=[200])
     with pytest.raises(RuntimeError, match="overflow"):
-        device_fib(14, capacity=64, interpret=True, num_values=64)
+        mk2.run(b2)
 
 
 @pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs TPU")

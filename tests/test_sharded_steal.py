@@ -2,6 +2,7 @@
 (device/sharded.py steal rounds; CPU interpret mode over an 8-device virtual
 mesh)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -99,3 +100,36 @@ def test_steal_respects_whitelist():
         assert int(iv[d, 0]) == expected[ns[d]]
     per_dev = info["per_device_counts"][:, 5]
     assert all(int(x) > 1 for x in per_dev)  # each ran its own tree
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs TPU")
+def test_reentrant_staging_on_tpu():
+    """Re-entrant kernel entries on REAL TPU: SMEM output windows do not
+    inherit the aliased input's contents, so value slots carried between
+    entries (row-owned fib blocks) depend on stage_all_values - interpret
+    mode cannot catch this. (The tunnel cannot compile shard_map kernels,
+    so this drives the bare kernel through a host re-entry loop, which is
+    what the sharded round loop does on-device.)"""
+    import jax.numpy as jnp
+
+    from hclib_tpu.device.megakernel import C_PENDING
+    from hclib_tpu.device.workloads import FIB, make_fib_megakernel
+
+    mk = make_fib_megakernel(capacity=768, interpret=False)
+    kernel = jax.jit(mk._build_raw(200, stage_all_values=True))
+    b = TaskGraphBuilder()
+    b.add(FIB, args=[13], out=0)  # 1219 dynamic tasks, ~7 entries
+    tasks, succ, ring, counts = b.finalize(
+        capacity=mk.capacity, succ_capacity=mk.succ_capacity
+    )
+    iv = np.zeros(mk.num_values, np.int32)
+    for _ in range(64):
+        outs = kernel(
+            jnp.asarray(tasks), jnp.asarray(succ), jnp.asarray(ring),
+            jnp.asarray(counts), jnp.asarray(iv),
+        )
+        tasks, ring, counts, iv = (np.asarray(o) for o in outs[:4])
+        if counts[C_PENDING] == 0:
+            break
+    assert counts[C_PENDING] == 0
+    assert int(iv[0]) == 233
