@@ -1,0 +1,346 @@
+"""Fully-fused Pallas UTS: the entire tree traversal in ONE resident kernel.
+
+The XLA engine (uts_vec.py) emits the DFS step as ~1.3k separate VPU ops;
+unfused intermediates round-trip HBM, putting the measured per-step wall
+(~85us at 8192 lanes) ~8x above the raw op cost. Splitting only the
+expansion loop into a Pallas phase kernel got ~16us/step but left ~1ms of
+XLA glue per refill round (gathers + layout conversions around the custom
+call) - at 60-250 rounds per run that glue dominated. This engine therefore
+runs EVERYTHING on-core in one kernel launch:
+
+- the DFS traversal (uts_vec.make_traversal - the exact driver and step
+  shared with the XLA engine) with all lane state (~2 MB at (64,128)
+  lanes) living in VMEM/registers;
+- the shared-root-queue refill, re-expressed in Mosaic-supported primitives:
+  * flat cumsum over the starved mask -> two triangular MXU matmuls (exact:
+    counts <= nlanes << 2^24 in f32);
+  * the root-window DMA -> a 1024-aligned dynamic row-block copy from HBM
+    (roots are laid out (rows, 128) host-side; the residual offset folds
+    into the gather indices);
+  * the monotone claim gather -> same-shape ``take_along_axis`` passes
+    (Mosaic's only gather form): claim ranks are a prefix sum, so each
+    output row's indices span <= 127 and touch <= 2 window rows - select
+    those two rows (clipped row-gathers), roll each by the row's start
+    offset, stitch, then one in-row gather finishes the job.
+
+The reference's work-stealing scheduler loop (src/hclib-runtime.c:705-724)
+maps to the megakernel (device/megakernel.py) for task graphs; this is the
+same persistent-kernel idea specialized to the data-parallel engine - the
+core never returns to XLA until the tree is fully counted.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.uts import FIXED, UTSParams
+from .uts_vec import (
+    LANES,
+    _host_seed,
+    apply_claim,
+    child_thresholds,
+    make_traversal,
+)
+
+__all__ = ["uts_pallas"]
+
+ALIGN = 1024  # dynamic DMA offsets must be 1024-aligned (Mosaic tiling)
+
+
+def _mm_cumsum(mask, lanes):
+    """Inclusive prefix sum of a 0/1 mask over flat lane order via two
+    triangular MXU matmuls (exact in f32 for counts < 2^24)."""
+    rows, cols = lanes
+    m = mask.astype(jnp.float32)
+    Uc = (
+        jax.lax.broadcasted_iota(jnp.int32, (cols, cols), 0)
+        <= jax.lax.broadcasted_iota(jnp.int32, (cols, cols), 1)
+    ).astype(jnp.float32)
+    P = jnp.dot(m, Uc, preferred_element_type=jnp.float32)
+    t = P[:, cols - 1 : cols]  # (rows, 1) row totals
+    Ur = (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0)
+        < jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1)
+    ).astype(jnp.float32)
+    carry = jnp.dot(t.T, Ur, preferred_element_type=jnp.float32)  # (1, rows)
+    return (P + carry.T).astype(jnp.int32)
+
+
+def _row_select(win2d, a, lanes, winrows):
+    """A[i, :] = win2d[a[i], :] for a (rows,)-vector of window-row indices.
+
+    Mosaic's axis-0 dynamic gather is single-vreg-only, so this is a
+    one-hot MXU matmul instead: onehot(a) (rows, winrows) @ win2d
+    (winrows, cols). The MXU multiplies f32 inputs at bf16 precision
+    (8-bit mantissa), so full 32-bit words are split into four BYTES -
+    integers <= 255 are exact in bf16, the one-hot rows are 0/1, and each
+    output sums exactly one product."""
+    rows, cols = lanes
+    oh = (
+        a[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (rows, winrows), 1)
+    ).astype(jnp.float32)
+    out = jnp.zeros(lanes, jnp.int32)
+    for b in range(4):
+        byte = ((win2d >> (8 * b)) & 0xFF).astype(jnp.float32)
+        got = jnp.dot(oh, byte, preferred_element_type=jnp.float32).astype(
+            jnp.int32
+        )
+        out = out | (got << (8 * b))
+    return out
+
+
+def _monotone_gather(win2d, idx, lanes, winrows):
+    """out[i,j] = win2d.flat[idx[i,j]] for flat-monotone idx (a prefix-sum
+    rank + offset): each output row spans <= cols indices, touching <= 2
+    window rows - so two row-selects + per-row rolls + one in-row gather."""
+    rows, cols = lanes
+    start = idx[:, 0]  # (rows,) monotone
+    a = start // cols
+    c = start % cols
+    A = _row_select(win2d, a, lanes, winrows)
+    B = _row_select(win2d, jnp.minimum(a + 1, winrows - 1), lanes, winrows)
+    j = jax.lax.broadcasted_iota(jnp.int32, lanes, 1)
+    roll = (j + c[:, None]) % cols
+    Ar = jnp.take_along_axis(A, roll, axis=1)
+    Br = jnp.take_along_axis(B, roll, axis=1)
+    W = jnp.where(c[:, None] + j < cols, Ar, Br)  # W[i,j] = flat[start_i+j]
+    o = jnp.clip(idx - start[:, None], 0, cols - 1)
+    return jnp.take_along_axis(W, o, axis=1)
+
+
+def _dfs_kernel(
+    S: int,
+    lanes: tuple,
+    thresholds: tuple,
+    gen_mx: int,
+    d0: int,
+    min_idle: int,
+    max_steps: int,
+    winrows: int,
+    # refs
+    roots_state_ref,  # ANY (5, Rrows, 128) i32 (u32 bits)
+    roots_count_ref,  # ANY (Rrows, 128) i32
+    scal_ref,  # SMEM (1,): R (real root count)
+    nodes_ref, leaves_ref, maxd_ref,  # VMEM lanes, outputs
+    ctl_ref,  # SMEM (2,): steps, unfinished
+    wstate, wcount, sems,  # scratch: (5, winrows, 128), (winrows, 128), DMA
+) -> None:
+    rows, cols = lanes
+    nlanes = rows * cols
+    R = scal_ref[0]
+
+    def refill(sp, next_root, st0, ch0, cn0, dp0):
+        starved = sp < 0
+        cum = _mm_cumsum(starved, lanes)
+        avail = R - next_root
+        claim = starved & (cum <= avail)
+        aligned = (next_root // ALIGN) * ALIGN
+        rowstart = aligned // cols  # divisible by ALIGN/cols = 8
+        cps = [
+            pltpu.make_async_copy(
+                roots_state_ref.at[i, pl.ds(rowstart, winrows)],
+                wstate.at[i],
+                sems.at[i],
+            )
+            for i in range(5)
+        ]
+        cpc = pltpu.make_async_copy(
+            roots_count_ref.at[pl.ds(rowstart, winrows)], wcount, sems.at[5]
+        )
+        for cp in cps:
+            cp.start()
+        cpc.start()
+        for cp in cps:
+            cp.wait()
+        cpc.wait()
+        idx = jnp.clip(cum - 1, 0, nlanes - 1) + (next_root - aligned)
+        rst = [
+            _monotone_gather(
+                wstate[i], idx, lanes, winrows
+            ).astype(jnp.uint32)
+            for i in range(5)
+        ]
+        rcn = _monotone_gather(wcount[...], idx, lanes, winrows)
+        sp, st0, ch0, cn0, dp0 = apply_claim(
+            claim, rst, rcn, d0, sp, st0, ch0, cn0, dp0
+        )
+        next_root = next_root + jnp.minimum(
+            jnp.sum(starved.astype(jnp.int32)), avail
+        )
+        return sp, next_root, st0, ch0, cn0, dp0
+
+    run = make_traversal(
+        S, lanes, thresholds, gen_mx, min_idle, max_steps, refill, R
+    )
+    sp, next_root, nodes, leaves, maxd, steps = run()
+    nodes_ref[...] = nodes
+    leaves_ref[...] = leaves
+    maxd_ref[...] = maxd
+    ctl_ref[0] = steps
+    ctl_ref[1] = (jnp.any(sp >= 0) | (next_root < R)).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
+        "min_idle_div", "interpret",
+    ),
+)
+def _uts_dfs_pallas(
+    roots_state,  # (5, Rrows, 128) i32 (u32 bits), padded + aligned
+    roots_count,  # (Rrows, 128) i32
+    nroots,  # () i32 - real root count R
+    stack_size: int,
+    gen_mx: int,
+    d0: int,
+    thresholds: tuple,
+    max_steps: int,
+    lanes: tuple,
+    min_idle_div: int = 8,
+    interpret: bool = False,
+):
+    S = stack_size
+    rows, cols = lanes
+    nlanes = rows * cols
+    min_idle = max(64, nlanes // min_idle_div)
+    winrows = nlanes // cols + ALIGN // cols  # window covers slack + claims
+    i32 = jnp.int32
+    kernel = pl.pallas_call(
+        functools.partial(
+            _dfs_kernel, S, lanes, thresholds, gen_mx, d0, min_idle,
+            max_steps, winrows,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(lanes, i32),  # nodes
+            jax.ShapeDtypeStruct(lanes, i32),  # leaves
+            jax.ShapeDtypeStruct(lanes, i32),  # maxd
+            jax.ShapeDtypeStruct((2,), i32),   # steps, unfinished
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=tuple(
+            [pl.BlockSpec(memory_space=pltpu.VMEM)] * 3
+            + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((5, winrows, cols), i32),
+            pltpu.VMEM((winrows, cols), i32),
+            pltpu.SemaphoreType.DMA((6,)),
+        ],
+        interpret=interpret,
+    )
+    nodes, leaves, maxd, ctl = kernel(
+        roots_state, roots_count, nroots.reshape(1)
+    )
+    return (
+        jnp.sum(nodes),
+        jnp.sum(leaves),
+        jnp.max(maxd),
+        ctl[0],
+        ctl[1] != 0,
+    )
+
+
+def uts_pallas(
+    params: UTSParams,
+    target_roots: int = 16 * LANES[0] * LANES[1],
+    max_steps: Optional[int] = None,
+    device=None,
+    lanes: Tuple[int, int] = LANES,
+    min_idle_div: int = 8,
+    interpret: Optional[bool] = None,
+) -> dict:
+    """uts_vec with the whole traversal fused into one Pallas kernel; same
+    exact counts, same host seeding, same result dict."""
+    if params.shape != FIXED:
+        raise NotImplementedError("uts_pallas supports the GEO/FIXED shape")
+    if lanes[1] != 128:
+        raise ValueError("uts_pallas lanes must be (rows, 128)")
+    import time
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    t_seed = time.perf_counter()
+    host_nodes, host_leaves, host_maxd, d0, roots_state, roots_count = (
+        _host_seed(params, target_roots)
+    )
+    seed_seconds = time.perf_counter() - t_seed
+    result = {
+        "host_seed_nodes": host_nodes,
+        "roots": 0 if roots_count is None else int(roots_count.shape[0]),
+        "seed_seconds": seed_seconds,
+    }
+    if roots_count is None:
+        result.update(
+            nodes=host_nodes, leaves=host_leaves, max_depth=host_maxd, steps=0
+        )
+        return result
+    if max_steps is None:
+        max_steps = (1 << 31) - 1
+    rows, cols = lanes
+    nlanes = rows * cols
+    R = int(roots_count.shape[0])
+    # Pad so any aligned window [align_down(next_root), +nlanes+ALIGN) is in
+    # bounds (next_root <= R), then lay out as (Rrows, 128) for row-block DMA.
+    rpad = -(-(R + nlanes + ALIGN) // ALIGN) * ALIGN
+    pstate = np.zeros((5, rpad), np.int32)
+    pstate[:, :R] = roots_state.astype(np.int32)
+    pcount = np.zeros(rpad, np.int32)
+    pcount[:R] = roots_count
+    args = (
+        jnp.asarray(pstate.reshape(5, rpad // cols, cols)),
+        jnp.asarray(pcount.reshape(rpad // cols, cols)),
+        jnp.int32(R),
+    )
+    kw = dict(
+        stack_size=max(1, params.gen_mx - d0),
+        gen_mx=params.gen_mx,
+        d0=d0,
+        thresholds=tuple(int(t) for t in child_thresholds(params.b0)),
+        max_steps=max_steps,
+        lanes=tuple(lanes),
+        min_idle_div=min_idle_div,
+        interpret=interpret,
+    )
+    if device is not None:
+        args = tuple(jax.device_put(a, device) for a in args[:2]) + args[2:]
+    nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
+    t0 = time.perf_counter()
+    nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
+    dev_nodes = int(nodes)
+    dt = time.perf_counter() - t0
+    if bool(unfinished):
+        raise RuntimeError(f"uts_pallas ran out of steps ({max_steps})")
+    result.update(
+        nodes=host_nodes + dev_nodes,
+        leaves=host_leaves + int(leaves),
+        max_depth=max(host_maxd, int(maxd)),
+        steps=int(steps),
+        device_nodes=dev_nodes,
+        device_seconds=dt,
+        nodes_per_sec=dev_nodes / dt if dt > 0 else float("inf"),
+        lane_efficiency=dev_nodes / (int(steps) * nlanes) if steps else 0.0,
+    )
+    return result
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    from ..models.uts import T1, T1L, T3
+
+    name = sys.argv[1] if len(sys.argv) > 1 else "T3"
+    params = {"T1": T1, "T1L": T1L, "T3": T3}[name]
+    print(uts_pallas(params))

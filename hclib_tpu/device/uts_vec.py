@@ -52,7 +52,10 @@ import numpy as np
 from ..models.uts import FIXED, UTSParams
 from ..ops.sha1 import sha1_block as _sha1_block, sha1_child as _sha1_child
 
-__all__ = ["uts_vec", "child_thresholds", "LANES", "NLANES"]
+__all__ = [
+    "uts_vec", "child_thresholds", "LANES", "NLANES",
+    "make_count_children", "make_dfs_step", "make_refill",
+]
 
 LANES = (8, 128)
 NLANES = LANES[0] * LANES[1]
@@ -110,85 +113,26 @@ def _level_store(stack, sp, value, mask):
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
-        "min_idle_div",
-    ),
-)
-def _uts_dfs(
-    roots_state,  # (5, R) u32 - subtree roots, all at BFS depth d0
-    roots_count,  # (R,) i32 - exact child counts (all >= 1)
-    stack_size: int,
-    gen_mx: int,
-    d0: int,
-    thresholds: tuple,  # static ints: compiled as immediates
-    max_steps: int,
-    lanes: tuple,
-    min_idle_div: int = 8,
-):
-    nthresh = len(thresholds)
-    S = stack_size
-    nlanes = lanes[0] * lanes[1]
-    # Root arrays arrive padded by nlanes (see uts_vec) so the refill window
-    # dynamic_slice below is always in bounds; R is the real root count.
-    R = roots_count.shape[0] - nlanes
+def make_count_children(thresholds: tuple, gen_mx: int, lanes: tuple):
+    """Exact geometric child count from the static threshold table."""
 
     def count_children(r, depth):
         cnt = jnp.zeros(lanes, jnp.int32)
-        for k in range(nthresh):
+        for k in range(len(thresholds)):
             cnt = cnt + (r >= jnp.int32(thresholds[k])).astype(jnp.int32)
         return jnp.where(depth < gen_mx, cnt, 0)
 
-    # Refill threshold: the gather+cumsum claim is much more expensive than
-    # one SHA-1 step, so the hot expansion loop runs refill-free (inner
-    # while) until this many lanes are idle; the outer loop then claims
-    # roots for all of them at once. Imbalance cost is bounded by
-    # min_idle/nlanes per refill round; refill wall cost by R/min_idle
-    # rounds - min_idle_div trades the two.
-    refill_min_idle = max(64, nlanes // min_idle_div)
+    return count_children
 
-    def refill(sp, next_root, st0, ch0, cn0, dp0):
-        done = sp < 0
-        rank = jnp.cumsum(done.reshape(-1).astype(jnp.int32)).reshape(lanes)
-        avail = R - next_root
-        claim = done & (rank <= avail)
-        # Claims are contiguous [next_root, next_root + nclaim): slice an
-        # nlanes-wide window once, then gather within it - a gather over a
-        # small VMEM-resident window instead of the whole HBM root array.
-        win = [
-            jax.lax.dynamic_slice(roots_state[i], (next_root,), (nlanes,))
-            for i in range(5)
-        ]
-        wcn = jax.lax.dynamic_slice(roots_count, (next_root,), (nlanes,))
-        idx = jnp.clip(rank - 1, 0, nlanes - 1)
-        rst = [jnp.take(win[i], idx, axis=0) for i in range(5)]
-        rcn = jnp.take(wcn, idx, axis=0)
-        st0 = tuple(jnp.where(claim, rst[i], st0[i]) for i in range(5))
-        ch0 = jnp.where(claim, 0, ch0)
-        cn0 = jnp.where(claim, rcn, cn0)
-        dp0 = jnp.where(claim, d0, dp0)
-        sp = jnp.where(claim, 0, sp)
-        next_root = next_root + jnp.minimum(
-            jnp.sum(done.astype(jnp.int32)), avail
-        )
-        return sp, next_root, st0, ch0, cn0, dp0
 
-    def inner_cond(carry):
-        sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
-        active = jnp.any(sp >= 0)
-        ndone = jnp.sum((sp < 0).astype(jnp.int32))
-        # Keep expanding while work remains and either too few lanes are
-        # idle to justify a refill, or there is nothing left to claim.
-        return (
-            active
-            & ((ndone < refill_min_idle) | (avail <= 0))
-            & (steps < max_steps)
-        )
+def make_dfs_step(S: int, lanes: tuple, thresholds: tuple, gen_mx: int):
+    """One vectorized DFS expansion step over all lanes (the hot loop body,
+    shared by the XLA engine here and the fused Pallas engine in
+    uts_pallas.py). Signature:
+    (sp, nodes, leaves, maxd, st, ch, cn, dp) -> same tuple."""
+    count_children = make_count_children(thresholds, gen_mx, lanes)
 
-    def inner_body(carry):
-        sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
+    def step(sp, nodes, leaves, maxd, st, ch, cn, dp):
         active = sp >= 0
         child = _level_select(ch, sp)
         count = _level_select(cn, sp)
@@ -233,6 +177,92 @@ def _uts_dfs(
         cn = _level_store(cn, lvl, ccount, newf)
         dp = _level_store(dp, lvl, cdepth, newf)
         sp = jnp.where(push, spp, jnp.where(pop, sp - 1, sp))
+        return sp, nodes, leaves, maxd, st, ch, cn, dp
+
+    return step
+
+
+def apply_claim(claim, rst, rcn, d0, sp, st0, ch0, cn0, dp0):
+    """Install gathered roots into level 0 of claiming lanes (the shared
+    tail of every refill implementation)."""
+    st0 = tuple(jnp.where(claim, rst[i], st0[i]) for i in range(5))
+    ch0 = jnp.where(claim, 0, ch0)
+    cn0 = jnp.where(claim, rcn, cn0)
+    dp0 = jnp.where(claim, d0, dp0)
+    sp = jnp.where(claim, 0, sp)
+    return sp, st0, ch0, cn0, dp0
+
+
+def make_refill(lanes: tuple, d0: int):
+    """Shared-root-queue claim: starved lanes (sp < 0) take the next
+    contiguous unclaimed roots via prefix-sum rank + windowed gather.
+    Returns refill(roots_state, roots_count, R, sp, next_root, st0, ch0,
+    cn0, dp0) -> (sp, next_root, st0, ch0, cn0, dp0)."""
+    nlanes = lanes[0] * lanes[1]
+
+    def refill(roots_state, roots_count, R, sp, next_root, st0, ch0, cn0,
+               dp0):
+        done = sp < 0
+        rank = jnp.cumsum(done.reshape(-1).astype(jnp.int32)).reshape(lanes)
+        avail = R - next_root
+        claim = done & (rank <= avail)
+        # Claims are contiguous [next_root, next_root + nclaim): slice an
+        # nlanes-wide window once, then gather within it - a gather over a
+        # small VMEM-resident window instead of the whole HBM root array.
+        win = [
+            jax.lax.dynamic_slice(roots_state[i], (next_root,), (nlanes,))
+            for i in range(5)
+        ]
+        wcn = jax.lax.dynamic_slice(roots_count, (next_root,), (nlanes,))
+        idx = jnp.clip(rank - 1, 0, nlanes - 1)
+        rst = [jnp.take(win[i], idx, axis=0) for i in range(5)]
+        rcn = jnp.take(wcn, idx, axis=0)
+        sp, st0, ch0, cn0, dp0 = apply_claim(
+            claim, rst, rcn, d0, sp, st0, ch0, cn0, dp0
+        )
+        next_root = next_root + jnp.minimum(
+            jnp.sum(done.astype(jnp.int32)), avail
+        )
+        return sp, next_root, st0, ch0, cn0, dp0
+
+    return refill
+
+
+def make_traversal(
+    S: int,
+    lanes: tuple,
+    thresholds: tuple,
+    gen_mx: int,
+    min_idle: int,
+    max_steps: int,
+    refill,
+    R,
+):
+    """The complete traversal driver shared by both engines: outer loop =
+    refill + refill-free inner expansion loop until `min_idle` lanes are
+    starved (or nothing is left to claim). ``refill(sp, next_root, st0,
+    ch0, cn0, dp0)`` is the only engine-specific part (XLA gather here vs
+    in-kernel DMA + matmul gather in uts_pallas). Returns run() ->
+    (sp, next_root, nodes, leaves, maxd, steps)."""
+    step = make_dfs_step(S, lanes, thresholds, gen_mx)
+
+    def inner_cond(carry):
+        sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
+        active = jnp.any(sp >= 0)
+        ndone = jnp.sum((sp < 0).astype(jnp.int32))
+        # Keep expanding while work remains and either too few lanes are
+        # idle to justify a refill, or there is nothing left to claim.
+        return (
+            active
+            & ((ndone < min_idle) | (avail <= 0))
+            & (steps < max_steps)
+        )
+
+    def inner_body(carry):
+        sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
+        sp, nodes, leaves, maxd, st, ch, cn, dp = step(
+            sp, nodes, leaves, maxd, st, ch, cn, dp
+        )
         return sp, nodes, leaves, maxd, st, ch, cn, dp, steps + 1, avail
 
     def outer_cond(carry):
@@ -256,18 +286,68 @@ def _uts_dfs(
         ) = jax.lax.while_loop(inner_cond, inner_body, inner)
         return sp, next_root, nodes, leaves, maxd, st, ch, cn, dp, steps
 
-    zeros = jnp.zeros(lanes, jnp.int32)
-    uzeros = jnp.zeros(lanes, jnp.uint32)
-    st0 = tuple(tuple(uzeros for _ in range(5)) for _ in range(S))
-    ch0 = tuple(zeros for _ in range(S))
-    cn0 = tuple(zeros for _ in range(S))
-    dp0 = tuple(zeros for _ in range(S))
-    sp0 = jnp.full(lanes, -1, jnp.int32)
-    carry = (sp0, jnp.int32(0), zeros, zeros, zeros, st0, ch0, cn0, dp0,
-             jnp.int32(0))
-    sp, next_root, nodes, leaves, maxd, *_rest, steps = jax.lax.while_loop(
-        outer_cond, outer_body, carry
+    def run():
+        zeros = jnp.zeros(lanes, jnp.int32)
+        uzeros = jnp.zeros(lanes, jnp.uint32)
+        st0 = tuple(tuple(uzeros for _ in range(5)) for _ in range(S))
+        ch0 = tuple(zeros for _ in range(S))
+        cn0 = tuple(zeros for _ in range(S))
+        dp0 = tuple(zeros for _ in range(S))
+        carry = (
+            jnp.full(lanes, -1, jnp.int32), jnp.int32(0), zeros, zeros,
+            zeros, st0, ch0, cn0, dp0, jnp.int32(0),
+        )
+        (sp, next_root, nodes, leaves, maxd, *_rest, steps) = (
+            jax.lax.while_loop(outer_cond, outer_body, carry)
+        )
+        return sp, next_root, nodes, leaves, maxd, steps
+
+    return run
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
+        "min_idle_div",
+    ),
+)
+def _uts_dfs(
+    roots_state,  # (5, R) u32 - subtree roots, all at BFS depth d0
+    roots_count,  # (R,) i32 - exact child counts (all >= 1)
+    stack_size: int,
+    gen_mx: int,
+    d0: int,
+    thresholds: tuple,  # static ints: compiled as immediates
+    max_steps: int,
+    lanes: tuple,
+    min_idle_div: int = 8,
+):
+    S = stack_size
+    nlanes = lanes[0] * lanes[1]
+    # Root arrays arrive padded by nlanes (see uts_vec) so the refill window
+    # dynamic_slice below is always in bounds; R is the real root count.
+    R = roots_count.shape[0] - nlanes
+
+    # Refill threshold: the gather+cumsum claim is much more expensive than
+    # one SHA-1 step, so the hot expansion loop runs refill-free (inner
+    # while) until this many lanes are idle; the outer loop then claims
+    # roots for all of them at once. Imbalance cost is bounded by
+    # min_idle/nlanes per refill round; refill wall cost by R/min_idle
+    # rounds - min_idle_div trades the two.
+    refill_min_idle = max(64, nlanes // min_idle_div)
+
+    refill_fn = make_refill(lanes, d0)
+
+    def refill(sp, next_root, st0, ch0, cn0, dp0):
+        return refill_fn(
+            roots_state, roots_count, R, sp, next_root, st0, ch0, cn0, dp0
+        )
+
+    run = make_traversal(
+        S, lanes, thresholds, gen_mx, refill_min_idle, max_steps, refill, R
     )
+    sp, next_root, nodes, leaves, maxd, steps = run()
     # int32 totals: fine up to 2^31 device-side nodes (T1L is 102M; the 4.2B
     # T1XXL tree would need per-lane int64 counters or periodic draining).
     return (
