@@ -102,8 +102,8 @@ def bench_native_fib(n: int = 27):
 
 
 def bench_device_cholesky():
-    """In-kernel tiled-Cholesky throughput: the full 816-task DDF DAG
-    (n=4096, 256x256 MXU tiles) is re-run R times inside one kernel launch
+    """In-kernel tiled-Cholesky throughput: the full 120-task DDF DAG
+    (n=4096, 512x512 MXU tiles) is re-run R times inside one kernel launch
     and the per-graph cost is the slope between two R values - the same
     steady-state harness as the fib bench, since a single graph (a few ms)
     would drown in the ~70 ms tunnel launch+transfer overhead. Correctness
@@ -121,7 +121,10 @@ def bench_device_cholesky():
     )
     from hclib_tpu.models.cholesky import make_spd
 
-    n, tile = 4096, 256
+    # 512 tiles flip the GEMMs compute-bound (arithmetic intensity ts/8
+    # flops/byte, so 2x that of 256) and the blocked POTRF keeps
+    # factorization off the critical path; 1024 tiles exceed VMEM.
+    n, tile = 4096, 512
     nt = n // tile
     mk = make_cholesky_megakernel(nt, interpret=False, tile=tile)
     b = build_cholesky_graph(nt)

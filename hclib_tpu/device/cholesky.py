@@ -4,13 +4,13 @@ Same dependency structure as the host model (models/cholesky.py; reference
 test/cholesky/cholesky.cpp), with the four tile kernels designed for the TPU
 compute units rather than translated from LAPACK:
 
-- POTRF (VPU): 128x128 factorization as T masked rank-1 updates on a
-  *symmetric trailing matrix* - row j equals column j by symmetry, so both
-  outer-product factors come from cheap masked reductions; no transposes,
-  no dynamic lane indexing. Also produces inv(L_kk) via Newton-Schulz
-  iterations X <- X(2I - LX), which are *exact* for triangular matrices
-  after ceil(log2 T) = 7 steps - 14 MXU matmuls instead of a scalar
-  substitution sweep.
+- POTRF (VPU + MXU): ``factor_and_inv`` - the serial masked rank-1 sweep
+  runs only on 128x128 diagonal base blocks (row j equals column j by
+  symmetry, so both outer-product factors come from cheap masked
+  reductions); larger tiles recurse by 2x2 blocking with panels, trailing
+  updates, and the inverse assembled as MXU block algebra, and inv(L) of a
+  base block comes from Newton-Schulz iterations (exact for triangular
+  matrices after ceil(log2 T) steps).
 - TRSM (MXU): with inv(L_kk) available, the triangular solve is one
   dot_general: A_ik <- A_ik inv(L_kk)^T.
 - SYRK/GEMM (MXU): A_ij -= L_ik L_jk^T as dot_general contractions on the
@@ -31,7 +31,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..ops.tiles import dma_copy as _dma, factor_tile, mm_nt as _mm_nt, tri_inverse
+from ..ops.tiles import dma_copy as _dma, factor_and_inv, mm_nt as _mm_nt
 from .descriptor import TaskGraphBuilder
 from .megakernel import KernelContext, Megakernel
 
@@ -45,17 +45,29 @@ SYRK = 2
 GEMM = 3
 
 
+def _load_all(pairs, sems) -> None:
+    """Start every (src, dst) copy, then wait - loads ride the DMA engines
+    concurrently instead of serializing start/wait per tile."""
+    cps = [
+        pltpu.make_async_copy(src, dst, sems.at[i])
+        for i, (src, dst) in enumerate(pairs)
+    ]
+    for cp in cps:
+        cp.start()
+    for cp in cps:
+        cp.wait()
+
+
 def _potrf_kernel(ctx: KernelContext, ts: int = T) -> None:
     k = ctx.arg(0)
     tiles, linv = ctx.data["tiles"], ctx.data["linv"]
-    va = ctx.scratch["va"]
+    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
     sem = ctx.scratch["sems"]
     _dma(tiles.at[k, k], va, sem.at[0])
-    l = factor_tile(va[:], ts)
+    l, inv = factor_and_inv(va[:], ts)
     va[:] = l
-    _dma(va, tiles.at[k, k], sem.at[0])
-    va[:] = tri_inverse(l, ts)
-    _dma(va, linv.at[k], sem.at[0])
+    vb[:] = inv
+    _load_all([(va, tiles.at[k, k]), (vb, linv.at[k])], sem)
 
 
 def _trsm_kernel(ctx: KernelContext, ts: int = T) -> None:
@@ -63,8 +75,7 @@ def _trsm_kernel(ctx: KernelContext, ts: int = T) -> None:
     tiles, linv = ctx.data["tiles"], ctx.data["linv"]
     va, vb = ctx.scratch["va"], ctx.scratch["vb"]
     sem = ctx.scratch["sems"]
-    _dma(tiles.at[i, k], va, sem.at[0])
-    _dma(linv.at[k], vb, sem.at[1])
+    _load_all([(tiles.at[i, k], va), (linv.at[k], vb)], sem)
     va[:] = _mm_nt(va[:], vb[:])  # A_ik inv(L_kk)^T
     _dma(va, tiles.at[i, k], sem.at[0])
 
@@ -74,8 +85,7 @@ def _syrk_kernel(ctx: KernelContext, ts: int = T) -> None:
     tiles = ctx.data["tiles"]
     va, vb = ctx.scratch["va"], ctx.scratch["vb"]
     sem = ctx.scratch["sems"]
-    _dma(tiles.at[i, i], va, sem.at[0])
-    _dma(tiles.at[i, k], vb, sem.at[1])
+    _load_all([(tiles.at[i, i], va), (tiles.at[i, k], vb)], sem)
     va[:] = va[:] - _mm_nt(vb[:], vb[:])
     _dma(va, tiles.at[i, i], sem.at[0])
 
@@ -85,9 +95,10 @@ def _gemm_kernel(ctx: KernelContext, ts: int = T) -> None:
     tiles = ctx.data["tiles"]
     va, vb, vc = ctx.scratch["va"], ctx.scratch["vb"], ctx.scratch["vc"]
     sem = ctx.scratch["sems"]
-    _dma(tiles.at[i, j], va, sem.at[0])
-    _dma(tiles.at[i, k], vb, sem.at[1])
-    _dma(tiles.at[j, k], vc, sem.at[2])
+    _load_all(
+        [(tiles.at[i, j], va), (tiles.at[i, k], vb), (tiles.at[j, k], vc)],
+        sem,
+    )
     va[:] = va[:] - _mm_nt(vb[:], vc[:])
     _dma(va, tiles.at[i, j], sem.at[0])
 

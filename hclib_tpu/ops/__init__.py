@@ -13,13 +13,20 @@ compose (the layer the package docstring calls ``hclib_tpu.ops``).
 
 from .scan import decay_cummax  # noqa: F401
 from .sha1 import sha1_block, sha1_child  # noqa: F401
-from .tiles import dma_copy, factor_tile, mm_nt, tri_inverse  # noqa: F401
+from .tiles import (  # noqa: F401
+    dma_copy,
+    factor_and_inv,
+    factor_tile,
+    mm_nt,
+    tri_inverse,
+)
 
 __all__ = [
     "decay_cummax",
     "sha1_block",
     "sha1_child",
     "dma_copy",
+    "factor_and_inv",
     "factor_tile",
     "mm_nt",
     "tri_inverse",

@@ -11,6 +11,9 @@ test/cholesky/cholesky.cpp):
 - ``tri_inverse`` (MXU): inv(L) via Newton-Schulz X <- X(2I - LX), *exact*
   for triangular matrices after ceil(log2 T) steps - matmuls instead of a
   scalar substitution sweep.
+- ``factor_and_inv``: (L, inv(L)) for any tile size - the serial sweep
+  runs only on 128x128 diagonal base blocks; larger tiles recurse by 2x2
+  blocking with panels/updates/inverse as MXU block algebra.
 - ``mm_nt`` (MXU): A @ B^T as a dot_general contraction on the second axis
   of both operands (no materialized transpose). HIGHEST precision keeps f32
   inputs f32 on the MXU.
@@ -25,7 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["factor_tile", "tri_inverse", "mm_nt", "dma_copy"]
+__all__ = [
+    "factor_tile", "tri_inverse", "factor_and_inv", "mm_nt", "dma_copy",
+]
 
 
 def factor_tile(t, ts: int):
@@ -63,6 +68,44 @@ def tri_inverse(l, ts: int):
             x, lx, preferred_element_type=jnp.float32, precision=hi
         )
     return x
+
+
+def factor_and_inv(t, ts: int, base: int = 128):
+    """(L, inv(L)) for a symmetric (ts, ts) tile.
+
+    The scalar rank-1 sweep (factor_tile) costs O(ts) serial iterations on
+    O(ts^2) planes - ~100us at ts=256 - so tiles larger than ``base`` are
+    factored recursively by 2x2 blocking, keeping the sweep on base-sized
+    diagonal blocks and doing panels/updates/inverses as MXU block algebra:
+
+        A = [[A00,  . ], [A10, A11]]
+        L00, I00 = factor_and_inv(A00);  L10 = A10 I00^T
+        L11, I11 = factor_and_inv(A11 - L10 L10^T)
+        inv(L)   = [[I00, 0], [-I11 L10 I00, I11]]
+    """
+    if ts <= base:
+        l = factor_tile(t, ts)
+        return l, tri_inverse(l, ts)
+    h = ts // 2
+    a00 = jax.lax.slice(t, (0, 0), (h, h))
+    a10 = jax.lax.slice(t, (h, 0), (ts, h))
+    a11 = jax.lax.slice(t, (h, h), (ts, ts))
+    l00, i00 = factor_and_inv(a00, h, base)
+    l10 = mm_nt(a10, i00)
+    l11, i11 = factor_and_inv(a11 - mm_nt(l10, l10), h, base)
+    hi = jax.lax.Precision.HIGHEST
+    off = -jnp.dot(
+        jnp.dot(i11, l10, preferred_element_type=jnp.float32, precision=hi),
+        i00, preferred_element_type=jnp.float32, precision=hi,
+    )
+    z = jnp.zeros((h, h), t.dtype)
+    l = jnp.concatenate(
+        [jnp.concatenate([l00, z], 1), jnp.concatenate([l10, l11], 1)], 0
+    )
+    inv = jnp.concatenate(
+        [jnp.concatenate([i00, z], 1), jnp.concatenate([off, i11], 1)], 0
+    )
+    return l, inv
 
 
 def mm_nt(a, b):

@@ -28,6 +28,16 @@ def test_device_cholesky_interpret():
     assert info["executed"] == 4
 
 
+def test_device_cholesky_interpret_blocked_potrf():
+    """tile=256 > the 128 factor base exercises the recursive 2x2 blocked
+    factor_and_inv path (panel/update/inverse as block algebra)."""
+    a = make_spd(512).astype(np.float32)
+    L, info = device_cholesky(a, interpret=True, tile=256)
+    rel = np.max(np.abs(L @ L.T - a)) / np.max(np.abs(a))
+    assert rel < 1e-5
+    assert info["executed"] == 4
+
+
 def test_device_sw_interpret_multi_tile():
     a, b = random_seq(256, 3), random_seq(384, 4)
     score, h, info = device_sw(a, b, interpret=True)
@@ -48,6 +58,18 @@ def test_device_cholesky_tpu():
     L, info = device_cholesky(a, interpret=False)
     rel = np.max(np.abs(L @ L.T - a)) / np.max(np.abs(a))
     assert rel < 1e-5, rel
+
+
+@pytest.mark.skipif(not on_tpu, reason="needs TPU")
+def test_device_cholesky_tpu_tile512():
+    """The bench configuration's tile size: recursion depth 2 in
+    factor_and_inv (512 -> 256 -> 128 base), residual checked on hardware
+    (MXU precision differs from the interpret path)."""
+    a = make_spd(1024).astype(np.float32)
+    L, info = device_cholesky(a, interpret=False, tile=512)
+    rel = np.max(np.abs(L @ L.T - a)) / np.max(np.abs(a))
+    assert rel < 1e-5, rel
+    assert info["executed"] == 4
 
 
 @pytest.mark.skipif(not on_tpu, reason="needs TPU")
