@@ -101,6 +101,37 @@ def bench_native_fib(n: int = 27):
         return None
 
 
+def bench_device_sw():
+    """Secondary: batched Smith-Waterman GCUPS via the fused Pallas sweep
+    (device/sw_pallas.py). Per-call tunnel overhead (~80 ms) dwarfs the
+    compute, so the rate is the slope between two query lengths."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return None
+    from hclib_tpu.device.sw_pallas import _sw_pallas
+
+    rng = np.random.default_rng(1)
+    B, m = 1024, 1024
+    bt = jax.device_put(jnp.asarray(rng.integers(0, 4, (m, B)), jnp.int32))
+    times = {}
+    for n in (256, 2048):
+        at = jax.device_put(
+            jnp.asarray(rng.integers(0, 4, (n, B)), jnp.int32)
+        )
+        np.asarray(_sw_pallas(at, bt, block_b=256, interpret=False))
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(_sw_pallas(at, bt, block_b=256, interpret=False))
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    gcups = B * m * (2048 - 256) / (times[2048] - times[256]) / 1e9
+    log(f"device SW [pallas]: B={B} m={m}, {gcups:.0f} GCUPS (slope)")
+    return gcups
+
+
 def bench_device_cholesky():
     """In-kernel tiled-Cholesky throughput: the full 120-task DDF DAG
     (n=4096, 512x512 MXU tiles) is re-run R times inside one kernel launch
@@ -250,6 +281,10 @@ def main() -> None:
     if native_fib_rate:
         line += f"; vs native C++: {device_fib_rate / native_fib_rate:.2f}x"
     log(line)
+    try:
+        bench_device_sw()
+    except Exception as e:  # secondary metric must not break the contract
+        log(f"sw bench failed: {e}")
     try:
         bench_device_cholesky()
     except Exception as e:  # secondary metric must not break the contract
