@@ -1,0 +1,26 @@
+"""Every tutorial lesson is a self-checking script; run each as a user
+would (fresh subprocess, repo root on path via the lesson's own bootstrap)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+TUTORIAL = pathlib.Path(__file__).resolve().parent.parent / "tutorial"
+LESSONS = sorted(p.name for p in TUTORIAL.glob("0*.py"))
+
+
+def test_tutorial_is_complete():
+    assert len(LESSONS) == 6
+
+
+@pytest.mark.parametrize("lesson", LESSONS)
+def test_lesson_runs(lesson):
+    proc = subprocess.run(
+        [sys.executable, str(TUTORIAL / lesson)],
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (lesson, proc.stdout[-800:], proc.stderr[-800:])

@@ -1,0 +1,40 @@
+"""Lesson 1: structured task parallelism.
+
+``launch`` brings up the runtime (worker threads, locality graph, modules)
+and runs your root function as a task; ``async_`` spawns a child task;
+``finish()`` is a scope that blocks until every task spawned inside it -
+transitively - has completed. This is the reference's
+finish/async model (a task may outlive its spawner, but never its
+enclosing finish).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import hclib_tpu as hc
+
+
+def fib(n: int, out: list, slot: int) -> None:
+    if n < 2:
+        out[slot] = n
+        return
+    part = [0, 0]
+    with hc.finish():  # wait for BOTH children (and their subtrees)
+        hc.async_(fib, n - 1, part, 0)
+        hc.async_(fib, n - 2, part, 1)
+    out[slot] = part[0] + part[1]
+
+
+def main() -> None:
+    out = [0]
+    # nworkers=4: four work-stealing workers; stats=True prints per-worker
+    # executed/spawned/steal counters at exit.
+    hc.launch(lambda: fib(16, out, 0), nworkers=4, stats=True)
+    assert out[0] == 987, out[0]
+    print("fib(16) =", out[0], "computed by a tree of dynamic tasks")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Lesson 2: data-driven futures.
+
+``async_future`` spawns a task and returns a Future for its result;
+``Promise`` is the single-assignment cell behind it. A task that waits on
+a future does not block its worker: ready tasks run in its place
+(help-first work-shifting), so dataflow graphs schedule themselves by
+data availability - the reference's DDF model.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import hclib_tpu as hc
+
+
+def main() -> None:
+    result = {}
+
+    def body() -> None:
+        # A small dataflow diamond: c consumes a and b.
+        fa = hc.async_future(lambda: 20)
+        fb = hc.async_future(lambda: 22)
+
+        def join():
+            return fa.wait() + fb.wait()
+
+        fc = hc.async_future(join)
+        result["c"] = fc.wait()
+
+        # Promises directly: producer/consumer decoupled from task results.
+        p = hc.Promise()
+        hc.async_(lambda: p.put("ready"))
+        result["p"] = p.future.wait()
+
+    hc.launch(body, nworkers=2)
+    assert result["c"] == 42
+    assert result["p"] == "ready"
+    print("dataflow diamond ->", result["c"], "| promise ->", result["p"])
+
+
+if __name__ == "__main__":
+    main()
