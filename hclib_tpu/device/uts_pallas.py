@@ -245,9 +245,12 @@ def _uts_dfs_pallas(
         roots_state, roots_count, nroots.reshape(1)
     )
     return (
-        jnp.sum(nodes),
-        jnp.sum(leaves),
-        jnp.max(maxd),
+        # Per-lane planes, not totals: totals are summed on the host in
+        # int64 so trees beyond 2^31 total nodes (T1XXL's 4.23B) count
+        # correctly while per-lane counters stay comfortably in int32.
+        nodes,
+        leaves,
+        maxd,
         ctl[0],
         ctl[1] != 0,
     )
@@ -319,14 +322,14 @@ def uts_pallas(
     nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
     t0 = time.perf_counter()
     nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
-    dev_nodes = int(nodes)
+    dev_nodes = int(np.asarray(nodes).sum(dtype=np.int64))
     dt = time.perf_counter() - t0
     if bool(unfinished):
         raise RuntimeError(f"uts_pallas ran out of steps ({max_steps})")
     result.update(
         nodes=host_nodes + dev_nodes,
-        leaves=host_leaves + int(leaves),
-        max_depth=max(host_maxd, int(maxd)),
+        leaves=host_leaves + int(np.asarray(leaves).sum(dtype=np.int64)),
+        max_depth=max(host_maxd, int(np.asarray(maxd).max())),
         steps=int(steps),
         device_nodes=dev_nodes,
         device_seconds=dt,

@@ -348,12 +348,13 @@ def _uts_dfs(
         S, lanes, thresholds, gen_mx, refill_min_idle, max_steps, refill, R
     )
     sp, next_root, nodes, leaves, maxd, steps = run()
-    # int32 totals: fine up to 2^31 device-side nodes (T1L is 102M; the 4.2B
-    # T1XXL tree would need per-lane int64 counters or periodic draining).
     return (
-        jnp.sum(nodes),
-        jnp.sum(leaves),
-        jnp.max(maxd),
+        # Per-lane planes, not totals: totals are summed on the host in
+        # int64 so trees beyond 2^31 total nodes (T1XXL's 4.23B) count
+        # correctly while per-lane counters stay comfortably in int32.
+        nodes,
+        leaves,
+        maxd,
         steps,
         jnp.any(sp >= 0) | (next_root < R),
     )
@@ -480,15 +481,15 @@ def uts_vec(
     nodes, leaves, maxd, steps, unfinished = _uts_dfs(*args, **kw)
     t0 = time.perf_counter()
     nodes, leaves, maxd, steps, unfinished = _uts_dfs(*args, **kw)
-    dev_nodes = int(nodes)
+    dev_nodes = int(np.asarray(nodes).sum(dtype=np.int64))
     dt = time.perf_counter() - t0
     if bool(unfinished):
         raise RuntimeError(f"uts_vec ran out of steps ({max_steps})")
     nlanes = lanes[0] * lanes[1]
     result.update(
         nodes=host_nodes + dev_nodes,
-        leaves=host_leaves + int(leaves),
-        max_depth=max(host_maxd, int(maxd)),
+        leaves=host_leaves + int(np.asarray(leaves).sum(dtype=np.int64)),
+        max_depth=max(host_maxd, int(np.asarray(maxd).max())),
         steps=int(steps),
         device_nodes=dev_nodes,
         device_seconds=dt,

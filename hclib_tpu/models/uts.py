@@ -32,7 +32,10 @@ from typing import List, Tuple
 
 import hclib_tpu as hc
 
-__all__ = ["UTSParams", "T1", "T1L", "T3", "count_seq", "count_parallel", "run"]
+__all__ = [
+    "UTSParams", "T1", "T1L", "T1XL", "T1XXL", "T3", "count_seq",
+    "count_parallel", "run",
+]
 
 MAX_CHILDREN = 100  # MAXNUMCHILDREN (reference: test/uts/uts.h:31)
 
@@ -50,6 +53,11 @@ class UTSParams:
 # Canonical trees (reference: test/uts/sample_trees.sh:18,37)
 T1 = UTSParams(shape=FIXED, gen_mx=10, b0=4.0, root_seed=19)  # 4,130,071 nodes
 T1L = UTSParams(shape=FIXED, gen_mx=13, b0=4.0, root_seed=29)  # 102,181,082 nodes
+# test/uts/sample_trees.sh XL/XXL geometric trees. Per-lane counters stay
+# well under int32 for both; T1XXL's 4.23B TOTAL exceeds int32, which is
+# why engine totals are summed in int64 on the host.
+T1XL = UTSParams(shape=FIXED, gen_mx=15, b0=4.0, root_seed=29)  # 1,635,119,272
+T1XXL = UTSParams(shape=FIXED, gen_mx=15, b0=4.0, root_seed=19)  # 4,230,646,601
 T3 = UTSParams(shape=FIXED, gen_mx=5, b0=4.0, root_seed=42)  # small, for tests
 
 
