@@ -128,12 +128,14 @@ class KernelContext:
         flag and clamps so writes stay in bounds - the host raises after
         the kernel returns.
 
-        Re-entrant callers (the sharded steal round loop): both free stacks
-        are scratch, reset on every kernel entry, so blocks freed in an
-        earlier round are NOT reusable later - the bump cursor holds its
-        high-water mark and exhaustion is reported as overflow, never
-        corruption. Long-lived recycling under re-entry wants row-owned
-        blocks (``row_values``), which recycle with descriptor rows."""
+        Re-entrant callers (the sharded steal round loop): the value-block
+        free stack is scratch, reset on every kernel entry, so blocks freed
+        in an earlier round are NOT reusable later - the bump cursor holds
+        its high-water mark and exhaustion is reported as overflow, never
+        corruption. (Descriptor rows don't have this limit: stage()
+        rebuilds their free stack from completion tombstones.) Long-lived
+        recycling under re-entry wants row-owned blocks (``row_values``),
+        which recycle with the rows."""
         if self._uses_row_values:
             # Trace-time guard: the bump region starts exactly at the
             # row-block base (C_VBASE == initial C_VALLOC), so any bump
@@ -355,6 +357,13 @@ class Megakernel:
             def copy_task(i, _):
                 for w in range(DESC_WORDS):
                     tasks[i, w] = tasks_in[i, w]
+                # Rebuild the row free stack from completion tombstones so
+                # rows freed in earlier entries (sharded steal rounds) are
+                # reusable - the stack itself is scratch and resets here.
+                tomb = tasks_in[i, F_DEP] == -1
+                nf = free[0] + tomb.astype(jnp.int32)
+                free[jnp.where(tomb, nf, 0)] = jnp.where(tomb, i, free[0])
+                free[0] = nf
                 return 0
 
             jax.lax.fori_loop(0, counts_in[C_ALLOC], copy_task, 0)
@@ -363,7 +372,15 @@ class Megakernel:
                 ready[i] = ready_in[i]
                 return 0
 
-            jax.lax.fori_loop(0, counts_in[C_TAIL], copy_ready, 0)
+            # C_TAIL is the all-time push counter; once it passes capacity
+            # the whole ring may be live (entries wrap), and raw C_TAIL as
+            # a bound would walk out of the ring.
+            jax.lax.fori_loop(
+                0,
+                jnp.minimum(counts_in[C_TAIL], capacity),
+                copy_ready,
+                0,
+            )
 
             def copy_vals(i, _):
                 ivalues[i] = ivalues_in[i]
@@ -421,6 +438,11 @@ class Megakernel:
             # forward), so it can back future spawns - a bounded table runs
             # unbounded dynamic graphs whose live set fits (the reference
             # frees tasks after execution, src/hclib-runtime.c:448-478).
+            # The F_DEP=-1 tombstone lets stage() rediscover freed rows on
+            # re-entry (the free stack itself is scratch): spawn overwrites
+            # it on reuse, and completed rows are never re-examined
+            # otherwise.
+            tasks[idx, F_DEP] = -1
             nf = free[0] + 1
             free[0] = nf
             free[nf] = idx

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .descriptor import (
     DESC_WORDS,
     F_CSR_N,
+    F_DEP,
     F_FN,
     F_SUCC0,
     F_SUCC1,
@@ -117,11 +118,10 @@ class ShardedMegakernel:
         device ring, repeat until psum(pending) == 0."""
         # Full value staging: the round loop re-enters the kernel, and value
         # slots above value_alloc (row-owned blocks, bump allocations) carry
-        # live results between entries. Free stacks are scratch and reset
-        # per entry, so rows/blocks freed in one round are not reused in
-        # later rounds (alloc cursors ratchet; exhaustion raises overflow) -
-        # size capacity/num_values for the executed total, not the live set,
-        # when quantum splits a dynamic graph across rounds.
+        # live results between entries. Descriptor rows freed in earlier
+        # rounds ARE reusable (stage() rebuilds the row free stack from
+        # completion tombstones), so capacity tracks the live set; only
+        # bump-side alloc_values blocks ratchet across rounds.
         inner = self.mk._build_raw(quantum, stage_all_values=True)
         ndata = len(self.mk.data_specs)
         axis = self.axis
@@ -173,6 +173,14 @@ class ShardedMegakernel:
                 ).astype(jnp.int32)
                 sendmask = j < nsend
                 sendbuf = jnp.where(sendmask[:, None], desc, 0)
+                # Tombstone the exported rows (F_DEP=-1): the task now lives
+                # on the neighbor, so the victim's row is dead and stage()
+                # can hand it to future spawns/imports. Unmasked lanes point
+                # out of bounds - scatter drops OOB updates, so there are
+                # no duplicate-index write races.
+                tasks = tasks.at[jnp.where(sendmask, cand, cap), F_DEP].set(
+                    -1
+                )
                 counts = counts.at[C_HEAD].add(nsend).at[C_PENDING].add(-nsend)
                 # ---- exchange: one hop around the ICI ring per round
                 # (surplus diffuses across rounds).
@@ -180,20 +188,30 @@ class ShardedMegakernel:
                 nrecv = jax.lax.ppermute(
                     nsend.reshape(1), axis, perm
                 )[0]
-                # ---- import: allocate fresh rows + push to my ready ring.
+                # ---- import: reuse tombstoned (freed/exported) rows first,
+                # then fresh rows from the bump cursor - so steal-heavy runs
+                # only need capacity for the LIVE set, not cumulative
+                # imports.
                 alloc, tail = counts[C_ALLOC], counts[C_TAIL]
-                can = jnp.minimum(nrecv, cap - alloc)
+                tomb = (tasks[:, F_DEP] == -1) & (
+                    jnp.arange(cap) < alloc
+                )
+                # First (at most) K tombstoned row indices, ascending; the
+                # cap fill value is only reachable on lanes j >= nre, which
+                # take the fresh-row branch below.
+                (reuse,) = jnp.nonzero(tomb, size=K, fill_value=cap)
+                ntomb = jnp.sum(tomb.astype(jnp.int32))
+                can = jnp.minimum(nrecv, ntomb + (cap - alloc))
+                nre = jnp.minimum(can, ntomb)
                 take = j < can
-                rows = jnp.clip(alloc + j, 0, cap - 1)
-                tasks = tasks.at[rows].set(
-                    jnp.where(take[:, None], recvbuf, tasks[rows])
-                )
-                slot = (tail + j) % cap
-                ring_ = ring_.at[slot].set(
-                    jnp.where(take, alloc + j, ring_[slot])
-                )
+                rows = jnp.where(j < nre, reuse[j], alloc + j - nre)
+                # OOB indices on untaken lanes: scatter drops them, avoiding
+                # duplicate-index races with the taken lanes' writes.
+                tasks = tasks.at[jnp.where(take, rows, cap)].set(recvbuf)
+                slot = jnp.where(take, (tail + j) % cap, cap)
+                ring_ = ring_.at[slot].set(rows)
                 counts = (
-                    counts.at[C_ALLOC].add(can)
+                    counts.at[C_ALLOC].add(can - nre)
                     .at[C_TAIL].add(can)
                     .at[C_PENDING].add(can)
                     .at[C_OVERFLOW].max(
