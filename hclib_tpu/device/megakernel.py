@@ -193,10 +193,23 @@ class KernelContext:
         consumes the block's values - after this, the slots may be handed to
         any later allocation (the analogue of the reference freeing a task's
         promise cells once its continuation has read them). Never free
-        host-preset slots or k > VBLOCK allocations."""
+        host-preset slots or k > VBLOCK allocations.
+
+        A full stack means more frees than blocks exist (double-free or a
+        host-preset base): the push is clamped inside the stack and
+        C_OVERFLOW is set so the host raises instead of silently corrupting
+        SMEM past the scratch window."""
+        vcap = self._num_values // VBLOCK  # stack slots available
         nf = self._vfree[0] + 1
-        self._vfree[0] = nf
-        self._vfree[nf] = base
+        ok = nf <= vcap
+        nf_c = jnp.minimum(nf, vcap)
+        self._vfree[0] = nf_c
+        # On overflow this rewrites the top element with itself (one block
+        # leaks; no corruption).
+        self._vfree[nf_c] = jnp.where(ok, base, self._vfree[nf_c])
+        self._counts[C_OVERFLOW] = jnp.where(
+            ok, self._counts[C_OVERFLOW], 1
+        )
 
     def push_ready(self, t) -> None:
         tail = self._counts[C_TAIL]
@@ -592,7 +605,16 @@ class Megakernel:
         fuel: int = 1 << 22,
     ):
         """Execute the task graph to completion; returns
-        (ivalues, data_dict, info_dict)."""
+        (ivalues, data_dict, info_dict).
+
+        Value-slot readback contract: only slots below the staged
+        ``value_alloc`` (host presets + declared out slots, widened over any
+        nonzero entries of ``ivalues``) round-trip host -> kernel -> host.
+        Slots above it are device temporaries (row-owned blocks, bump
+        allocations): their returned contents are whatever the last kernel
+        entry left there and must not be relied on. A deliberate ZERO preset
+        above the out-slot range is invisible to the widening scan - declare
+        it with ``TaskGraphBuilder.reserve_values`` so staging covers it."""
         tasks, succ, ring, counts = builder.finalize(
             capacity=self.capacity, succ_capacity=self.succ_capacity
         )
@@ -642,10 +664,11 @@ class Megakernel:
         }
         if info["overflow"]:
             raise RuntimeError(
-                f"megakernel overflow (task-table capacity={self.capacity}, "
-                f"live set exceeded it, or value slots num_values="
-                f"{self.num_values} exhausted); raise the limits or coarsen "
-                "tasks"
+                f"megakernel overflow: task-table capacity={self.capacity} "
+                f"exceeded by the live set, value slots num_values="
+                f"{self.num_values} exhausted, or more free_values calls "
+                "than allocated blocks (double-free / host-preset base); "
+                "raise the limits, coarsen tasks, or audit frees"
             )
         if info["pending"] != 0:
             raise RuntimeError(

@@ -149,38 +149,52 @@ class ShardedMegakernel:
                 outs = inner(tasks, succ0, ring_, counts, iv, *data)
                 tasks, ring_, counts, iv = outs[:4]
                 data = tuple(outs[4:])
-                # ---- export: a prefix of my ready ring, oldest first (the
-                # Chase-Lev thief steals from the top; here the "thief" is
-                # the ring neighbor).
+                # ---- export: eligible tasks from the head-side window,
+                # oldest first (the Chase-Lev thief steals from the top;
+                # here the "thief" is the ring neighbor). Eligible
+                # candidates are COMPACTED across the whole scanned window
+                # - a non-migratable task at the head does not block the
+                # ones behind it; the survivors are compacted back toward
+                # the head so the ring stays dense.
                 head, tail = counts[C_HEAD], counts[C_TAIL]
                 backlog = tail - head
                 gavg = jax.lax.psum(backlog, axis) // ndev
                 quota = jnp.clip(backlog - gavg, 0, K)
+                scanned = j < jnp.minimum(backlog, K)
                 ring_idx = (head + j) % cap
                 cand = ring_[ring_idx]
                 desc = tasks[jnp.clip(cand, 0, cap - 1)]
                 elig = (
-                    (j < backlog)
+                    scanned
                     & (cand >= 0)
                     & wl[jnp.clip(desc[:, F_FN], 0, wl.shape[0] - 1)]
                     & (desc[:, F_SUCC0] == NO_TASK)
                     & (desc[:, F_SUCC1] == NO_TASK)
                     & (desc[:, F_CSR_N] == 0)
                 )
-                prefix = jnp.cumprod(elig.astype(jnp.int32)) == 1
-                nsend = jnp.minimum(
-                    jnp.sum(prefix.astype(jnp.int32)), quota
-                ).astype(jnp.int32)
-                sendmask = j < nsend
-                sendbuf = jnp.where(sendmask[:, None], desc, 0)
+                rank_e = jnp.cumsum(elig.astype(jnp.int32)) - 1
+                send = elig & (rank_e < quota)
+                nsend = jnp.sum(send.astype(jnp.int32))
+                # Gather exported descriptors densely into sendbuf[0:nsend]
+                # (OOB scatter lanes drop the non-send rows).
+                sendbuf = (
+                    jnp.zeros((K, DESC_WORDS), jnp.int32)
+                    .at[jnp.where(send, rank_e, K)]
+                    .set(desc)
+                )
+                # Compact the scanned-but-kept entries to the new head so
+                # no live slot is skipped when head advances.
+                keep = scanned & jnp.logical_not(send)
+                rank_k = jnp.cumsum(keep.astype(jnp.int32)) - 1
+                ring_ = ring_.at[
+                    jnp.where(keep, (head + nsend + rank_k) % cap, cap)
+                ].set(cand, mode="drop")
                 # Tombstone the exported rows (F_DEP=-1): the task now lives
                 # on the neighbor, so the victim's row is dead and stage()
                 # can hand it to future spawns/imports. Unmasked lanes point
                 # out of bounds - scatter drops OOB updates, so there are
                 # no duplicate-index write races.
-                tasks = tasks.at[jnp.where(sendmask, cand, cap), F_DEP].set(
-                    -1
-                )
+                tasks = tasks.at[jnp.where(send, cand, cap), F_DEP].set(-1)
                 counts = counts.at[C_HEAD].add(nsend).at[C_PENDING].add(-nsend)
                 # ---- exchange: one hop around the ICI ring per round
                 # (surplus diffuses across rounds).
@@ -288,7 +302,11 @@ class ShardedMegakernel:
             raise ValueError(
                 f"data buffers {sorted(data)} != declared {sorted(self.mk.data_specs)}"
             )
-        key = (fuel, steal, quantum, window, max_rounds)
+        # fuel is unused on the steal path (each round runs `quantum`), so
+        # keep it out of that cache key - varying fuel must not recompile.
+        key = (
+            (True, quantum, window, max_rounds) if steal else (False, fuel)
+        )
         if key not in self._jitted:
             self._jitted[key] = (
                 self._build_steal(quantum, window, max_rounds)

@@ -142,6 +142,29 @@ def test_alloc_free_values_recycles_interpret():
         mk2.run(b2)
 
 
+def _double_free_kernel(ctx):
+    base = ctx.alloc_values(2)
+    ctx.free_values(base)
+    ctx.free_values(base)  # freeing twice walks the stack past its blocks
+
+
+def test_double_free_sets_overflow_interpret():
+    """More frees than blocks exist must clamp the vfree push inside the
+    stack and surface C_OVERFLOW (ADVICE r1) instead of silently walking
+    SMEM past the scratch window."""
+    from hclib_tpu.device.megakernel import Megakernel
+
+    mk = Megakernel(kernels=[("df", _double_free_kernel)], capacity=16,
+                    num_values=8, succ_capacity=8, interpret=True)
+    b = TaskGraphBuilder()
+    # Three tasks: 6 frees against a 2-block stack - guaranteed to hit the
+    # clamp regardless of how alloc/free interleave.
+    for _ in range(3):
+        b.add(0)
+    with pytest.raises(RuntimeError, match="free_values|overflow"):
+        mk.run(b)
+
+
 @pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs TPU")
 def test_device_fib_tpu():
     v, info = device_fib(12, capacity=768, interpret=False)

@@ -155,6 +155,32 @@ def test_rounds_reuse_freed_rows():
     assert int(iv[0, 0]) == 233 and int(iv[1, 0]) == 144
 
 
+def test_non_migratable_head_does_not_block_export():
+    """A non-migratable task parked at the ring head must not pin the
+    migratable backlog behind it: export compacts eligible candidates
+    across the scanned window (ADVICE r1), so the BUMPs still diffuse."""
+    ndev, ntasks = 8, 200
+    mesh = cpu_mesh(ndev, axis_name="queues")
+    mk = Megakernel(
+        kernels=[("stay", lambda ctx: ctx.set_value(1, ctx.value(1) + 1)),
+                 ("bump", _bump_kernel)],
+        capacity=512, num_values=4, succ_capacity=8, interpret=True,
+    )
+    smk = ShardedMegakernel(mk, mesh, migratable_fns=[1])  # bump only
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    builders[0].add(0)  # STAY lands at the head (owner pops LIFO from tail)
+    for i in range(ntasks):
+        builders[0].add(1, args=[i + 1])
+    iv, _, info = smk.run(builders, steal=True, quantum=4, window=16)
+    assert info["pending"] == 0
+    assert info["executed"] == ntasks + 1
+    assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
+    assert int(iv[:, 1].sum()) == 1  # STAY ran exactly once, on its owner
+    assert int(iv[0, 1]) == 1
+    per_dev = info["per_device_counts"][:, 5]
+    assert int((per_dev > 0).sum()) >= 3, per_dev
+
+
 def _spawner_kernel(ctx):
     # Emit one migratable BUMP per step and chain to self: a generator
     # whose cumulative output far exceeds the table capacity.
