@@ -25,27 +25,22 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_device_fib():
-    """Steady-state megakernel throughput: the fib(12) task graph (697
-    dynamic tasks: spawns, joins, continuation passing) is re-run R times
-    *inside one kernel launch* (the resident scheduler never exits), and the
-    per-task cost is the slope between two R values - this cancels launch +
-    host<->device transfer overhead, which on this tunnel setup is ~75 ms
-    and would otherwise swamp the measurement."""
+def _slope_rate(mk, builder, expect_value, fuel, reps_pair, label):
+    """Shared steady-state harness: re-run the staged graph R times inside
+    one kernel launch for two R values; per-task cost is the slope between
+    them - this cancels launch + host<->device transfer overhead, which on
+    this tunnel setup is ~75 ms and would otherwise swamp the measurement.
+    The warm-up call's value slot 0 is asserted against ``expect_value``;
+    the D2H read of the counts word is the only reliable sync through the
+    tunnel (block_until_ready returns early on remote arrays)."""
     import jax
     import jax.numpy as jnp
 
-    from hclib_tpu.device.descriptor import TaskGraphBuilder
     from hclib_tpu.device.megakernel import C_EXECUTED
-    from hclib_tpu.device.workloads import FIB, make_fib_megakernel
 
-    interpret = jax.default_backend() != "tpu"
-    cap = 768
-    r_lo, r_hi = (100, 2000) if not interpret else (1, 3)
-    mk = make_fib_megakernel(cap, interpret=interpret)
-    b = TaskGraphBuilder()
-    b.add(FIB, args=[12], out=0)  # 697 tasks, fits the SMEM table
-    tasks, succ, ring, counts = b.finalize(capacity=cap, succ_capacity=64)
+    tasks, succ, ring, counts = builder.finalize(
+        capacity=mk.capacity, succ_capacity=mk.succ_capacity
+    )
 
     def fresh():
         return [
@@ -55,19 +50,62 @@ def bench_device_fib():
         ]
 
     points = []
-    for reps in (r_lo, r_hi):
-        jitted = mk._build(1 << 22, reps=reps)
+    for reps in reps_pair:
+        jitted = mk._build(fuel, reps=reps)
         outs = jitted(*fresh())
-        assert int(np.asarray(outs[3])[0]) == 144, "device fib wrong"
+        assert int(np.asarray(outs[3])[0]) == expect_value, f"{label} wrong"
         t0 = time.perf_counter()
         outs = jitted(*fresh())
         n = int(np.asarray(outs[2])[C_EXECUTED])  # d2h read = true sync
         dt = time.perf_counter() - t0
         points.append((dt, n))
-        log(f"device fib reps={reps}: {n} tasks in {dt*1000:.1f} ms (incl overhead)")
+        log(f"{label} reps={reps}: {n} tasks in {dt*1000:.1f} ms (incl overhead)")
     (d1, n1), (d2, n2) = points
     slope = (d2 - d1) / (n2 - n1)
-    rate = 1.0 / slope
+    return 1.0 / slope, slope
+
+
+def bench_device_vfib():
+    """Steady-state batch-dispatch (vector tier) throughput: the fib(30)
+    graph (2,692,537 tasks - the whole recursion tree, lane-level work
+    stealing balancing the lanes) under the shared slope harness."""
+    import jax
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import VFIB, make_vfib_megakernel
+
+    interpret = jax.default_backend() != "tpu"
+    n, reps_pair = (30, (2, 12)) if not interpret else (10, (1, 2))
+    expect = {30: 832040, 10: 55}[n]
+    mk = make_vfib_megakernel(max_n=n + 2, interpret=interpret)
+    b = TaskGraphBuilder()
+    b.add(VFIB, args=[n], out=0)
+    rate, slope = _slope_rate(
+        mk, b, expect, 1 << 30, reps_pair, f"device vfib({n})"
+    )
+    log(f"device fib batch-dispatch steady-state: {slope*1e9:.2f} ns/task -> "
+        f"{rate/1e6:,.1f}M tasks/s ({'interpret' if interpret else 'tpu'})")
+    return rate
+
+
+def bench_device_fib():
+    """Steady-state scalar-tier megakernel throughput: the fib(12) task
+    graph (697 dynamic tasks: spawns, joins, continuation passing) under
+    the shared slope harness (the resident scheduler never exits between
+    reps)."""
+    import jax
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import FIB, make_fib_megakernel
+
+    interpret = jax.default_backend() != "tpu"
+    reps_pair = (100, 2000) if not interpret else (1, 3)
+    mk = make_fib_megakernel(768, interpret=interpret)
+    b = TaskGraphBuilder()
+    b.add(FIB, args=[12], out=0)  # 697 tasks, fits the SMEM table
+    rate, slope = _slope_rate(
+        mk, b, 144, 1 << 22, reps_pair, "device fib"
+    )
     log(f"device fib steady-state: {slope*1e9:.0f} ns/task -> "
         f"{rate:,.0f} tasks/s ({'interpret' if interpret else 'tpu'})")
     return rate
@@ -277,10 +315,24 @@ def main() -> None:
     host_rate = bench_host_fib()
     native_fib_rate = bench_native_fib()
     device_fib_rate = bench_device_fib()
-    line = f"fib megakernel vs python host: {device_fib_rate / host_rate:.1f}x"
+    line = (
+        f"fib megakernel (scalar tier) vs python host: "
+        f"{device_fib_rate / host_rate:.1f}x"
+    )
     if native_fib_rate:
         line += f"; vs native C++: {device_fib_rate / native_fib_rate:.2f}x"
     log(line)
+    try:
+        vfib_rate = bench_device_vfib()
+        line = (
+            f"fib megakernel (batch-dispatch tier) vs python host: "
+            f"{vfib_rate / host_rate:.0f}x"
+        )
+        if native_fib_rate:
+            line += f"; vs native C++: {vfib_rate / native_fib_rate:.1f}x"
+        log(line)
+    except Exception as e:  # secondary metric must not break the contract
+        log(f"vfib bench failed: {e}")
     try:
         bench_device_sw()
     except Exception as e:  # secondary metric must not break the contract

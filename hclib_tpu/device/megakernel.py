@@ -216,6 +216,19 @@ class KernelContext:
         self._ready[tail % self._capacity] = t
         self._counts[C_TAIL] = tail + 1
 
+    def add_executed(self, n) -> None:
+        """Credit ``n`` extra executed tasks (the vector tier reports its
+        expanded node count here so 'executed' means tasks across both
+        tiers, and fuel accounting sees vector work)."""
+        self._counts[C_EXECUTED] = self._counts[C_EXECUTED] + n
+
+    def flag_overflow(self, cond) -> None:
+        """Raise the overflow flag where ``cond`` (host raises after the
+        kernel returns)."""
+        self._counts[C_OVERFLOW] = jnp.where(
+            cond, 1, self._counts[C_OVERFLOW]
+        )
+
     def take_continuation(self, new_idx) -> None:
         """Transfer this task's successors to ``new_idx`` - the descriptor
         equivalent of the reference turning a blocked stack into a
@@ -288,6 +301,45 @@ class KernelContext:
         return a_clamped
 
 
+def _is_vector_spec(fn) -> bool:
+    from .vector_engine import VectorTaskSpec
+
+    return isinstance(fn, VectorTaskSpec)
+
+
+def _wrap_vector_spec(spec, interpret: bool):
+    """Bridge a VectorTaskSpec into the scalar kernel table: popping a task
+    of this F_FN dispatches its whole subtree across VPU lanes (the batch-
+    dispatch tier, device/vector_engine.py). The seed task's 6 arg words
+    feed ``spec.seed``; the ``out_acc`` accumulator lands in the task's
+    F_OUT value slot; expanded-node count is credited to C_EXECUTED so
+    'executed' counts tasks across both tiers."""
+    from .vector_engine import make_subtree_runner
+
+    runner = make_subtree_runner(spec, use_pltpu_roll=not interpret)
+
+    def body(ctx: "KernelContext") -> None:
+        args = tuple(ctx.arg(i) for i in range(6))
+        seed_frame, seed_count = spec.seed(args)
+        nodes, accs, over = runner(seed_frame, seed_count)
+        if spec.root_contrib is not None:
+            # The vector steps only ever expand *children*; a seed that is
+            # itself a leaf contributes here (its execution is already
+            # counted by the scalar tier's complete()).
+            rc = spec.root_contrib(args)
+            root_leaf = jnp.int32(seed_count) == 0
+            accs = {
+                name: accs[name] + jnp.where(root_leaf, rc.get(name, 0), 0)
+                for name in accs
+            }
+        if spec.out_acc is not None:
+            ctx.set_out(accs[spec.out_acc])
+        ctx.add_executed(nodes)
+        ctx.flag_overflow(over)
+
+    return body
+
+
 class Megakernel:
     """Builds and runs the single-core scheduler kernel over a task DAG.
 
@@ -309,8 +361,13 @@ class Megakernel:
         interpret: Optional[bool] = None,
         uses_row_values: bool = False,
     ) -> None:
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
         self.kernel_names = [name for name, _ in kernels]
-        self.kernel_fns = [fn for _, fn in kernels]
+        self.kernel_fns = [
+            _wrap_vector_spec(fn, interpret) if _is_vector_spec(fn) else fn
+            for _, fn in kernels
+        ]
         self.fn_id = {name: i for i, name in enumerate(self.kernel_names)}
         self.data_specs = dict(data_specs or {})
         self.scratch_specs = dict(scratch_specs or {})
@@ -321,8 +378,6 @@ class Megakernel:
         # every row's block fits below num_values (the region starts at the
         # runtime value_alloc, which out-slots and presets can push up).
         self.uses_row_values = uses_row_values
-        if interpret is None:
-            interpret = jax.default_backend() == "cpu"
         self.interpret = interpret
         self._jitted: Dict[int, Any] = {}  # fuel -> compiled call
         # Packs counts + ivalues into one array so the host needs a single
@@ -666,9 +721,11 @@ class Megakernel:
             raise RuntimeError(
                 f"megakernel overflow: task-table capacity={self.capacity} "
                 f"exceeded by the live set, value slots num_values="
-                f"{self.num_values} exhausted, or more free_values calls "
-                "than allocated blocks (double-free / host-preset base); "
-                "raise the limits, coarsen tasks, or audit frees"
+                f"{self.num_values} exhausted, more free_values calls "
+                "than allocated blocks (double-free / host-preset base), "
+                "or a vector-tier task overran its spec (per-lane "
+                "stack_depth too shallow for the subtree, or max_steps "
+                "exhausted); raise the limits, coarsen tasks, or audit frees"
             )
         if info["pending"] != 0:
             raise RuntimeError(

@@ -1,0 +1,376 @@
+"""Batched task dispatch: recursive task families run wide over VPU lanes.
+
+This is the megakernel's *vector tier* - the answer to the scalar
+scheduler's per-task SMEM cost (~30 read-modify-writes per task,
+megakernel.py). A task family that is (a) recursive (tasks spawn tasks of
+the same family) and (b) reduction-shaped (results combine associatively
+into accumulators) is described by a ``VectorTaskSpec`` and dispatched as a
+whole subtree across (rows, 128) VPU lanes inside the resident kernel:
+
+- every lane runs an independent tail-call DFS over its own stack of task
+  *frames* (the descriptor equivalent for the vector tier: a tuple of int32
+  planes per stack level), so each active step executes one task per lane -
+  thousands of tasks per VPU step instead of one per ~30 scalar RMWs;
+- load balancing is *lane-level work stealing by ring rotation*: when
+  enough lanes starve, each starved lane claims the bottom-of-stack frame
+  (the largest remaining subtree, the classic steal-from-the-cold-end of
+  Chase-Lev) from the lane a rotating ring permutation pairs it with.
+  Rotations are plain vector rolls, so there is no gather/scatter at all,
+  and a rotation pairs each donor with exactly one claimant (it is a
+  bijection) - the same conflict-freedom the reference gets from CAS on the
+  deque head (src/hclib-deque.c:75-106), by construction instead of by
+  atomics.
+
+The reference analogue of this tier is the flat/recursive ``forasync``
+family (src/hclib.c:158-416) plus the dynamic-tasking benchmarks (fib, UTS:
+test/fib/fib.c, test/uts/uts.c); the per-lane DFS machinery generalizes
+uts_vec.make_dfs_step (same tail-call discipline, same starve/refill
+structure) from the UTS tree to any user-defined task family.
+
+Frames vs descriptors: a vector-tier task never owns a 16-word SMEM
+descriptor row. Its identity is a tuple of ``frame_words`` int32 words plus
+the engine-managed (cursor, count) pair; dependencies are implicit in the
+tree structure (children complete before the parent's accumulator is read),
+and the only cross-task communication is through the named accumulators -
+which is exactly the fragment of the task model the five reference
+benchmarks that matter for throughput (fib, UTS, forasync reductions)
+actually use. General DAGs (Cholesky, Smith-Waterman) stay on the scalar
+tier; the two tiers share one kernel, one ready ring, and one
+pending/executed protocol (megakernel.py wires a VectorTaskSpec into the
+``lax.switch`` table next to scalar kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["VectorTaskSpec", "make_subtree_runner", "fib_spec"]
+
+
+class VectorTaskSpec:
+    """Describes a recursive, reduction-shaped task family for the vector
+    tier.
+
+    ``frame_words``: number of int32 words identifying one task (a *frame*).
+    ``seed(args)``: host/scalar-side map from the 6 descriptor arg words to
+        ``(frame_word_scalars, child_count_scalar)`` for the root task.
+    ``child(frame_planes, k, jnp)``: vectorized map from a parent frame and
+        child ordinal k to ``(child_frame_planes, child_child_count)``.
+        A count of 0 marks the child a leaf.
+    ``contrib(frame_planes, ccount, jnp)``: per-expanded-node contributions,
+        a dict acc_name -> int32 plane (added where the node was expanded).
+    ``accumulators``: ordered accumulator names; ``out_acc`` names the one
+        written to the task's F_OUT value slot by the megakernel bridge.
+    ``stack_depth``: static per-lane stack height (frames). Overflow sets
+        the engine's overflow flag (reported through C_OVERFLOW by the
+        megakernel bridge - the analogue of the reference's deque-full
+        assert, src/hclib-runtime.c:520-524).
+    ``root_contrib(args)``: scalar contribution of the seed task itself
+        when the seed is a leaf (count == 0); vector steps never see the
+        seed node.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        frame_words: int,
+        seed: Callable,
+        child: Callable,
+        contrib: Callable,
+        accumulators: Sequence[str],
+        out_acc: Optional[str] = None,
+        stack_depth: int = 34,
+        lanes: Tuple[int, int] = (8, 128),
+        min_idle_div: int = 8,
+        root_contrib: Optional[Callable] = None,
+    ) -> None:
+        self.name = name
+        self.frame_words = frame_words
+        self.seed = seed
+        self.child = child
+        self.contrib = contrib
+        self.accumulators = tuple(accumulators)
+        self.out_acc = out_acc if out_acc is not None else (
+            self.accumulators[0] if self.accumulators else None
+        )
+        self.stack_depth = stack_depth
+        self.lanes = tuple(lanes)
+        self.min_idle_div = min_idle_div
+        self.root_contrib = root_contrib
+
+
+def _select(stack, sp):
+    """Per-lane read of a tuple-of-planes stack at level sp (select chain -
+    Mosaic has no per-lane axis-0 gather; see uts_vec._level_select)."""
+    out = jnp.zeros_like(stack[0])
+    for L, plane in enumerate(stack):
+        out = jnp.where(sp == L, plane, out)
+    return out
+
+
+def _store(stack, sp, value, mask):
+    return tuple(
+        jnp.where(mask & (sp == L), value, plane)
+        for L, plane in enumerate(stack)
+    )
+
+
+def _shift_down(stack, mask):
+    """Drop level 0 where mask (the donated frame): level L takes level
+    L+1's plane. The top level keeps its plane (it is dead above sp)."""
+    S = len(stack)
+    return tuple(
+        jnp.where(mask, stack[L + 1], stack[L]) if L + 1 < S else stack[L]
+        for L in range(S)
+    )
+
+
+def make_subtree_runner(
+    spec: VectorTaskSpec,
+    max_steps: int = (1 << 31) - 1,
+    use_pltpu_roll: bool = False,
+):
+    """Builds ``run(seed_frame_scalars, seed_count_scalar) ->
+    (nodes, acc_dict, overflow)`` - the whole-subtree vector dispatch,
+    usable inside a Pallas kernel branch or as plain JAX.
+
+    ``nodes`` counts expanded tasks (the seed itself is NOT counted - the
+    scalar tier accounts for it as one task); ``acc_dict`` maps accumulator
+    names to int32 totals reduced over all lanes.
+    """
+    S = spec.stack_depth
+    lanes = spec.lanes
+    rows, cols = lanes
+    nlanes = rows * cols
+    U = spec.frame_words
+    min_idle = max(cols // 2, nlanes // spec.min_idle_div)
+    nacc = len(spec.accumulators)
+
+    if use_pltpu_roll:
+        # jnp.roll with a traced shift lowers to dynamic_slice, which
+        # Mosaic does not implement; inside a real TPU kernel the native
+        # dynamic-rotate primitive does the job.
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _roll(x, shift, axis):
+            return pltpu.roll(x, shift, axis)
+    else:
+
+        def _roll(x, shift, axis):
+            return jnp.roll(x, shift, axis)
+
+    def step(carry):
+        sp, over, nodes, accs, fr, ch, cn = carry
+        active = sp >= 0
+        child = _select(ch, sp)
+        count = _select(cn, sp)
+        frame = tuple(
+            _select(tuple(fr[L][w] for L in range(S)), sp) for w in range(U)
+        )
+        expand = active & (child < count)
+        cframe, ccount = spec.child(frame, child, jnp)
+        is_leaf = ccount == 0
+        nodes = nodes + expand.astype(jnp.int32)
+        contribs = spec.contrib(cframe, ccount, jnp)
+        accs = tuple(
+            accs[i] + jnp.where(expand, contribs.get(name, 0), 0)
+            for i, name in enumerate(spec.accumulators)
+        )
+        # Tail-call scheduling (uts_vec.make_dfs_step): the last non-leaf
+        # child replaces its parent's frame, so no steps pop exhausted
+        # frames and stack depth tracks the leftmost spine only.
+        last = expand & (child + 1 >= count)
+        push = expand & ~is_leaf & ~last
+        tail = expand & ~is_leaf & last
+        pop = (expand & is_leaf & last) | (active & ~expand)
+        ch = _store(ch, sp, child + 1, expand & ~last)
+        spp = sp + 1
+        # `over` is an int32 0/1 plane: i1 vectors do not survive Mosaic
+        # while-loop carries (scf.yield legalization).
+        over = over | (push & (spp >= S)).astype(jnp.int32)
+        spp = jnp.minimum(spp, S - 1)
+        lvl = jnp.where(push, spp, sp)
+        newf = push | tail
+        fr = tuple(
+            tuple(
+                jnp.where(newf & (lvl == L), cframe[w], fr[L][w])
+                for w in range(U)
+            )
+            for L in range(S)
+        )
+        ch = _store(ch, lvl, jnp.zeros(lanes, jnp.int32), newf)
+        cn = _store(cn, lvl, ccount, newf)
+        sp = jnp.where(push, spp, jnp.where(pop, sp - 1, sp))
+        return sp, over, nodes, accs, fr, ch, cn
+
+    def balance(rnd, carry):
+        """One ring-rotation steal round: starved lanes take the bottom
+        frame of the donor lane the current rotation pairs them with."""
+        sp, over, nodes, accs, fr, ch, cn = carry
+        # Rotation schedule: column shift walks 1..cols-1 while the row
+        # shift advances every full column cycle, so over rows*(cols-1)
+        # rounds every (donor, claimant) lane pair meets at least once -
+        # no pairing can starve forever. Any bijective family works for
+        # correctness (who meets whom only affects efficiency); covering
+        # all offsets is what guarantees balance progress.
+        dc = 1 + rnd % (cols - 1)
+        dr = (rnd // (cols - 1)) % rows
+
+        def rot(x):
+            return _roll(_roll(x, dc, 1), dr, 0)
+
+        def unrot(x):
+            # Positive complementary shifts (some rotate lowerings dislike
+            # negative amounts); % keeps them in [0, size).
+            return _roll(_roll(x, (rows - dr) % rows, 0), (cols - dc) % cols, 1)
+
+        donor = sp >= 1  # keeps its top frame; gives away level 0
+        # Masks ride through the rotate as int32 (TPU dynamic_rotate has no
+        # 1-bit flavor).
+        claim = (sp < 0) & (rot(donor.astype(jnp.int32)) != 0)
+        robbed = unrot(claim.astype(jnp.int32)) != 0
+        taken_fr = tuple(rot(fr[0][w]) for w in range(U))
+        taken_ch = rot(ch[0])
+        taken_cn = rot(cn[0])
+        # Donors lose their bottom: stacks shift down one level.
+        fr_cols = tuple(
+            tuple(fr[L][w] for L in range(S)) for w in range(U)
+        )
+        fr_cols = tuple(_shift_down(c, robbed) for c in fr_cols)
+        ch = _shift_down(ch, robbed)
+        cn = _shift_down(cn, robbed)
+        sp = jnp.where(robbed, sp - 1, sp)
+        # Claimants install the stolen frame at level 0.
+        fr_cols = tuple(
+            (jnp.where(claim, taken_fr[w], fr_cols[w][0]),) + fr_cols[w][1:]
+            for w in range(U)
+        )
+        ch = (jnp.where(claim, taken_ch, ch[0]),) + ch[1:]
+        cn = (jnp.where(claim, taken_cn, cn[0]),) + cn[1:]
+        sp = jnp.where(claim, 0, sp)
+        fr = tuple(
+            tuple(fr_cols[w][L] for w in range(U)) for L in range(S)
+        )
+        return sp, over, nodes, accs, fr, ch, cn
+
+    def run(seed_frame, seed_count):
+        zeros = jnp.zeros(lanes, jnp.int32)
+        flat = (
+            jax.lax.broadcasted_iota(jnp.int32, lanes, 0) * cols
+            + jax.lax.broadcasted_iota(jnp.int32, lanes, 1)
+        )
+        lane0 = flat == 0
+        fr = tuple(
+            tuple(
+                jnp.where(lane0, jnp.int32(seed_frame[w]), 0)
+                if L == 0
+                else zeros
+                for w in range(U)
+            )
+            for L in range(S)
+        )
+        ch = (zeros,) + (zeros,) * (S - 1)
+        cn = (jnp.where(lane0, jnp.int32(seed_count), 0),) + (zeros,) * (
+            S - 1
+        )
+        sp = jnp.where(lane0 & (jnp.int32(seed_count) > 0), 0, -1)
+        accs = tuple(zeros for _ in range(nacc))
+
+        def outer_cond(carry):
+            (sp, *_), rnd, steps = carry[0], carry[1], carry[2]
+            return jnp.any(sp >= 0) & (steps < max_steps)
+
+        def inner_cond(carry):
+            inner, steps = carry
+            sp = inner[0]
+            ndone = jnp.sum((sp < 0).astype(jnp.int32))
+            # Expand refill-free until enough lanes starve to justify a
+            # steal round - unless no lane can donate, in which case a
+            # round is pointless and expansion continues to drain.
+            donors = jnp.any(sp >= 1)
+            return (
+                jnp.any(sp >= 0)
+                & ((ndone < min_idle) | ~donors)
+                & (steps < max_steps)
+            )
+
+        def inner_body(carry):
+            inner, steps = carry
+            return step(inner), steps + 1
+
+        def outer_body(carry):
+            inner, rnd, steps = carry
+            inner = balance(rnd, inner)
+            # Do-while: at least one expansion step per balance round, so
+            # `steps` (and with it max_steps) bounds the whole run - a
+            # rotation round that claims nothing can never spin the outer
+            # loop without forward progress.
+            inner, steps = jax.lax.while_loop(
+                inner_cond, inner_body, inner_body((inner, steps))
+            )
+            return inner, rnd + 1, steps
+
+        inner = (sp, zeros, zeros, accs, fr, ch, cn)
+        inner, _, steps = jax.lax.while_loop(
+            outer_cond, outer_body, (inner, jnp.int32(0), jnp.int32(0))
+        )
+        sp, over, nodes, accs, *_ = inner
+        acc_dict = {
+            name: jnp.sum(accs[i])
+            for i, name in enumerate(spec.accumulators)
+        }
+        return (
+            jnp.sum(nodes),
+            acc_dict,
+            jnp.any(over != 0) | (steps >= max_steps),
+        )
+
+    return run
+
+
+# ----------------------------------------------------------------- fib
+
+def fib_spec(
+    max_n: int = 32,
+    lanes: Tuple[int, int] = (8, 128),
+    min_idle_div: int = 8,
+) -> VectorTaskSpec:
+    """fib as a vector-tier task family: frame = (n,), children (n-1, n-2),
+    leaves contribute F(n) = n for n in {0, 1}. Task count equals the naive
+    recursion-tree node count (2*fib(n+1) - 1), the same count the native
+    C++ runtime reports for its fib (native/src/workloads: one task per
+    call) - the join/SUM tasks of the scalar-tier fib are an artifact of
+    explicit continuation passing and do not exist here (the reference's
+    fib likewise has no separate join tasks, test/fib/fib.c:119-131)."""
+
+    def seed(args):
+        n = args[0]
+        return (n,), jnp.where(n >= 2, 2, 0)
+
+    def child(frame, k, jnp):
+        c = frame[0] - 1 - k
+        return (c,), jnp.where(c >= 2, 2, 0)
+
+    def contrib(cframe, ccount, jnp):
+        # Expanded leaves are n in {0, 1}: contribution is n itself.
+        return {"value": jnp.where(ccount == 0, cframe[0], 0)}
+
+    def root_contrib(args):
+        return {"value": args[0]}
+
+    return VectorTaskSpec(
+        name="vfib",
+        frame_words=1,
+        seed=seed,
+        child=child,
+        contrib=contrib,
+        accumulators=("value",),
+        out_acc="value",
+        stack_depth=max_n + 2,
+        lanes=lanes,
+        min_idle_div=min_idle_div,
+        root_contrib=root_contrib,
+    )

@@ -18,7 +18,13 @@ from jax.experimental.pallas import tpu as pltpu
 from .descriptor import NO_TASK, TaskGraphBuilder
 from .megakernel import VBLOCK, KernelContext, Megakernel
 
-__all__ = ["device_fib", "device_arrayadd", "make_fib_megakernel"]
+__all__ = [
+    "device_fib",
+    "device_arrayadd",
+    "make_fib_megakernel",
+    "make_vfib_megakernel",
+    "device_vfib",
+]
 
 
 # ------------------------------------------------------------------- fib
@@ -92,6 +98,46 @@ def device_fib(
     mk = make_fib_megakernel(capacity, interpret, num_values=num_values)
     b = TaskGraphBuilder()
     b.add(FIB, args=[n], out=0)
+    ivalues, _, info = mk.run(b)
+    return int(ivalues[0]), info
+
+
+# ------------------------------------------------------- fib, vector tier
+
+VFIB = 0
+
+
+def make_vfib_megakernel(
+    max_n: int = 32,
+    lanes: Tuple[int, int] = (8, 128),
+    interpret: Optional[bool] = None,
+    capacity: int = 64,
+) -> Megakernel:
+    """fib on the megakernel's batch-dispatch tier: one seed descriptor in
+    the scalar table; the subtree runs wide over VPU lanes
+    (device/vector_engine.py). Far larger fibs fit than on the scalar tier
+    (the tree lives in per-lane VMEM stacks, not SMEM descriptor rows)."""
+    from .vector_engine import fib_spec
+
+    return Megakernel(
+        kernels=[("vfib", fib_spec(max_n=max_n, lanes=lanes))],
+        capacity=capacity,
+        num_values=16,
+        succ_capacity=8,
+        interpret=interpret,
+    )
+
+
+def device_vfib(
+    n: int,
+    lanes: Tuple[int, int] = (8, 128),
+    interpret: Optional[bool] = None,
+) -> Tuple[int, dict]:
+    """Compute fib(n) via batched vector dispatch; info['executed'] counts
+    the full recursion tree (2*fib(n+1) - 1 tasks)."""
+    mk = make_vfib_megakernel(max_n=n + 2, lanes=lanes, interpret=interpret)
+    b = TaskGraphBuilder()
+    b.add(VFIB, args=[n], out=0)
     ivalues, _, info = mk.run(b)
     return int(ivalues[0]), info
 

@@ -1,0 +1,96 @@
+"""Batch-dispatch (vector) tier: exactness, stealing, megakernel bridge.
+
+The reference has no vector tier (its fib is one heap task per call,
+test/fib/fib.c); these tests pin the rebuild-specific contract instead:
+exact counts/results for the whole family, overflow reporting, and the
+scalar<->vector bridge (a vector task firing scalar successors)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hclib_tpu.device.descriptor import TaskGraphBuilder
+from hclib_tpu.device.megakernel import Megakernel
+from hclib_tpu.device.vector_engine import fib_spec, make_subtree_runner
+from hclib_tpu.device.workloads import VFIB, device_vfib, make_vfib_megakernel
+
+
+def fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def tree_tasks(n):
+    # Naive recursion-tree node count: N(n) = 1 + N(n-1) + N(n-2).
+    if n < 2:
+        return 1
+    return 1 + tree_tasks(n - 1) + tree_tasks(n - 2)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    spec = fib_spec(max_n=14, lanes=(1, 8))
+    run = make_subtree_runner(spec, max_steps=100000)
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield jax.jit(
+            lambda n: run((n,), jnp.where(n >= 2, 2, 0))
+        )
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 10, 14])
+def test_runner_exact(runner, n):
+    nodes, accs, over = runner(jnp.int32(n))
+    assert int(accs["value"]) == fib(n)
+    assert int(nodes) + 1 == tree_tasks(n)  # +1: the seed task itself
+    assert not bool(over)
+
+
+def test_runner_leaf_seed(runner):
+    # Seeds with count 0 do no vector work (the megakernel bridge adds
+    # root_contrib for them).
+    for n in (0, 1):
+        nodes, accs, over = runner(jnp.int32(n))
+        assert int(nodes) == 0 and int(accs["value"]) == 0
+
+
+def test_runner_stack_overflow_flag():
+    spec = fib_spec(max_n=3, lanes=(1, 8))  # depth 5: too shallow for 12
+    run = make_subtree_runner(spec, max_steps=100000)
+    with jax.default_device(jax.devices("cpu")[0]):
+        _, _, over = jax.jit(lambda: run((12,), jnp.int32(2)))()
+    assert bool(over)
+
+
+def test_device_vfib_interpret():
+    v, info = device_vfib(10, lanes=(1, 8), interpret=True)
+    assert v == fib(10)
+    assert info["executed"] == tree_tasks(10)
+
+
+def test_vector_task_fires_scalar_successors():
+    # A vfib task's completion must run downstream scalar-tier tasks with
+    # its reduced output visible in the out slot.
+    spec = fib_spec(max_n=12, lanes=(1, 8))
+
+    def double(ctx):
+        ctx.set_value(1, ctx.value(0) * 2)
+
+    mk = Megakernel(
+        kernels=[("vfib", spec), ("double", double)],
+        capacity=16,
+        num_values=8,
+        succ_capacity=8,
+        interpret=True,
+    )
+    b = TaskGraphBuilder()
+    t0 = b.add(0, args=[9], out=0)
+    b.add(1, deps=[t0], out=1)
+    b.reserve_values(2)
+    ivalues, _, info = mk.run(b)
+    assert ivalues[0] == fib(9)
+    assert ivalues[1] == 2 * fib(9)
+    assert info["executed"] == tree_tasks(9) + 1  # +1: the double task
+    assert info["pending"] == 0
