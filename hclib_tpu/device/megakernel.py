@@ -387,22 +387,30 @@ class Megakernel:
 
     # -- the kernel body --
 
-    def _kernel(
-        self, fuel: int, reps: int, stage_all_values: bool, *refs
-    ) -> None:
-        ndata = len(self.data_specs)
-        nscratch = len(self.scratch_specs)
-        n_in = 5 + ndata
-        in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata]
-        scratch_refs = refs[n_in + 4 + ndata : -2]
-        free = refs[-2]  # internal free-stack: [0]=count, [1..]=rows
-        vfree = refs[-1]  # value-block free-stack, same layout
-        succ = in_refs[1]
-        tasks, ready, counts, ivalues = out_refs[:4]
-        data = dict(zip(self.data_specs.keys(), out_refs[4:]))
-        scratch = dict(zip(self.scratch_specs.keys(), scratch_refs))
-
+    def _make_core(
+        self,
+        succ,
+        tasks,
+        ready,
+        counts,
+        ivalues,
+        data,
+        scratch,
+        free,
+        vfree,
+        tasks_in,
+        ready_in,
+        counts_in,
+        ivalues_in,
+        stage_all_values: bool,
+    ):
+        """Builds the scheduler core closures over a concrete set of refs:
+        ``stage()`` (copy host state into the mutable windows), and
+        ``sched(fuel)`` (pop/dispatch/complete until the ready ring drains
+        or ``fuel`` tasks have run since this call). Used by this class's
+        own kernel body and by kernels that embed the scheduler next to
+        other phases (the in-kernel ICI steal runner, device/ici_steal.py).
+        """
         capacity = self.capacity
 
         # On TPU, SMEM output windows do NOT start with the aliased input's
@@ -412,8 +420,6 @@ class Megakernel:
         # ring ([0, tail)), and host-preset value slots ([0, value_alloc)) -
         # scalar SMEM stores are expensive enough that staging the whole
         # capacity would dominate small dynamic graphs.
-        tasks_in, _, ready_in, counts_in, ivalues_in = in_refs[:5]
-
         def stage() -> None:
             free[0] = 0
             vfree[0] = 0
@@ -525,47 +531,84 @@ class Megakernel:
             jax.lax.switch(tasks[idx, F_FN], branches)
             complete(idx)
 
-        def cond(carry):
-            # `fuel` budgets *this call*: compare against tasks executed
-            # since entry, not the all-time counter (which persists across
-            # steal rounds when the sharded runner re-enters the kernel).
-            pending, executed, e0, stuck = carry
-            return (pending > 0) & (executed - e0 < fuel) & jnp.logical_not(stuck)
+        def sched(fuel) -> None:
+            """Pop/dispatch/complete until the ready ring drains, `fuel`
+            tasks have run since this call, or the ring empties with work
+            still pending (a dependency cycle, a lost wakeup, or - sharded -
+            tasks parked on another device's queue; the caller rebalances
+            or inspects)."""
 
-        def body(carry):
-            _, _, e0, _ = carry
-            head = counts[C_HEAD]
-            tail = counts[C_TAIL]
-            has_work = head < tail
+            def cond(carry):
+                # `fuel` budgets *this call*: compare against tasks executed
+                # since entry, not the all-time counter (which persists
+                # across steal rounds re-entering the scheduler).
+                pending, executed, e0, stuck = carry
+                return (
+                    (pending > 0)
+                    & (executed - e0 < fuel)
+                    & jnp.logical_not(stuck)
+                )
 
-            @pl.when(has_work)
-            def _():
-                # LIFO on the owner side (newest first, depth-first, small
-                # live sets); the head side is the steal/export side
-                # (device/sharded.py) - the Chase-Lev split of the reference
-                # deque (src/hclib-deque.c).
-                idx = ready[(tail - 1) % capacity]
-                counts[C_TAIL] = tail - 1
-                step(idx)
+            def body(carry):
+                _, _, e0, _ = carry
+                head = counts[C_HEAD]
+                tail = counts[C_TAIL]
+                has_work = head < tail
 
-            # pending > 0 with an empty ring means a dependency cycle, a
-            # lost wakeup, or (sharded) tasks parked on another device's
-            # queue; bail out so the caller can rebalance or inspect.
-            return (
-                counts[C_PENDING],
-                counts[C_EXECUTED],
-                e0,
-                jnp.logical_not(has_work),
-            )
+                @pl.when(has_work)
+                def _():
+                    # LIFO on the owner side (newest first, depth-first,
+                    # small live sets); the head side is the steal/export
+                    # side (device/sharded.py, device/ici_steal.py) - the
+                    # Chase-Lev split of the reference deque
+                    # (src/hclib-deque.c).
+                    idx = ready[(tail - 1) % capacity]
+                    counts[C_TAIL] = tail - 1
+                    step(idx)
 
-        def one_rep(r, total_executed) -> jnp.int32:
-            stage()
+                return (
+                    counts[C_PENDING],
+                    counts[C_EXECUTED],
+                    e0,
+                    jnp.logical_not(has_work),
+                )
+
             e0 = counts[C_EXECUTED]
             jax.lax.while_loop(
                 cond,
                 body,
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
+
+        import types
+
+        return types.SimpleNamespace(
+            stage=stage, sched=sched, push_ready=push_ready,
+            complete=complete,
+        )
+
+    def _kernel(
+        self, fuel: int, reps: int, stage_all_values: bool, *refs
+    ) -> None:
+        ndata = len(self.data_specs)
+        n_in = 5 + ndata
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + 4 + ndata]
+        scratch_refs = refs[n_in + 4 + ndata : -2]
+        free = refs[-2]  # internal free-stack: [0]=count, [1..]=rows
+        vfree = refs[-1]  # value-block free-stack, same layout
+        tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
+        tasks, ready, counts, ivalues = out_refs[:4]
+        data = dict(zip(self.data_specs.keys(), out_refs[4:]))
+        scratch = dict(zip(self.scratch_specs.keys(), scratch_refs))
+        core = self._make_core(
+            succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
+            tasks_in, ready_in, counts_in, ivalues_in, stage_all_values,
+        )
+
+        def one_rep(r, total_executed) -> jnp.int32:
+            core.stage()
+            core.sched(fuel)
             return total_executed + counts[C_EXECUTED]
 
         # reps > 1 re-runs the staged graph as a steady-state throughput

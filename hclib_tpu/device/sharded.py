@@ -11,9 +11,10 @@ partition must be internally closed under dependencies, like the reference's
 per-locale task placement); optional **bulk-synchronous work stealing**
 rebalances load at runtime: each round, every device runs its resident
 scheduler for a bounded quantum, then surplus *migratable* ready tasks
-(successor-free descriptors whose kernel is whitelisted) hop to the next
-device over a ``ppermute`` ring, and a ``psum`` over the pending counters
-decides termination. This is the reference's work-stealing loop
+(successor-free descriptors whose kernel is whitelisted) exchange over the
+ICI ring at hop distances 1, 2, 4, ... (hypercube diffusion: a fully-skewed
+load reaches every device in one round), and a ``psum`` over the pending
+counters decides termination. This is the reference's work-stealing loop
 (src/hclib-deque.c steals, src/hclib-runtime.c:403-421 done-flag join)
 re-designed for XLA's SPMD model: instead of thieves CASing a victim's deque
 top, surplus diffuses over the ICI ring in bulk steps, and the pthread-join
@@ -50,7 +51,28 @@ from .megakernel import (
     Megakernel,
 )
 
-__all__ = ["ShardedMegakernel", "round_robin_partition"]
+__all__ = [
+    "ShardedMegakernel",
+    "round_robin_partition",
+    "partition_builders",
+]
+
+
+def partition_builders(
+    mk: Megakernel, ndev: int, builders: Sequence[TaskGraphBuilder]
+):
+    """Finalize one builder per device into stacked (tasks, succ, ring,
+    counts) arrays - shared by every multi-device runner."""
+    if len(builders) != ndev:
+        raise ValueError(f"need {ndev} partitions, got {len(builders)}")
+    cap, scap = mk.capacity, mk.succ_capacity
+    parts = [b.finalize(capacity=cap, succ_capacity=scap) for b in builders]
+    return (
+        np.stack([p[0] for p in parts]),
+        np.stack([p[1] for p in parts]),
+        np.stack([p[2] for p in parts]),
+        np.stack([p[3] for p in parts]),
+    )
 
 
 class ShardedMegakernel:
@@ -131,24 +153,20 @@ class ShardedMegakernel:
         wl_host = np.zeros(max(1, len(self.mk.kernel_fns)), bool)
         for f in self.migratable_fns:
             wl_host[f] = True
-        perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+        # Hypercube diffusion: each round exchanges at hop distances 1, 2,
+        # 4, ... so a fully-skewed load reaches every device in ONE round
+        # (log2(ndev) ppermutes) instead of diffusing one neighbor per
+        # round - the SPMD rendering of the reference thief scanning ALL
+        # victims along its steal path (src/hclib-locality-graph.c:843-888),
+        # rather than only the adjacent one.
+        hop_dists = [d for d in (1 << k for k in range(16)) if d < ndev]
 
         def step(tasks, succ, ring, counts, iv, *data):
             succ0 = succ[0]
             wl = jnp.asarray(wl_host)
             j = jnp.arange(K)
 
-            def cond(carry):
-                tasks, ring_, counts, iv, data, rounds = carry
-                return (jax.lax.psum(counts[C_PENDING], axis) > 0) & (
-                    rounds < max_rounds
-                )
-
-            def body(carry):
-                tasks, ring_, counts, iv, data, rounds = carry
-                outs = inner(tasks, succ0, ring_, counts, iv, *data)
-                tasks, ring_, counts, iv = outs[:4]
-                data = tuple(outs[4:])
+            def exchange(tasks, ring_, counts, perm):
                 # ---- export: eligible tasks from the head-side window,
                 # oldest first (the Chase-Lev thief steals from the top;
                 # here the "thief" is the ring neighbor). Eligible
@@ -196,8 +214,7 @@ class ShardedMegakernel:
                 # no duplicate-index write races.
                 tasks = tasks.at[jnp.where(send, cand, cap), F_DEP].set(-1)
                 counts = counts.at[C_HEAD].add(nsend).at[C_PENDING].add(-nsend)
-                # ---- exchange: one hop around the ICI ring per round
-                # (surplus diffuses across rounds).
+                # ---- exchange over the ICI ring at this hop distance.
                 recvbuf = jax.lax.ppermute(sendbuf, axis, perm)
                 nrecv = jax.lax.ppermute(
                     nsend.reshape(1), axis, perm
@@ -232,6 +249,22 @@ class ShardedMegakernel:
                         jnp.where(nrecv > can, 1, 0).astype(jnp.int32)
                     )
                 )
+                return tasks, ring_, counts
+
+            def cond(carry):
+                tasks, ring_, counts, iv, data, rounds = carry
+                return (jax.lax.psum(counts[C_PENDING], axis) > 0) & (
+                    rounds < max_rounds
+                )
+
+            def body(carry):
+                tasks, ring_, counts, iv, data, rounds = carry
+                outs = inner(tasks, succ0, ring_, counts, iv, *data)
+                tasks, ring_, counts, iv = outs[:4]
+                data = tuple(outs[4:])
+                for d in hop_dists:
+                    perm = [(i, (i + d) % ndev) for i in range(ndev)]
+                    tasks, ring_, counts = exchange(tasks, ring_, counts, perm)
                 return (tasks, ring_, counts, iv, data, rounds + 1)
 
             init = (
@@ -262,15 +295,7 @@ class ShardedMegakernel:
 
     def partition(self, builders: Sequence[TaskGraphBuilder]):
         """Finalize one builder per device into stacked arrays."""
-        if len(builders) != self.ndev:
-            raise ValueError(f"need {self.ndev} partitions, got {len(builders)}")
-        cap, scap = self.mk.capacity, self.mk.succ_capacity
-        parts = [b.finalize(capacity=cap, succ_capacity=scap) for b in builders]
-        tasks = np.stack([p[0] for p in parts])
-        succ = np.stack([p[1] for p in parts])
-        ring = np.stack([p[2] for p in parts])
-        counts = np.stack([p[3] for p in parts])
-        return tasks, succ, ring, counts
+        return partition_builders(self.mk, self.ndev, builders)
 
     def run(
         self,

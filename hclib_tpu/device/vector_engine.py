@@ -211,11 +211,12 @@ def make_subtree_runner(
         frame of the donor lane the current rotation pairs them with."""
         sp, over, nodes, accs, fr, ch, cn = carry
         # Rotation schedule: column shift walks 1..cols-1 while the row
-        # shift advances every full column cycle, so over rows*(cols-1)
-        # rounds every (donor, claimant) lane pair meets at least once -
-        # no pairing can starve forever. Any bijective family works for
-        # correctness (who meets whom only affects efficiency); covering
-        # all offsets is what guarantees balance progress.
+        # shift advances every full column cycle, covering every offset
+        # with dc != 0 (same-column pairs at dc=0 never meet directly -
+        # their work drains through other columns). Any bijective family
+        # works for correctness (who meets whom only affects efficiency),
+        # and liveness never rests on the schedule: the outer do-while
+        # guarantees an expansion step per round regardless of claims.
         dc = 1 + rnd % (cols - 1)
         dr = (rnd // (cols - 1)) % rows
 
