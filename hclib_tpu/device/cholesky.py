@@ -13,11 +13,19 @@ compute units rather than translated from LAPACK:
   matrices after ceil(log2 T) steps).
 - TRSM (MXU): with inv(L_kk) available, the triangular solve is one
   dot_general: A_ik <- A_ik inv(L_kk)^T.
-- SYRK/GEMM (MXU): A_ij -= L_ik L_jk^T as dot_general contractions on the
-  second axis of both operands (no explicit transpose).
+- UPDROW (MXU, row-fused trailing update): one task per (row i, step k)
+  performs A_ij -= L_ik L_jk^T for all j in (k, i] (the SYRK j = i case
+  included), loading L_ik once and double-buffering the (A_ij, L_jk) tile
+  streams so the next pair's DMA rides under the current GEMM - the
+  HBM-bandwidth half of the workload overlaps the MXU half instead of
+  serializing 4 transfers around every matmul. Tile-level tasks (the
+  reference's granularity, test/cholesky/cholesky.cpp) spend ~half their
+  wall on un-overlapped DMA; row fusion is the TPU-first regrouping: the
+  DAG keeps real parallelism across rows while each task gets a
+  long-enough tile stream to pipeline.
 
-All tiles are DMA'd HBM->VMEM per task; f32 with
-preferred_element_type=f32 on every MXU op.
+f32 data, MXU matmuls at ~f32 accuracy via the 3-pass bf16 hi/lo split
+(ops/tiles.mm_nt).
 """
 
 from __future__ import annotations
@@ -41,8 +49,7 @@ T = 128  # default tile edge (MXU-native); 256 amortizes scheduling
 
 POTRF = 0
 TRSM = 1
-SYRK = 2
-GEMM = 3
+UPDROW = 2
 
 
 def _load_all(pairs, sems) -> None:
@@ -80,50 +87,100 @@ def _trsm_kernel(ctx: KernelContext, ts: int = T) -> None:
     _dma(va, tiles.at[i, k], sem.at[0])
 
 
-def _syrk_kernel(ctx: KernelContext, ts: int = T) -> None:
+def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
+    """Row-fused trailing update: A_ij -= L_ik L_jk^T for j in (k, i].
+
+    L_ik stays resident in VMEM for the whole row; the (A_ij, L_jk) pairs
+    stream through two double-buffered slots - iteration t starts the DMAs
+    for t+1 before computing t, and store-backs ride their own semaphores
+    so a slot is only reused once its previous store completed. Every
+    started DMA is waited exactly once (the epilogue drains the last two
+    stores)."""
     i, k = ctx.arg(0), ctx.arg(1)
     tiles = ctx.data["tiles"]
-    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    vl = ctx.scratch["vl"]
+    ab, lb = ctx.scratch["ab"], ctx.scratch["lb"]
+    sl = ctx.scratch["sload"]  # (2, 2): [slot, {A, L}]
+    ss = ctx.scratch["sstore"]  # (2,): per-slot store sems
     sem = ctx.scratch["sems"]
-    _load_all([(tiles.at[i, i], va), (tiles.at[i, k], vb)], sem)
-    va[:] = va[:] - _mm_nt(vb[:], vb[:])
-    _dma(va, tiles.at[i, i], sem.at[0])
+    _dma(tiles.at[i, k], vl, sem.at[0])  # L_ik, resident for the row
+    nj = i - k  # j walks k+1 .. i
 
+    def start_loads(slot, j) -> None:
+        pltpu.make_async_copy(tiles.at[i, j], ab.at[slot], sl.at[slot, 0]).start()
+        # j == i loads tiles[i, k] = L_ik again: harmless, keeps the DMA
+        # count per iteration uniform (the compute selects vl for SYRK).
+        pltpu.make_async_copy(tiles.at[j, k], lb.at[slot], sl.at[slot, 1]).start()
 
-def _gemm_kernel(ctx: KernelContext, ts: int = T) -> None:
-    i, j, k = ctx.arg(0), ctx.arg(1), ctx.arg(2)
-    tiles = ctx.data["tiles"]
-    va, vb, vc = ctx.scratch["va"], ctx.scratch["vb"], ctx.scratch["vc"]
-    sem = ctx.scratch["sems"]
-    _load_all(
-        [(tiles.at[i, j], va), (tiles.at[i, k], vb), (tiles.at[j, k], vc)],
-        sem,
-    )
-    va[:] = va[:] - _mm_nt(vb[:], vc[:])
-    _dma(va, tiles.at[i, j], sem.at[0])
+    start_loads(0, k + 1)
+
+    def body(t, _):
+        j = k + 1 + t
+        cur = t % 2
+        nxt = 1 - cur
+
+        @pl.when(t + 1 < nj)
+        def _():
+            # Slot nxt last stored at t-1; its store must land before the
+            # prefetch overwrites the buffer.
+            @pl.when(t >= 1)
+            def _():
+                pltpu.make_async_copy(ab.at[nxt], tiles.at[i, j], ss.at[nxt]).wait()
+
+            start_loads(nxt, j + 1)
+
+        pltpu.make_async_copy(tiles.at[i, j], ab.at[cur], sl.at[cur, 0]).wait()
+        pltpu.make_async_copy(tiles.at[j, k], lb.at[cur], sl.at[cur, 1]).wait()
+        rhs = jnp.where(j == i, vl[:], lb[cur])
+        ab[cur] = ab[cur] - _mm_nt(vl[:], rhs)
+        pltpu.make_async_copy(ab.at[cur], tiles.at[i, j], ss.at[cur]).start()
+        return 0
+
+    jax.lax.fori_loop(0, nj, body, 0)
+    # Drain the last two stores. The wait descriptors name the transfers
+    # these semaphores actually signal: slot `last` stored tiles[i, i]
+    # (j = i at t = nj-1), slot `1-last` stored tiles[i, i-1] (t = nj-2).
+    last = (nj - 1) % 2
+
+    @pl.when(nj >= 2)
+    def _():
+        pltpu.make_async_copy(
+            ab.at[1 - last], tiles.at[i, i - 1], ss.at[1 - last]
+        ).wait()
+
+    pltpu.make_async_copy(ab.at[last], tiles.at[i, i], ss.at[last]).wait()
 
 
 def build_cholesky_graph(nt: int) -> TaskGraphBuilder:
-    """Static DAG, same structure as models/cholesky.py."""
+    """Static DAG: POTRF / TRSM tile tasks + row-fused trailing updates.
+
+    Dependency shape (R = UPDROW row task):
+      POTRF(k)  <- R(k, k-1)             (its diagonal tile's last writer)
+      TRSM(i,k) <- POTRF(k), R(i, k-1)   (tile (i,k)'s last writer)
+      R(i, k)   <- TRSM(j,k) for k<j<=i  (the L_jk operands; TRSM(i,k)
+                                          transitively carries R(i,k-1),
+                                          the last writer of row i's tiles)
+    """
     b = TaskGraphBuilder()
-    U = {}  # (i, j) -> last task updating that tile
     P = {}
     S = {}
+    R = {}  # (i, k) -> row-update task for row i at step k
 
     def dep(*ids):
         return [t for t in ids if t is not None]
 
     for k in range(nt):
-        P[k] = b.add(POTRF, args=[k], deps=dep(U.get((k, k))))
+        P[k] = b.add(POTRF, args=[k], deps=dep(R.get((k, k - 1))))
         for i in range(k + 1, nt):
-            S[(i, k)] = b.add(TRSM, args=[i, k], deps=dep(U.get((i, k)), P[k]))
+            S[(i, k)] = b.add(
+                TRSM, args=[i, k], deps=dep(P[k], R.get((i, k - 1)))
+            )
         for i in range(k + 1, nt):
-            U[(i, i)] = b.add(SYRK, args=[i, k], deps=dep(U.get((i, i)), S[(i, k)]))
-            for j in range(k + 1, i):
-                U[(i, j)] = b.add(
-                    GEMM, args=[i, j, k],
-                    deps=dep(U.get((i, j)), S[(i, k)], S[(j, k)]),
-                )
+            R[(i, k)] = b.add(
+                UPDROW,
+                args=[i, k],
+                deps=[S[(j, k)] for j in range(k + 1, i + 1)],
+            )
     return b
 
 
@@ -134,26 +191,34 @@ def make_cholesky_megakernel(
 
     tile_spec = jax.ShapeDtypeStruct((nt, nt, tile, tile), jnp.float32)
     linv_spec = jax.ShapeDtypeStruct((nt, tile, tile), jnp.float32)
-    ntasks = nt + nt * (nt - 1) // 2 + nt * (nt - 1) * (nt + 1) // 6
+    # POTRF + TRSM tile tasks + one row-update task per (row, step).
+    ntasks = nt + 2 * (nt * (nt - 1) // 2)
     capacity = max(64, ntasks)
     return Megakernel(
         kernels=[
             ("potrf", _ft.partial(_potrf_kernel, ts=tile)),
             ("trsm", _ft.partial(_trsm_kernel, ts=tile)),
-            ("syrk", _ft.partial(_syrk_kernel, ts=tile)),
-            ("gemm", _ft.partial(_gemm_kernel, ts=tile)),
+            ("updrow", _ft.partial(_updrow_kernel, ts=tile)),
         ],
         data_specs={"tiles": tile_spec, "linv": linv_spec},
         scratch_specs={
             "va": pltpu.VMEM((tile, tile), jnp.float32),
             "vb": pltpu.VMEM((tile, tile), jnp.float32),
-            "vc": pltpu.VMEM((tile, tile), jnp.float32),
+            "vl": pltpu.VMEM((tile, tile), jnp.float32),
+            "ab": pltpu.VMEM((2, tile, tile), jnp.float32),
+            "lb": pltpu.VMEM((2, tile, tile), jnp.float32),
             "sems": pltpu.SemaphoreType.DMA((3,)),
+            "sload": pltpu.SemaphoreType.DMA((2, 2)),
+            "sstore": pltpu.SemaphoreType.DMA((2,)),
         },
         capacity=capacity,
         num_values=8,
-        succ_capacity=max(64, 4 * ntasks),
+        succ_capacity=max(64, 4 * ntasks + nt * nt * nt // 2),
         interpret=interpret,
+        # 7 tile buffers + compiler stack temporaries (factor_and_inv block
+        # values, bf16 split operands): past the 16 MiB scoped default once
+        # tile >= 768.
+        vmem_limit_bytes=max(16 * tile * tile * 4, 16 * 1024 * 1024),
     )
 
 

@@ -59,6 +59,7 @@ from .megakernel import (
     C_HEAD,
     C_OVERFLOW,
     C_PENDING,
+    C_ROUNDS,
     C_TAIL,
     C_VALLOC,
     Megakernel,
@@ -288,7 +289,6 @@ class ICIStealMegakernel:
             d = 2^(r mod nh), receive from the mirror device."""
             d = (jnp.int32(1) << (r % nh)) % ndev
             target = (me + d) % ndev
-            source = (me + ndev - d) % ndev
             gavg = tot_b // ndev
             backlog = counts[C_TAIL] - counts[C_HEAD]
             quota = jnp.clip(backlog - gavg, 0, W)
@@ -334,7 +334,7 @@ class ICIStealMegakernel:
         r, done = jax.lax.while_loop(
             cond, body, (jnp.int32(0), jnp.bool_(False))
         )
-        counts[7] = r  # rounds, for info
+        counts[C_ROUNDS] = r
         # Drain outstanding flow-control credits so semaphores are zero at
         # kernel exit: the first send of each channel never waited (round-0
         # priming), so each channel holds exactly one unconsumed credit
@@ -435,42 +435,15 @@ class ICIStealMegakernel:
     ):
         """Execute all partitions fully on-device; returns
         (ivalues[ndev, V], data, info)."""
-        from .sharded import partition_builders
+        from .sharded import execute_partitions
 
-        mk = self.mk
-        tasks, succ, ring, counts = partition_builders(
-            mk, self.ndev, builders
-        )
-        if ivalues is None:
-            ivalues = np.zeros((self.ndev, mk.num_values), np.int32)
-        else:
-            ivalues = np.asarray(ivalues)
-            for d in range(self.ndev):
-                mk.widen_value_alloc(counts[d], ivalues[d])
-        for c in counts:
-            mk.check_row_values(int(c[C_VALLOC]))
-        data = dict(data or {})
-        if set(data.keys()) != set(mk.data_specs.keys()):
-            raise ValueError("data buffers != declared data_specs")
         key = (quantum, max_rounds)
         if key not in self._jitted:
             self._jitted[key] = self._build(quantum, max_rounds)
-        sh = NamedSharding(self.mesh, P(self.axis))
-        put = lambda x: jax.device_put(np.ascontiguousarray(x), sh)  # noqa: E731
-        outs = self._jitted[key](
-            put(tasks), put(succ), put(ring), put(counts), put(ivalues),
-            *[put(data[k]) for k in mk.data_specs.keys()],
+        iv_o, data_o, info = execute_partitions(
+            self.mk, self.mesh, self.ndev, self._jitted[key], builders,
+            data, ivalues, with_rounds=True,
         )
-        counts_o, iv_o, gcounts = outs[0], outs[1], outs[2]
-        data_o = dict(zip(mk.data_specs.keys(), outs[3:]))
-        g = np.asarray(gcounts)[0]
-        info = {
-            "executed": int(g[C_EXECUTED]),
-            "pending": int(g[C_PENDING]),
-            "overflow": bool(g[C_OVERFLOW]),
-            "per_device_counts": np.asarray(counts_o),
-            "steal_rounds": int(np.asarray(counts_o)[0][7]),
-        }
         if info["overflow"]:
             raise RuntimeError("ici steal: task-table overflow")
         if info["pending"] != 0:
@@ -478,4 +451,4 @@ class ICIStealMegakernel:
                 f"ici steal stalled: {info['pending']} pending after "
                 f"{info['executed']} executed ({info['steal_rounds']} rounds)"
             )
-        return np.asarray(iv_o), data_o, info
+        return iv_o, data_o, info

@@ -22,6 +22,7 @@ spawn new tasks dynamically (fib/UTS-style recursion) through
 from __future__ import annotations
 
 import functools
+import types
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -60,9 +61,11 @@ C_PENDING = 3
 C_VALLOC = 4
 C_EXECUTED = 5
 C_OVERFLOW = 6
-# First value slot above the host-preset range (set by stage() on every
-# kernel entry; meaningful in-kernel only - the sharded steal runner reuses
-# slot 7 to report its round count AFTER its loop finishes).
+# Slot 7 is time-shared: during a kernel entry it is C_VBASE (first value
+# slot above the host-preset range, set by stage()); AFTER a multi-device
+# steal loop finishes, the runners (device/sharded.py, device/ici_steal.py)
+# overwrite it with their round count for the host to read.
+C_ROUNDS = 7
 C_VBASE = 7
 
 
@@ -360,6 +363,7 @@ class Megakernel:
         succ_capacity: int = 4096,
         interpret: Optional[bool] = None,
         uses_row_values: bool = False,
+        vmem_limit_bytes: Optional[int] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
@@ -379,6 +383,10 @@ class Megakernel:
         # runtime value_alloc, which out-slots and presets can push up).
         self.uses_row_values = uses_row_values
         self.interpret = interpret
+        # Kernels whose scratch exceeds the compiler's default 16 MiB
+        # scoped-vmem budget (e.g. 1024x1024 f32 tile pipelines) raise it
+        # here; real VMEM is 128 MiB on v5e.
+        self.vmem_limit_bytes = vmem_limit_bytes
         self._jitted: Dict[int, Any] = {}  # fuel -> compiled call
         # Packs counts + ivalues into one array so the host needs a single
         # device->host fetch (transfers are ~67ms each through the axon
@@ -580,8 +588,6 @@ class Megakernel:
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
 
-        import types
-
         return types.SimpleNamespace(
             stage=stage, sched=sched, push_ready=push_ready,
             complete=complete,
@@ -690,6 +696,13 @@ class Megakernel:
             ],
             input_output_aliases=aliases,
             interpret=self.interpret,
+            compiler_params=(
+                pltpu.CompilerParams(
+                    vmem_limit_bytes=self.vmem_limit_bytes
+                )
+                if self.vmem_limit_bytes and not self.interpret
+                else None
+            ),
         )
 
     def _build(self, fuel: int, reps: int = 1):

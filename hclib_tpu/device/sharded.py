@@ -46,6 +46,7 @@ from .megakernel import (
     C_HEAD,
     C_OVERFLOW,
     C_PENDING,
+    C_ROUNDS,
     C_TAIL,
     C_VALLOC,
     Megakernel,
@@ -73,6 +74,56 @@ def partition_builders(
         np.stack([p[2] for p in parts]),
         np.stack([p[3] for p in parts]),
     )
+
+
+def execute_partitions(
+    mk: Megakernel,
+    mesh: Mesh,
+    ndev: int,
+    jitted,
+    builders: Sequence[TaskGraphBuilder],
+    data: Optional[Dict[str, np.ndarray]],
+    ivalues: Optional[np.ndarray],
+    with_rounds: bool,
+):
+    """Shared host-side driver for the multi-device runners: partition the
+    builders, widen per-device value allocs over presets, validate data
+    keys, device_put everything sharded on the mesh axis, invoke, and
+    unpack (ivalues, data, info). Raising on overflow/stall is left to the
+    caller (the runners word their diagnostics differently)."""
+    axis = mesh.axis_names[0]
+    tasks, succ, ring, counts = partition_builders(mk, ndev, builders)
+    if ivalues is None:
+        ivalues = np.zeros((ndev, mk.num_values), np.int32)
+    else:
+        ivalues = np.asarray(ivalues)
+        for d in range(ndev):
+            mk.widen_value_alloc(counts[d], ivalues[d])
+    for c in counts:
+        mk.check_row_values(int(c[C_VALLOC]))
+    data = dict(data or {})
+    if set(data.keys()) != set(mk.data_specs.keys()):
+        raise ValueError(
+            f"data buffers {sorted(data)} != declared {sorted(mk.data_specs)}"
+        )
+    sh = NamedSharding(mesh, P(axis))
+    put = lambda x: jax.device_put(np.ascontiguousarray(x), sh)  # noqa: E731
+    outs = jitted(
+        put(tasks), put(succ), put(ring), put(counts), put(ivalues),
+        *[put(data[k]) for k in mk.data_specs.keys()],
+    )
+    counts_o, iv_o, gcounts = outs[0], outs[1], outs[2]
+    data_o = dict(zip(mk.data_specs.keys(), outs[3:]))
+    g = np.asarray(gcounts)[0]  # identical on every row
+    info = {
+        "executed": int(g[C_EXECUTED]),
+        "pending": int(g[C_PENDING]),
+        "overflow": bool(g[C_OVERFLOW]),
+        "per_device_counts": np.asarray(counts_o),
+    }
+    if with_rounds:
+        info["steal_rounds"] = int(np.asarray(counts_o)[0][C_ROUNDS])
+    return np.asarray(iv_o), data_o, info
 
 
 class ShardedMegakernel:
@@ -274,7 +325,7 @@ class ShardedMegakernel:
             tasks_o, ring_o, counts_o, iv_o, data_o, rounds = (
                 jax.lax.while_loop(cond, body, init)
             )
-            counts_o = counts_o.at[7].set(rounds)  # steal rounds, for info
+            counts_o = counts_o.at[C_ROUNDS].set(rounds)
             gcounts = jax.lax.psum(counts_o, axis)
             return (
                 counts_o[None],
@@ -313,20 +364,6 @@ class ShardedMegakernel:
         ``steal=True`` enables bulk-synchronous work stealing: devices run
         ``quantum`` tasks per round, then up to ``window`` surplus migratable
         ready tasks hop one device along the ring between rounds."""
-        tasks, succ, ring, counts = self.partition(builders)
-        if ivalues is None:
-            ivalues = np.zeros((self.ndev, self.mk.num_values), np.int32)
-        else:
-            ivalues = np.asarray(ivalues)
-            for d in range(self.ndev):
-                self.mk.widen_value_alloc(counts[d], ivalues[d])
-        for c in counts:
-            self.mk.check_row_values(int(c[C_VALLOC]))
-        data = dict(data or {})
-        if set(data.keys()) != set(self.mk.data_specs.keys()):
-            raise ValueError(
-                f"data buffers {sorted(data)} != declared {sorted(self.mk.data_specs)}"
-            )
         # fuel is unused on the steal path (each round runs `quantum`), so
         # keep it out of that cache key - varying fuel must not recompile.
         key = (
@@ -338,27 +375,10 @@ class ShardedMegakernel:
                 if steal
                 else self._build(fuel)
             )
-        sh = NamedSharding(self.mesh, P(self.axis))
-        put = lambda x: jax.device_put(np.ascontiguousarray(x), sh)  # noqa: E731
-        outs = self._jitted[key](
-            put(tasks),
-            put(succ),
-            put(ring),
-            put(counts),
-            put(ivalues),
-            *[put(data[k]) for k in self.mk.data_specs.keys()],
+        iv_o, data_o, info = execute_partitions(
+            self.mk, self.mesh, self.ndev, self._jitted[key], builders,
+            data, ivalues, with_rounds=steal,
         )
-        counts_o, iv_o, gcounts = outs[0], outs[1], outs[2]
-        data_o = dict(zip(self.mk.data_specs.keys(), outs[3:]))
-        g = np.asarray(gcounts)[0]  # identical on every row
-        info = {
-            "executed": int(g[C_EXECUTED]),
-            "pending": int(g[C_PENDING]),
-            "overflow": bool(g[C_OVERFLOW]),
-            "per_device_counts": np.asarray(counts_o),
-        }
-        if steal:
-            info["steal_rounds"] = int(np.asarray(counts_o)[0][7])
         if info["overflow"]:
             raise RuntimeError("sharded megakernel task-table overflow")
         if info["pending"] != 0:
@@ -367,7 +387,7 @@ class ShardedMegakernel:
                 f"tasks after {info['executed']} executed (dependency cycle "
                 f"or fuel {fuel} exhausted)"
             )
-        return np.asarray(iv_o), data_o, info
+        return iv_o, data_o, info
 
 
 def round_robin_partition(

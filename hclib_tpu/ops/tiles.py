@@ -33,25 +33,65 @@ __all__ = [
 ]
 
 
+PANEL = 8  # factor-panel width: one sublane group
+
+
 def factor_tile(t, ts: int):
-    """Lower-Cholesky a symmetric (ts, ts) tile with masked rank-1 updates."""
+    """Panel-blocked lower-Cholesky of a symmetric (ts, ts) tile.
+
+    Exploits symmetry: for the 8-column panel J, the rows s[J, :] ARE the
+    columns s[:, J] transposed, so the whole panel factorization runs on
+    one (8, ts) sublane block with VPU broadcast rank-1 updates (no
+    reductions over the full plane, no dynamic indexing - the panel loop
+    is fully unrolled, all slices static). The trailing matrix then takes
+    ONE rank-8 MXU update per panel (3-pass bf16 split, ~f32 exact),
+    replacing 8 full-plane rank-1 sweep iterations - about an order of
+    magnitude fewer vector ops than the naive masked rank-1 sweep, which
+    dominated the whole Cholesky wall clock at 32 sweeps per n=4096.
+
+    Builds U = L^T row-by-row (static sublane writes) and transposes once.
+    """
+    assert ts % PANEL == 0, ts
     rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
+    lanep = jax.lax.broadcasted_iota(jnp.int32, (PANEL, ts), 1)
+    prow = jax.lax.broadcasted_iota(jnp.int32, (PANEL, ts), 0)
+    s = t
+    pans = []
+    npanels = ts // PANEL
+    for p in range(npanels):
+        j0 = p * PANEL
+        pan = jax.lax.slice(s, (j0, 0), (j0 + PANEL, ts))
 
-    def body(j, carry):
-        s, l = carry
-        diag = jnp.sum(jnp.where((rows == j) & (cols == j), s, 0.0))
-        inv_sqrt = jax.lax.rsqrt(diag)
-        col = jnp.sum(jnp.where(cols == j, s, 0.0), axis=1, keepdims=True)
-        row = jnp.sum(jnp.where(rows == j, s, 0.0), axis=0, keepdims=True)
-        lcol = jnp.where(rows >= j, col * inv_sqrt, 0.0)
-        l = jnp.where(cols == j, lcol, l)
-        upd = (col * row) / diag
-        s = jnp.where((rows > j) & (cols > j), s - upd, s)
-        return s, l
+        # All extraction is mask+reduce on the single (PANEL, ts) block,
+        # so the 8 micro-iterations share one rolled fori_loop body
+        # (unrolling them bloated the kernel ~8x and the register/spill
+        # pressure cost far more than the loop saves).
+        def micro(q, pan):
+            j = j0 + q
+            rowq = jnp.sum(
+                jnp.where(prow == q, pan, 0.0), axis=0, keepdims=True
+            )
+            diag = jnp.sum(jnp.where(lanep[:1] == j, rowq, 0.0))
+            lrow = jnp.where(lanep[:1] >= j, rowq * jax.lax.rsqrt(diag), 0.0)
+            # In-panel rank-1 coefficients = pan's own column j (symmetry),
+            # scaled like lrow.
+            coeff = jnp.sum(
+                jnp.where(lanep == j, pan, 0.0), axis=1, keepdims=True
+            ) * jax.lax.rsqrt(diag)
+            return jnp.where(
+                prow == q, lrow, jnp.where(prow > q, pan - coeff * lrow, pan)
+            )
 
-    _, l = jax.lax.fori_loop(0, ts, body, (t, jnp.zeros_like(t)))
-    return l
+        pan = jax.lax.fori_loop(0, PANEL, micro, pan)
+        pans.append(pan)
+        if p + 1 < npanels:
+            # Rank-8 trailing update in one contraction over the panel:
+            # s[m, n] -= sum_q L[m, j0+q] L[n, j0+q] = (pan^T pan)[m, n].
+            upd8 = _mm_tn(pan, pan)
+            edge = j0 + PANEL - 1
+            s = jnp.where((rows > edge) & (cols > edge), s - upd8, s)
+    return jnp.transpose(jnp.concatenate(pans, axis=0))
 
 
 def tri_inverse(l, ts: int):
@@ -61,12 +101,9 @@ def tri_inverse(l, ts: int):
     dg = jnp.sum(jnp.where(rows == cols, l, 0.0), axis=1, keepdims=True)
     x = jnp.where(rows == cols, 1.0 / dg, 0.0)
     steps = max(1, int(np.ceil(np.log2(ts))))
-    hi = jax.lax.Precision.HIGHEST
     for _ in range(steps):
-        lx = jnp.dot(l, x, preferred_element_type=jnp.float32, precision=hi)
-        x = 2.0 * x - jnp.dot(
-            x, lx, preferred_element_type=jnp.float32, precision=hi
-        )
+        lx = mm_nn(l, x)
+        x = 2.0 * x - mm_nn(x, lx)
     return x
 
 
@@ -93,11 +130,7 @@ def factor_and_inv(t, ts: int, base: int = 128):
     l00, i00 = factor_and_inv(a00, h, base)
     l10 = mm_nt(a10, i00)
     l11, i11 = factor_and_inv(a11 - mm_nt(l10, l10), h, base)
-    hi = jax.lax.Precision.HIGHEST
-    off = -jnp.dot(
-        jnp.dot(i11, l10, preferred_element_type=jnp.float32, precision=hi),
-        i00, preferred_element_type=jnp.float32, precision=hi,
-    )
+    off = -mm_nn(mm_nn(i11, l10), i00)
     z = jnp.zeros((h, h), t.dtype)
     l = jnp.concatenate(
         [jnp.concatenate([l00, z], 1), jnp.concatenate([l10, l11], 1)], 0
@@ -116,18 +149,46 @@ def mm_nt(a, b):
     2x slower than this with no measurable residual gain on Cholesky:
     7.7e-7 vs 8.8e-7 at n=1024)."""
     dims = (((1,), (1,)), ((), ()))
-
-    def d(x, y):
-        return jax.lax.dot_general(
+    return _split3(
+        lambda x, y: jax.lax.dot_general(
             x, y, dimension_numbers=dims,
             preferred_element_type=jnp.float32,
-        )
+        ),
+        a, b,
+    )
 
+
+def _split3(d, a, b):
+    """The shared 3-pass bf16 hi/lo split: decompose both operands, sum the
+    three passes whose products are above f32 noise (lo x lo is not).
+    ``d`` supplies the contraction (NT / TN / NN variants below)."""
     ah = a.astype(jnp.bfloat16)
     al = (a - ah.astype(jnp.float32)).astype(jnp.bfloat16)
     bh = b.astype(jnp.bfloat16)
     bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
     return d(ah, bh) + d(ah, bl) + d(al, bh)
+
+
+def _mm_tn(a, b):
+    """a^T @ b (contraction over axis 0 of both) via the 3-pass bf16
+    hi/lo split - the rank-8 panel contraction of factor_tile."""
+    dims = (((0,), (0,)), ((), ()))
+    return _split3(
+        lambda x, y: jax.lax.dot_general(
+            x, y, dimension_numbers=dims,
+            preferred_element_type=jnp.float32,
+        ),
+        a, b,
+    )
+
+
+def mm_nn(a, b):
+    """a @ b at ~f32 accuracy via the same 3-pass bf16 hi/lo split as
+    mm_nt (2x the throughput of Precision.HIGHEST's 6 passes)."""
+    return _split3(
+        lambda x, y: jnp.dot(x, y, preferred_element_type=jnp.float32),
+        a, b,
+    )
 
 
 def dma_copy(src, dst, sem):

@@ -14,8 +14,8 @@ on_tpu = jax.default_backend() == "tpu"
 
 def test_cholesky_graph_structure():
     b = build_cholesky_graph(4)
-    # 4 potrf + 6 trsm + 10 syrk/gemm
-    assert b.num_tasks == 4 + 6 + 10
+    # 4 potrf + 6 trsm + 6 row-fused trailing updates
+    assert b.num_tasks == 4 + 6 + 6
     _, _, ring, counts = b.finalize(capacity=32, succ_capacity=128)
     assert counts[1] == 1  # only potrf(0) initially ready
 

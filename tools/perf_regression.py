@@ -15,7 +15,14 @@ Usage:
   python tools/perf_regression.py               # full sizes, 3 trials
   python tools/perf_regression.py --quick       # tiny sizes (CI/smoke)
   python tools/perf_regression.py --trials 5 --tolerance 0.2
+  python tools/perf_regression.py --device      # + TPU device suite
 Exit code 1 if any app regressed beyond tolerance vs the previous log.
+
+``--device`` adds the TPU engines (megakernel fib scalar + batch tiers,
+Cholesky GFLOP/s, Smith-Waterman GCUPS, UTS nodes/s) - the numbers of
+record bench.py reports, guarded here so no TPU claim floats free of a
+harness. Device entries record a RATE (higher is better); host entries
+record wall time.
 """
 
 from __future__ import annotations
@@ -58,6 +65,20 @@ def _suite(quick: bool) -> List[Tuple[str, Callable[[], dict]]]:
     ]
 
 
+def _device_suite() -> List[Tuple[str, Callable[[], float], str]]:
+    """TPU device engines: (name, fn -> rate, unit). Each fn measures its
+    own steady-state rate (slope harness, bench.py)."""
+    import bench as b
+
+    return [
+        ("device-fib-scalar", b.bench_device_fib, "tasks/s"),
+        ("device-fib-batch", b.bench_device_vfib, "tasks/s"),
+        ("device-cholesky", lambda: b.bench_device_cholesky() * 1e9, "FLOP/s"),
+        ("device-sw", lambda: b.bench_device_sw() * 1e9, "CUPS"),
+        ("device-uts", lambda: b.bench_device_uts()[0], "nodes/s"),
+    ]
+
+
 def _latest_log(log_dir: str) -> Dict[str, dict]:
     if not os.path.isdir(log_dir):
         return {}
@@ -71,6 +92,8 @@ def _latest_log(log_dir: str) -> Dict[str, dict]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="tiny inputs (smoke)")
+    ap.add_argument("--device", action="store_true",
+                    help="also run the TPU device suite (rates)")
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--tolerance", type=float, default=0.25,
                     help="allowed fractional slowdown vs previous log")
@@ -106,6 +129,34 @@ def main(argv=None) -> int:
                 failures.append(f"{name}: {ratio:.2f}x slower than previous log")
                 line += "  REGRESSED"
         print(line, flush=True)
+
+    if args.device:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            print("--device: no TPU attached, skipping device suite",
+                  file=sys.stderr)
+        else:
+            for name, fn, unit in _device_suite():
+                if wanted and name not in wanted:
+                    continue
+                try:
+                    rate = float(fn())
+                except Exception as e:  # one engine must not sink the log
+                    print(f"{name:20s} FAILED: {e}", file=sys.stderr)
+                    failures.append(f"{name}: failed ({e})")
+                    continue
+                results[name] = {"rate": rate, "unit": unit}
+                line = f"{name:20s} rate {rate:14.3e} {unit}"
+                if name in prev and "rate" in prev[name]:
+                    ratio = rate / prev[name]["rate"]
+                    line += f"  vs prev {ratio:5.2f}x"
+                    if ratio < 1 - args.tolerance:
+                        failures.append(
+                            f"{name}: {1/ratio:.2f}x slower than previous log"
+                        )
+                        line += "  REGRESSED"
+                print(line, flush=True)
 
     os.makedirs(args.log_dir, exist_ok=True)
     out_path = os.path.join(args.log_dir, f"{int(time.time())}.json")
