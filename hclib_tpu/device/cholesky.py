@@ -121,11 +121,13 @@ def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
 
         @pl.when(t + 1 < nj)
         def _():
-            # Slot nxt last stored at t-1; its store must land before the
-            # prefetch overwrites the buffer.
+            # Slot nxt last stored at t-1 (dst tiles[i, j-1]); that store
+            # must land before the prefetch overwrites the buffer.
             @pl.when(t >= 1)
             def _():
-                pltpu.make_async_copy(ab.at[nxt], tiles.at[i, j], ss.at[nxt]).wait()
+                pltpu.make_async_copy(
+                    ab.at[nxt], tiles.at[i, j - 1], ss.at[nxt]
+                ).wait()
 
             start_loads(nxt, j + 1)
 

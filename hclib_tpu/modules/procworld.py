@@ -1,0 +1,301 @@
+"""Cross-process ranks over the jax.distributed coordination service.
+
+The reference's comm modules span real OS processes launched by mpirun
+(modules/mpi/src/hclib_mpi.cpp:107-286 two-sided + collectives;
+modules/openshmem symmetric heap put/get; modules/openshmem-am active
+messages, hclib_openshmem-am.cpp:64-123). The in-process ``World``
+(modules/world.py) gives rank semantics inside one controller; this module
+is the *multi-controller* counterpart: every rank is a separate process
+wired by ``jax.distributed.initialize``, and the transport is the JAX
+coordination service (key-value store + named barriers) that the
+multi-controller runtime already establishes over DCN.
+
+Design mapping (reference -> here):
+
+- MPI_Send/Recv            -> ordered KV messages (per (src, dst, tag)
+                              sequence numbers; receiver deletes after take)
+- MPI_Allreduce/Barrier    -> epoch-keyed contributions + local reduce;
+                              coordination-service named barriers
+- SHMEM symmetric heap     -> same-named numpy arrays allocated collectively
+                              in every process; put/get are *op records*
+                              addressed to the owner
+- SHMEM progress engine    -> a daemon progress thread per process polling
+                              its op directory and applying puts / serving
+                              gets / running AM handlers in arrival order -
+                              the reference's NIC-locale poller
+                              (modules/common/hclib-module-common.h:10-115)
+                              as a thread instead of a pinned worker
+- shmem_quiet / fence      -> a no-op op with a reply key: when the owner's
+                              progress thread reaches it, every earlier op
+                              from this rank has been applied (ops apply in
+                              global sequence order)
+- async_remote (AM)        -> op records naming a registered handler
+                              (handlers must be registered in every process,
+                              mirroring the reference's identical-binary
+                              assumption)
+
+The KV store is a control-plane transport: fine for task descriptors,
+small tensors, and coordination; bulk tensors should ride XLA collectives
+over a global mesh (parallel/multihost.py) - the same split the reference
+makes between AM packets and bulk MPI datatypes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ProcWorld"]
+
+
+def _pack(meta: dict, arr: Optional[np.ndarray]) -> bytes:
+    """4-byte length + JSON metadata + optional .npy payload."""
+    m = json.dumps(meta).encode()
+    buf = io.BytesIO()
+    if arr is not None:
+        np.save(buf, arr, allow_pickle=False)
+    return struct.pack("<I", len(m)) + m + buf.getvalue()
+
+
+def _unpack(b: bytes) -> Tuple[dict, Optional[np.ndarray]]:
+    (mlen,) = struct.unpack("<I", b[:4])
+    meta = json.loads(b[4 : 4 + mlen].decode())
+    rest = b[4 + mlen :]
+    arr = np.load(io.BytesIO(rest), allow_pickle=False) if rest else None
+    return meta, arr
+
+
+class ProcWorld:
+    """Rank-per-process communication world (requires an initialized
+    jax.distributed runtime; see parallel/multihost.init_multihost).
+
+    All collective entry points (``barrier``, ``allreduce``, ``alloc``)
+    follow SPMD discipline: every process calls them in the same order.
+    """
+
+    def __init__(
+        self,
+        namespace: str = "hcpw",
+        poll_interval_s: float = 0.002,
+        timeout_s: float = 60.0,
+    ) -> None:
+        import jax
+        from jax._src import distributed
+
+        if not jax.distributed.is_initialized():
+            raise RuntimeError(
+                "ProcWorld needs jax.distributed initialized "
+                "(parallel.multihost.init_multihost)"
+            )
+        self._c = distributed.global_state.client
+        self.rank = jax.process_index()
+        self.size = jax.process_count()
+        self._ns = namespace
+        self._timeout_ms = int(timeout_s * 1000)
+        self._poll_s = poll_interval_s
+        self._send_seq: Dict[Tuple[int, int], int] = {}
+        self._recv_seq: Dict[Tuple[int, int], int] = {}
+        self._barrier_n = 0
+        self._ar_epoch = 0
+        self._reply_n = 0
+        self._heap: Dict[str, np.ndarray] = {}
+        self._heap_lock = threading.Lock()
+        self._handlers: Dict[str, Callable] = {}
+        self._applied = 0  # ops applied by the progress thread, in order
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._progress_loop, daemon=True,
+            name=f"procworld-progress-{self.rank}",
+        )
+        self._thread.start()
+
+    # ---- two-sided messaging (hclib_mpi.cpp:107-128) ----
+
+    def send(self, dst: int, arr, tag: int = 0) -> None:
+        """Ordered per (src, dst, tag); non-blocking (KV deposit)."""
+        arr = np.asarray(arr)
+        seq = self._send_seq.get((dst, tag), 0)
+        self._send_seq[(dst, tag)] = seq + 1
+        key = f"{self._ns}/msg/{self.rank}/{dst}/{tag}/{seq}"
+        self._c.key_value_set_bytes(key, _pack({}, arr))
+
+    def recv(self, src: int, tag: int = 0) -> np.ndarray:
+        """Blocks for the next in-order message from (src, tag)."""
+        seq = self._recv_seq.get((src, tag), 0)
+        self._recv_seq[(src, tag)] = seq + 1
+        key = f"{self._ns}/msg/{src}/{self.rank}/{tag}/{seq}"
+        b = self._c.blocking_key_value_get_bytes(key, self._timeout_ms)
+        self._c.key_value_delete(key)
+        _, arr = _unpack(b)
+        return arr
+
+    # ---- collectives (hclib_mpi.cpp:220-286) ----
+
+    def barrier(self) -> None:
+        self._barrier_n += 1
+        self._c.wait_at_barrier(
+            f"{self._ns}/b/{self._barrier_n}", self._timeout_ms
+        )
+
+    def allreduce(self, arr, op: str = "sum") -> np.ndarray:
+        """Contribution exchange through the KV store + local reduce (the
+        data path for bulk arrays is XLA collectives over a global mesh;
+        this is the control-plane reduce for scalars/small tensors)."""
+        arr = np.asarray(arr)
+        self._ar_epoch += 1
+        e = self._ar_epoch
+        mine = f"{self._ns}/ar/{e}/{self.rank}"
+        self._c.key_value_set_bytes(mine, _pack({}, arr))
+        parts = []
+        for r in range(self.size):
+            b = self._c.blocking_key_value_get_bytes(
+                f"{self._ns}/ar/{e}/{r}", self._timeout_ms
+            )
+            parts.append(_unpack(b)[1])
+        self.barrier()  # everyone has read: contributions deletable
+        self._c.key_value_delete(mine)
+        fn = {
+            "sum": np.sum, "max": np.max, "min": np.min, "prod": np.prod,
+        }[op]
+        return fn(np.stack(parts), axis=0)
+
+    # ---- symmetric heap + one-sided ops (modules/openshmem) ----
+
+    def alloc(self, name: str, shape, dtype=np.int32) -> np.ndarray:
+        """Collective: allocate the same-named array in every process (the
+        symmetric-heap contract; SPMD call order required)."""
+        with self._heap_lock:
+            if name in self._heap:
+                raise ValueError(f"heap array {name!r} exists")
+            a = np.zeros(shape, dtype)
+            self._heap[name] = a
+        self.barrier()
+        return a
+
+    def heap(self, name: str) -> np.ndarray:
+        return self._heap[name]
+
+    def _post_op(self, dst: int, meta: dict, arr=None) -> None:
+        if dst == self.rank:
+            self._apply(meta, arr)  # loopback: apply inline
+            return
+        # Global per-target sequencing: increment-then-set; the target's
+        # progress thread applies strictly in sequence order, so a visible
+        # gap (incremented but not yet set) just parks the queue briefly.
+        seq = self._c.key_value_increment(f"{self._ns}/opseq/{dst}", 1) - 1
+        self._c.key_value_set_bytes(
+            f"{self._ns}/op/{dst}/{seq}", _pack(meta, arr)
+        )
+
+    def put(self, dst: int, name: str, arr, offset: int = 0) -> None:
+        """One-sided write into rank ``dst``'s heap array (applied by its
+        progress thread; order vs other ops from this rank preserved).
+        Completion at the target is observable via fence()/barrier()."""
+        self._post_op(
+            dst, {"op": "put", "name": name, "off": int(offset)},
+            np.asarray(arr),
+        )
+
+    def get(self, src: int, name: str, offset: int = 0,
+            size: Optional[int] = None) -> np.ndarray:
+        """One-sided read of rank ``src``'s heap array (served by its
+        progress thread; sequenced after this rank's earlier ops to src)."""
+        self._reply_n += 1
+        rk = f"{self._ns}/re/{self.rank}/{self._reply_n}"
+        self._post_op(
+            src,
+            {"op": "get", "name": name, "off": int(offset),
+             "size": -1 if size is None else int(size), "reply": rk},
+        )
+        b = self._c.blocking_key_value_get_bytes(rk, self._timeout_ms)
+        self._c.key_value_delete(rk)
+        return _unpack(b)[1]
+
+    def fence(self, dst: int) -> None:
+        """Returns once every op this rank posted to ``dst`` has been
+        applied (shmem_quiet for one target: a no-op op with a reply)."""
+        if dst == self.rank:
+            return
+        self._reply_n += 1
+        rk = f"{self._ns}/re/{self.rank}/{self._reply_n}"
+        self._post_op(dst, {"op": "fence", "reply": rk})
+        self._c.blocking_key_value_get_bytes(rk, self._timeout_ms)
+        self._c.key_value_delete(rk)
+
+    def quiet(self) -> None:
+        """shmem_quiet: fence every target this rank has posted ops to."""
+        for r in range(self.size):
+            self.fence(r)
+
+    # ---- active messages (hclib_openshmem-am.cpp:64-123) ----
+
+    def register_handler(self, name: str, fn: Callable) -> None:
+        """AM handlers are named (not function pointers): every process
+        registers the same names - the portable form of the reference's
+        identical-binary fn-pointer assumption."""
+        self._handlers[name] = fn
+
+    def am(self, dst: int, handler: str, arr=None, **kwargs) -> None:
+        """Run the named handler on rank ``dst``'s progress thread with
+        (world, payload_array, **kwargs)."""
+        self._post_op(
+            dst, {"op": "am", "h": handler, "kw": kwargs},
+            None if arr is None else np.asarray(arr),
+        )
+
+    # ---- progress engine ----
+
+    def _apply(self, meta: dict, arr) -> None:
+        op = meta["op"]
+        if op == "put":
+            with self._heap_lock:
+                a = self._heap[meta["name"]]
+                flat = a.reshape(-1)
+                v = arr.astype(a.dtype, copy=False).reshape(-1)
+                flat[meta["off"] : meta["off"] + v.size] = v
+        elif op == "get":
+            with self._heap_lock:
+                a = self._heap[meta["name"]].reshape(-1)
+                off = meta["off"]
+                end = a.size if meta["size"] < 0 else off + meta["size"]
+                out = a[off:end].copy()
+            self._c.key_value_set_bytes(meta["reply"], _pack({}, out))
+        elif op == "fence":
+            self._c.key_value_set_bytes(meta["reply"], _pack({}, None))
+        elif op == "am":
+            self._handlers[meta["h"]](self, arr, **meta.get("kw", {}))
+        else:  # pragma: no cover
+            raise ValueError(f"unknown op {op!r}")
+
+    def _progress_loop(self) -> None:
+        me = self.rank
+        while not self._stop.is_set():
+            key = f"{self._ns}/op/{me}/{self._applied}"
+            try:
+                b = self._c.key_value_try_get_bytes(key)
+            except Exception:  # NOT_FOUND surfaces as JaxRuntimeError
+                b = None
+            if b is None:
+                time.sleep(self._poll_s)
+                continue
+            meta, arr = _unpack(b)
+            self._c.key_value_delete(key)
+            self._applied += 1
+            try:
+                self._apply(meta, arr)
+            except Exception:  # pragma: no cover - keep the engine alive
+                import traceback
+
+                traceback.print_exc()
+
+    def close(self) -> None:
+        """Stop the progress engine (pending remote ops stay queued in the
+        coordination service; call quiet() first for a clean drain)."""
+        self._stop.set()
+        self._thread.join(timeout=5)

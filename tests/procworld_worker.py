@@ -1,0 +1,78 @@
+"""Subprocess body for the cross-process ProcWorld tests: every assertion
+here runs in BOTH ranks of a real 2-process jax.distributed world (the
+reference's comm-module tests need mpirun + a cluster; this needs two local
+processes - SURVEY section 4's 'do better without a cluster')."""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=n, process_id=pid
+    )
+    from hclib_tpu.modules.procworld import ProcWorld
+
+    w = ProcWorld(timeout_s=30.0)
+    assert w.rank == pid and w.size == n
+    peer = (pid + 1) % n
+
+    # two-sided: ordered ping-pong with tags
+    w.send(peer, np.arange(8, dtype=np.int32) + 10 * pid, tag=1)
+    w.send(peer, np.float64(3.5) * (pid + 1), tag=2)
+    got1 = w.recv((pid - 1) % n, tag=1)
+    got2 = w.recv((pid - 1) % n, tag=2)
+    src = (pid - 1) % n
+    assert (got1 == np.arange(8) + 10 * src).all(), got1
+    assert float(got2) == 3.5 * (src + 1), got2
+    # ordering within a tag
+    for i in range(4):
+        w.send(peer, np.int32(i), tag=7)
+    for i in range(4):
+        assert int(w.recv(src, tag=7)) == i
+
+    # collectives
+    w.barrier()
+    s = w.allreduce(np.arange(4, dtype=np.int64) + pid)
+    assert (s == np.arange(4) * n + sum(range(n))).all(), s
+    m = w.allreduce(np.float32(pid), op="max")
+    assert float(m) == n - 1
+    s2 = w.allreduce(np.int32(pid + 1))  # epochs keep repeats distinct
+    assert int(s2) == sum(range(1, n + 1))
+
+    # symmetric heap: put (one-sided write), fence, get (one-sided read)
+    w.alloc("buf", (16,), np.int32)
+    w.put(peer, "buf", np.full(4, 100 + pid, np.int32), offset=4 * pid)
+    w.fence(peer)
+    w.barrier()  # both fences done -> every put applied everywhere
+    mine = w.heap("buf")
+    assert (mine[4 * src : 4 * src + 4] == 100 + src).all(), mine
+    # Read back this rank's own put from the peer's heap (the only region
+    # of the peer's array anyone wrote is offset 4*pid).
+    remote = w.get(peer, "buf", offset=4 * pid, size=4)
+    assert (remote == 100 + pid).all(), remote
+
+    # active message: remote increments its own heap cell
+    def bump(world, arr, slot=0):
+        world.heap("buf")[slot] += int(arr[0])
+
+    w.register_handler("bump", bump)
+    w.am(peer, "bump", np.array([5 + pid]), slot=15)
+    w.fence(peer)
+    w.barrier()
+    assert int(w.heap("buf")[15]) == 5 + src, w.heap("buf")[15]
+
+    w.quiet()
+    w.barrier()
+    w.close()
+    jax.distributed.shutdown()
+    print(f"rank {pid}: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,15 +65,23 @@ def _suite(quick: bool) -> List[Tuple[str, Callable[[], dict]]]:
     ]
 
 
-def _device_suite() -> List[Tuple[str, Callable[[], float], str]]:
+def _device_suite(trials: int) -> List[Tuple[str, Callable[[], float], str]]:
     """TPU device engines: (name, fn -> rate, unit). Each fn measures its
-    own steady-state rate (slope harness, bench.py)."""
+    own steady-state rate (slope harness, bench.py); --trials scales the
+    throttle-window spreading (1 = quick smoke, no sleeps)."""
     import bench as b
 
+    spread = 8.0 if trials > 1 else 0.0
     return [
         ("device-fib-scalar", b.bench_device_fib, "tasks/s"),
         ("device-fib-batch", b.bench_device_vfib, "tasks/s"),
-        ("device-cholesky", lambda: b.bench_device_cholesky() * 1e9, "FLOP/s"),
+        (
+            "device-cholesky",
+            lambda: b.bench_device_cholesky(
+                trials=max(1, trials), spread_seconds=spread
+            ) * 1e9,
+            "FLOP/s",
+        ),
         ("device-sw", lambda: b.bench_device_sw() * 1e9, "CUPS"),
         ("device-uts", lambda: b.bench_device_uts()[0], "nodes/s"),
     ]
@@ -137,7 +145,7 @@ def main(argv=None) -> int:
             print("--device: no TPU attached, skipping device suite",
                   file=sys.stderr)
         else:
-            for name, fn, unit in _device_suite():
+            for name, fn, unit in _device_suite(args.trials):
                 if wanted and name not in wanted:
                     continue
                 try:
