@@ -247,39 +247,14 @@ class ICIStealMegakernel:
             return nsend
 
         def import_rows():
-            """Install received descriptors: freed rows first, then fresh
-            rows from the bump cursor; push each onto the ready ring."""
+            """Install received descriptors through the shared adoption
+            path (core.install_descriptor: freed rows first, then the bump
+            cursor; stolen rows came off a ready ring so their dep counter
+            is 0 and they go straight back to ready)."""
             n = inbox[W, 0]
 
             def one(i, _):
-                nf = free[0]
-                use_free = nf > 0
-                row_free = free[jnp.maximum(nf, 1)]
-                a = counts[C_ALLOC]
-                ok = use_free | (a < cap)
-                row = jnp.where(
-                    use_free, row_free, jnp.minimum(a, cap - 1)
-                )
-
-                @pl.when(use_free)
-                def _():
-                    free[0] = nf - 1
-
-                @pl.when(jnp.logical_not(use_free) & (a < cap))
-                def _():
-                    counts[C_ALLOC] = a + 1
-
-                @pl.when(ok)
-                def _():
-                    for w in range(DESC_WORDS):
-                        tasks[row, w] = inbox[i, w]
-                    counts[C_PENDING] = counts[C_PENDING] + 1
-                    core.push_ready(row)
-
-                @pl.when(jnp.logical_not(ok))
-                def _():
-                    counts[C_OVERFLOW] = 1
-
+                core.install_descriptor(lambda w: inbox[i, w])
                 return 0
 
             jax.lax.fori_loop(0, n, one, 0)

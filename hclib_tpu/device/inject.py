@@ -1,0 +1,346 @@
+"""Host -> resident-kernel task injection: streaming graphs over an HBM ring.
+
+The reference can hand new work to a running runtime from outside: an AM
+handler materializes a task on a remote PE mid-execution
+(modules/openshmem-am/src/hclib_openshmem-am.cpp:64-123), and hclib_async
+may be called while workers run. The megakernel's task table, by contrast,
+was sealed at launch. This module adds the missing channel: an **injection
+ring** in HBM that the scheduler polls *from inside the kernel*:
+
+- ring[R, 256] int32: descriptor rows padded to 1024 B so any row offset is
+  a legal dynamic DMA offset (Mosaic wants coarse alignment); row words
+  0..15 are the standard descriptor ABI (device/descriptor.py).
+- ctl[8] int32: [0]=tail (total rows ever appended), [1]=close flag.
+- Write ordering (the fence contract): the producer writes descriptor rows
+  FIRST, then bumps tail - release semantics. The kernel reads tail, then
+  DMAs only rows below it - acquire semantics; a row is never read before
+  the tail that published it.
+- The kernel interleaves scheduler quanta with ring polls, installing new
+  rows through the same row-allocation path spawns use, and reports its
+  consumed count back through the aliased ctl output.
+
+Execution model: ``StreamingMegakernel.run_stream`` re-enters the kernel in
+bounded quanta; each entry drains everything available (including rows that
+appear mid-entry: the poll runs between quanta INSIDE the kernel) and
+returns when there is nothing left and the stream is not yet closed. Host
+threads may call ``inject()`` at any time; ``close()`` lets the final entry
+drain and exit. On a directly-attached TPU VM the same ring layout admits
+zero-copy pinned-host production (host writes rows then tail over PCIe;
+the in-kernel poll is the consumer side already); through a tunnel-attached
+chip (this dev environment) physical concurrent writes are not reachable,
+so delivery lands at entry boundaries while the in-kernel poll/drain path
+is exercised by pre-published rows discovered mid-entry
+(tests/test_inject.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .descriptor import DESC_WORDS, NO_TASK, TaskGraphBuilder
+from .megakernel import (
+    C_ALLOC,
+    C_EXECUTED,
+    C_OVERFLOW,
+    C_PENDING,
+    C_VALLOC,
+    Megakernel,
+)
+
+__all__ = ["StreamingMegakernel", "RING_ROW"]
+
+RING_ROW = 256  # padded descriptor row (1024 B): any row offset DMA-aligns
+
+
+class StreamingMegakernel:
+    """Megakernel + injection ring: a resident scheduler whose task supply
+    is open-ended (the streaming/AM substrate).
+
+    ``mk`` supplies kernels/capacities; the injection ring holds
+    ``ring_capacity`` rows. The ring is a linear (non-wrapping) append log
+    per stream: capacity bounds TOTAL injected tasks per run_stream (keeps
+    the producer/consumer index algebra trivial; streams needing more roll
+    over to a fresh run_stream).
+    """
+
+    def __init__(self, mk: Megakernel, ring_capacity: int = 1024) -> None:
+        self.mk = mk
+        # Rounded up to a whole 8-row chunk: the kernel fetches the ring in
+        # 8-row DMAs, and the final chunk must not run off the array.
+        self.ring_capacity = -(-int(ring_capacity) // 8) * 8
+        self._jitted: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+        self._pending_rows: List[np.ndarray] = []
+        self._closed = False
+
+    # ---- producer side (host; any thread) ----
+
+    def inject(
+        self,
+        fn: int,
+        args: Sequence[int] = (),
+        out: int = 0,
+        dep_count: int = 0,
+        succ0: int = NO_TASK,
+        succ1: int = NO_TASK,
+    ) -> None:
+        """Queue one descriptor for the stream (thread-safe; rows reach the
+        device ring at the next entry boundary, or immediately on attached
+        hosts writing the pinned ring directly)."""
+        from .descriptor import F_A0, F_DEP, F_FN, F_OUT, F_SUCC0, F_SUCC1
+
+        if dep_count != 0:
+            # A dependent injected row would wait on predecessors, but the
+            # host has no way to wire successor edges INTO a row whose
+            # device id is unknown until installation - nothing could ever
+            # decrement it. (Successor edges OUT of injected rows, succ0/1
+            # naming static-graph rows, are fine.)
+            raise ValueError("injected tasks must have dep_count == 0")
+        row = np.zeros(RING_ROW, np.int32)
+        row[F_FN] = fn
+        row[F_DEP] = dep_count
+        row[F_SUCC0] = succ0
+        row[F_SUCC1] = succ1
+        for i, a in enumerate(args):
+            row[F_A0 + i] = int(a)
+        row[F_OUT] = out
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("stream closed")
+            self._pending_rows.append(row)
+
+    def close(self) -> None:
+        """No more injections: the stream drains and run_stream returns."""
+        with self._lock:
+            self._closed = True
+
+    # ---- kernel ----
+
+    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+        mk = self.mk
+        ndata = len(mk.data_specs)
+        n_in = 7 + ndata  # + ring, ctl
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + 5 + ndata]  # + ctl out
+        rest = refs[n_in + 5 + ndata :]
+        nscratch = len(mk.scratch_specs)
+        scratch_refs = rest[:nscratch]
+        free, vfree, ctlbuf, rowbuf, isem = rest[nscratch:]
+        tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
+        ring, ctl_in = in_refs[5], in_refs[6]
+        tasks, ready, counts, ivalues = out_refs[:4]
+        ctl_out = out_refs[4]
+        data = dict(zip(mk.data_specs.keys(), out_refs[5:]))
+        scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
+        core = mk._make_core(
+            succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
+            tasks_in, ready_in, counts_in, ivalues_in, True,
+        )
+        cap = mk.capacity
+
+        core.stage()
+
+        def install(row_slot) -> None:
+            core.install_descriptor(lambda w: rowbuf[row_slot, w])
+
+        def poll(consumed):
+            """Acquire-read the ring: ctl first (tail publishes rows), then
+            the rows below tail, fetched in 8-row chunks (Mosaic dynamic
+            slices along the sublane-tiled dim must be 8-aligned).
+            Returns (consumed', close_flag)."""
+            cp = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
+            cp.start()
+            cp.wait()
+            tail = ctlbuf[0]
+            close = ctlbuf[1]
+
+            def chunk(c):
+                base = (c // 8) * 8
+                rp = pltpu.make_async_copy(
+                    ring.at[pl.ds(base, 8)], rowbuf, isem.at[1]
+                )
+                rp.start()
+                rp.wait()
+                n = jnp.minimum(tail - c, 8 - (c - base))
+
+                def ins(i, _):
+                    install(c - base + i)
+                    return 0
+
+                jax.lax.fori_loop(0, n, ins, 0)
+                return c + n
+
+            consumed = jax.lax.while_loop(
+                lambda c: c < tail, chunk, consumed
+            )
+            return consumed, close
+
+        def cond(carry):
+            r, consumed, done = carry
+            return jnp.logical_not(done) & (r < max_rounds)
+
+        def body(carry):
+            r, consumed, _ = carry
+            core.sched(quantum)
+            consumed, close = poll(consumed)
+            # Nothing runnable and nothing new: exit. The host re-enters
+            # while the stream is open; a closed, drained stream is final.
+            idle = counts[C_PENDING] == 0
+            done = idle & (consumed == ctlbuf[0])
+            return r + 1, consumed, done
+
+        # Initial ctl fetch: the consumed cursor (slot 2) persists across
+        # entries through the host-echoed ctl.
+        cp0 = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
+        cp0.start()
+        cp0.wait()
+        _, consumed, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), ctlbuf[2], jnp.bool_(False))
+        )
+        # Report progress: consumed count rides the aliased ctl output
+        # (slot 2); tail/close echo through.
+        ctl_out[0] = ctlbuf[0]
+        ctl_out[1] = ctlbuf[1]
+        ctl_out[2] = consumed
+        for i in range(3, 8):
+            ctl_out[i] = 0
+
+    def _build(self, quantum: int, max_rounds: int):
+        mk = self.mk
+        ndata = len(mk.data_specs)
+        smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+        anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
+        # ring AND ctl live in ANY (HBM): the kernel re-reads them by DMA
+        # on every poll - the consumer side of the pinned-host production
+        # path - instead of snapshotting them into SMEM at entry.
+        in_specs = (
+            [smem()] * 5 + [anyspace(), anyspace()] + [anyspace()] * ndata
+        )
+        data_shapes = [
+            jax.ShapeDtypeStruct(s.shape, s.dtype)
+            for s in mk.data_specs.values()
+        ]
+        out_shape = tuple(
+            [
+                jax.ShapeDtypeStruct((mk.capacity, DESC_WORDS), jnp.int32),
+                jax.ShapeDtypeStruct((mk.capacity,), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),
+                jax.ShapeDtypeStruct((mk.num_values,), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),  # ctl out
+            ]
+            + data_shapes
+        )
+        out_specs = tuple(
+            [smem()] * 4 + [smem()] + [anyspace()] * ndata
+        )
+        aliases = {0: 0, 2: 1, 3: 2, 4: 3}
+        for i in range(ndata):
+            aliases[7 + i] = 5 + i
+        from .megakernel import VBLOCK
+
+        return jax.jit(pl.pallas_call(
+            functools.partial(self._kernel, quantum, max_rounds),
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=list(mk.scratch_specs.values())
+            + [
+                pltpu.SMEM((mk.capacity + 1,), jnp.int32),
+                pltpu.SMEM((mk.num_values // VBLOCK + 1,), jnp.int32),
+                pltpu.SMEM((8,), jnp.int32),  # ctl staging
+                pltpu.SMEM((8, RING_ROW), jnp.int32),  # row staging (8-row chunks)
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            input_output_aliases=aliases,
+            interpret=mk.interpret,
+        ))
+
+    # ---- the stream driver ----
+
+    def run_stream(
+        self,
+        builder: TaskGraphBuilder,
+        ivalues: Optional[np.ndarray] = None,
+        data: Optional[Dict[str, Any]] = None,
+        quantum: int = 1 << 10,
+        max_rounds: int = 64,
+        poll_interval_s: float = 0.001,
+    ) -> Tuple[np.ndarray, dict]:
+        """Run the stream to completion: entries re-enter the resident
+        scheduler while the host (any thread) injects; returns after
+        close() once everything drained. Returns (ivalues, info)."""
+        import time
+
+        mk = self.mk
+        tasks, succ, ring0, counts = builder.finalize(
+            capacity=mk.capacity, succ_capacity=mk.succ_capacity
+        )
+        if ivalues is None:
+            ivalues = np.zeros(mk.num_values, np.int32)
+        else:
+            counts = counts.copy()
+            mk.widen_value_alloc(counts, ivalues)
+        mk.check_row_values(int(counts[C_VALLOC]))
+        data = dict(data or {})
+        if set(data.keys()) != set(mk.data_specs.keys()):
+            raise ValueError("data buffers != declared data_specs")
+        key = (quantum, max_rounds)
+        if key not in self._jitted:
+            self._jitted[key] = self._build(quantum, max_rounds)
+        jitted = self._jitted[key]
+
+        ring = np.zeros((self.ring_capacity, RING_ROW), np.int32)
+        ctl = np.zeros(8, np.int32)  # [tail, close, consumed]
+        state = [tasks, ring0, counts, ivalues]
+        data_np = [np.asarray(data[k]) for k in mk.data_specs.keys()]
+        injected = 0
+        while True:
+            # Publish queued rows: rows first, then tail (release order;
+            # over the tunnel both land before the next entry launches).
+            with self._lock:
+                rows, self._pending_rows = self._pending_rows, []
+                closed = self._closed
+            for row in rows:
+                if injected >= self.ring_capacity:
+                    raise RuntimeError(
+                        f"injection ring exhausted ({self.ring_capacity} "
+                        "rows per stream)"
+                    )
+                ring[injected] = row
+                injected += 1
+            ctl[0] = injected
+            ctl[1] = 1 if closed else 0
+            outs = jitted(
+                jnp.asarray(state[0]), jnp.asarray(succ),
+                jnp.asarray(state[1]), jnp.asarray(state[2]),
+                jnp.asarray(state[3]), jnp.asarray(ring),
+                jnp.asarray(ctl), *[jnp.asarray(d) for d in data_np],
+            )
+            state = [np.asarray(o) for o in outs[:4]]
+            ctl_o = np.asarray(outs[4])
+            data_np = [np.asarray(o) for o in outs[5:]]
+            counts_np = state[2]
+            ctl[2] = ctl_o[2]  # device-consumed cursor persists
+            if bool(counts_np[C_OVERFLOW]):
+                raise RuntimeError("streaming megakernel overflow")
+            if (
+                closed
+                and int(counts_np[C_PENDING]) == 0
+                and int(ctl_o[2]) == injected
+                and not self._pending_rows
+            ):
+                info = {
+                    "executed": int(counts_np[C_EXECUTED]),
+                    "pending": int(counts_np[C_PENDING]),
+                    "injected": injected,
+                }
+                return state[3], info
+            time.sleep(poll_interval_s)

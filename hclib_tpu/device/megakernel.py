@@ -588,9 +588,45 @@ class Megakernel:
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
 
+        def install_descriptor(read_word) -> None:
+            """Adopt one externally-produced descriptor row (a stolen row
+            arriving over ICI, an injected stream row): allocate a row
+            through the same path spawns use (freed rows first, then the
+            bump cursor), copy the ABI words via ``read_word(w)``, count it
+            pending, and push it ready only when its dep counter is zero -
+            a dependent row waits for its predecessors like any other."""
+            nf = free[0]
+            use_free = nf > 0
+            row_free = free[jnp.maximum(nf, 1)]
+            a = counts[C_ALLOC]
+            ok = use_free | (a < capacity)
+            row = jnp.where(use_free, row_free, jnp.minimum(a, capacity - 1))
+
+            @pl.when(use_free)
+            def _():
+                free[0] = nf - 1
+
+            @pl.when(jnp.logical_not(use_free) & (a < capacity))
+            def _():
+                counts[C_ALLOC] = a + 1
+
+            @pl.when(ok)
+            def _():
+                for w in range(DESC_WORDS):
+                    tasks[row, w] = read_word(w)
+                counts[C_PENDING] = counts[C_PENDING] + 1
+
+                @pl.when(tasks[row, F_DEP] == 0)
+                def _():
+                    push_ready(row)
+
+            @pl.when(jnp.logical_not(ok))
+            def _():
+                counts[C_OVERFLOW] = 1
+
         return types.SimpleNamespace(
             stage=stage, sched=sched, push_ready=push_ready,
-            complete=complete,
+            complete=complete, install_descriptor=install_descriptor,
         )
 
     def _kernel(

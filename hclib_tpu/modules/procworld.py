@@ -99,6 +99,10 @@ class ProcWorld:
         self._ns = namespace
         self._timeout_ms = int(timeout_s * 1000)
         self._poll_s = poll_interval_s
+        # Guards the sequence/reply counters: AM handlers run on the
+        # progress thread and receive this world, so send/get/fence may be
+        # called concurrently with the application thread.
+        self._seq_lock = threading.Lock()
         self._send_seq: Dict[Tuple[int, int], int] = {}
         self._recv_seq: Dict[Tuple[int, int], int] = {}
         self._barrier_n = 0
@@ -120,15 +124,17 @@ class ProcWorld:
     def send(self, dst: int, arr, tag: int = 0) -> None:
         """Ordered per (src, dst, tag); non-blocking (KV deposit)."""
         arr = np.asarray(arr)
-        seq = self._send_seq.get((dst, tag), 0)
-        self._send_seq[(dst, tag)] = seq + 1
+        with self._seq_lock:
+            seq = self._send_seq.get((dst, tag), 0)
+            self._send_seq[(dst, tag)] = seq + 1
         key = f"{self._ns}/msg/{self.rank}/{dst}/{tag}/{seq}"
         self._c.key_value_set_bytes(key, _pack({}, arr))
 
     def recv(self, src: int, tag: int = 0) -> np.ndarray:
         """Blocks for the next in-order message from (src, tag)."""
-        seq = self._recv_seq.get((src, tag), 0)
-        self._recv_seq[(src, tag)] = seq + 1
+        with self._seq_lock:
+            seq = self._recv_seq.get((src, tag), 0)
+            self._recv_seq[(src, tag)] = seq + 1
         key = f"{self._ns}/msg/{src}/{self.rank}/{tag}/{seq}"
         b = self._c.blocking_key_value_get_bytes(key, self._timeout_ms)
         self._c.key_value_delete(key)
@@ -206,8 +212,9 @@ class ProcWorld:
             size: Optional[int] = None) -> np.ndarray:
         """One-sided read of rank ``src``'s heap array (served by its
         progress thread; sequenced after this rank's earlier ops to src)."""
-        self._reply_n += 1
-        rk = f"{self._ns}/re/{self.rank}/{self._reply_n}"
+        with self._seq_lock:
+            self._reply_n += 1
+            rk = f"{self._ns}/re/{self.rank}/{self._reply_n}"
         self._post_op(
             src,
             {"op": "get", "name": name, "off": int(offset),
@@ -222,8 +229,9 @@ class ProcWorld:
         applied (shmem_quiet for one target: a no-op op with a reply)."""
         if dst == self.rank:
             return
-        self._reply_n += 1
-        rk = f"{self._ns}/re/{self.rank}/{self._reply_n}"
+        with self._seq_lock:
+            self._reply_n += 1
+            rk = f"{self._ns}/re/{self.rank}/{self._reply_n}"
         self._post_op(dst, {"op": "fence", "reply": rk})
         self._c.blocking_key_value_get_bytes(rk, self._timeout_ms)
         self._c.key_value_delete(rk)
@@ -279,8 +287,22 @@ class ProcWorld:
             key = f"{self._ns}/op/{me}/{self._applied}"
             try:
                 b = self._c.key_value_try_get_bytes(key)
-            except Exception:  # NOT_FOUND surfaces as JaxRuntimeError
-                b = None
+            except Exception as e:
+                # Absent keys surface as NOT_FOUND JaxRuntimeErrors; any
+                # OTHER failure means the coordination service / client is
+                # gone - stop the engine loudly instead of spinning while
+                # every pending fence/get runs out its timeout silently.
+                if "NOT_FOUND" in str(e):
+                    b = None
+                else:  # pragma: no cover - requires killing the service
+                    import traceback
+
+                    print(
+                        f"procworld rank {me}: progress engine died:",
+                        flush=True,
+                    )
+                    traceback.print_exc()
+                    return
             if b is None:
                 time.sleep(self._poll_s)
                 continue
