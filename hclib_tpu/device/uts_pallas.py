@@ -268,7 +268,12 @@ def uts_pallas(
     """uts_vec with the whole traversal fused into one Pallas kernel; same
     exact counts, same host seeding, same result dict."""
     if params.shape != FIXED:
-        raise NotImplementedError("uts_pallas supports the GEO/FIXED shape")
+        raise NotImplementedError(
+            "uts_pallas supports the GEO/FIXED shape (the canonical "
+            "benchmark trees); depth-varying shapes run on uts_vec, whose "
+            "per-depth table gather is XLA-level (Mosaic's gather forms "
+            "do not cover a (depth -> row) table lookup per lane)"
+        )
     if lanes[1] != 128:
         raise ValueError("uts_pallas lanes must be (rows, 128)")
     import time

@@ -32,8 +32,11 @@ operation vectorized across (rows, 128) VPU planes:
   on numpy arrays over whole frontier levels, so seeding hundreds of
   thousands of subtree roots costs well under a second.
 
-Supports the GEO/FIXED shape (all canonical T1/T1L/T1XL/T3 trees); the
-depth-varying shapes would need per-depth threshold tables.
+Supports every GEO shape: FIXED (canonical T1/T1L/T1XL/T3) on the
+depth-independent threshold fast path, LINEAR/CYCLIC (canonical T5/T2) and
+EXPDEC via exact per-depth threshold tables (one row of integer thresholds
+per depth from the f64 shape function, -1 padded; the device gathers its
+row by depth and counts with pure int32 compares).
 
 This is pure JAX (jnp + while_loop) - XLA maps it onto the VPU without a
 hand-written kernel; it also runs on the CPU backend for tests.
@@ -49,12 +52,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.uts import FIXED, UTSParams
+from ..models.uts import (
+    CYCLIC,
+    EXPDEC,
+    FIXED,
+    LINEAR,
+    UTSParams,
+    _branching,
+)
 from ..ops.sha1 import sha1_block as _sha1_block, sha1_child as _sha1_child
 
 __all__ = [
-    "uts_vec", "child_thresholds", "LANES", "NLANES",
-    "make_count_children", "make_dfs_step", "make_refill",
+    "uts_vec", "child_thresholds", "child_threshold_table", "depth_cap",
+    "LANES", "NLANES", "make_count_children", "make_dfs_step",
+    "make_refill",
 ]
 
 LANES = (8, 128)
@@ -62,10 +73,12 @@ NLANES = LANES[0] * LANES[1]
 MAX_CHILDREN = 100
 
 
-def child_thresholds(b0: float) -> np.ndarray:
-    """Integer thresholds for the geometric child count at branching b0:
+def _thresholds_for_b(b_i: float) -> List[int]:
+    """Integer thresholds for the geometric child count at branching b_i:
     count(r) = #{k : r >= t_k}. Exact w.r.t. the f64 scalar formula."""
-    p = 1.0 / (1.0 + b0)
+    if b_i <= 0.0:
+        return []
+    p = 1.0 / (1.0 + b_i)
     logq = math.log(1.0 - p)
 
     def count_of(r: int) -> int:
@@ -87,7 +100,43 @@ def child_thresholds(b0: float) -> np.ndarray:
             else:
                 lo = mid + 1
         ts.append(lo)
-    return np.asarray(ts, dtype=np.int32)
+    return ts
+
+
+def child_thresholds(b0: float) -> np.ndarray:
+    """Depth-independent thresholds (the GEO/FIXED fast path)."""
+    return np.asarray(_thresholds_for_b(b0), dtype=np.int32)
+
+
+def depth_cap(params: UTSParams) -> Optional[int]:
+    """Smallest depth bound that covers every node the shape can produce
+    (node depths strictly below the returned value), or None when the
+    shape is unbounded (EXPDEC: b_i decays but never reaches 0, so a cap
+    must be chosen by the caller and validated against the observed max
+    depth)."""
+    if params.shape == FIXED:
+        return params.gen_mx + 1
+    if params.shape == LINEAR:
+        return params.gen_mx + 1  # b_i <= 0 at depth >= gen_mx
+    if params.shape == CYCLIC:
+        return 5 * params.gen_mx + 2  # b_i = 0 beyond 5*gen_mx
+    return None
+
+
+def child_threshold_table(params: UTSParams, max_depth: int) -> np.ndarray:
+    """Per-depth threshold table for the depth-varying shapes
+    (reference: the b_i shape functions, test/uts/uts.c:171-221): row d
+    holds the thresholds for a node AT depth d, -1 padding marks child
+    ordinals unreachable at that depth. Rows cover d in [0, max_depth]."""
+    rows = [
+        _thresholds_for_b(_branching(params, d))
+        for d in range(max_depth + 1)
+    ]
+    K = max((len(r) for r in rows), default=0) or 1
+    table = np.full((max_depth + 1, K), -1, dtype=np.int32)
+    for d, r in enumerate(rows):
+        table[d, : len(r)] = r
+    return table
 
 
 def _level_select(stack, sp):
@@ -114,7 +163,21 @@ def _level_store(stack, sp, value, mask):
 
 
 def make_count_children(thresholds: tuple, gen_mx: int, lanes: tuple):
-    """Exact geometric child count from the static threshold table."""
+    """Exact geometric child count. ``thresholds`` is either a flat tuple
+    (depth-independent FIXED shape, guarded by gen_mx) or a tuple of
+    per-depth rows from child_threshold_table (-1 padded): the count then
+    comes from a row gather by each lane's depth."""
+    if thresholds and isinstance(thresholds[0], tuple):
+        tab = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
+        D = tab.shape[0] - 1
+
+        def count_children(r, depth):
+            rows = jnp.take(tab, jnp.clip(depth, 0, D), axis=0)
+            return jnp.sum(
+                (rows >= 0) & (r[..., None] >= rows), axis=-1
+            ).astype(jnp.int32)
+
+        return count_children
 
     def count_children(r, depth):
         cnt = jnp.zeros(lanes, jnp.int32)
@@ -367,13 +430,16 @@ def _host_seed(params: UTSParams, target_roots: int):
     roots_count (R,) i32). Roots all sit at depth d0 and have count >= 1;
     leaf frontier nodes are counted host-side.
     """
-    thresholds = child_thresholds(params.b0)
-
     def counts_of(state5, depth: int) -> np.ndarray:
-        if depth >= params.gen_mx:
+        # Per-level thresholds from the depth's branching factor: one code
+        # path covers FIXED and every depth-varying shape exactly.
+        ts = np.asarray(
+            _thresholds_for_b(_branching(params, depth)), np.int32
+        )
+        if ts.size == 0:
             return np.zeros(state5[0].shape, np.int32)
         r = (state5[4] & np.uint32(0x7FFFFFFF)).astype(np.int32)
-        return (r[:, None] >= thresholds[None, :]).sum(axis=1, dtype=np.int32)
+        return (r[:, None] >= ts[None, :]).sum(axis=1, dtype=np.int32)
 
     # Root state: SHA1(16 zero bytes || BE32(seed)) per the UTS spec
     # (models/uts.py root_state).
@@ -433,14 +499,19 @@ def uts_vec(
     device=None,
     lanes: Tuple[int, int] = LANES,
     min_idle_div: int = 8,
+    depth_bound: Optional[int] = None,
 ) -> dict:
     """Run UTS with the vectorized DFS engine; returns counts + timing info.
 
     The host BFS-expands the tree top until >= target_roots frontier nodes
     (counting that part itself), then the device traverses the subtrees,
-    lanes claiming roots from the shared queue as they drain."""
-    if params.shape != FIXED:
-        raise NotImplementedError("uts_vec supports the GEO/FIXED shape")
+    lanes claiming roots from the shared queue as they drain.
+
+    All GEO shapes are supported: FIXED uses the depth-independent
+    threshold fast path; LINEAR/CYCLIC get exact per-depth threshold
+    tables with a shape-derived depth cap; EXPDEC (whose branching decays
+    but never reaches zero) uses ``depth_bound`` (default 8*gen_mx) and
+    the run fails loudly if the tree actually reaches the bound."""
     import time
 
     t_seed = time.perf_counter()
@@ -467,11 +538,35 @@ def uts_vec(
     )
     roots_count = np.concatenate([roots_count, np.zeros(nlanes, np.int32)])
     args = (jnp.asarray(roots_state), jnp.asarray(roots_count))
+    derived = depth_cap(params)
+    if derived is None:  # EXPDEC: caller-chosen bound, validated below
+        cap = depth_bound if depth_bound is not None else 8 * params.gen_mx
+        bounded = True
+    elif depth_bound is not None and depth_bound < derived:
+        # An explicit bound below the shape's own cap shrinks the stack
+        # for known-shallow trees - and gets the same loud validation.
+        cap = depth_bound
+        bounded = True
+    else:
+        cap = derived
+        bounded = False
+    if params.shape == FIXED and not bounded:
+        thr = tuple(int(t) for t in child_thresholds(params.b0))
+        stack_size = max(1, params.gen_mx - d0)
+    else:
+        table = child_threshold_table(params, cap)
+        thr = tuple(tuple(int(x) for x in row) for row in table)
+        # Pushed frames hold non-leaf nodes only; for shapes whose cap is
+        # exact the deepest non-leaf sits at cap-2, so the tight height is
+        # cap-1-d0 (every extra level costs select/store work per step).
+        stack_size = max(
+            1, (cap - d0) if bounded else (cap - 1 - d0)
+        )
     kw = dict(
-        stack_size=max(1, params.gen_mx - d0),
+        stack_size=stack_size,
         gen_mx=params.gen_mx,
         d0=d0,
-        thresholds=tuple(int(t) for t in child_thresholds(params.b0)),
+        thresholds=thr,
         max_steps=max_steps,
         lanes=tuple(lanes),
         min_idle_div=min_idle_div,
@@ -485,6 +580,11 @@ def uts_vec(
     dt = time.perf_counter() - t0
     if bool(unfinished):
         raise RuntimeError(f"uts_vec ran out of steps ({max_steps})")
+    if bounded and int(np.asarray(maxd).max()) >= cap:
+        raise RuntimeError(
+            f"tree reached the depth bound ({cap}): counts beyond it are "
+            "truncated - rerun with a larger depth_bound"
+        )
     nlanes = lanes[0] * lanes[1]
     result.update(
         nodes=host_nodes + dev_nodes,

@@ -33,8 +33,8 @@ from typing import List, Tuple
 import hclib_tpu as hc
 
 __all__ = [
-    "UTSParams", "T1", "T1L", "T1XL", "T1XXL", "T3", "count_seq",
-    "count_parallel", "run",
+    "UTSParams", "T1", "T1L", "T1XL", "T1XXL", "T2", "T3", "T5",
+    "count_seq", "count_parallel", "run",
 ]
 
 MAX_CHILDREN = 100  # MAXNUMCHILDREN (reference: test/uts/uts.h:31)
@@ -53,6 +53,9 @@ class UTSParams:
 # Canonical trees (reference: test/uts/sample_trees.sh:18,37)
 T1 = UTSParams(shape=FIXED, gen_mx=10, b0=4.0, root_seed=19)  # 4,130,071 nodes
 T1L = UTSParams(shape=FIXED, gen_mx=13, b0=4.0, root_seed=29)  # 102,181,082 nodes
+# Canonical depth-varying trees (test/uts/sample_trees.sh:20-24):
+T5 = UTSParams(shape=LINEAR, gen_mx=20, b0=4.0, root_seed=34)  # 4,147,582
+T2 = UTSParams(shape=CYCLIC, gen_mx=16, b0=6.0, root_seed=502)  # 4,117,769
 # test/uts/sample_trees.sh XL/XXL geometric trees. Per-lane counters stay
 # well under int32 for both; T1XXL's 4.23B TOTAL exceeds int32, which is
 # why engine totals are summed in int64 on the host.
@@ -170,5 +173,5 @@ if __name__ == "__main__":  # pragma: no cover
     import sys
 
     name = sys.argv[1] if len(sys.argv) > 1 else "T3"
-    params = {"T1": T1, "T1L": T1L, "T3": T3}[name]
+    params = {"T1": T1, "T1L": T1L, "T2": T2, "T3": T3, "T5": T5}[name]
     print(run(params))
