@@ -173,9 +173,15 @@ def make_count_children(thresholds: tuple, gen_mx: int, lanes: tuple):
 
         def count_children(r, depth):
             rows = jnp.take(tab, jnp.clip(depth, 0, D), axis=0)
-            return jnp.sum(
+            cnt = jnp.sum(
                 (rows >= 0) & (r[..., None] >= rows), axis=-1
             ).astype(jnp.int32)
+            # Beyond the table the count is 0, NOT the last row's (which
+            # may be supercritical when a depth_bound truncates a live
+            # region): the traversal then terminates and the caller's
+            # maxd >= cap validation fails loudly instead of the kernel
+            # grinding a phantom infinite subtree to max_steps.
+            return jnp.where(depth <= D, cnt, 0)
 
         return count_children
 

@@ -1,0 +1,26 @@
+"""Build hook: compile the native host runtime with the wheel.
+
+The package also builds the library lazily at first use (native/__init__.py
+runs `make` when the .so is missing or stale), so a source checkout works
+without installation; this hook just front-loads that for wheels."""
+
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        try:
+            subprocess.run(
+                ["make", "-s"], cwd="hclib_tpu/native", check=True
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            # A toolchain-less install still gets the pure-Python runtime;
+            # the native baseline raises NativeBuildError on first use.
+            print(f"warning: native runtime not prebuilt ({e})")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -87,14 +87,21 @@ def _device_suite(trials: int) -> List[Tuple[str, Callable[[], float], str]]:
     ]
 
 
-def _latest_log(log_dir: str) -> Dict[str, dict]:
+def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
+    """Most recent log of the SAME size class (quick vs full): comparing
+    tiny smoke inputs against full-size baselines is meaningless in either
+    direction."""
     if not os.path.isdir(log_dir):
         return {}
-    logs = sorted(f for f in os.listdir(log_dir) if f.endswith(".json"))
-    if not logs:
-        return {}
-    with open(os.path.join(log_dir, logs[-1])) as f:
-        return json.load(f).get("apps", {})
+    for name in sorted(
+        (f for f in os.listdir(log_dir) if f.endswith(".json")),
+        reverse=True,
+    ):
+        with open(os.path.join(log_dir, name)) as f:
+            log = json.load(f)
+        if bool(log.get("quick")) == quick:
+            return log.get("apps", {})
+    return {}
 
 
 def main(argv=None) -> int:
@@ -111,7 +118,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     wanted = {a for a in args.apps.split(",") if a}
-    prev = _latest_log(args.log_dir)
+    prev = _latest_log(args.log_dir, args.quick)
     results: Dict[str, dict] = {}
     failures: List[str] = []
 
