@@ -67,6 +67,8 @@ def load() -> ctypes.CDLL:
     lib.hcn_destroy.argtypes = [ctypes.c_void_p]
     lib.hcn_nworkers.restype = ctypes.c_int
     lib.hcn_nworkers.argtypes = [ctypes.c_void_p]
+    lib.hcn_pinned_cpu.restype = ctypes.c_int
+    lib.hcn_pinned_cpu.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.hcn_executed.restype = ctypes.c_ulonglong
     lib.hcn_executed.argtypes = [ctypes.c_void_p]
     lib.hcn_steals.restype = ctypes.c_ulonglong
@@ -243,6 +245,16 @@ class NativeRuntime:
     @property
     def steals(self) -> int:
         return int(self._lib.hcn_steals(self._handle))
+
+    def pinned_cpus(self) -> list:
+        """Per-worker pinned CPU ids (-1 = unpinned). Pinning is opt-in
+        via HCLIB_TPU_AFFINITY / HCLIB_AFFINITY = "strided" | "chunked"
+        at runtime creation (reference: HCLIB_AFFINITY hwloc cpusets,
+        src/hclib-runtime.c:731-900)."""
+        h = self._handle
+        return [
+            int(self._lib.hcn_pinned_cpu(h, w)) for w in range(self.nworkers)
+        ]
 
     # -- tasking API ------------------------------------------------------
 

@@ -42,6 +42,9 @@ void* hcn_create_graph(int nworkers, int nlocales, const int* pop_off,
 
 void hcn_destroy(void* rt) { delete static_cast<Runtime*>(rt); }
 int hcn_nworkers(void* rt) { return static_cast<Runtime*>(rt)->nworkers(); }
+int hcn_pinned_cpu(void* rt, int w) {
+  return static_cast<Runtime*>(rt)->pinned_cpu(w);
+}
 int hcn_nlocales(void* rt) { return static_cast<Runtime*>(rt)->nlocales(); }
 unsigned long long hcn_executed(void* rt) {
   return static_cast<Runtime*>(rt)->total_executed();

@@ -1,8 +1,15 @@
 #include "runtime.hpp"
 
+#include <cstdlib>
 #include <cstring>
 #include <new>
 #include <sstream>
+
+#ifdef __linux__
+#include <pthread.h>
+#include <sched.h>
+#include <unistd.h>
+#endif
 
 namespace hcn {
 
@@ -55,6 +62,66 @@ void FinishScope::check_out() {
   }
 }
 
+// Worker->CPU pinning (reference: HCLIB_AFFINITY strided/chunked over
+// hwloc cpusets, src/hclib-runtime.c:731-900). Opt-in via
+// HCLIB_TPU_AFFINITY (or HCLIB_AFFINITY) = "strided" | "chunked"; any
+// other value is rejected with a warning. Candidate CPUs come from the
+// process's ALLOWED set (sched_getaffinity), so cgroup/taskset-restricted
+// environments pin correctly.
+struct AffinityPlan {
+  bool active = false;
+  std::vector<int> cpu;  // per-worker target
+};
+
+static AffinityPlan affinity_plan(int nworkers) {
+  AffinityPlan plan;
+#ifdef __linux__
+  const char* mode = std::getenv("HCLIB_TPU_AFFINITY");
+  if (mode == nullptr) mode = std::getenv("HCLIB_AFFINITY");
+  if (mode == nullptr || *mode == '\0') return plan;
+  std::string m(mode);
+  if (m != "strided" && m != "chunked") {
+    std::fprintf(
+        stderr,
+        "hclib_tpu native: ignoring unknown affinity mode '%s' "
+        "(use strided|chunked)\n",
+        mode);
+    return plan;
+  }
+  cpu_set_t allowed;
+  if (sched_getaffinity(0, sizeof(allowed), &allowed) != 0) return plan;
+  std::vector<int> cpus;
+  for (int c = 0; c < CPU_SETSIZE; ++c)
+    if (CPU_ISSET(c, &allowed)) cpus.push_back(c);
+  if (cpus.empty()) return plan;
+  plan.active = true;
+  plan.cpu.resize(nworkers);
+  int n = int(cpus.size());
+  for (int w = 0; w < nworkers; ++w)
+    plan.cpu[w] = (m == "chunked") ? cpus[size_t((long(w) * n) / nworkers)]
+                                   : cpus[w % n];  // strided (ref default)
+#else
+  (void)nworkers;
+#endif
+  return plan;
+}
+
+static int pin_self(int cpu) {
+#ifdef __linux__
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  CPU_SET(cpu, &set);
+  if (pthread_setaffinity_np(pthread_self(), sizeof(set), &set) != 0)
+    return -1;
+  return cpu;
+#else
+  (void)cpu;
+  return -1;
+#endif
+}
+
+constexpr int kPinPending = -2;
+
 Runtime::Runtime(int nworkers, GraphSpec graph)
     : nworkers_(nworkers < 1 ? 1 : nworkers),
       graph_(std::move(graph)),
@@ -63,21 +130,56 @@ Runtime::Runtime(int nworkers, GraphSpec graph)
   deques_ = std::vector<Deque>(size_t(graph_.nlocales) * nworkers_);
   stats_ = std::vector<WorkerStats>(nworkers_);
   for (auto& s : stats_) s.stolen_from.assign(nworkers_, 0);
+  AffinityPlan plan = affinity_plan(nworkers_);
+  pinned_.reset(new std::atomic<int>[nworkers_]);
+  for (int w = 0; w < nworkers_; ++w)
+    pinned_[w].store(plan.active ? kPinPending : -1,
+                     std::memory_order_relaxed);
+#ifdef __linux__
+  if (plan.active) {
+    // The calling thread becomes worker 0 and gets pinned below; remember
+    // its mask so destruction undoes the side effect on the host program.
+    orig_mask_.resize(sizeof(cpu_set_t));
+    if (pthread_getaffinity_np(
+            pthread_self(), sizeof(cpu_set_t),
+            reinterpret_cast<cpu_set_t*>(orig_mask_.data())) == 0)
+      restore_mask_ = true;
+  }
+#endif
   g_runtime = this;
   g_worker = 0;
   threads_.reserve(nworkers_ - 1);
+  // Spawn BEFORE pinning worker 0: children inherit the caller's original
+  // mask and then apply their own targets.
   for (int w = 1; w < nworkers_; ++w) {
-    threads_.emplace_back([this, w] {
+    int target = plan.active ? plan.cpu[w] : -1;
+    threads_.emplace_back([this, w, target] {
       g_runtime = this;
       g_worker = w;
+      pinned_[w].store(target >= 0 ? pin_self(target) : -1,
+                       std::memory_order_release);
       worker_loop(w);
     });
+  }
+  if (plan.active) {
+    pinned_[0].store(pin_self(plan.cpu[0]), std::memory_order_release);
+    // Rendezvous: pinned_cpu() is well-defined the moment the constructor
+    // returns (workers record their result first thing).
+    for (int w = 1; w < nworkers_; ++w)
+      while (pinned_[w].load(std::memory_order_acquire) == kPinPending)
+        std::this_thread::yield();
   }
 }
 
 Runtime::~Runtime() {
   shutdown_.store(true, std::memory_order_release);
   for (auto& t : threads_) t.join();
+#ifdef __linux__
+  if (restore_mask_)
+    pthread_setaffinity_np(
+        pthread_self(), sizeof(cpu_set_t),
+        reinterpret_cast<const cpu_set_t*>(orig_mask_.data()));
+#endif
   g_runtime = nullptr;
   g_worker = -1;
   g_finish = nullptr;

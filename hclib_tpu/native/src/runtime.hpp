@@ -26,6 +26,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -238,6 +239,15 @@ class Runtime {
 
   int nworkers() const { return nworkers_; }
   int nlocales() const { return graph_.nlocales; }
+  // CPU this worker was pinned to, or -1 (no affinity requested / pin
+  // failed / unsupported platform). Well-defined once the constructor
+  // returns. Reference: HCLIB_AFFINITY hwloc cpusets,
+  // src/hclib-runtime.c:731-900.
+  int pinned_cpu(int w) const {
+    return (w >= 0 && w < nworkers_)
+               ? pinned_[w].load(std::memory_order_acquire)
+               : -1;
+  }
 
   // Thread-local context (reference: pthread_setspecific ws_key,
   // src/hclib-runtime.c:151-193).
@@ -299,6 +309,9 @@ class Runtime {
   }
 
   int nworkers_;
+  std::unique_ptr<std::atomic<int>[]> pinned_;
+  std::vector<char> orig_mask_;  // caller-thread mask, restored at teardown
+  bool restore_mask_ = false;
   GraphSpec graph_;
   std::vector<Deque> deques_;  // [locale][worker]
   std::vector<WorkerStats> stats_;
