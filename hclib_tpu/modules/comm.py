@@ -139,6 +139,27 @@ def _commit_to_rank(payload: Any, rank: int) -> Any:
     return payload
 
 
+def _batch_commit(values: Sequence[Any], rank: int) -> List[Any]:
+    """Commit many HOST-resident values to one rank's device as ONE
+    stacked transfer (instead of one device_put per element - a w-element
+    gather from host is one hop, not w): the returned entries are views of
+    the stacked device array. Device-resident, mixed-shape, or non-array
+    payloads fall back to the per-element path (a gather of values already
+    spread over w devices is w hops whichever way it is expressed)."""
+    w = _active().world()
+    dev = w.device_for(rank)
+    host_arrays = all(isinstance(v, np.ndarray) for v in values)
+    if dev is not None and host_arrays and len(values) > 1:
+        import jax
+        import jax.numpy as jnp
+
+        shapes = {(v.shape, v.dtype) for v in values}
+        if len(shapes) == 1:
+            stacked = jax.device_put(jnp.stack(list(values)), dev)
+            return list(stacked)
+    return [_commit_to_rank(v, rank) for v in values]
+
+
 def _is_jax(x: Any) -> bool:
     try:
         import jax
@@ -304,16 +325,23 @@ def _stack_reduce(values: Sequence[Any], op: Callable) -> Any:
 
 
 def gather(values: Sequence[Any], root: int = 0) -> List[Any]:
+    """MPI_Gather: one value per rank lands on root (one stacked transfer,
+    not one per element)."""
     w = _active().world()
-    return _collective(lambda: [_commit_to_rank(v, root) for v in values])
+    if len(values) != w.size:
+        raise ValueError(f"need one value per rank ({w.size}), got {len(values)}")
+    return _collective(lambda: _batch_commit(values, root))
 
 
 def allgather(values: Sequence[Any]) -> List[List[Any]]:
-    """MPI_Allgather: every rank gets the full list."""
+    """MPI_Allgather: every rank gets the full list (one stacked transfer
+    per destination rank)."""
     w = _active().world()
+    if len(values) != w.size:
+        raise ValueError(f"need one value per rank ({w.size}), got {len(values)}")
 
     def run() -> List[List[Any]]:
-        return [[_commit_to_rank(v, r) for v in values] for r in range(w.size)]
+        return [_batch_commit(values, r) for r in range(w.size)]
 
     return _collective(run)
 
@@ -326,12 +354,15 @@ def scatter(values: Sequence[Any], root: int = 0) -> List[Any]:
 
 
 def alltoall(matrix: Sequence[Sequence[Any]]) -> List[List[Any]]:
-    """matrix[src][dst] -> out[dst][src], each committed to dst's device."""
+    """matrix[src][dst] -> out[dst][src], each destination's column
+    committed as one stacked transfer (w hops total, not w^2)."""
     w = _active().world()
+    if len(matrix) != w.size or any(len(row) != w.size for row in matrix):
+        raise ValueError(f"need a {w.size}x{w.size} matrix")
 
     def run() -> List[List[Any]]:
         return [
-            [_commit_to_rank(matrix[s][d], d) for s in range(w.size)]
+            _batch_commit([matrix[s][d] for s in range(w.size)], d)
             for d in range(w.size)
         ]
 

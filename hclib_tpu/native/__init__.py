@@ -69,6 +69,8 @@ def load() -> ctypes.CDLL:
     lib.hcn_nworkers.argtypes = [ctypes.c_void_p]
     lib.hcn_pinned_cpu.restype = ctypes.c_int
     lib.hcn_pinned_cpu.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.hcn_typed_promise_demo.restype = ctypes.c_longlong
+    lib.hcn_typed_promise_demo.argtypes = [ctypes.c_void_p]
     lib.hcn_executed.restype = ctypes.c_ulonglong
     lib.hcn_executed.argtypes = [ctypes.c_void_p]
     lib.hcn_steals.restype = ctypes.c_ulonglong

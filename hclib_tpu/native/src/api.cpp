@@ -278,6 +278,43 @@ long long hcn_fib_ddt(void* rtp, int n) {
   return result;
 }
 
+// Exercises the typed C++ promise/future layer (promise_t<T>/future_t<T>,
+// reference inc/hclib_promise.h:41-124): an int promise chained through
+// async_await into a double future; returns 1000*int + (int)double.
+namespace {
+struct TypedDemo {
+  long long* out;
+};
+void typed_demo_root(void* env) {
+  auto* d = static_cast<TypedDemo*>(env);
+  long long* out = d->out;
+  delete d;
+  auto* pi = new hcn::promise_t<int>;
+  hcn::future_t<int> fi = pi->get_future();
+  hcn::NPromise* pd = nullptr;
+  hcn::finish([out, pi, fi, &pd] {
+    auto fd = hcn::async_future_t([] { return 2.5; });
+    pd = fd.raw();
+    hcn::async_await(
+        [out, fi, fd]() mutable {
+          *out = 1000LL * fi.get() + (long long)fd.wait();
+        },
+        {fi.raw()});
+    hcn::async([pi] { pi->put(42); });
+  });
+  // Caller-owns convention (async_future comment above): reclaim both
+  // promises once the finish scope guarantees no task still reads them.
+  delete pi;
+  delete pd;
+}
+}  // namespace
+
+long long hcn_typed_promise_demo(void* rtp) {
+  long long result = 0;
+  static_cast<Runtime*>(rtp)->run_root(typed_demo_root, new TypedDemo{&result});
+  return result;
+}
+
 // ------------------------------------------------------------------ UTS
 // Tree spec re-implemented from the published UTS algorithm (see
 // hclib_tpu/models/uts.py for the parameter citations).

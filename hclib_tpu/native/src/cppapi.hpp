@@ -8,7 +8,9 @@
 #pragma once
 
 #include <algorithm>
+#include <cstring>
 #include <new>
+#include <type_traits>
 #include <utility>
 #include <vector>
 
@@ -88,6 +90,75 @@ NPromise* async_future(F&& body) {
     Runtime::current()->promise_put(p, b());
   });
   return p;
+}
+
+// -- typed promises/futures (inc/hclib_promise.h:41-124,
+//    inc/hclib_future.h:9-77) ---------------------------------------------
+// The reference's design: typed views POD-cast over the untyped machine-
+// word promise, zero storage of their own. T must be trivially copyable
+// and fit in a void* (ints, pointers, enums, float); wider payloads go
+// through a pointer, exactly as in the reference.
+
+template <typename T>
+class future_t {
+  static_assert(sizeof(T) <= sizeof(void*),
+                "future_t<T>: T must fit the promise word (pass a pointer)");
+  static_assert(std::is_trivially_copyable<T>::value,
+                "future_t<T>: T must be trivially copyable");
+
+ public:
+  explicit future_t(NPromise* p) : p_(p) {}
+  bool satisfied() const { return p_->satisfied(); }
+  T wait() {
+    Runtime::current()->future_wait(p_);
+    return get();
+  }
+  T get() const {
+    void* w = p_->get();
+    T v;
+    std::memcpy(&v, &w, sizeof(T));
+    return v;
+  }
+  NPromise* raw() const { return p_; }
+
+ private:
+  NPromise* p_;
+};
+
+template <typename T>
+class promise_t : public NPromise {
+  static_assert(sizeof(T) <= sizeof(void*),
+                "promise_t<T>: T must fit the promise word (pass a pointer)");
+  static_assert(std::is_trivially_copyable<T>::value,
+                "promise_t<T>: T must be trivially copyable");
+
+ public:
+  void put(T v) {
+    void* w = nullptr;
+    std::memcpy(&w, &v, sizeof(T));
+    Runtime::current()->promise_put(this, w);
+  }
+  future_t<T> get_future() { return future_t<T>(this); }
+};
+
+template <>
+class promise_t<void> : public NPromise {
+ public:
+  void put() { Runtime::current()->promise_put(this, nullptr); }
+};
+
+// Typed async_future: runs `body`, puts its result (hclib::async_future
+// returning future_t<T>, inc/hclib-async.h:424-547). Void-returning
+// bodies are not supported here - use async + promise_t<void> directly.
+template <typename F, typename T = std::invoke_result_t<std::decay_t<F>>>
+future_t<T> async_future_t(F&& body) {
+  static_assert(!std::is_void<T>::value,
+                "async_future_t: void body - use async + promise_t<void>");
+  auto* p = new promise_t<T>;
+  async([p, b = std::decay_t<F>(std::forward<F>(body))]() mutable {
+    p->put(b());
+  });
+  return p->get_future();
 }
 
 // -- finish (inc/hclib-async.h:550-563) -----------------------------------

@@ -97,15 +97,29 @@ class _IdentityManager:
     """Hands worker identities to threads. Resumed contexts (priority) beat
     generic pool threads so program state is never starved of a worker."""
 
-    def __init__(self, nworkers: int) -> None:
+    def __init__(self, nworkers: int, on_priority_wait=None) -> None:
         self._cv = threading.Condition()
         self._free: List[int] = list(range(nworkers))
         self._priority_waiters = 0
         self._normal_waiters = 0
         self._shutdown = False
         self.has_priority_waiter = False  # racy read is fine; checked under lock on release
+        # Pokes the runtime's work condvar so idle workers wake, see the
+        # priority waiter, and hand their identity over - event-driven
+        # instead of the workers' idle poll discovering it.
+        self._on_priority_wait = on_priority_wait
 
     def acquire(self, priority: bool) -> Optional[int]:
+        if priority:
+            # Flag FIRST, then wake: a worker woken by the notify must see
+            # the waiter (the flag's racy read is already the protocol);
+            # flag-after-notify would let it re-park for the full timeout.
+            self.has_priority_waiter = True
+            if self._on_priority_wait is not None:
+                # Called before taking our lock (no lock-order coupling
+                # with the runtime's condvar); harmless when an identity
+                # is free.
+                self._on_priority_wait()
         with self._cv:
             if priority:
                 self._priority_waiters += 1
@@ -118,11 +132,18 @@ class _IdentityManager:
                         return None
                     if self._free and (priority or self._priority_waiters == 0):
                         return self._free.pop()
-                    self._cv.wait(0.05)
+                    # Every wake path notifies (release, shutdown, last
+                    # priority waiter leaving); the timeout is a safety
+                    # net, not the latency floor.
+                    self._cv.wait(1.0)
             finally:
                 if priority:
                     self._priority_waiters -= 1
                     self.has_priority_waiter = self._priority_waiters > 0
+                    if self._priority_waiters == 0:
+                        # Normal waiters blocked behind priority ones must
+                        # learn the road is clear.
+                        self._cv.notify_all()
                 else:
                     self._normal_waiters -= 1
 
@@ -180,7 +201,9 @@ class Runtime:
         }
         self.worker_stats = [_WorkerStats(nworkers) for _ in range(nworkers)]
         self._last_steal = [0] * nworkers
-        self._idmgr = _IdentityManager(nworkers)
+        self._idmgr = _IdentityManager(
+            nworkers, on_priority_wait=self._wake_workers
+        )
         self._work_cv = threading.Condition()
         self._pending = 0  # tasks in deques (approximate wakeup hint)
         self._shutdown = False
@@ -373,8 +396,18 @@ class Runtime:
                 continue
             with self._work_cv:
                 if self._pending == 0 and not self._shutdown:
-                    self._work_cv.wait(0.01)
+                    # Event-driven park: spawns, shutdown, and priority
+                    # waiters all notify. Registered idle fns (comm
+                    # pollers) still need a polling cadence; otherwise the
+                    # timeout is only a safety net.
+                    self._work_cv.wait(0.01 if self._idle_fns else 0.5)
         _tls.identity = None
+
+    def _wake_workers(self) -> None:
+        """Wake idle workers (a resumed context needs an identity: the
+        next loop iteration sees has_priority_waiter and yields one)."""
+        with self._work_cv:
+            self._work_cv.notify_all()
 
     def _record_error(self, e: BaseException) -> None:
         with self._first_error_lock:

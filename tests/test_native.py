@@ -249,3 +249,13 @@ def test_multicore_speedup():
     t1 = min(wall(1) for _ in range(2))
     tn = min(wall(min(ncpu, 4)) for _ in range(2))
     assert tn < t1 / 1.15, (t1, tn)
+
+
+def test_typed_cpp_promise_future():
+    """promise_t<int>/future_t<double> (reference inc/hclib_promise.h:41-124):
+    a typed int promise chained through async_await into a typed double
+    future; the demo returns 1000*42 + 2."""
+    from hclib_tpu.native import NativeRuntime
+
+    with NativeRuntime(nworkers=2) as r:
+        assert r._lib.hcn_typed_promise_demo(r._handle) == 42002
