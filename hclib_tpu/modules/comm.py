@@ -151,11 +151,12 @@ def _batch_commit(values: Sequence[Any], rank: int) -> List[Any]:
     host_arrays = all(isinstance(v, np.ndarray) for v in values)
     if dev is not None and host_arrays and len(values) > 1:
         import jax
-        import jax.numpy as jnp
 
         shapes = {(v.shape, v.dtype) for v in values}
         if len(shapes) == 1:
-            stacked = jax.device_put(jnp.stack(list(values)), dev)
+            # Stack on the HOST, then one device_put: truly a single
+            # hop (jnp.stack would first commit to the default device).
+            stacked = jax.device_put(np.stack(list(values)), dev)
             return list(stacked)
     return [_commit_to_rank(v, rank) for v in values]
 

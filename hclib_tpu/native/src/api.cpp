@@ -291,10 +291,13 @@ void typed_demo_root(void* env) {
   delete d;
   auto* pi = new hcn::promise_t<int>;
   hcn::future_t<int> fi = pi->get_future();
-  hcn::NPromise* pd = nullptr;
+  hcn::promise_t<double>* pd = nullptr;
   hcn::finish([out, pi, fi, &pd] {
     auto fd = hcn::async_future_t([] { return 2.5; });
-    pd = fd.raw();
+    // async_future_t allocated a promise_t<double>; keep the concrete
+    // type so the delete below is well-formed (NPromise has no virtual
+    // destructor by design - it is a POD-ish machine word cell).
+    pd = static_cast<hcn::promise_t<double>*>(fd.raw());
     hcn::async_await(
         [out, fi, fd]() mutable {
           *out = 1000LL * fi.get() + (long long)fd.wait();

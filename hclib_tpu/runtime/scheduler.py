@@ -395,12 +395,22 @@ class Runtime:
             if self._run_idle_fns(wid):
                 continue
             with self._work_cv:
-                if self._pending == 0 and not self._shutdown:
+                if (
+                    self._pending == 0
+                    and not self._shutdown
+                    # Re-checked under the condvar lock: a priority
+                    # waiter's flag-then-notify cannot be lost against
+                    # this predicate (the notify blocks on this lock
+                    # until wait() releases it).
+                    and not self._idmgr.has_priority_waiter
+                ):
                     # Event-driven park: spawns, shutdown, and priority
                     # waiters all notify. Registered idle fns (comm
-                    # pollers) still need a polling cadence; otherwise the
-                    # timeout is only a safety net.
-                    self._work_cv.wait(0.01 if self._idle_fns else 0.5)
+                    # pollers) still need a polling cadence; the 0.2s cap
+                    # bounds one theoretical flag race (a departing
+                    # priority waiter clearing over an arriving one's
+                    # pre-lock set) instead of being the latency floor.
+                    self._work_cv.wait(0.01 if self._idle_fns else 0.2)
         _tls.identity = None
 
     def _wake_workers(self) -> None:
