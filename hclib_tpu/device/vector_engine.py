@@ -47,7 +47,12 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["VectorTaskSpec", "make_subtree_runner", "fib_spec"]
+__all__ = [
+    "VectorTaskSpec",
+    "make_subtree_runner",
+    "fib_spec",
+    "nqueens_spec",
+]
 
 
 class VectorTaskSpec:
@@ -374,4 +379,74 @@ def fib_spec(
         lanes=lanes,
         min_idle_div=min_idle_div,
         root_contrib=root_contrib,
+    )
+
+
+# ------------------------------------------------------------- n-queens
+
+def _popcount(x, jnp):
+    """SWAR popcount over int32 planes (no hardware popcount in the VPU
+    op set; 12 plane ops)."""
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    return (x * 0x01010101) >> 24
+
+
+def nqueens_spec(
+    n: int,
+    lanes: Tuple[int, int] = (8, 128),
+    min_idle_div: int = 8,
+) -> VectorTaskSpec:
+    """N-queens as a vector-tier task family (reference workload
+    test/misc/nqueens): a frame is a partial placement as three bitboards
+    (cols, left-diag, right-diag attack masks); a task's children are the
+    safe columns of the next row, selected by ordinal with an unrolled
+    k-th-set-bit scan (all branch-free plane ops). Completed boards
+    (cols == full) contribute one solution. Task count = number of safe
+    partial placements (the same tree the host model explores)."""
+    if not (1 <= n <= 16):
+        raise ValueError("nqueens_spec wants 1 <= n <= 16")
+    full = (1 << n) - 1
+
+    def counts_of(cols, ld, rd, jnp):
+        free = jnp.bitwise_not(cols | ld | rd) & full
+        return jnp.where(cols == full, 0, _popcount(free, jnp))
+
+    def seed(args):
+        # args unused: the seed is the empty board. jnp-typed zeros keep
+        # the bridge's seed plumbing uniform.
+        z = args[0] * 0
+        return (z, z, z), jnp.int32(n)
+
+    def child(frame, k, jnp):
+        cols, ld, rd = frame
+        free = jnp.bitwise_not(cols | ld | rd) & full
+        # k-th set bit of `free` (branch-free ordinal selection).
+        bit = jnp.zeros_like(free)
+        rank = jnp.zeros_like(free)
+        for b in range(n):
+            m = (free >> b) & 1
+            hit = (m == 1) & (rank == k)
+            bit = jnp.where(hit, 1 << b, bit)
+            rank = rank + m
+        ncols = cols | bit
+        nld = ((ld | bit) << 1) & full
+        nrd = (rd | bit) >> 1
+        return (ncols, nld, nrd), counts_of(ncols, nld, nrd, jnp)
+
+    def contrib(cframe, ccount, jnp):
+        return {"solutions": (cframe[0] == full).astype(jnp.int32)}
+
+    return VectorTaskSpec(
+        name="vnqueens",
+        frame_words=3,
+        seed=seed,
+        child=child,
+        contrib=contrib,
+        accumulators=("solutions",),
+        out_acc="solutions",
+        stack_depth=n + 2,
+        lanes=lanes,
+        min_idle_div=min_idle_div,
     )

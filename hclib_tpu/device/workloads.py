@@ -142,6 +142,33 @@ def device_vfib(
     return int(ivalues[0]), info
 
 
+# --------------------------------------------------- n-queens, vector tier
+
+VNQUEENS = 0
+
+
+def device_nqueens(
+    n: int,
+    lanes: Tuple[int, int] = (8, 128),
+    interpret: Optional[bool] = None,
+) -> Tuple[int, dict]:
+    """Count n-queens solutions via batched vector dispatch;
+    info['executed'] counts safe partial placements (the search tree)."""
+    from .vector_engine import nqueens_spec
+
+    mk = Megakernel(
+        kernels=[("vnqueens", nqueens_spec(n, lanes=lanes))],
+        capacity=64,
+        num_values=16,
+        succ_capacity=8,
+        interpret=interpret,
+    )
+    b = TaskGraphBuilder()
+    b.add(VNQUEENS, args=[0], out=0)
+    ivalues, _, info = mk.run(b)
+    return int(ivalues[0]), info
+
+
 # --------------------------------------------------------------- arrayadd
 
 ADD_TILE = 0

@@ -34,10 +34,16 @@ def tree_tasks(n):
 def runner():
     spec = fib_spec(max_n=14, lanes=(1, 8))
     run = make_subtree_runner(spec, max_steps=100000)
-    with jax.default_device(jax.devices("cpu")[0]):
-        yield jax.jit(
-            lambda n: run((n,), jnp.where(n >= 2, 2, 0))
-        )
+    jitted = jax.jit(lambda n: run((n,), jnp.where(n >= 2, 2, 0)))
+    cpu = jax.devices("cpu")[0]
+
+    # Pin via committed inputs, NOT a default_device context held across
+    # the yield - that context would leak into every other test in the
+    # module (a TPU-gated test then lowers its kernel for CPU and fails).
+    def call(n):
+        return jitted(jax.device_put(n, cpu))
+
+    return call
 
 
 @pytest.mark.parametrize("n", [2, 3, 5, 10, 14])
@@ -94,3 +100,42 @@ def test_vector_task_fires_scalar_successors():
     assert ivalues[1] == 2 * fib(9)
     assert info["executed"] == tree_tasks(9) + 1  # +1: the double task
     assert info["pending"] == 0
+
+
+KNOWN_NQ = {1: 1, 4: 2, 5: 10, 6: 4, 8: 92}
+
+
+@pytest.mark.parametrize("n", [1, 4, 5, 6])
+def test_nqueens_runner_exact(n):
+    """The vector tier is a generic engine, not a fib special case: the
+    n-queens family (3-word bitboard frames, data-dependent child counts)
+    counts exactly (reference workload test/misc/nqueens)."""
+    from hclib_tpu.device.vector_engine import nqueens_spec
+
+    spec = nqueens_spec(n, lanes=(1, 8))
+    run = make_subtree_runner(spec, max_steps=200000)
+    with jax.default_device(jax.devices("cpu")[0]):
+        _, accs, over = jax.jit(
+            lambda: run(spec.seed((jnp.int32(0),))[0], n)
+        )()
+    assert int(accs["solutions"]) == KNOWN_NQ[n]
+    assert not bool(over)
+
+
+def test_device_nqueens_interpret():
+    from hclib_tpu.device.workloads import device_nqueens
+
+    v, info = device_nqueens(6, lanes=(1, 8), interpret=True)
+    assert v == KNOWN_NQ[6]
+    # The host model agrees (it runs under the host runtime).
+    from hclib_tpu.models import nqueens as nq
+
+    assert nq.run(6)["value"] == v
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs TPU")
+def test_device_nqueens_tpu():
+    from hclib_tpu.device.workloads import device_nqueens
+
+    v, info = device_nqueens(10)
+    assert v == 724
