@@ -1,0 +1,98 @@
+"""Lesson 7: the batch-dispatch vector tier and streaming task injection.
+
+Two round-2 capabilities of the megakernel:
+
+1. **Batch dispatch** - a recursive, reduction-shaped task family
+   (fib, n-queens, tree searches) declared as a ``VectorTaskSpec`` runs
+   its whole subtree wide over VPU lanes: per-lane tail-call DFS stacks,
+   and *lane-level work stealing* - starved lanes claim a donor lane's
+   bottom stack frame under a rotating ring permutation. One seed
+   descriptor in the scalar table fans out to thousands of tasks per
+   vector step (~0.5 ns/task on v5e vs ~126 ns on the scalar tier).
+
+2. **Streaming injection** - a resident scheduler's task supply can be
+   open-ended: the host appends descriptors to an HBM ring that the
+   kernel polls mid-run (write rows, then publish the tail - the
+   release/acquire contract), so work can arrive while earlier work
+   executes (the reference's analogue is an active message materializing
+   a task on a running PE).
+
+Runs on the CPU backend in interpret mode; identical code drives the TPU.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from hclib_tpu.device.descriptor import TaskGraphBuilder
+from hclib_tpu.device.inject import StreamingMegakernel
+from hclib_tpu.device.megakernel import Megakernel
+from hclib_tpu.device.vector_engine import fib_spec, nqueens_spec
+
+
+def fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+# -- 1. batch dispatch: one seed descriptor, a whole subtree over lanes --
+
+# A kernel-table entry can BE a VectorTaskSpec: the scalar scheduler pops
+# the seed task and dispatches the entire recursion tree across lanes.
+mk = Megakernel(
+    kernels=[
+        ("vfib", fib_spec(max_n=18, lanes=(1, 8))),
+        ("vnqueens", nqueens_spec(6, lanes=(1, 8))),
+    ],
+    capacity=16, num_values=8, succ_capacity=8, interpret=True,
+)
+b = TaskGraphBuilder()
+b.add(0, args=[16], out=0)  # fib(16) - 3193 tasks
+b.add(1, args=[0], out=1)   # 6-queens - 4 solutions
+b.reserve_values(2)
+ivalues, _, info = mk.run(b)
+assert int(ivalues[0]) == fib(16), ivalues[0]
+assert int(ivalues[1]) == 4, ivalues[1]
+print(f"batch dispatch: fib(16)={int(ivalues[0])}, 6-queens={int(ivalues[1])}, "
+      f"{info['executed']} tasks through 2 seed descriptors")
+
+# -- 2. streaming injection: the host feeds a running scheduler ---------
+
+BUMP = 0
+
+
+def bump(ctx):
+    ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+
+sm = StreamingMegakernel(
+    Megakernel(kernels=[("bump", bump)], capacity=64, num_values=4,
+               succ_capacity=8, interpret=True),
+    ring_capacity=64,
+)
+seed = TaskGraphBuilder()
+seed.add(BUMP, args=[1000])
+
+
+def feeder():
+    for i in range(20):
+        sm.inject(BUMP, args=[i + 1])  # thread-safe, any time
+        time.sleep(0.002)
+    sm.close()  # no more work: the stream drains and returns
+
+
+t = threading.Thread(target=feeder)
+t.start()
+iv, sinfo = sm.run_stream(seed)
+t.join()
+assert int(iv[0]) == 1000 + 20 * 21 // 2, iv[0]
+print(f"streaming: {sinfo['executed']} tasks total, "
+      f"{sinfo['injected']} injected while the scheduler ran")
+
+print("lesson 7 OK")
