@@ -45,7 +45,7 @@ def main() -> int:
     assert int(s2) == sum(range(1, n + 1))
 
     # symmetric heap: put (one-sided write), fence, get (one-sided read)
-    w.alloc("buf", (16,), np.int32)
+    w.alloc("buf", (4 * n + 4,), np.int32)
     w.put(peer, "buf", np.full(4, 100 + pid, np.int32), offset=4 * pid)
     w.fence(peer)
     w.barrier()  # both fences done -> every put applied everywhere
@@ -56,15 +56,58 @@ def main() -> int:
     remote = w.get(peer, "buf", offset=4 * pid, size=4)
     assert (remote == 100 + pid).all(), remote
 
-    # active message: remote increments its own heap cell
+    # active message: remote increments its own heap cell. Register BEFORE
+    # any rank can send (the engine also tolerates a short registration
+    # race, but SPMD discipline is register-then-communicate).
     def bump(world, arr, slot=0):
         world.heap("buf")[slot] += int(arr[0])
 
     w.register_handler("bump", bump)
-    w.am(peer, "bump", np.array([5 + pid]), slot=15)
+    w.barrier()
+    w.am(peer, "bump", np.array([5 + pid]), slot=4 * n)
     w.fence(peer)
     w.barrier()
-    assert int(w.heap("buf")[15]) == 5 + src, w.heap("buf")[15]
+    assert int(w.heap("buf")[4 * n]) == 5 + src, w.heap("buf")[4 * n]
+
+    # bulk allreduce: payloads over BULK_THRESHOLD ride XLA collectives
+    # over the global device runtime (parallel/multihost.bulk_allreduce)
+    big = np.full((1 << 15,), pid + 1, np.float32)  # 128 KiB
+    s3 = w.allreduce(big)
+    assert (s3 == sum(range(1, n + 1))).all(), s3[:4]
+    assert w.last_allreduce_path == "bulk", w.last_allreduce_path
+    small = w.allreduce(np.int32(1))
+    assert int(small) == n and w.last_allreduce_path == "kv"
+
+    # --- module integration: ProcWorld ops as COMM-locale tasks returning
+    # futures that hclib tasks await (the reference's hclib_mpi.cpp:130-210
+    # Isend/Irecv + pending-op polling shape) ---
+    import hclib_tpu as hc
+    from hclib_tpu.modules.procworld import ProcWorldModule
+
+    w.alloc("mbuf", (2 * n,), np.int32)
+    mod = ProcWorldModule(world=w)
+    hc.register_module(mod)
+
+    def body():
+        out = {}
+        sf = mod.isend(peer, np.arange(6, dtype=np.int64) + 7 * pid, tag=21)
+        rf = mod.irecv(src, tag=21)
+        pf = mod.iput(peer, "mbuf", np.full(2, 50 + pid, np.int32),
+                      offset=2 * pid)
+        ff = mod.ifence(peer)
+        gf = mod.iget(w.rank, "mbuf", offset=0, size=2)
+
+        def consume():
+            out["msg"] = rf.get()  # this task ran gated on a comm future
+
+        hc.async_(consume, await_=[rf])
+        mod.wait_all(sf, pf, ff, gf)
+        return out
+
+    out = hc.launch(body, nworkers=2)
+    assert (out["msg"] == np.arange(6) + 7 * src).all(), out["msg"]
+    w.barrier()  # every rank's iput fenced -> heap slice visible
+    assert (w.heap("mbuf")[2 * src : 2 * src + 2] == 50 + src).all()
 
     w.quiet()
     w.barrier()

@@ -20,22 +20,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_world():
+def _run_world(n: int, timeout: int = 180) -> None:
     port = str(_free_port())
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), "2", port],
+            [sys.executable, WORKER, str(pid), str(n), port],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in range(2)
+        for pid in range(n)
     ]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=120)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -44,3 +44,14 @@ def test_two_process_world():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {pid} failed:\n{out}"
         assert f"rank {pid}: OK" in out, out
+
+
+def test_two_process_world():
+    _run_world(2)
+
+
+def test_four_process_world():
+    """Non-trivial fan-out: recursive-doubling allreduce (4 = full
+    doubling), bulk collective bridge, and the module layer, across 4 real
+    processes."""
+    _run_world(4)

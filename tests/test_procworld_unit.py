@@ -1,0 +1,261 @@
+"""Single-process ProcWorld engine tests over a fake coordination client.
+
+The progress engine's failure taxonomy (transient retry, fatal death with
+reply poisoning + tombstones) can't be driven from the integration tests -
+you can't make the real coordination service fail on cue. The fake client
+runs every rank as a thread over one shared dict and injects errors by
+status code. (The reference's comm modules have no equivalent seam: their
+failure behavior is abort-only and untested, SURVEY §5.)
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hclib_tpu.modules.procworld import (
+    ProcWorld,
+    ProcWorldError,
+    _status,
+)
+
+
+class FakeClient:
+    """In-process stand-in for jaxlib's coordination-service client.
+
+    Mimics the observed API surface: absent keys raise NOT_FOUND-prefixed
+    errors; ``fail`` (op_name, key) -> Exception lets tests inject faults.
+    """
+
+    def __init__(self, world_size: int = 1):
+        self._kv = {}
+        self._ctr = {}
+        self._cv = threading.Condition()
+        self._barriers = {}
+        self.world_size = world_size
+        self.fail = None
+
+    def _maybe_fail(self, op, key):
+        if self.fail is not None:
+            e = self.fail(op, key)
+            if e is not None:
+                raise e
+
+    def key_value_set_bytes(self, key, val):
+        self._maybe_fail("set", key)
+        with self._cv:
+            self._kv[key] = bytes(val) if not isinstance(val, bytes) else val
+            self._cv.notify_all()
+
+    def key_value_try_get_bytes(self, key):
+        self._maybe_fail("try_get", key)
+        with self._cv:
+            if key in self._kv:
+                return self._kv[key]
+        raise RuntimeError(f"NOT_FOUND: key {key} not found")
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        self._maybe_fail("blocking_get", key)
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        f"DEADLINE_EXCEEDED: GetKeyValue() timed out "
+                        f"with key: {key}"
+                    )
+                self._cv.wait(left)
+            return self._kv[key]
+
+    def key_value_delete(self, key):
+        with self._cv:
+            self._kv.pop(key, None)
+
+    def key_value_increment(self, key, n):
+        self._maybe_fail("increment", key)
+        with self._cv:
+            self._ctr[key] = self._ctr.get(key, 0) + n
+            return self._ctr[key]
+
+    def wait_at_barrier(self, bid, timeout_ms, *a, **k):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            self._barriers[bid] = self._barriers.get(bid, 0) + 1
+            self._cv.notify_all()
+            while self._barriers[bid] < self.world_size:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(f"DEADLINE_EXCEEDED: Barrier {bid}")
+                self._cv.wait(left)
+
+
+def _world(client, rank, size, **kw):
+    kw.setdefault("timeout_s", 5.0)
+    return ProcWorld(_client=client, _rank=rank, _size=size, **kw)
+
+
+def test_status_classification_is_by_leading_token():
+    assert _status(RuntimeError("NOT_FOUND: key x")) == "NOT_FOUND"
+    assert _status(RuntimeError("UNAVAILABLE: conn refused")) == "UNAVAILABLE"
+    # 'NOT_FOUND' *inside* a message must not classify as NOT_FOUND - the
+    # round-2 substring test turned UNAVAILABLE errors into silent death.
+    assert _status(
+        RuntimeError("INTERNAL: handler for NOT_FOUND missing")
+    ) == "INTERNAL"
+    assert _status(RuntimeError("weird free-text error")) == "UNKNOWN"
+
+
+def test_basic_ops_over_fake_client():
+    c = FakeClient(world_size=2)
+    a, b = _world(c, 0, 2), _world(c, 1, 2)
+    try:
+        a.send(1, np.arange(3), tag=4)
+        assert (b.recv(0, tag=4) == np.arange(3)).all()
+        for w in (a, b):
+            with w._heap_lock:
+                w._heap["x"] = np.zeros(4, np.int32)
+        a.put(1, "x", np.array([7, 8]), offset=1)
+        a.fence(1)
+        assert (b.heap("x") == [0, 7, 8, 0]).all()
+        assert (a.get(1, "x", offset=1, size=2) == [7, 8]).all()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_allreduce_recursive_doubling_all_sizes():
+    """Exact allreduce for power-of-two and ragged world sizes (the
+    pre/post folding steps), every supported op."""
+    for n in (2, 3, 4, 5):
+        c = FakeClient(world_size=n)
+        worlds = [_world(c, r, n) for r in range(n)]
+        results = [None] * n
+
+        def run(r):
+            w = worlds[r]
+            results[r] = (
+                w.allreduce(np.arange(4, dtype=np.int64) + r),
+                w.allreduce(np.float64(r), op="max"),
+                w.allreduce(np.int32(r + 1), op="prod"),
+            )
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(n)]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        expect_sum = np.arange(4) * n + sum(range(n))
+        prod = int(np.prod(np.arange(1, n + 1)))
+        for r in range(n):
+            s, m, p = results[r]
+            assert (s == expect_sum).all(), (n, r, s)
+            assert float(m) == n - 1
+            assert int(p) == prod
+        for w in worlds:
+            w.close()
+
+
+def test_engine_retries_transient_errors():
+    """UNAVAILABLE during the poll must not kill the engine (round 2's
+    deterministic tutorial-08 failure): it backs off, retries, and applies
+    the op once the service recovers."""
+    c = FakeClient(world_size=2)
+    flaky = {"n": 0}
+
+    def fail(op, key):
+        if op == "try_get" and "/op/1/" in key and flaky["n"] < 3:
+            flaky["n"] += 1
+            return RuntimeError("UNAVAILABLE: failed to connect")
+        return None
+
+    a, b = _world(c, 0, 2), _world(c, 1, 2)
+    c.fail = fail
+    try:
+        with b._heap_lock:
+            b._heap["x"] = np.zeros(2, np.int32)
+        a.put(1, "x", np.array([5]), offset=0)
+        deadline = time.monotonic() + 5
+        while int(b.heap("x")[0]) != 5:
+            assert time.monotonic() < deadline, "put never applied"
+            time.sleep(0.01)
+        assert flaky["n"] == 3  # the transient path was actually exercised
+        assert b.dead is None
+    finally:
+        c.fail = None
+        a.close()
+        b.close()
+
+
+def test_fatal_error_poisons_pending_replies_and_tombstones():
+    """A dying engine must fail peers fast: poison queued reply keys and
+    publish a tombstone - not strand them until DEADLINE_EXCEEDED."""
+    c = FakeClient(world_size=2)
+    a, b = _world(c, 0, 2), _world(c, 1, 2)
+    try:
+        with b._heap_lock:
+            b._heap["x"] = np.zeros(2, np.int32)
+        # Stop b's engine from seeing ops, then post a get that will queue.
+        c.fail = lambda op, key: (
+            RuntimeError("INVALID_ARGUMENT: boom")
+            if op == "try_get" and "/op/1/" in key
+            else None
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ProcWorldError):
+            a.get(1, "x")
+        # Fail-fast: poisoned reply or tombstone, not a 5 s timeout.
+        assert time.monotonic() - t0 < 4.0
+        deadline = time.monotonic() + 2
+        while b.dead is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.dead is not None
+        # New ops on the dead world raise immediately.
+        with pytest.raises(ProcWorldError):
+            b.send(0, np.int32(1))
+    finally:
+        c.fail = None
+        a.close()
+        b.close()
+
+
+def test_module_future_fails_fast_on_dead_peer():
+    """A ProcWorldModule future must poison (not pend forever) when the
+    target rank's engine is tombstoned - same failure model as the
+    blocking API - and roll back the receive-sequence claim."""
+    import hclib_tpu as hc
+    from hclib_tpu.modules.procworld import ProcWorldModule
+    from hclib_tpu.runtime.promise import PromiseError
+
+    c = FakeClient(world_size=2)
+    a = _world(c, 0, 2, timeout_s=3.0)
+    try:
+        mod = ProcWorldModule(world=a)
+        hc.register_module(mod)
+        c.key_value_set_bytes("hcpw/dead/1", b"INTERNAL: dead peer")
+
+        def body():
+            rf = mod.irecv(1, tag=3)
+            t0 = time.monotonic()
+            with pytest.raises(PromiseError):
+                rf.wait()
+            assert time.monotonic() - t0 < 2.5  # tombstone, not timeout
+
+        hc.launch(body, nworkers=2)
+        assert a._recv_seq.get((1, 3), 0) == 0  # claim rolled back
+    finally:
+        a.close()
+
+
+def test_await_reply_fails_fast_on_peer_tombstone():
+    """Even when the reply was queued before the peer died (so it never
+    got poisoned), the waiter sees the tombstone at its next poll chunk."""
+    c = FakeClient(world_size=2)
+    a = _world(c, 0, 2, timeout_s=6.0)
+    try:
+        c.key_value_set_bytes("hcpw/dead/1", b"INTERNAL: dead peer")
+        t0 = time.monotonic()
+        with pytest.raises(ProcWorldError, match="progress engine died"):
+            a._await_reply("hcpw/re/0/999", 1)
+        assert time.monotonic() - t0 < 4.0  # one chunk, not the timeout
+    finally:
+        a.close()

@@ -46,7 +46,8 @@ WORKER = textwrap.dedent("""
     from hclib_tpu.modules.procworld import ProcWorld
     w = ProcWorld(timeout_s=30.0)
     w.alloc("cell", (2,), np.int32)
-    w.put(1 - pid, "cell", np.array([10 + pid]), offset=pid)  # one-sided write
+    for r in range(2):  # one-sided write of my slot into EVERY rank's cell
+        w.put(r, "cell", np.array([10 + pid]), offset=pid)
     w.fence(1 - pid)
     w.barrier()
     total = w.allreduce(np.int32(w.heap("cell").sum()))
@@ -59,7 +60,13 @@ WORKER = textwrap.dedent("""
 with socket.socket() as s:
     s.bind(("localhost", 0))
     port = str(s.getsockname()[1])
-env = dict(os.environ, JAX_PLATFORMS="cpu")
+# The ranks are CPU-only coordination processes: pin PYTHONPATH to the repo
+# so no site hook (e.g. a TPU-tunnel PJRT plugin injected via the parent's
+# PYTHONPATH) initializes accelerator state in every rank - two ranks
+# fighting over one tunneled chip wedges the coordination service. The
+# engine also tolerates transient service errors (see
+# tests/test_procworld_unit.py), but a demo should not rely on retries.
+env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
 env.pop("XLA_FLAGS", None)
 procs = [
     subprocess.Popen([sys.executable, "-c", WORKER, str(pid), port], env=env)
