@@ -411,13 +411,17 @@ class Megakernel:
         counts_in,
         ivalues_in,
         stage_all_values: bool,
+        ctx_hook: Optional[Callable[["KernelContext"], None]] = None,
     ):
         """Builds the scheduler core closures over a concrete set of refs:
         ``stage()`` (copy host state into the mutable windows), and
         ``sched(fuel)`` (pop/dispatch/complete until the ready ring drains
         or ``fuel`` tasks have run since this call). Used by this class's
         own kernel body and by kernels that embed the scheduler next to
-        other phases (the in-kernel ICI steal runner, device/ici_steal.py).
+        other phases (the in-kernel ICI steal runner, device/ici_steal.py;
+        the one-sided PGAS runner, device/pgas_kernel.py - whose
+        ``ctx_hook`` attaches its put/am/wait-until ops to each task's
+        KernelContext before dispatch).
         """
         capacity = self.capacity
 
@@ -535,6 +539,8 @@ class Megakernel:
                 capacity, free, self.num_values, vfree,
                 self.uses_row_values,
             )
+            if ctx_hook is not None:
+                ctx_hook(ctx)
             branches = [functools.partial(fn, ctx) for fn in self.kernel_fns]
             jax.lax.switch(tasks[idx, F_FN], branches)
             complete(idx)
@@ -588,13 +594,16 @@ class Megakernel:
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
 
-        def install_descriptor(read_word) -> None:
+        def install_descriptor(read_word):
             """Adopt one externally-produced descriptor row (a stolen row
             arriving over ICI, an injected stream row): allocate a row
             through the same path spawns use (freed rows first, then the
             bump cursor), copy the ABI words via ``read_word(w)``, count it
             pending, and push it ready only when its dep counter is zero -
-            a dependent row waits for its predecessors like any other."""
+            a dependent row waits for its predecessors like any other.
+            Returns the row index; on table overflow it is the clamped
+            fallback row, so callers must gate any use of it on the
+            overflow flag staying clear."""
             nf = free[0]
             use_free = nf > 0
             row_free = free[jnp.maximum(nf, 1)]
@@ -623,6 +632,8 @@ class Megakernel:
             @pl.when(jnp.logical_not(ok))
             def _():
                 counts[C_OVERFLOW] = 1
+
+            return row
 
         return types.SimpleNamespace(
             stage=stage, sched=sched, push_ready=push_ready,

@@ -85,14 +85,23 @@ def execute_partitions(
     data: Optional[Dict[str, np.ndarray]],
     ivalues: Optional[np.ndarray],
     with_rounds: bool,
+    mutate=None,
+    extra_inputs: Sequence[np.ndarray] = (),
 ):
     """Shared host-side driver for the multi-device runners: partition the
     builders, widen per-device value allocs over presets, validate data
     keys, device_put everything sharded on the mesh axis, invoke, and
     unpack (ivalues, data, info). Raising on overflow/stall is left to the
-    caller (the runners word their diagnostics differently)."""
+    caller (the runners word their diagnostics differently).
+
+    ``mutate(tasks, succ, ring, counts)`` lets a runner adjust the
+    partitioned arrays in place before upload (e.g. the PGAS runner's
+    wait-dependency bumps); ``extra_inputs`` are device_put after the data
+    buffers (same leading device axis)."""
     axis = mesh.axis_names[0]
     tasks, succ, ring, counts = partition_builders(mk, ndev, builders)
+    if mutate is not None:
+        mutate(tasks, succ, ring, counts)
     if ivalues is None:
         ivalues = np.zeros((ndev, mk.num_values), np.int32)
     else:
@@ -111,6 +120,7 @@ def execute_partitions(
     outs = jitted(
         put(tasks), put(succ), put(ring), put(counts), put(ivalues),
         *[put(data[k]) for k in mk.data_specs.keys()],
+        *[put(x) for x in extra_inputs],
     )
     counts_o, iv_o, gcounts = outs[0], outs[1], outs[2]
     data_o = dict(zip(mk.data_specs.keys(), outs[3:]))
