@@ -7,29 +7,38 @@ host-jitted ``ppermute``): here each device's kernel runs rounds internally,
 
   1. drain the local ready ring for a bounded quantum
      (megakernel._make_core's scheduler - the same pop/dispatch/complete),
-  2. ring-allreduce (pending, backlog) over the ICI with
-     ``pltpu.make_async_remote_copy`` - the termination collective,
-  3. exit when global pending hits zero, else
-  4. exchange surplus descriptor rows with the device at hop distance
-     1, 2, 4, ... (cycling per round - hypercube diffusion) by remote-DMAing
-     the rows straight between SMEM task tables.
+  2. for every XOR dimension k < log2(ndev): a paired stats exchange with
+     the partner at distance 2^k folds (pending, backlog) partial sums -
+     recursive-doubling termination in log2(ndev) hops - then a paired
+     row exchange pairwise-equalizes backlog (send (mine - theirs)/2,
+     window-capped) by remote-DMAing descriptor rows straight between SMEM
+     task tables, importing before the next hop so received work diffuses
+     further the same round,
+  3. exit when the folded global pending hits zero.
+
+(Non-power-of-two 1D meshes keep the older schedule: a ring allreduce for
+termination plus one cycling partner per round.)
 
 The reference analogue is the thief CASing a victim's deque slot from
 another core (src/hclib-locality-graph.c:843-888, src/hclib-deque.c:75-106);
 on TPU the "CAS" becomes paired remote DMAs with semaphore flow control:
 
-- every data channel (stats, rows) is 1-deep double-ended: the receiver
-  signals a REGULAR *credit* semaphore to the device that will target its
-  inbox next round, and a sender remote-writes only after taking a credit -
+- every (hop, sub-channel) inbox is 1-deep with a fixed writer: the
+  receiver signals that writer's REGULAR *credit* semaphore after
+  consuming, and the writer waits a credit before its next-round write -
   so an inbox is never overwritten before it is consumed, without any
   global barrier;
-- all devices execute the identical round schedule, so every semaphore wait
+- recv DMA semaphores are per-hop: a device two hops ahead may deliver
+  early, and a shared recv semaphore would hand its signal to a wait for a
+  different hop's message (desynchronizing the pairing);
+- all devices execute the identical hop schedule, so every semaphore wait
   has a matching signal by construction (lockstep SPMD, no dynamic
   handshakes to deadlock on).
 
-Tested end-to-end on an 8-device simulated mesh via Mosaic's TPU interpret
-mode (``pltpu.InterpretParams`` - simulates remote DMA + semaphores on CPU)
-and compiled/run on real TPU hardware on a 1-device mesh (self-loop ring).
+Tested end-to-end on 8-device 1D and 4x2 2D simulated meshes via Mosaic's
+TPU interpret mode (``pltpu.InterpretParams`` - simulates remote DMA +
+semaphores on CPU) and compiled/run on real TPU hardware on a 1-device mesh
+(self-loop exchange).
 """
 
 from __future__ import annotations
@@ -69,12 +78,26 @@ __all__ = ["ICIStealMegakernel"]
 
 
 class ICIStealMegakernel:
-    """Runs one resident scheduler+steal kernel per device of a 1D mesh.
+    """Runs one resident scheduler+steal kernel per device of a 1D or 2D
+    mesh.
 
     ``mk`` supplies the kernel table/capacities (as for ShardedMegakernel);
     ``migratable_fns`` whitelists kernel ids whose successor-free tasks may
     migrate; ``window`` bounds rows per exchange; ``scan`` bounds how far
     past the ring head the exporter looks for eligible rows.
+
+    Power-of-two device counts (the practical case: TPU slices come in
+    pof2 per-axis shapes) use the **paired hypercube dimension-exchange**:
+    every round runs ALL log2(ndev) XOR-partner hops, each hop pairwise-
+    equalizing backlog (send (mine - theirs)/2, capped at ``window``) and
+    folding (pending, backlog) partial sums into the same hop schedule -
+    recursive-doubling termination in log2(ndev) hops with no separate
+    ring collective, and a maximal skew spreads across the whole mesh in
+    one or two rounds instead of one window per round. On a 2D mesh the
+    XOR dimensions decompose into per-axis exchanges (low bits = minor
+    axis), so every hop is a torus-neighbor-distance transfer. Non-pof2
+    1D meshes keep the cycling single-partner schedule with the ring
+    termination collective.
     """
 
     def __init__(
@@ -85,16 +108,48 @@ class ICIStealMegakernel:
         window: int = 8,
         scan: Optional[int] = None,
     ) -> None:
-        if len(mesh.axis_names) != 1:
-            raise ValueError("ICIStealMegakernel wants a 1D mesh")
+        if len(mesh.axis_names) not in (1, 2):
+            raise ValueError("ICIStealMegakernel wants a 1D or 2D mesh")
         self.mk = mk
         self.mesh = mesh
-        self.axis = mesh.axis_names[0]
-        self.ndev = int(np.prod(mesh.devices.shape))
+        self.axes = tuple(mesh.axis_names)
+        self.axis = self.axes[0]  # psum axis for gcounts (legacy name)
+        self.dims = tuple(int(d) for d in mesh.devices.shape)
+        self.ndev = int(np.prod(self.dims))
+        self._pof2 = self.ndev & (self.ndev - 1) == 0
+        if len(self.axes) == 2 and not self._pof2:
+            raise ValueError("2D meshes need power-of-two device counts")
         self.migratable_fns = frozenset(int(f) for f in migratable_fns)
         self.window = int(window)
         self.scan = int(scan) if scan is not None else 2 * self.window
         self._jitted: Dict[Any, Any] = {}
+
+    # -- shared kernel helpers --
+
+    def _flat_me(self):
+        """Flattened device index (row-major over mesh axes)."""
+        if len(self.axes) == 1:
+            return jax.lax.axis_index(self.axes[0])
+        return (
+            jax.lax.axis_index(self.axes[0]) * self.dims[1]
+            + jax.lax.axis_index(self.axes[1])
+        )
+
+    def _did(self, flat):
+        """Remote-op device_id for a flattened index: the logical id on a
+        1D mesh (DeviceIdType.LOGICAL), the per-axis coordinate tuple on a
+        2D mesh (DeviceIdType.MESH - LOGICAL rejects tuples)."""
+        if len(self.axes) == 1:
+            return flat
+        return (flat // self.dims[1], flat % self.dims[1])
+
+    @property
+    def _did_type(self):
+        return (
+            pltpu.DeviceIdType.LOGICAL
+            if len(self.axes) == 1
+            else pltpu.DeviceIdType.MESH
+        )
 
     # -- the kernel --
 
@@ -326,6 +381,202 @@ class ICIStealMegakernel:
             def _():
                 pltpu.semaphore_wait(csems.at[0], 1)
 
+    def _kernel_hc(self, quantum: int, max_rounds: int, *refs) -> None:
+        """Paired hypercube dimension-exchange body (pof2 device counts).
+
+        Each round: drain the local ring for a quantum, then for every XOR
+        dimension k: (1) paired stats exchange folding (pending, backlog)
+        partial sums - recursive-doubling termination - and carrying the
+        partner's current backlog, (2) paired row exchange sending
+        clip((mine - theirs)/2, 0, W) eligible rows, importing the mirror
+        flow immediately so later hops diffuse just-received work further.
+        Every (hop, sub-channel) has its own inbox buffer and credit
+        semaphore: the writer for a given hop never changes, so a 1-deep
+        credited channel per hop is race-free without any global barrier.
+        """
+        mk = self.mk
+        ndata = len(mk.data_specs)
+        n_in = 5 + ndata
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + 4 + ndata]
+        rest = refs[n_in + 4 + ndata :]
+        nscratch = len(mk.scratch_specs)
+        scratch_refs = rest[:nscratch]
+        nh = self._nh
+        tail = rest[nscratch:]
+        free, vfree, candbuf, sendbuf, statsnd = tail[:5]
+        statrcv = tail[5 : 5 + nh]
+        inboxes = tail[5 + nh : 5 + 2 * nh]
+        ssems, rsems, csems = tail[5 + 2 * nh :]
+        tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
+        tasks, ready, counts, ivalues = out_refs[:4]
+        data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
+        scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
+        core = mk._make_core(
+            succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
+            tasks_in, ready_in, counts_in, ivalues_in, True,
+        )
+
+        ndev = self.ndev
+        cap = mk.capacity
+        W = self.window
+        SCAN = self.scan
+        wl = sorted(self.migratable_fns)
+        me = self._flat_me()
+        did_type = self._did_type
+
+        def remote_copy(src, dst, dev, s_send, s_recv):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=src, dst_ref=dst, send_sem=s_send, recv_sem=s_recv,
+                device_id=dev, device_id_type=did_type,
+            )
+            rdma.start()
+            rdma.wait()
+
+        def export(quota):
+            head = counts[C_HEAD]
+            backlog = counts[C_TAIL] - head
+            S = jnp.minimum(backlog, SCAN)
+
+            def copy_cand(j, _):
+                candbuf[j] = ready[(head + j) % cap]
+                return 0
+
+            jax.lax.fori_loop(0, S, copy_cand, 0)
+
+            def elig_of(cand):
+                d_fn = tasks[cand, F_FN]
+                ok = jnp.bool_(False)
+                for f in wl:
+                    ok = ok | (d_fn == f)
+                return (
+                    ok
+                    & (tasks[cand, F_SUCC0] == -1)
+                    & (tasks[cand, F_SUCC1] == -1)
+                    & (tasks[cand, F_CSR_N] == 0)
+                )
+
+            def count_elig(j, n):
+                return n + elig_of(candbuf[j]).astype(jnp.int32)
+
+            nelig = jax.lax.fori_loop(0, S, count_elig, jnp.int32(0))
+            nsend = jnp.minimum(quota, nelig)
+
+            def classify(j, carry):
+                se, kp = carry
+                cand = candbuf[j]
+                take = elig_of(cand) & (se < nsend)
+
+                @pl.when(take)
+                def _():
+                    for w in range(DESC_WORDS):
+                        sendbuf[se, w] = tasks[cand, w]
+                    tasks[cand, F_DEP] = -1
+                    nf = free[0] + 1
+                    free[0] = nf
+                    free[nf] = cand
+
+                @pl.when(jnp.logical_not(take))
+                def _():
+                    ready[(head + nsend + kp) % cap] = cand
+
+                return (
+                    se + take.astype(jnp.int32),
+                    kp + (1 - take.astype(jnp.int32)),
+                )
+
+            jax.lax.fori_loop(0, S, classify, (jnp.int32(0), jnp.int32(0)))
+            counts[C_HEAD] = head + nsend
+            counts[C_PENDING] = counts[C_PENDING] - nsend
+            return nsend
+
+        def import_rows(box):
+            n = box[W, 0]
+
+            def one(i, _):
+                core.install_descriptor(lambda w: box[i, w])
+                return 0
+
+            jax.lax.fori_loop(0, n, one, 0)
+
+        core.stage()
+
+        def cond(carry):
+            r, done = carry
+            return jnp.logical_not(done) & (r < max_rounds)
+
+        def body(carry):
+            r, done = carry
+            core.sched(quantum)
+            # Round-start snapshot: every task is either in some device's
+            # pending count or was already executed - nothing is in flight
+            # between rounds, so the folded sums are exact.
+            tot_p = counts[C_PENDING]
+            for k in range(nh):
+                partner = (me ^ (1 << k)) % ndev  # ndev==1: self-loop
+                pdev = self._did(partner)
+                statsnd[0] = tot_p
+                statsnd[1] = counts[C_TAIL] - counts[C_HEAD]
+
+                @pl.when(r > 0)
+                def _(k=k):
+                    pltpu.semaphore_wait(csems.at[2 * k], 1)
+
+                # Per-hop recv semaphores: a faster device two hops ahead
+                # may deliver its hop-k' message while we still wait at
+                # hop k - a shared recv sem would hand us its signal and
+                # desynchronize the pairing (observed as a deadlock).
+                remote_copy(
+                    statsnd, statrcv[k], pdev, ssems.at[0], rsems.at[2 * k]
+                )
+                tot_p = tot_p + statrcv[k][0]
+                peer_b = statrcv[k][1]
+                pltpu.semaphore_signal(
+                    csems.at[2 * k], inc=1, device_id=pdev,
+                    device_id_type=did_type,
+                )
+                myb = counts[C_TAIL] - counts[C_HEAD]
+                quota = jnp.clip((myb - peer_b + 1) // 2, 0, W)
+                # Zero quota (balanced or deficit side - the steady state)
+                # skips the whole export scan/compact pass.
+                sendbuf[W, 0] = 0
+
+                @pl.when(quota > 0)
+                def _():
+                    sendbuf[W, 0] = export(quota)
+
+                @pl.when(r > 0)
+                def _(k=k):
+                    pltpu.semaphore_wait(csems.at[2 * k + 1], 1)
+
+                remote_copy(
+                    sendbuf, inboxes[k], pdev, ssems.at[1],
+                    rsems.at[2 * k + 1],
+                )
+                import_rows(inboxes[k])
+                pltpu.semaphore_signal(
+                    csems.at[2 * k + 1], inc=1, device_id=pdev,
+                    device_id_type=did_type,
+                )
+            return r + 1, tot_p == 0
+
+        r, done = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.bool_(False))
+        )
+        counts[C_ROUNDS] = r
+        # Every executed round ran every hop and its first send never
+        # waited, so each of the 2*nh credit channels holds exactly one
+        # unconsumed credit once any round ran.
+        for k in range(2 * self._nh):
+
+            @pl.when(r >= 1)
+            def _(k=k):
+                pltpu.semaphore_wait(csems.at[k], 1)
+
+    @property
+    def _nh(self) -> int:
+        return max(1, (self.ndev - 1).bit_length())
+
     # -- host entry --
 
     def _build(self, quantum: int, max_rounds: int):
@@ -354,23 +605,43 @@ class ICIStealMegakernel:
         from .megakernel import VBLOCK
 
         W = self.window
-        kern = pl.pallas_call(
-            functools.partial(self._kernel, quantum, max_rounds),
-            out_shape=out_shape,
-            in_specs=in_specs,
-            out_specs=out_specs,
-            scratch_shapes=list(mk.scratch_specs.values())
-            + [
-                pltpu.SMEM((mk.capacity + 1,), jnp.int32),  # free
-                pltpu.SMEM((mk.num_values // VBLOCK + 1,), jnp.int32),
-                pltpu.SMEM((self.scan,), jnp.int32),  # candbuf
-                pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32),  # sendbuf
+        base_scratch = list(mk.scratch_specs.values()) + [
+            pltpu.SMEM((mk.capacity + 1,), jnp.int32),  # free
+            pltpu.SMEM((mk.num_values // VBLOCK + 1,), jnp.int32),
+            pltpu.SMEM((self.scan,), jnp.int32),  # candbuf
+            pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32),  # sendbuf
+        ]
+        if self._pof2:
+            nh = self._nh
+            body = self._kernel_hc
+            scratch = base_scratch + (
+                [pltpu.SMEM((4,), jnp.int32)]  # statsnd
+                + [pltpu.SMEM((4,), jnp.int32) for _ in range(nh)]
+                + [
+                    pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32)
+                    for _ in range(nh)
+                ]  # per-hop inboxes (fixed writer each -> own channel)
+                + [
+                    pltpu.SemaphoreType.DMA((2,)),  # send sems (stat, rows)
+                    pltpu.SemaphoreType.DMA((2 * nh,)),  # per-hop recv sems
+                    pltpu.SemaphoreType.REGULAR((2 * nh,)),
+                ]
+            )
+        else:
+            body = self._kernel
+            scratch = base_scratch + [
                 pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32),  # inbox
                 pltpu.SMEM((2,), jnp.int32),  # statsnd
                 pltpu.SMEM((2,), jnp.int32),  # statrcv
                 pltpu.SemaphoreType.DMA((4,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
-            ],
+            ]
+        kern = pl.pallas_call(
+            functools.partial(body, quantum, max_rounds),
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
             input_output_aliases=aliases,
             interpret=pltpu.InterpretParams() if mk.interpret else False,
         )
@@ -382,7 +653,7 @@ class ICIStealMegakernel:
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
             data_o = outs[4:]
-            gcounts = jax.lax.psum(counts_o, self.axis)
+            gcounts = jax.lax.psum(counts_o, self.axes)
             return (
                 counts_o[None],
                 iv_o[None],
@@ -394,8 +665,8 @@ class ICIStealMegakernel:
         f = jax.shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(P(self.axis),) * nin,
-            out_specs=(P(self.axis),) * (3 + ndata),
+            in_specs=(P(self.axes),) * nin,
+            out_specs=(P(self.axes),) * (3 + ndata),
             check_vma=False,
         )
         return jax.jit(f)

@@ -594,16 +594,13 @@ class Megakernel:
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
 
-        def install_descriptor(read_word):
+        def install_descriptor(read_word) -> None:
             """Adopt one externally-produced descriptor row (a stolen row
             arriving over ICI, an injected stream row): allocate a row
             through the same path spawns use (freed rows first, then the
             bump cursor), copy the ABI words via ``read_word(w)``, count it
             pending, and push it ready only when its dep counter is zero -
-            a dependent row waits for its predecessors like any other.
-            Returns the row index; on table overflow it is the clamped
-            fallback row, so callers must gate any use of it on the
-            overflow flag staying clear."""
+            a dependent row waits for its predecessors like any other."""
             nf = free[0]
             use_free = nf > 0
             row_free = free[jnp.maximum(nf, 1)]
@@ -632,8 +629,6 @@ class Megakernel:
             @pl.when(jnp.logical_not(ok))
             def _():
                 counts[C_OVERFLOW] = 1
-
-            return row
 
         return types.SimpleNamespace(
             stage=stage, sched=sched, push_ready=push_ready,

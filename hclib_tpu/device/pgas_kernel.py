@@ -206,12 +206,14 @@ class PGASMegakernel:
             rdma.wait_send()
             data_sent[dev, chan] = data_sent[dev, chan] + 1
 
-        def op_am(dev, fn: int, args: Sequence = (), out=0, dep=0) -> None:
+        def op_am(dev, fn: int, args: Sequence = (), out=0) -> None:
             """Queue a task descriptor for device ``dev``'s scheduler (the
             reference's async_remote at a chosen PE). Non-blocking: the
             round loop launches it under the inbox-window cap; a full
             outbox sets the overflow flag (bounded, like every queue
             here)."""
+            if len(args) > 6:
+                raise ValueError(f"at most 6 args per AM, got {len(args)}")
             h = obctl[1]
             ok = h - obctl[0] < OUTQ
             slot = h % OUTQ
@@ -220,7 +222,7 @@ class PGASMegakernel:
             def _():
                 outq_tgt[slot] = dev
                 outq_desc[slot, F_FN] = jnp.int32(fn)
-                outq_desc[slot, F_DEP] = jnp.int32(dep)
+                outq_desc[slot, F_DEP] = 0
                 outq_desc[slot, F_SUCC0] = jnp.int32(NO_TASK)
                 outq_desc[slot, F_SUCC1] = jnp.int32(NO_TASK)
                 outq_desc[slot, F_CSR_OFF] = 0

@@ -115,7 +115,7 @@ def execute_partitions(
         raise ValueError(
             f"data buffers {sorted(data)} != declared {sorted(mk.data_specs)}"
         )
-    sh = NamedSharding(mesh, P(axis))
+    sh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
     put = lambda x: jax.device_put(np.ascontiguousarray(x), sh)  # noqa: E731
     outs = jitted(
         put(tasks), put(succ), put(ring), put(counts), put(ivalues),

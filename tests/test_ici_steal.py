@@ -133,3 +133,61 @@ def test_ici_steal_compiles_and_runs_on_tpu():
     iv, _, info = smk.run(_skewed(1, ntasks), quantum=16)
     assert info["pending"] == 0
     assert int(iv[0, 0]) == ntasks * (ntasks + 1) // 2
+
+
+def test_ici_steal_hypercube_spreads_max_skew_fast():
+    """VERDICT round-2 efficiency target: a 64-task skew on 8 devices
+    spreads across the whole mesh in <= 3 exchange rounds (the paired
+    dimension-exchange moves (mine-theirs)/2 per hop, all hops per round,
+    vs. one fixed window to a single partner per round)."""
+    ndev, ntasks = 8, 64
+    smk = ICIStealMegakernel(
+        _make_mk(), cpu_mesh(ndev, axis_name="queues"),
+        migratable_fns=[BUMP], window=32,
+    )
+    iv, _, info = smk.run(_skewed(ndev, ntasks), quantum=4)
+    assert info["pending"] == 0
+    assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
+    per_dev = info["per_device_counts"][:, 5]
+    assert int((per_dev > 0).sum()) == ndev, per_dev  # EVERY device worked
+    # quantum=4: ~64/(8*4)=2 execution rounds once spread; the spread
+    # itself happens inside round 1's three hops.
+    assert info["steal_rounds"] <= 4, info["steal_rounds"]
+
+
+def test_ici_steal_2d_mesh_exact():
+    """4x2 mesh (VERDICT item 6): the XOR dimension-exchange decomposes
+    into per-axis torus hops; totals must be exact and work must reach
+    both rows and columns."""
+    from hclib_tpu.parallel.mesh import make_mesh
+
+    cpus = jax.devices("cpu")
+    mesh = make_mesh((4, 2), ("r", "c"), cpus[:8])
+    ntasks = 48
+    smk = ICIStealMegakernel(
+        _make_mk(), mesh, migratable_fns=[BUMP], window=8,
+    )
+    builders = [TaskGraphBuilder() for _ in range(8)]
+    for i in range(ntasks):
+        builders[0].add(BUMP, args=[i + 1])
+    iv, _, info = smk.run(builders, quantum=4)
+    assert info["pending"] == 0
+    assert info["executed"] == ntasks
+    assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
+    per_dev = info["per_device_counts"][:, 5]
+    assert int((per_dev > 0).sum()) >= 6, per_dev
+
+
+def test_ici_steal_non_pof2_legacy_ring():
+    """3 devices take the cycling-partner + ring-termination path; totals
+    stay exact."""
+    ndev, ntasks = 3, 30
+    smk = ICIStealMegakernel(
+        _make_mk(), cpu_mesh(ndev, axis_name="queues"),
+        migratable_fns=[BUMP], window=8,
+    )
+    iv, _, info = smk.run(_skewed(ndev, ntasks), quantum=4)
+    assert info["pending"] == 0
+    assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
+    per_dev = info["per_device_counts"][:, 5]
+    assert int((per_dev > 0).sum()) >= 2, per_dev
