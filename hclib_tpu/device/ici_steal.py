@@ -151,92 +151,23 @@ class ICIStealMegakernel:
             else pltpu.DeviceIdType.MESH
         )
 
-    # -- the kernel --
-
-    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
-        mk = self.mk
-        ndata = len(mk.data_specs)
-        n_in = 5 + ndata
-        in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata]
-        rest = refs[n_in + 4 + ndata :]
-        nscratch = len(mk.scratch_specs)
-        scratch_refs = rest[:nscratch]
-        (
-            free, vfree, candbuf, sendbuf, inbox, statsnd, statrcv,
-            dsems, csems,
-        ) = rest[nscratch:]
-        tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
-        tasks, ready, counts, ivalues = out_refs[:4]
-        data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
-        scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
-        # stage_all_values=True: imported tasks may read/accumulate value
-        # slots the local partition never declared (an empty partition has
-        # value_alloc 0 but still hosts migrated counter tasks).
-        core = mk._make_core(
-            succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
-            tasks_in, ready_in, counts_in, ivalues_in, True,
-        )
-
-        ndev = self.ndev
-        cap = mk.capacity
+    def _make_xfer(self, core, tasks, ready, counts, free, candbuf, sendbuf):
+        """Shared transfer closures for both kernel bodies: paired remote
+        copy (device-id type per mesh rank), the export scan/compact pass,
+        and descriptor-row import via the core's adoption path."""
+        cap = self.mk.capacity
         W = self.window
         SCAN = self.scan
-        axis = self.axis
-        # Hop schedule: powers of two below ndev (hypercube diffusion); a
-        # 1-device ring degenerates to hop 0 = self-exchange, which still
-        # exercises the full remote-DMA path (quota is 0 vs oneself).
-        nh = max(1, (ndev - 1).bit_length())
         wl = sorted(self.migratable_fns)
-
-        me = jax.lax.axis_index(axis)
-        right = (me + 1) % ndev
-        left = (me + ndev - 1) % ndev
+        did_type = self._did_type
 
         def remote_copy(src, dst, dev, s_send, s_recv):
             rdma = pltpu.make_async_remote_copy(
-                src_ref=src,
-                dst_ref=dst,
-                send_sem=s_send,
-                recv_sem=s_recv,
-                device_id=dev,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                src_ref=src, dst_ref=dst, send_sem=s_send, recv_sem=s_recv,
+                device_id=dev, device_id_type=did_type,
             )
             rdma.start()
             rdma.wait()
-
-        def allreduce(r):
-            """Ring-allreduce of (pending, backlog): every device learns
-            the global totals in ndev-1 hops (the done-flag join,
-            src/hclib-runtime.c:403-421, as an in-kernel collective)."""
-            cur_p = counts[C_PENDING]
-            cur_b = counts[C_TAIL] - counts[C_HEAD]
-            tot_p, tot_b = cur_p, cur_b
-            for k in range(ndev - 1):
-                statsnd[0] = cur_p
-                statsnd[1] = cur_b
-                if k > 0:
-                    pltpu.semaphore_wait(csems.at[0], 1)
-                else:
-
-                    @pl.when(r > 0)
-                    def _():
-                        pltpu.semaphore_wait(csems.at[0], 1)
-
-                remote_copy(
-                    statsnd, statrcv, right, dsems.at[0], dsems.at[1]
-                )
-                cur_p = statrcv[0]
-                cur_b = statrcv[1]
-                # Consumed: free the writer (our left neighbor) to send its
-                # next step into our statrcv.
-                pltpu.semaphore_signal(
-                    csems.at[0], inc=1, device_id=left,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL,
-                )
-                tot_p = tot_p + cur_p
-                tot_b = tot_b + cur_b
-            return tot_p, tot_b
 
         def export(quota):
             """Scan up to SCAN entries behind the ring head (the cold,
@@ -301,18 +232,95 @@ class ICIStealMegakernel:
             counts[C_PENDING] = counts[C_PENDING] - nsend
             return nsend
 
-        def import_rows():
+        def import_rows(box):
             """Install received descriptors through the shared adoption
             path (core.install_descriptor: freed rows first, then the bump
             cursor; stolen rows came off a ready ring so their dep counter
             is 0 and they go straight back to ready)."""
-            n = inbox[W, 0]
+            n = box[W, 0]
 
             def one(i, _):
-                core.install_descriptor(lambda w: inbox[i, w])
+                core.install_descriptor(lambda w: box[i, w])
                 return 0
 
             jax.lax.fori_loop(0, n, one, 0)
+
+        return remote_copy, export, import_rows
+
+    # -- the kernel --
+
+    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+        mk = self.mk
+        ndata = len(mk.data_specs)
+        n_in = 5 + ndata
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + 4 + ndata]
+        rest = refs[n_in + 4 + ndata :]
+        nscratch = len(mk.scratch_specs)
+        scratch_refs = rest[:nscratch]
+        (
+            free, vfree, candbuf, sendbuf, inbox, statsnd, statrcv,
+            dsems, csems,
+        ) = rest[nscratch:]
+        tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
+        tasks, ready, counts, ivalues = out_refs[:4]
+        data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
+        scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
+        # stage_all_values=True: imported tasks may read/accumulate value
+        # slots the local partition never declared (an empty partition has
+        # value_alloc 0 but still hosts migrated counter tasks).
+        core = mk._make_core(
+            succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
+            tasks_in, ready_in, counts_in, ivalues_in, True,
+        )
+
+        ndev = self.ndev
+        W = self.window
+        axis = self.axis
+        # Hop schedule: powers of two below ndev (hypercube diffusion); a
+        # 1-device ring degenerates to hop 0 = self-exchange, which still
+        # exercises the full remote-DMA path (quota is 0 vs oneself).
+        nh = max(1, (ndev - 1).bit_length())
+
+        me = jax.lax.axis_index(axis)
+        right = (me + 1) % ndev
+        left = (me + ndev - 1) % ndev
+        remote_copy, export, import_rows = self._make_xfer(
+            core, tasks, ready, counts, free, candbuf, sendbuf
+        )
+
+        def allreduce(r):
+            """Ring-allreduce of (pending, backlog): every device learns
+            the global totals in ndev-1 hops (the done-flag join,
+            src/hclib-runtime.c:403-421, as an in-kernel collective)."""
+            cur_p = counts[C_PENDING]
+            cur_b = counts[C_TAIL] - counts[C_HEAD]
+            tot_p, tot_b = cur_p, cur_b
+            for k in range(ndev - 1):
+                statsnd[0] = cur_p
+                statsnd[1] = cur_b
+                if k > 0:
+                    pltpu.semaphore_wait(csems.at[0], 1)
+                else:
+
+                    @pl.when(r > 0)
+                    def _():
+                        pltpu.semaphore_wait(csems.at[0], 1)
+
+                remote_copy(
+                    statsnd, statrcv, right, dsems.at[0], dsems.at[1]
+                )
+                cur_p = statrcv[0]
+                cur_b = statrcv[1]
+                # Consumed: free the writer (our left neighbor) to send its
+                # next step into our statrcv.
+                pltpu.semaphore_signal(
+                    csems.at[0], inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+                tot_p = tot_p + cur_p
+                tot_b = tot_b + cur_b
+            return tot_p, tot_b
 
         def exchange(r, tot_b):
             """One steal hop: send surplus rows to the device at distance
@@ -333,7 +341,7 @@ class ICIStealMegakernel:
                 pltpu.semaphore_wait(csems.at[1], 1)
 
             remote_copy(sendbuf, inbox, target, dsems.at[2], dsems.at[3])
-            import_rows()
+            import_rows(inbox)
             # Our inbox is consumed: credit the device that targets it
             # next round (distance 2^((r+1) mod nh)).
             dn = (jnp.int32(1) << ((r + 1) % nh)) % ndev

@@ -98,7 +98,6 @@ def execute_partitions(
     partitioned arrays in place before upload (e.g. the PGAS runner's
     wait-dependency bumps); ``extra_inputs`` are device_put after the data
     buffers (same leading device axis)."""
-    axis = mesh.axis_names[0]
     tasks, succ, ring, counts = partition_builders(mk, ndev, builders)
     if mutate is not None:
         mutate(tasks, succ, ring, counts)

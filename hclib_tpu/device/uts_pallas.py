@@ -45,7 +45,10 @@ from .uts_vec import (
     LANES,
     _host_seed,
     apply_claim,
+    child_threshold_table,
     child_thresholds,
+    depth_cap,
+    inrow_threshold_table,
     make_traversal,
 )
 
@@ -129,6 +132,8 @@ def _dfs_kernel(
     roots_state_ref,  # ANY (5, Rrows, 128) i32 (u32 bits)
     roots_count_ref,  # ANY (Rrows, 128) i32
     scal_ref,  # SMEM (1,): R (real root count)
+    tab_ref,  # VMEM (K, 128): in-row threshold table ((1,128) dummy when
+    # the shape is depth-independent - kernels cannot capture constants)
     nodes_ref, leaves_ref, maxd_ref,  # VMEM lanes, outputs
     ctl_ref,  # SMEM (2,): steps, unfinished
     wstate, wcount, sems,  # scratch: (5, winrows, 128), (winrows, 128), DMA
@@ -177,8 +182,10 @@ def _dfs_kernel(
         )
         return sp, next_root, st0, ch0, cn0, dp0
 
+    table = thresholds and isinstance(thresholds[0], tuple)
     run = make_traversal(
-        S, lanes, thresholds, gen_mx, min_idle, max_steps, refill, R
+        S, lanes, thresholds, gen_mx, min_idle, max_steps, refill, R,
+        inrow_table=tab_ref[...] if table else None,
     )
     sp, next_root, nodes, leaves, maxd, steps = run()
     nodes_ref[...] = nodes
@@ -199,6 +206,7 @@ def _uts_dfs_pallas(
     roots_state,  # (5, Rrows, 128) i32 (u32 bits), padded + aligned
     roots_count,  # (Rrows, 128) i32
     nroots,  # () i32 - real root count R
+    tab,  # (K, 128) i32 in-row threshold table ((1, 128) dummy for FIXED)
     stack_size: int,
     gen_mx: int,
     d0: int,
@@ -229,6 +237,7 @@ def _uts_dfs_pallas(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=tuple(
             [pl.BlockSpec(memory_space=pltpu.VMEM)] * 3
@@ -240,9 +249,17 @@ def _uts_dfs_pallas(
             pltpu.SemaphoreType.DMA((6,)),
         ],
         interpret=interpret,
+        # Lane state + refill windows + a (K,128) threshold table overflow
+        # the compiler's default 16 MiB scoped-vmem budget at (64,128)
+        # lanes; real VMEM is 128 MiB on v5e.
+        compiler_params=(
+            None
+            if interpret
+            else pltpu.CompilerParams(vmem_limit_bytes=100 * 2**20)
+        ),
     )
     nodes, leaves, maxd, ctl = kernel(
-        roots_state, roots_count, nroots.reshape(1)
+        roots_state, roots_count, nroots.reshape(1), tab
     )
     return (
         # Per-lane planes, not totals: totals are summed on the host in
@@ -264,16 +281,18 @@ def uts_pallas(
     lanes: Tuple[int, int] = LANES,
     min_idle_div: int = 8,
     interpret: Optional[bool] = None,
+    depth_bound: Optional[int] = None,
 ) -> dict:
     """uts_vec with the whole traversal fused into one Pallas kernel; same
-    exact counts, same host seeding, same result dict."""
-    if params.shape != FIXED:
-        raise NotImplementedError(
-            "uts_pallas supports the GEO/FIXED shape (the canonical "
-            "benchmark trees); depth-varying shapes run on uts_vec, whose "
-            "per-depth table gather is XLA-level (Mosaic's gather forms "
-            "do not cover a (depth -> row) table lookup per lane)"
-        )
+    exact counts, same host seeding, same result dict.
+
+    All GEO shapes run fused: FIXED on the depth-independent threshold
+    fast path; LINEAR/CYCLIC (canonical T5/T2) and EXPDEC via the same
+    exact per-depth threshold tables as uts_vec, realized on-core as
+    same-shape ``take_along_axis`` in-row lookups (the one gather form
+    Mosaic supports); the table's depth cap must fit a 128-lane row.
+    EXPDEC's cap comes from ``depth_bound`` (default 8*gen_mx) and the
+    run fails loudly if the tree actually reaches it."""
     if lanes[1] != 128:
         raise ValueError("uts_pallas lanes must be (rows, 128)")
     import time
@@ -307,23 +326,47 @@ def uts_pallas(
     pstate[:, :R] = roots_state.astype(np.int32)
     pcount = np.zeros(rpad, np.int32)
     pcount[:R] = roots_count
+    # Shape -> (thresholds, stack height, depth cap) exactly as uts_vec.
+    derived = depth_cap(params)
+    if derived is None:  # EXPDEC: caller-chosen bound, validated below
+        cap = depth_bound if depth_bound is not None else 8 * params.gen_mx
+        bounded = True
+    elif depth_bound is not None and depth_bound < derived:
+        cap = depth_bound
+        bounded = True
+    else:
+        cap = derived
+        bounded = False
+    if params.shape == FIXED and not bounded:
+        thr = tuple(int(t) for t in child_thresholds(params.b0))
+        stack_size = max(1, params.gen_mx - d0)
+        tabnp = np.zeros((1, cols), np.int32)  # unused dummy input
+    else:
+        table = child_threshold_table(params, cap)
+        thr = tuple(tuple(int(x) for x in row) for row in table)
+        stack_size = max(1, (cap - d0) if bounded else (cap - 1 - d0))
+        tabnp = inrow_threshold_table(thr, cols)
     args = (
         jnp.asarray(pstate.reshape(5, rpad // cols, cols)),
         jnp.asarray(pcount.reshape(rpad // cols, cols)),
         jnp.int32(R),
+        jnp.asarray(tabnp),
     )
     kw = dict(
-        stack_size=max(1, params.gen_mx - d0),
+        stack_size=stack_size,
         gen_mx=params.gen_mx,
         d0=d0,
-        thresholds=tuple(int(t) for t in child_thresholds(params.b0)),
+        thresholds=thr,
         max_steps=max_steps,
         lanes=tuple(lanes),
         min_idle_div=min_idle_div,
         interpret=interpret,
     )
     if device is not None:
-        args = tuple(jax.device_put(a, device) for a in args[:2]) + args[2:]
+        args = tuple(
+            a if i == 2 else jax.device_put(a, device)
+            for i, a in enumerate(args)
+        )
     nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
     t0 = time.perf_counter()
     nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
@@ -331,6 +374,11 @@ def uts_pallas(
     dt = time.perf_counter() - t0
     if bool(unfinished):
         raise RuntimeError(f"uts_pallas ran out of steps ({max_steps})")
+    if bounded and int(np.asarray(maxd).max()) >= cap:
+        raise RuntimeError(
+            f"tree reached the depth bound ({cap}): counts beyond it are "
+            "truncated - rerun with a larger depth_bound"
+        )
     result.update(
         nodes=host_nodes + dev_nodes,
         leaves=host_leaves + int(np.asarray(leaves).sum(dtype=np.int64)),

@@ -64,6 +64,7 @@ from ..ops.sha1 import sha1_block as _sha1_block, sha1_child as _sha1_child
 
 __all__ = [
     "uts_vec", "child_thresholds", "child_threshold_table", "depth_cap",
+    "inrow_threshold_table",
     "LANES", "NLANES", "make_count_children", "make_dfs_step",
     "make_refill",
 ]
@@ -162,14 +163,56 @@ def _level_store(stack, sp, value, mask):
     )
 
 
-def make_count_children(thresholds: tuple, gen_mx: int, lanes: tuple):
+def inrow_threshold_table(thresholds: tuple, cols: int) -> np.ndarray:
+    """Transpose a per-depth threshold table to the in-row-gather layout:
+    one ``cols``-wide row per child ordinal, -1 padded, so a per-lane
+    (depth -> threshold) lookup is a same-shape ``take_along_axis``. The
+    fused Pallas engine passes this as a kernel input (Mosaic kernels
+    cannot capture array constants)."""
+    tab_np = np.asarray(thresholds, dtype=np.int32)  # (D+1, K)
+    D = tab_np.shape[0] - 1
+    if D + 1 > cols:
+        raise NotImplementedError(
+            f"in-row table gather needs depth cap + 1 <= {cols} "
+            f"lane columns, got {D + 1}"
+        )
+    padded = np.full((tab_np.shape[1], cols), -1, np.int32)
+    padded[:, : D + 1] = tab_np.T
+    return padded
+
+
+def make_count_children(
+    thresholds: tuple, gen_mx: int, lanes: tuple, inrow_table=None
+):
     """Exact geometric child count. ``thresholds`` is either a flat tuple
     (depth-independent FIXED shape, guarded by gen_mx) or a tuple of
     per-depth rows from child_threshold_table (-1 padded): the count then
-    comes from a row gather by each lane's depth."""
+    comes from a row gather by each lane's depth.
+
+    ``inrow_table`` (a (K, cols) array laid out by inrow_threshold_table,
+    same values as ``thresholds``) selects the Mosaic-compatible
+    formulation for the fused Pallas engine: the per-lane
+    (depth -> threshold) lookup becomes a same-shape ``take_along_axis``
+    per child ordinal - the only gather form Mosaic supports (the default
+    axis-0 ``jnp.take`` per-lane row gather is XLA-only). Same integer
+    thresholds, bit-identical counts."""
     if thresholds and isinstance(thresholds[0], tuple):
-        tab = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
-        D = tab.shape[0] - 1
+        tab_np = np.asarray(thresholds, dtype=np.int32)  # (D+1, K)
+        D = tab_np.shape[0] - 1
+        if inrow_table is not None:
+            K = tab_np.shape[1]
+
+            def count_children_inrow(r, depth):
+                dclip = jnp.clip(depth, 0, D)
+                cnt = jnp.zeros(lanes, jnp.int32)
+                for k in range(K):
+                    row = jnp.broadcast_to(inrow_table[k], lanes)
+                    t = jnp.take_along_axis(row, dclip, axis=1)
+                    cnt = cnt + ((t >= 0) & (r >= t)).astype(jnp.int32)
+                return jnp.where(depth <= D, cnt, 0)
+
+            return count_children_inrow
+        tab = jnp.asarray(tab_np)
 
         def count_children(r, depth):
             rows = jnp.take(tab, jnp.clip(depth, 0, D), axis=0)
@@ -194,12 +237,17 @@ def make_count_children(thresholds: tuple, gen_mx: int, lanes: tuple):
     return count_children
 
 
-def make_dfs_step(S: int, lanes: tuple, thresholds: tuple, gen_mx: int):
+def make_dfs_step(
+    S: int, lanes: tuple, thresholds: tuple, gen_mx: int,
+    inrow_table=None,
+):
     """One vectorized DFS expansion step over all lanes (the hot loop body,
     shared by the XLA engine here and the fused Pallas engine in
     uts_pallas.py). Signature:
     (sp, nodes, leaves, maxd, st, ch, cn, dp) -> same tuple."""
-    count_children = make_count_children(thresholds, gen_mx, lanes)
+    count_children = make_count_children(
+        thresholds, gen_mx, lanes, inrow_table
+    )
 
     def step(sp, nodes, leaves, maxd, st, ch, cn, dp):
         active = sp >= 0
@@ -306,6 +354,7 @@ def make_traversal(
     max_steps: int,
     refill,
     R,
+    inrow_table=None,
 ):
     """The complete traversal driver shared by both engines: outer loop =
     refill + refill-free inner expansion loop until `min_idle` lanes are
@@ -313,7 +362,7 @@ def make_traversal(
     ch0, cn0, dp0)`` is the only engine-specific part (XLA gather here vs
     in-kernel DMA + matmul gather in uts_pallas). Returns run() ->
     (sp, next_root, nodes, leaves, maxd, steps)."""
-    step = make_dfs_step(S, lanes, thresholds, gen_mx)
+    step = make_dfs_step(S, lanes, thresholds, gen_mx, inrow_table)
 
     def inner_cond(carry):
         sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
