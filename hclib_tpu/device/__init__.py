@@ -23,8 +23,10 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 from .megakernel import KernelContext, Megakernel
+from .resident import ResidentKernel
 
 __all__ = [
+    "ResidentKernel",
     "DESC_WORDS",
     "NO_TASK",
     "TaskGraphBuilder",

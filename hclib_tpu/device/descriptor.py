@@ -20,7 +20,19 @@ Word layout (all int32):
     5  F_CSR_N    number of CSR successors
     6..11 F_A0+i  six argument words (meaning defined by the kernel)
     12 F_OUT      output value slot (index into the int32 value buffer)
-    13..15        reserved
+    13 F_HOME     home device (flat mesh index) of a migrated task, or -1.
+                  A row with F_HOME >= 0 is a *traveling copy*: a proxy row
+                  F_HROW still exists on device F_HOME holding the real
+                  successor links, and completing this copy forwards its
+                  out-slot value home via a remote-completion active message
+                  (device/resident.py) - the TPU re-design of the reference
+                  thief taking dependency-bearing tasks out of a victim's
+                  deque (src/hclib-deque.c:75-106), where shared memory made
+                  links location-transparent.
+    14 F_HROW     proxy row index on device F_HOME (valid iff F_HOME >= 0)
+    15 F_VMASK    bitmask of arg words carrying *dereferenced values* (a
+                  migrated task's value-slot args are resolved at export and
+                  rehydrated into local slots at install)
 
 Static DAGs (Cholesky, Smith-Waterman) are built host-side with
 ``TaskGraphBuilder``; dynamic tasks (fib, UTS) are allocated on-device by
@@ -44,6 +56,9 @@ __all__ = [
     "F_CSR_N",
     "F_A0",
     "F_OUT",
+    "F_HOME",
+    "F_HROW",
+    "F_VMASK",
     "TaskGraphBuilder",
 ]
 
@@ -58,6 +73,9 @@ F_CSR_OFF = 4
 F_CSR_N = 5
 F_A0 = 6  # args occupy words 6..11
 F_OUT = 12
+F_HOME = 13
+F_HROW = 14
+F_VMASK = 15
 NUM_ARGS = 6
 
 
@@ -89,6 +107,7 @@ class TaskGraphBuilder:
         row[F_DEP] = len(deps)
         row[F_SUCC0] = NO_TASK
         row[F_SUCC1] = NO_TASK
+        row[F_HOME] = NO_TASK  # local task (no migration home-link)
         for i, a in enumerate(args):
             row[F_A0 + i] = int(a)
         row[F_OUT] = int(out)

@@ -95,7 +95,9 @@ class StreamingMegakernel:
         """Queue one descriptor for the stream (thread-safe; rows reach the
         device ring at the next entry boundary, or immediately on attached
         hosts writing the pinned ring directly)."""
-        from .descriptor import F_A0, F_DEP, F_FN, F_OUT, F_SUCC0, F_SUCC1
+        from .descriptor import (
+            F_A0, F_DEP, F_FN, F_HOME, F_OUT, F_SUCC0, F_SUCC1,
+        )
 
         if dep_count != 0:
             # A dependent injected row would wait on predecessors, but the
@@ -112,6 +114,7 @@ class StreamingMegakernel:
         for i, a in enumerate(args):
             row[F_A0 + i] = int(a)
         row[F_OUT] = out
+        row[F_HOME] = NO_TASK  # injected tasks are local to their device
         with self._lock:
             if self._closed:
                 raise RuntimeError("stream closed")

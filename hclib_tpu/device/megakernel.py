@@ -38,9 +38,12 @@ from .descriptor import (
     F_CSR_OFF,
     F_DEP,
     F_FN,
+    F_HOME,
+    F_HROW,
     F_OUT,
     F_SUCC0,
     F_SUCC1,
+    F_VMASK,
     NO_TASK,
     TaskGraphBuilder,
 )
@@ -245,6 +248,12 @@ class KernelContext:
         t[self.idx, F_SUCC0] = jnp.int32(NO_TASK)
         t[self.idx, F_SUCC1] = jnp.int32(NO_TASK)
         t[self.idx, F_CSR_N] = 0
+        # A migrated copy's continuation inherits the home-link as well:
+        # whoever ends the chain forwards the result to the home proxy
+        # (device/resident.py's remote-completion protocol).
+        t[new_idx, F_HOME] = t[self.idx, F_HOME]
+        t[new_idx, F_HROW] = t[self.idx, F_HROW]
+        t[self.idx, F_HOME] = jnp.int32(NO_TASK)
 
     def spawn(
         self,
@@ -292,6 +301,10 @@ class KernelContext:
                     jnp.int32(args[i]) if i < len(args) else 0
                 )
             self._tasks[a_clamped, F_OUT] = jnp.int32(out)
+            # Recycled rows may carry a stale home-link/value-mask from a
+            # previously migrated occupant; fresh spawns are local tasks.
+            self._tasks[a_clamped, F_HOME] = jnp.int32(NO_TASK)
+            self._tasks[a_clamped, F_VMASK] = 0
 
         @pl.when(ok & (jnp.int32(dep_count) == 0))
         def _():
@@ -412,6 +425,8 @@ class Megakernel:
         ivalues_in,
         stage_all_values: bool,
         ctx_hook: Optional[Callable[["KernelContext"], None]] = None,
+        complete_hook=None,
+        value_limit: Optional[int] = None,
     ):
         """Builds the scheduler core closures over a concrete set of refs:
         ``stage()`` (copy host state into the mutable windows), and
@@ -421,9 +436,14 @@ class Megakernel:
         other phases (the in-kernel ICI steal runner, device/ici_steal.py;
         the one-sided PGAS runner, device/pgas_kernel.py - whose
         ``ctx_hook`` attaches its put/am/wait-until ops to each task's
-        KernelContext before dispatch).
+        KernelContext before dispatch; the unified resident runner,
+        device/resident.py - whose ``complete_hook(idx)`` runs at the top
+        of every completion to forward migrated tasks' results home, and
+        whose ``value_limit`` caps dynamic value allocation below the
+        region it reserves for migration result slots).
         """
         capacity = self.capacity
+        num_values = value_limit if value_limit is not None else self.num_values
 
         # On TPU, SMEM output windows do NOT start with the aliased input's
         # contents (unlike interpret mode) - stage the initial scheduler
@@ -496,6 +516,8 @@ class Megakernel:
             """Decrement successors' dep counters; push newly-ready tasks
             (device analogue of hclib_promise_put waking the waiter list,
             src/hclib-promise.c:203-245)."""
+            if complete_hook is not None:
+                complete_hook(idx)
 
             def dec(s) -> None:
                 @pl.when(s != NO_TASK)
@@ -536,7 +558,7 @@ class Megakernel:
         def step(idx) -> None:
             ctx = KernelContext(
                 idx, tasks, succ, ready, counts, ivalues, data, scratch,
-                capacity, free, self.num_values, vfree,
+                capacity, free, num_values, vfree,
                 self.uses_row_values,
             )
             if ctx_hook is not None:
@@ -594,13 +616,16 @@ class Megakernel:
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
 
-        def install_descriptor(read_word) -> None:
+        def install_descriptor(read_word):
             """Adopt one externally-produced descriptor row (a stolen row
             arriving over ICI, an injected stream row): allocate a row
             through the same path spawns use (freed rows first, then the
             bump cursor), copy the ABI words via ``read_word(w)``, count it
             pending, and push it ready only when its dep counter is zero -
-            a dependent row waits for its predecessors like any other."""
+            a dependent row waits for its predecessors like any other.
+            Returns the installed row index (meaningful only when no
+            overflow was flagged) so callers can apply post-install fixups
+            (device/resident.py rewrites migrated rows' out slots)."""
             nf = free[0]
             use_free = nf > 0
             row_free = free[jnp.maximum(nf, 1)]
@@ -629,6 +654,8 @@ class Megakernel:
             @pl.when(jnp.logical_not(ok))
             def _():
                 counts[C_OVERFLOW] = 1
+
+            return row
 
         return types.SimpleNamespace(
             stage=stage, sched=sched, push_ready=push_ready,

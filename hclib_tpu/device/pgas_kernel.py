@@ -78,6 +78,7 @@ from .descriptor import (
     F_CSR_OFF,
     F_DEP,
     F_FN,
+    F_HOME,
     F_OUT,
     F_SUCC0,
     F_SUCC1,
@@ -233,7 +234,8 @@ class PGASMegakernel:
                     )
                 outq_desc[slot, F_OUT] = jnp.int32(out)
                 for w in range(F_OUT + 1, DESC_WORDS):
-                    outq_desc[slot, w] = 0
+                    # F_HOME word: AM tasks are local to their target.
+                    outq_desc[slot, w] = NO_TASK if w == F_HOME else 0
                 obctl[1] = h + 1
 
             @pl.when(jnp.logical_not(ok))

@@ -1,0 +1,1296 @@
+"""The unified resident kernel: ONE per-device scheduler composing work
+stealing, one-sided PGAS, active messages, remote atomics/locks, and host
+injection - with **general migration of dependency-bearing tasks**.
+
+This is the device-side analogue of the reference's module architecture,
+where every module adds locales to a SINGLE scheduler instead of spawning a
+private runtime (/root/reference/inc/hclib-module.h:79-97,
+src/hclib-runtime.c:294-317). Round 3 shipped three disjoint wrappers
+around ``Megakernel`` (ici_steal / pgas_kernel / inject); this module is
+their composition: one kernel per device that steals, puts, AMs, waits,
+and polls an injection ring in the same round loop. The older wrappers
+remain as narrower configurations (see their module docstrings).
+
+**General task migration** (the round-3 gap: only successor-free
+whitelisted rows could move). The reference thief takes ANY task out of a
+victim's deque - finish scopes, dependency edges, continuations included
+(/root/reference/src/hclib-deque.c:75-106, src/hclib-locality-graph.c:
+843-888) - because shared memory makes its pointers location-transparent.
+On a TPU mesh the links are device-local row/slot indices, so migration is
+re-designed as a **home-link protocol**:
+
+- Exporting a ready row WITH successor links keeps the row at home as a
+  *proxy* (off the ready ring, still pending, links intact) and ships a
+  copy whose F_HOME/F_HROW words name the proxy.
+- The copy executes on the thief like any local task; continuations
+  spawned there inherit the home-link (``take_continuation`` moves
+  F_HOME/F_HROW with the successor words).
+- Whoever ends the chain forwards its out-slot value home in a
+  **remote-completion active message**; the home device writes the value
+  into the proxy's out slot and completes the proxy - firing the real
+  successor edges exactly as if the task had run at home. Chains compose:
+  a proxy that is itself a migrated copy forwards again.
+- A migrated kernel's *value-slot arguments* (args that index the local
+  ivalues buffer, declared per kernel id in ``migratable_fns``) are
+  dereferenced at export - they are final, the row was ready - and
+  rehydrated into thief-local slots at install (the closure-capture of
+  the reference's AM lambda serialization, modules/openshmem-am).
+- Copies write results into a reserved per-row region at the top of the
+  value buffer ([num_values - capacity, num_values)), sized/validated at
+  run(): the slot is written by the chain-ending task and read by its
+  completion hook in the same scheduler step, so the serial per-device
+  scheduler makes slot reuse race-free by construction.
+
+**Remote atomics and locks** (round-3 gap #3, matching the reference
+SHMEM layer's AMO + promise-chained locks,
+/root/reference/modules/openshmem/src/hclib_openshmem.cpp:572-600,
+124-134): owner-computes via *builtin* active messages, dispatched by
+negative F_FN ids at drain time. The owner applies fetch-add /
+compare-swap on its own value slots - the per-device scheduler is serial,
+so owner-side application IS the atomicity - and replies with another AM
+that deposits the old value and dep-decrements the caller's parked
+continuation row. Locks keep a FIFO of (device, row) waiters in the
+owner's value slots; RC_GRANT releases the next waiter's row - the
+device translation of the reference chaining lock requests through
+promises.
+
+**Termination and flow control.** Counting protocol as in
+device/pgas_kernel.py (Mattern-style: exit when global pending == 0,
+outboxes and injection rings empty, and messages sent == received), but
+the per-round stat exchange is re-designed for pod scale (round-3 weak
+item #8): instead of ring-allreducing an O(ndev^2) send matrix, each
+round runs log2(ndev) paired XOR hops that (1) recursive-double the five
+scalar sums, and (2) route the per-destination send counts with the
+hypercube XOR all-to-all (slot p of device v ends holding the count from
+source v^p) - payload O(ndev + ndev*nchan) words per hop, O(ndev log
+ndev) per round. The same hops carry the backlog-equalizing steal
+exchange of device/ici_steal.py, so termination, stealing, and message
+accounting ride one credited lockstep schedule.
+
+Arrival correctness: every (source, channel) pair has its OWN DMA
+semaphore (``am_sems[src]``, ``chan_sems[src, chan]``), and receivers
+wait exactly the announced per-source count before reading - closing a
+latent aliasing hazard in the shared-semaphore drain of the round-3 PGAS
+kernel, where an early next-round arrival from a fast device could
+satisfy a wait for a slower device's still-in-flight message.
+
+Meshes: 1D or 2D, power-of-two per axis (TPU slices are pof2 per axis);
+2D hops decompose into per-axis torus-neighbor transfers exactly as in
+ici_steal (low XOR bits = minor axis). Tested on 8-device 1D and 4x2
+interpret meshes (including under the Mosaic race detector) and
+compiled/run on the real 1-device TPU (self-loop AMs, atomics, locks).
+"""
+
+from __future__ import annotations
+
+import functools
+import types
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .descriptor import (
+    DESC_WORDS,
+    F_A0,
+    F_CSR_N,
+    F_CSR_OFF,
+    F_DEP,
+    F_FN,
+    F_HOME,
+    F_HROW,
+    F_OUT,
+    F_SUCC0,
+    F_SUCC1,
+    F_VMASK,
+    NO_TASK,
+    NUM_ARGS,
+    TaskGraphBuilder,
+)
+from .megakernel import (
+    C_EXECUTED,
+    C_HEAD,
+    C_OVERFLOW,
+    C_PENDING,
+    C_ROUNDS,
+    C_TAIL,
+    C_VBASE,
+    Megakernel,
+    VBLOCK,
+)
+
+__all__ = [
+    "ResidentKernel",
+    "RC_COMPLETE",
+    "RC_FADD",
+    "RC_FADD_R",
+    "RC_CSWAP",
+    "RC_REPLY",
+    "RC_LOCK",
+    "RC_UNLOCK",
+    "RC_GRANT",
+    "lock_block_slots",
+]
+
+# Builtin active-message ids (negative F_FN values, dispatched at drain
+# time by the receiving scheduler - they never occupy a task row).
+RC_COMPLETE = -2  # [proxy_row, value]: forward a migrated task's result home
+RC_FADD = -3      # [slot, delta]: fire-and-forget remote fetch-add
+RC_FADD_R = -4    # [slot, delta, src, row, rslot]: fetch-add, reply old value
+RC_CSWAP = -5     # [slot, expected, new, src, row, rslot]: compare-swap
+RC_REPLY = -6     # [row, value, rslot]: deposit value, dep-decrement row
+RC_LOCK = -7      # [lbase, src, row, qcap]: acquire or enqueue
+RC_UNLOCK = -8    # [lbase, qcap]: release / grant next waiter
+RC_GRANT = -9     # [row]: lock granted - dep-decrement the parked row
+
+AMROW = 128  # padded AM wire row (SMEM DMA minor dim wants 128-word units)
+RING_ROW = 256  # injection ring row (matches device/inject.py)
+
+
+def lock_block_slots(qcap: int) -> int:
+    """Value slots a lock block occupies: [held, qlen, head, (dev,row)*qcap].
+    Host presets the block to zero at ``lbase`` on the owner device."""
+    return 3 + 2 * int(qcap)
+
+
+class ResidentKernel:
+    """One resident scheduler per device of a 1D/2D pof2 mesh, composing
+    stealing + PGAS + AM/atomics/locks + injection (see module docstring).
+
+    ``migratable_fns``: iterable of kernel-table ids eligible to migrate
+    (dependency-bearing rows included, via the home-link protocol), or a
+    dict ``{fn_id: (value_arg_index, ...)}`` naming which arg words of
+    that kernel are value-slot references to dereference at export.
+    ``channels``: as PGASMegakernel - ``{name: (data_buffer, rows)}``.
+    ``inject=True`` adds a per-device host injection ring (rows published
+    before entry are discovered by the in-kernel poll).
+    """
+
+    def __init__(
+        self,
+        mk: Megakernel,
+        mesh: Mesh,
+        *,
+        steal: bool = True,
+        migratable_fns: Union[Iterable[int], Dict[int, Sequence[int]]] = (),
+        channels: Optional[Dict[str, Tuple[str, int]]] = None,
+        inject: bool = False,
+        window: int = 8,
+        scan: Optional[int] = None,
+        am_window: int = 8,
+        outbox: int = 256,
+        max_waits: int = 64,
+        ring_capacity: int = 256,
+    ) -> None:
+        if len(mesh.axis_names) not in (1, 2):
+            raise ValueError("ResidentKernel wants a 1D or 2D mesh")
+        dims = tuple(int(d) for d in mesh.devices.shape)
+        for d in dims:
+            if d & (d - 1):
+                raise ValueError(
+                    f"mesh axes must be power-of-two, got {dims} (non-pof2 "
+                    "1D meshes: use ICIStealMegakernel / PGASMegakernel)"
+                )
+        if am_window < 2:
+            raise ValueError("am_window must be >= 2")
+        self.mk = mk
+        self.mesh = mesh
+        self.axes = tuple(mesh.axis_names)
+        self.dims = dims
+        self.ndev = int(np.prod(dims))
+        self.nh = self.ndev.bit_length() - 1  # log2 hops (0 for 1 device)
+        self.steal = bool(steal)
+        if isinstance(migratable_fns, dict):
+            self.migratable: Dict[int, Tuple[int, ...]] = {
+                int(f): tuple(int(i) for i in v)
+                for f, v in migratable_fns.items()
+            }
+        else:
+            self.migratable = {int(f): () for f in migratable_fns}
+        for f, vargs in self.migratable.items():
+            if len(vargs) > VBLOCK:
+                raise ValueError(
+                    f"kernel {f}: at most {VBLOCK} value args (rehydration "
+                    "uses the row's own value block)"
+                )
+            if vargs and not mk.uses_row_values:
+                raise ValueError(
+                    "value-arg rehydration needs uses_row_values=True "
+                    "(arriving rows rehydrate into their own row block)"
+                )
+        self.channels: List[Tuple[str, int]] = []
+        self.chan_id: Dict[str, int] = {}
+        for cname, (bname, rows) in (channels or {}).items():
+            if bname not in mk.data_specs:
+                raise ValueError(
+                    f"channel {cname!r}: no data buffer {bname!r}"
+                )
+            if rows < 1 or rows > mk.data_specs[bname].shape[0]:
+                raise ValueError(f"channel {cname!r}: bad row count {rows}")
+            self.chan_id[cname] = len(self.channels)
+            self.channels.append((bname, int(rows)))
+        self.nchan = max(1, len(self.channels))
+        self.inject = bool(inject)
+        self.window = int(window)
+        self.scan = int(scan) if scan is not None else 2 * self.window
+        self.am_window = int(am_window)
+        self.outbox = int(outbox)
+        self.max_waits = int(max_waits)
+        self.ring_capacity = -(-int(ring_capacity) // 8) * 8
+        # Migration result slots: one per descriptor row, at the top of the
+        # value buffer. The chain-ending task writes its result there and
+        # its completion hook reads it in the same scheduler step, so the
+        # serial scheduler makes reuse race-free (module docstring).
+        self.rbase = (
+            mk.num_values - mk.capacity if self.migratable else mk.num_values
+        )
+        if self.rbase <= 0:
+            raise ValueError(
+                "migration needs num_values > capacity (one result slot "
+                "per row is reserved at the top of the value buffer)"
+            )
+        # Stat-vector layout (exchanged every hop). Words [0, SX_AM) are
+        # recursive-doubling SUMS; [SX_AM, S_BL) route by the hypercube
+        # XOR all-to-all (slot p ends holding source me^p's count);
+        # [S_BL] is the sender's CURRENT backlog, read raw per hop.
+        self.SF_PEND = 0
+        self.SF_RECV = 1
+        self.SF_OUTB = 2
+        self.SF_SENT = 3
+        self.SF_INJ = 4
+        self.SX_AM = 5
+        self.SX_DATA = 5 + self.ndev
+        self.S_BL = self.SX_DATA + self.ndev * self.nchan
+        self.S = self.S_BL + 1
+        self._jitted: Dict[Any, Any] = {}
+
+    # -- mesh addressing (as ici_steal) --
+
+    def _flat_me(self):
+        if len(self.axes) == 1:
+            return jax.lax.axis_index(self.axes[0])
+        return (
+            jax.lax.axis_index(self.axes[0]) * self.dims[1]
+            + jax.lax.axis_index(self.axes[1])
+        )
+
+    def _did(self, flat):
+        if len(self.axes) == 1:
+            return flat
+        return (flat // self.dims[1], flat % self.dims[1])
+
+    @property
+    def _did_type(self):
+        return (
+            pltpu.DeviceIdType.LOGICAL
+            if len(self.axes) == 1
+            else pltpu.DeviceIdType.MESH
+        )
+
+    # -- the kernel --
+
+    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+        mk = self.mk
+        ndata = len(mk.data_specs)
+        n_in = 6 + ndata + (2 if self.inject else 0)
+        in_refs = refs[:n_in]
+        n_out = 4 + ndata + (1 if self.inject else 0)
+        out_refs = refs[n_in : n_in + n_out]
+        rest = refs[n_in + n_out :]
+        nscratch = len(mk.scratch_specs)
+        scratch_refs = rest[:nscratch]
+        tail = list(rest[nscratch:])
+
+        def take(n):
+            head, tail[:n] = tail[:n], []
+            return head
+
+        nh = self.nh
+        (free, vfree, candbuf, sendbuf, statacc, statsnd) = take(6)
+        statrcv = take(nh)
+        inboxes = take(nh) if self.steal else []
+        (
+            outq_tgt, outq_desc, obctl, ambuf, inbox, am_sent, am_recv,
+            sent_round, data_sent, chan_recv, chan_tot, pstate, wait_tab,
+        ) = take(13)
+        if self.inject:
+            ctlbuf, rowbuf = take(2)
+        (ssems, rsems, csems, am_sems, chan_sems) = take(5)
+        if self.inject:
+            (isem,) = take(1)
+        assert not tail, f"{len(tail)} unconsumed scratch refs"
+
+        tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
+        waits_in = in_refs[5 + ndata]
+        if self.inject:
+            iring, ictl = in_refs[6 + ndata], in_refs[7 + ndata]
+        tasks, ready, counts, ivalues = out_refs[:4]
+        data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        if self.inject:
+            ctl_out = out_refs[4 + ndata]
+        scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
+
+        ndev = self.ndev
+        nchan = self.nchan
+        AMW = self.am_window
+        OUTQ = self.outbox
+        MAXW = self.max_waits
+        W = self.window
+        SCAN = self.scan
+        cap = mk.capacity
+        RBASE = self.rbase
+        SF_PEND, SF_RECV, SF_OUTB, SF_SENT, SF_INJ = (
+            self.SF_PEND, self.SF_RECV, self.SF_OUTB, self.SF_SENT,
+            self.SF_INJ,
+        )
+        SX_AM, SX_DATA, S_BL, S = self.SX_AM, self.SX_DATA, self.S_BL, self.S
+        did_type = self._did_type
+        me = self._flat_me()
+
+        # pstate slots
+        PS_RECV, PS_NWAIT, PS_SENT = 0, 1, 2
+
+        # ---- outbox / active messages ----
+
+        def op_am(dev, fn, args: Sequence = (), out=0) -> None:
+            """Queue a descriptor (or builtin op, fn < 0) for device
+            ``dev``'s scheduler; the round loop launches it under the
+            per-target inbox window."""
+            if len(args) > NUM_ARGS:
+                raise ValueError(f"at most {NUM_ARGS} args per AM")
+            h = obctl[1]
+            ok = h - obctl[0] < OUTQ
+            slot = h % OUTQ
+
+            @pl.when(ok)
+            def _():
+                outq_tgt[slot] = dev
+                outq_desc[slot, F_FN] = jnp.int32(fn)
+                outq_desc[slot, F_DEP] = 0
+                outq_desc[slot, F_SUCC0] = jnp.int32(NO_TASK)
+                outq_desc[slot, F_SUCC1] = jnp.int32(NO_TASK)
+                outq_desc[slot, F_CSR_OFF] = 0
+                outq_desc[slot, F_CSR_N] = 0
+                for i in range(NUM_ARGS):
+                    outq_desc[slot, F_A0 + i] = (
+                        jnp.int32(args[i]) if i < len(args) else 0
+                    )
+                outq_desc[slot, F_OUT] = jnp.int32(out)
+                outq_desc[slot, F_HOME] = jnp.int32(NO_TASK)
+                outq_desc[slot, F_HROW] = 0
+                outq_desc[slot, F_VMASK] = 0
+                obctl[1] = h + 1
+
+            @pl.when(jnp.logical_not(ok))
+            def _():
+                counts[C_OVERFLOW] = 1
+
+        def op_put(dev, chan: int, dst_row, src_row) -> None:
+            """One-sided channel write (SHMEM put): local completion on
+            return; target-side arrival is what wait_until observes."""
+            if not isinstance(chan, int):
+                raise TypeError("chan must be a static channel id")
+            bname, rows = self.channels[chan]
+            buf = data[bname]
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=buf.at[pl.ds(src_row, rows)],
+                dst_ref=buf.at[pl.ds(dst_row, rows)],
+                send_sem=ssems.at[2],
+                # Per-(source, channel) arrival semaphore: slot [me, chan]
+                # on the TARGET (symmetric allocation).
+                recv_sem=chan_sems.at[me, chan],
+                device_id=self._did(dev),
+                device_id_type=did_type,
+            )
+            rdma.start()
+            rdma.wait_send()
+            data_sent[dev, chan] = data_sent[dev, chan] + 1
+            pstate[PS_SENT] = pstate[PS_SENT] + 1
+
+        def op_wait_until(chan, need, row) -> None:
+            n = pstate[PS_NWAIT]
+            ok = n < MAXW
+            nc = jnp.minimum(n, MAXW - 1)
+
+            @pl.when(ok)
+            def _():
+                wait_tab[nc, 0] = chan
+                wait_tab[nc, 1] = need
+                wait_tab[nc, 2] = row
+                pstate[PS_NWAIT] = n + 1
+
+            @pl.when(jnp.logical_not(ok))
+            def _():
+                counts[C_OVERFLOW] = 1
+
+        def op_count(chan: int):
+            return chan_tot[chan]
+
+        def op_fadd(dev, slot, delta) -> None:
+            """Fire-and-forget remote fetch-add (owner-computes)."""
+            op_am(dev, RC_FADD, (slot, delta))
+
+        def op_fadd_get(dev, slot, delta, row, rslot) -> None:
+            """Fetch-add whose OLD value lands in local slot ``rslot`` and
+            dep-decrements parked row ``row`` (spawn it with an extra
+            dep)."""
+            op_am(dev, RC_FADD_R, (slot, delta, me, row, rslot))
+
+        def op_cswap(dev, slot, expected, new, row, rslot) -> None:
+            """Remote compare-swap; old value replies to (row, rslot)."""
+            op_am(dev, RC_CSWAP, (slot, expected, new, row, rslot))
+
+        def op_lock(dev, lbase, row, qcap: int) -> None:
+            """Acquire the lock block at ``lbase`` on ``dev``; parked row
+            ``row`` (one extra dep) is dep-decremented when granted."""
+            op_am(dev, RC_LOCK, (lbase, me, row, qcap))
+
+        def op_unlock(dev, lbase, qcap: int) -> None:
+            op_am(dev, RC_UNLOCK, (lbase, qcap))
+
+        def ctx_hook(ctx) -> None:
+            ctx.pgas = types.SimpleNamespace(
+                put=op_put, am=op_am, wait_until=op_wait_until,
+                count=op_count, fadd=op_fadd, fadd_get=op_fadd_get,
+                cswap=op_cswap, lock=op_lock, unlock=op_unlock,
+                me=me, ndev=ndev, nchan=len(self.channels),
+            )
+
+        def complete_hook(idx) -> None:
+            """Migrated chains forward their result to the home proxy on
+            completion (module docstring: the home-link protocol)."""
+
+            @pl.when(tasks[idx, F_HOME] >= 0)
+            def _():
+                op_am(
+                    tasks[idx, F_HOME],
+                    RC_COMPLETE,
+                    (tasks[idx, F_HROW], ivalues[tasks[idx, F_OUT]]),
+                )
+
+        core = mk._make_core(
+            succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
+            tasks_in, ready_in, counts_in, ivalues_in, True, ctx_hook,
+            complete_hook if self.migratable else None,
+            value_limit=RBASE,
+        )
+
+        def dep_dec(row) -> None:
+            d = tasks[row, F_DEP] - 1
+            tasks[row, F_DEP] = d
+
+            @pl.when(d == 0)
+            def _():
+                core.push_ready(row)
+
+        # ---- stage ----
+
+        def stage_resident() -> None:
+            def z(i, _):
+                am_sent[i] = 0
+                am_recv[i] = 0
+                sent_round[i] = 0
+                for c in range(nchan):
+                    data_sent[i, c] = 0
+                    chan_recv[i, c] = 0
+                return 0
+
+            jax.lax.fori_loop(0, ndev, z, 0)
+            for c in range(nchan):
+                chan_tot[c] = 0
+            for i in range(8):
+                pstate[i] = 0
+            pstate[PS_NWAIT] = waits_in[0, 0]
+            obctl[0] = 0
+            obctl[1] = 0
+
+            def cw(i, _):
+                for w in range(3):
+                    wait_tab[i, w] = waits_in[1 + i, w]
+                return 0
+
+            jax.lax.fori_loop(0, waits_in[0, 0], cw, 0)
+
+        # ---- import fixups (stolen rows, AM task rows) ----
+
+        has_vargs = any(v for v in self.migratable.values())
+
+        def install_fixed(read_word):
+            """Adopt an external row, then apply migration fixups: homed
+            rows get a local result slot; dereferenced value args
+            rehydrate into the row's own value block."""
+            row = core.install_descriptor(read_word)
+
+            @pl.when(tasks[row, F_HOME] >= 0)
+            def _():
+                tasks[row, F_OUT] = jnp.int32(RBASE) + row
+
+            if has_vargs:
+                mask = tasks[row, F_VMASK]
+                base = counts[C_VBASE] + row * VBLOCK
+                jj = jnp.int32(0)
+                for i in range(NUM_ARGS):
+                    bit = (mask >> i) & 1
+
+                    @pl.when(bit == 1)
+                    def _(i=i, jj=jj):
+                        ivalues[base + jj] = tasks[row, F_A0 + i]
+                        tasks[row, F_A0 + i] = base + jj
+
+                    jj = jj + bit
+                tasks[row, F_VMASK] = 0
+            return row
+
+        # ---- steal export / import (general migration) ----
+
+        wl = sorted(self.migratable)
+
+        def elig_of(cand):
+            d_fn = tasks[cand, F_FN]
+            ok = jnp.bool_(False)
+            for f in wl:
+                ok = ok | (d_fn == f)
+            return ok
+
+        def export(quota):
+            """Move up to ``quota`` eligible ready rows into sendbuf.
+            Rows with successor links (or an existing home-link) export as
+            homed copies and leave a proxy; link-free rows move whole."""
+            head = counts[C_HEAD]
+            backlog = counts[C_TAIL] - head
+            Sn = jnp.minimum(backlog, SCAN)
+
+            def copy_cand(j, _):
+                candbuf[j] = ready[(head + j) % cap]
+                return 0
+
+            jax.lax.fori_loop(0, Sn, copy_cand, 0)
+
+            def count_elig(j, n):
+                return n + elig_of(candbuf[j]).astype(jnp.int32)
+
+            nelig = jax.lax.fori_loop(0, Sn, count_elig, jnp.int32(0))
+            nsend = jnp.minimum(quota, nelig)
+
+            def homed_of(cand):
+                """Rows migrate as homed copies when they carry successor
+                links, are already migrated copies, or write a DYNAMIC
+                value slot (>= the symmetric host region): a dynamic out
+                address is only valid on its home device, so the result
+                must forward home rather than land at the same index on
+                the thief (where it could alias a live block)."""
+                return (
+                    (tasks[cand, F_SUCC0] != NO_TASK)
+                    | (tasks[cand, F_SUCC1] != NO_TASK)
+                    | (tasks[cand, F_CSR_N] > 0)
+                    | (tasks[cand, F_HOME] >= 0)
+                    | (tasks[cand, F_OUT] >= counts[C_VBASE])
+                )
+
+            def classify(j, carry):
+                se, kp, nw = carry
+                cand = candbuf[j]
+                tk = elig_of(cand) & (se < nsend)
+
+                @pl.when(tk)
+                def _():
+                    for w in range(DESC_WORDS):
+                        sendbuf[se, w] = tasks[cand, w]
+                    links = homed_of(cand)
+
+                    @pl.when(links)
+                    def _():
+                        # Homed copy: links stay on the proxy; the copy
+                        # names us as home. (A proxy that is itself a
+                        # migrated copy keeps ITS home-link and forwards
+                        # on completion - chains compose.)
+                        sendbuf[se, F_SUCC0] = jnp.int32(NO_TASK)
+                        sendbuf[se, F_SUCC1] = jnp.int32(NO_TASK)
+                        sendbuf[se, F_CSR_OFF] = 0
+                        sendbuf[se, F_CSR_N] = 0
+                        sendbuf[se, F_HOME] = me
+                        sendbuf[se, F_HROW] = cand
+
+                    @pl.when(jnp.logical_not(links))
+                    def _():
+                        # Whole-row migration: the task now lives on the
+                        # target; tombstone + free the home row.
+                        tasks[cand, F_DEP] = -1
+                        nf = free[0] + 1
+                        free[0] = nf
+                        free[nf] = cand
+
+                    # Dereference declared value-slot args (final: the
+                    # row was ready, all predecessors completed).
+                    for f, vargs in self.migratable.items():
+                        if not vargs:
+                            continue
+                        m = 0
+                        for i in vargs:
+                            m |= 1 << i
+
+                        @pl.when(tasks[cand, F_FN] == f)
+                        def _(f=f, vargs=vargs, m=m):
+                            for i in vargs:
+                                sendbuf[se, F_A0 + i] = ivalues[
+                                    tasks[cand, F_A0 + i]
+                                ]
+                            sendbuf[se, F_VMASK] = m
+
+                @pl.when(jnp.logical_not(tk))
+                def _():
+                    ready[(head + nsend + kp) % cap] = cand
+
+                # Safe to re-evaluate after the mutation above: homed
+                # export leaves tasks[cand] untouched, and whole-row
+                # export only tombstones F_DEP, which homed_of never reads.
+                whole = tk & jnp.logical_not(homed_of(cand))
+                return (
+                    se + tk.astype(jnp.int32),
+                    kp + (1 - tk.astype(jnp.int32)),
+                    nw + whole.astype(jnp.int32),
+                )
+
+            _, _, nwhole = jax.lax.fori_loop(
+                0, Sn, classify, (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            )
+            counts[C_HEAD] = head + nsend
+            # Homed exports stay pending at home (the proxy); only
+            # whole-row exports hand their pending count to the thief.
+            counts[C_PENDING] = counts[C_PENDING] - nwhole
+            return nsend
+
+        def import_rows(box):
+            n = box[W, 0]
+
+            def one(i, _):
+                install_fixed(lambda w: box[i, w])
+                return 0
+
+            jax.lax.fori_loop(0, n, one, 0)
+
+        # ---- AM drain machinery ----
+
+        def drain_outbox() -> None:
+            """Launch queued AMs under the per-target inbox window (FIFO;
+            a capped head entry stalls until next round, preserving
+            per-target order)."""
+
+            def zz(i, _):
+                sent_round[i] = 0
+                return 0
+
+            jax.lax.fori_loop(0, ndev, zz, 0)
+
+            def cond(h):
+                more = h < obctl[1]
+                t = outq_tgt[h % OUTQ]
+                return more & (
+                    sent_round[jnp.where(more, t, 0)] < AMW // 2
+                )
+
+            def body(h):
+                slot_q = h % OUTQ
+                t = outq_tgt[slot_q]
+                slot = am_sent[t] % AMW
+                for w in range(DESC_WORDS):
+                    ambuf[w] = outq_desc[slot_q, w]
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=ambuf,
+                    dst_ref=inbox.at[me, slot],
+                    send_sem=ssems.at[3],
+                    # Slot [me] on the TARGET: per-source arrivals.
+                    recv_sem=am_sems.at[me],
+                    device_id=self._did(t),
+                    device_id_type=did_type,
+                )
+                rdma.start()
+                rdma.wait_send()
+                am_sent[t] = am_sent[t] + 1
+                sent_round[t] = sent_round[t] + 1
+                pstate[PS_SENT] = pstate[PS_SENT] + 1
+                return h + 1
+
+            obctl[0] = jax.lax.while_loop(cond, body, obctl[0])
+
+        def handle_am(s, slot) -> None:
+            """Dispatch one landed AM: builtin ops (fn < 0) run inline at
+            the receiving scheduler; task descriptors install."""
+            fn = inbox[s, slot, F_FN]
+
+            def a(i):
+                return inbox[s, slot, F_A0 + i]
+
+            @pl.when(fn >= 0)
+            def _():
+                install_fixed(lambda w: inbox[s, slot, w])
+
+            @pl.when(fn == RC_COMPLETE)
+            def _():
+                hrow = a(0)
+                ivalues[tasks[hrow, F_OUT]] = a(1)
+                core.complete(hrow)
+                # The execution was already counted on the thief.
+                counts[C_EXECUTED] = counts[C_EXECUTED] - 1
+
+            @pl.when(fn == RC_FADD)
+            def _():
+                ivalues[a(0)] = ivalues[a(0)] + a(1)
+
+            @pl.when(fn == RC_FADD_R)
+            def _():
+                old = ivalues[a(0)]
+                ivalues[a(0)] = old + a(1)
+                op_am(a(2), RC_REPLY, (a(3), old, a(4)))
+
+            @pl.when(fn == RC_CSWAP)
+            def _():
+                old = ivalues[a(0)]
+                ivalues[a(0)] = jnp.where(old == a(1), a(2), old)
+                op_am(a(3), RC_REPLY, (a(4), old, a(5)))
+
+            @pl.when(fn == RC_REPLY)
+            def _():
+                ivalues[a(2)] = a(1)
+                dep_dec(a(0))
+
+            @pl.when(fn == RC_LOCK)
+            def _():
+                lbase, src, row, qcap = a(0), a(1), a(2), a(3)
+                held = ivalues[lbase]
+
+                @pl.when(held == 0)
+                def _():
+                    ivalues[lbase] = 1
+                    op_am(src, RC_GRANT, (row,))
+
+                @pl.when(held != 0)
+                def _():
+                    qlen = ivalues[lbase + 1]
+                    head_q = ivalues[lbase + 2]
+                    okq = qlen < qcap
+                    pos = lbase + 3 + 2 * ((head_q + qlen) % qcap)
+
+                    @pl.when(okq)
+                    def _():
+                        ivalues[pos] = src
+                        ivalues[pos + 1] = row
+                        ivalues[lbase + 1] = qlen + 1
+
+                    @pl.when(jnp.logical_not(okq))
+                    def _():
+                        counts[C_OVERFLOW] = 1
+
+            @pl.when(fn == RC_UNLOCK)
+            def _():
+                lbase, qcap = a(0), a(1)
+                qlen = ivalues[lbase + 1]
+
+                @pl.when(qlen == 0)
+                def _():
+                    ivalues[lbase] = 0
+
+                @pl.when(qlen > 0)
+                def _():
+                    head_q = ivalues[lbase + 2]
+                    pos = lbase + 3 + 2 * (head_q % qcap)
+                    ivalues[lbase + 2] = (head_q + 1) % qcap
+                    ivalues[lbase + 1] = qlen - 1
+                    # Lock stays held; hand it to the next waiter.
+                    op_am(ivalues[pos], RC_GRANT, (ivalues[pos + 1],))
+
+            @pl.when(fn == RC_GRANT)
+            def _():
+                dep_dec(a(0))
+
+        def drain_receives() -> None:
+            """Consume exactly the per-source arrivals the fold announced:
+            wait each (source, channel) semaphore down by its announced
+            delta BEFORE reading - payloads are never observed partially
+            written, and a fast device's next-round message can never
+            satisfy a wait for a slower source (per-source semaphores)."""
+            me_did = self._did(me)
+            for c, (bname, rows) in enumerate(self.channels):
+                buf = data[bname]
+                for p in range(ndev):
+                    src = me ^ p
+                    expected = statacc[SX_DATA + p * nchan + c]
+                    delta = expected - chan_recv[src, c]
+                    waiter = pltpu.make_async_remote_copy(
+                        src_ref=buf.at[pl.ds(0, rows)],
+                        dst_ref=buf.at[pl.ds(0, rows)],
+                        send_sem=ssems.at[2],
+                        recv_sem=chan_sems.at[src, c],
+                        device_id=me_did,
+                        device_id_type=did_type,
+                    )
+
+                    def one(i, _):
+                        waiter.wait_recv()
+                        return 0
+
+                    jax.lax.fori_loop(0, delta, one, 0)
+                    chan_recv[src, c] = expected
+                    chan_tot[c] = chan_tot[c] + delta
+                    pstate[PS_RECV] = pstate[PS_RECV] + delta
+
+            for p in range(ndev):
+                src = me ^ p
+                expected = statacc[SX_AM + p]
+                base = am_recv[src]
+                delta = expected - base
+                waiter = pltpu.make_async_remote_copy(
+                    src_ref=inbox.at[0, 0],
+                    dst_ref=inbox.at[0, 0],
+                    send_sem=ssems.at[3],
+                    recv_sem=am_sems.at[src],
+                    device_id=me_did,
+                    device_id_type=did_type,
+                )
+
+                def wait_one(i, _):
+                    waiter.wait_recv()
+                    return 0
+
+                jax.lax.fori_loop(0, delta, wait_one, 0)
+
+                def install_one(i, _):
+                    handle_am(src, (base + i) % AMW)
+                    return 0
+
+                jax.lax.fori_loop(0, delta, install_one, 0)
+                am_recv[src] = expected
+                pstate[PS_RECV] = pstate[PS_RECV] + delta
+
+        def scan_waits() -> None:
+            n = pstate[PS_NWAIT]
+
+            def one(i, kept):
+                ch = wait_tab[i, 0]
+                need = wait_tab[i, 1]
+                row = wait_tab[i, 2]
+                fire = chan_tot[ch] >= need
+
+                @pl.when(fire)
+                def _():
+                    dep_dec(row)
+
+                @pl.when(jnp.logical_not(fire))
+                def _():
+                    wait_tab[kept, 0] = ch
+                    wait_tab[kept, 1] = need
+                    wait_tab[kept, 2] = row
+
+                return kept + jnp.where(fire, 0, 1)
+
+            pstate[PS_NWAIT] = jax.lax.fori_loop(0, n, one, jnp.int32(0))
+
+        # ---- injection ring poll (as device/inject.py) ----
+
+        if self.inject:
+
+            def poll(consumed):
+                cp = pltpu.make_async_copy(ictl, ctlbuf, isem.at[0])
+                cp.start()
+                cp.wait()
+                tl = ctlbuf[0]
+
+                def chunk(c):
+                    base = (c // 8) * 8
+                    rp = pltpu.make_async_copy(
+                        iring.at[pl.ds(base, 8)], rowbuf, isem.at[1]
+                    )
+                    rp.start()
+                    rp.wait()
+                    n = jnp.minimum(tl - c, 8 - (c - base))
+
+                    def ins(i, _):
+                        install_fixed(lambda w: rowbuf[c - base + i, w])
+                        return 0
+
+                    jax.lax.fori_loop(0, n, ins, 0)
+                    return c + n
+
+                return jax.lax.while_loop(lambda c: c < tl, chunk, consumed)
+
+        # ---- the fold + steal hops ----
+
+        def fold_and_steal(r, inj_backlog):
+            statacc[SF_PEND] = counts[C_PENDING]
+            statacc[SF_RECV] = pstate[PS_RECV]
+            statacc[SF_OUTB] = obctl[1] - obctl[0]
+            statacc[SF_SENT] = pstate[PS_SENT]
+            statacc[SF_INJ] = inj_backlog
+
+            def f1(p, _):
+                statacc[SX_AM + p] = am_sent[me ^ p]
+                for c in range(nchan):
+                    statacc[SX_DATA + p * nchan + c] = data_sent[me ^ p, c]
+                return 0
+
+            jax.lax.fori_loop(0, ndev, f1, 0)
+
+            for k in range(nh):
+                partner = me ^ (1 << k)
+                pdev = self._did(partner)
+
+                def cpy(i, _):
+                    statsnd[i] = statacc[i]
+                    return 0
+
+                jax.lax.fori_loop(0, S, cpy, 0)
+                statsnd[S_BL] = counts[C_TAIL] - counts[C_HEAD]
+
+                @pl.when(r > 0)
+                def _(k=k):
+                    pltpu.semaphore_wait(csems.at[2 * k], 1)
+
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=statsnd, dst_ref=statrcv[k],
+                    send_sem=ssems.at[0], recv_sem=rsems.at[2 * k],
+                    device_id=pdev, device_id_type=did_type,
+                )
+                rdma.start()
+                rdma.wait()
+                for i in range(SX_AM):  # the five scalar sums
+                    statacc[i] = statacc[i] + statrcv[k][i]
+
+                def mrg(p, _, k=k):
+                    swap = ((p >> k) & 1) == 1
+
+                    @pl.when(swap)
+                    def _():
+                        statacc[SX_AM + p] = statrcv[k][SX_AM + p]
+                        for c in range(nchan):
+                            statacc[SX_DATA + p * nchan + c] = statrcv[k][
+                                SX_DATA + p * nchan + c
+                            ]
+
+                    return 0
+
+                jax.lax.fori_loop(0, ndev, mrg, 0)
+                peer_b = statrcv[k][S_BL]
+                pltpu.semaphore_signal(
+                    csems.at[2 * k], inc=1, device_id=pdev,
+                    device_id_type=did_type,
+                )
+                if self.steal:
+                    myb = counts[C_TAIL] - counts[C_HEAD]
+                    quota = jnp.clip((myb - peer_b + 1) // 2, 0, W)
+                    sendbuf[W, 0] = 0
+
+                    @pl.when(quota > 0)
+                    def _():
+                        sendbuf[W, 0] = export(quota)
+
+                    @pl.when(r > 0)
+                    def _(k=k):
+                        pltpu.semaphore_wait(csems.at[2 * k + 1], 1)
+
+                    rdma2 = pltpu.make_async_remote_copy(
+                        src_ref=sendbuf, dst_ref=inboxes[k],
+                        send_sem=ssems.at[1], recv_sem=rsems.at[2 * k + 1],
+                        device_id=pdev, device_id_type=did_type,
+                    )
+                    rdma2.start()
+                    rdma2.wait()
+                    import_rows(inboxes[k])
+                    pltpu.semaphore_signal(
+                        csems.at[2 * k + 1], inc=1, device_id=pdev,
+                        device_id_type=did_type,
+                    )
+
+        # ---- the round loop ----
+
+        core.stage()
+        stage_resident()
+        if self.inject:
+            cp0 = pltpu.make_async_copy(ictl, ctlbuf, isem.at[0])
+            cp0.start()
+            cp0.wait()
+            consumed0 = ctlbuf[2]
+        else:
+            consumed0 = jnp.int32(0)
+
+        def cond(carry):
+            r, done, consumed = carry
+            return jnp.logical_not(done) & (r < max_rounds)
+
+        def body(carry):
+            r, done, consumed = carry
+            core.sched(quantum)
+            if self.inject:
+                consumed = poll(consumed)
+                inj_backlog = ctlbuf[0] - consumed
+            else:
+                inj_backlog = jnp.int32(0)
+            drain_outbox()
+            fold_and_steal(r, inj_backlog)
+            done = (
+                (statacc[SF_PEND] == 0)
+                & (statacc[SF_OUTB] == 0)
+                & (statacc[SF_INJ] == 0)
+                & (statacc[SF_SENT] == statacc[SF_RECV])
+            )
+            # Unconditional: on the done round every delta is zero; on a
+            # max_rounds cutoff this consumes every announced arrival.
+            drain_receives()
+            scan_waits()
+            return r + 1, done, consumed
+
+        r, done, consumed = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.bool_(False), consumed0)
+        )
+        counts[C_ROUNDS] = r
+        if self.inject:
+            ctl_out[0] = ctlbuf[0]
+            ctl_out[1] = ctlbuf[1]
+            ctl_out[2] = consumed
+            for i in range(3, 8):
+                ctl_out[i] = 0
+        # Credit drain: every executed round ran every hop, and the first
+        # send of each credited channel never waited - exactly one
+        # outstanding credit per used channel once any round ran.
+        for k in range(2 * nh):
+            if not self.steal and k % 2 == 1:
+                continue
+
+            @pl.when(r >= 1)
+            def _(k=k):
+                pltpu.semaphore_wait(csems.at[k], 1)
+
+    # -- host entry --
+
+    def _build(self, quantum: int, max_rounds: int):
+        mk = self.mk
+        ndata = len(mk.data_specs)
+        ndev, nchan, nh = self.ndev, self.nchan, self.nh
+        W = self.window
+        smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+        anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
+        in_specs = [smem()] * 5 + [anyspace()] * ndata + [smem()]
+        if self.inject:
+            in_specs += [anyspace(), anyspace()]  # iring, ictl (HBM)
+        out_specs = [smem()] * 4 + [anyspace()] * ndata
+        data_shapes = [
+            jax.ShapeDtypeStruct(s.shape, s.dtype)
+            for s in mk.data_specs.values()
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((mk.capacity, DESC_WORDS), jnp.int32),
+            jax.ShapeDtypeStruct((mk.capacity,), jnp.int32),
+            jax.ShapeDtypeStruct((8,), jnp.int32),
+            jax.ShapeDtypeStruct((mk.num_values,), jnp.int32),
+        ] + data_shapes
+        if self.inject:
+            out_specs.append(smem())
+            out_shape.append(jax.ShapeDtypeStruct((8,), jnp.int32))
+        aliases = {0: 0, 2: 1, 3: 2, 4: 3}
+        for i in range(ndata):
+            aliases[5 + i] = 4 + i
+        scratch = list(mk.scratch_specs.values()) + [
+            pltpu.SMEM((mk.capacity + 1,), jnp.int32),  # free
+            pltpu.SMEM((mk.num_values // VBLOCK + 1,), jnp.int32),  # vfree
+            pltpu.SMEM((self.scan,), jnp.int32),  # candbuf
+            pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32),  # sendbuf
+            pltpu.SMEM((self.S,), jnp.int32),  # statacc
+            pltpu.SMEM((self.S,), jnp.int32),  # statsnd
+        ]
+        scratch += [pltpu.SMEM((self.S,), jnp.int32) for _ in range(nh)]
+        if self.steal:
+            scratch += [
+                pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32)
+                for _ in range(nh)
+            ]
+        scratch += [
+            pltpu.SMEM((self.outbox,), jnp.int32),  # outq_tgt
+            pltpu.SMEM((self.outbox, DESC_WORDS), jnp.int32),  # outq_desc
+            pltpu.SMEM((2,), jnp.int32),  # obctl
+            pltpu.SMEM((AMROW,), jnp.int32),  # ambuf
+            pltpu.SMEM((ndev, self.am_window, AMROW), jnp.int32),  # inbox
+            pltpu.SMEM((ndev,), jnp.int32),  # am_sent
+            pltpu.SMEM((ndev,), jnp.int32),  # am_recv
+            pltpu.SMEM((ndev,), jnp.int32),  # sent_round
+            pltpu.SMEM((ndev, nchan), jnp.int32),  # data_sent
+            pltpu.SMEM((ndev, nchan), jnp.int32),  # chan_recv
+            pltpu.SMEM((nchan,), jnp.int32),  # chan_tot
+            pltpu.SMEM((8,), jnp.int32),  # pstate
+            pltpu.SMEM((self.max_waits, 3), jnp.int32),  # wait_tab
+        ]
+        if self.inject:
+            scratch += [
+                pltpu.SMEM((8,), jnp.int32),  # ctlbuf
+                pltpu.SMEM((8, RING_ROW), jnp.int32),  # rowbuf
+            ]
+        scratch += [
+            pltpu.SemaphoreType.DMA((4,)),  # ssems: stat,row,put,am sends
+            pltpu.SemaphoreType.DMA((max(1, 2 * nh),)),  # rsems (per hop)
+            pltpu.SemaphoreType.REGULAR((max(1, 2 * nh),)),  # csems
+            pltpu.SemaphoreType.DMA((ndev,)),  # am_sems (per source)
+            pltpu.SemaphoreType.DMA((ndev, nchan)),  # chan_sems
+        ]
+        if self.inject:
+            scratch += [pltpu.SemaphoreType.DMA((2,))]  # isem
+        kern = pl.pallas_call(
+            functools.partial(self._kernel, quantum, max_rounds),
+            out_shape=tuple(out_shape),
+            in_specs=in_specs,
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch,
+            input_output_aliases=aliases,
+            interpret=pltpu.InterpretParams() if mk.interpret else False,
+        )
+        axes = self.axes
+
+        def step(tasks, succ, ring, counts, iv, *rest):
+            data_in = rest[:ndata]
+            waits = rest[ndata]
+            extra = rest[ndata + 1 :]
+            outs = kern(
+                tasks[0], succ[0], ring[0], counts[0], iv[0],
+                *[d[0] for d in data_in], waits[0],
+                *[x[0] for x in extra],
+            )
+            counts_o, iv_o = outs[2], outs[3]
+            data_o = outs[4 : 4 + ndata]
+            gcounts = jax.lax.psum(counts_o, axes)
+            return (
+                counts_o[None],
+                iv_o[None],
+                gcounts[None],
+                *[d[None] for d in data_o],
+            )
+
+        nin = 6 + ndata + (2 if self.inject else 0)
+        f = jax.shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(axes),) * nin,
+            out_specs=(P(axes),) * (3 + ndata),
+            check_vma=False,
+        )
+        return jax.jit(f)
+
+    def run(
+        self,
+        builders: Sequence[TaskGraphBuilder],
+        data: Optional[Dict[str, np.ndarray]] = None,
+        ivalues: Optional[np.ndarray] = None,
+        waits: Optional[Sequence[Sequence[Tuple[int, int, int]]]] = None,
+        inject_rows: Optional[Sequence[Sequence[Tuple]]] = None,
+        quantum: int = 64,
+        max_rounds: int = 1 << 14,
+    ):
+        """Execute all partitions fully on-device.
+
+        ``waits[d]``: host-declared wait-sets (chan_id, need, task_index),
+        as PGASMegakernel. ``inject_rows[d]``: descriptor tuples
+        ``(fn, args[, out])`` published on device d's injection ring
+        before entry (requires ``inject=True``); the in-kernel poll
+        discovers and installs them mid-run. Returns
+        (ivalues[ndev, V], data, info).
+        """
+        from .sharded import execute_partitions
+
+        mk = self.mk
+        ndev = self.ndev
+        waits = list(waits or [])
+        if len(waits) < ndev:
+            waits = waits + [[] for _ in range(ndev - len(waits))]
+        waits_arr = np.zeros((ndev, self.max_waits + 1, 3), np.int32)
+        for d, wlist in enumerate(waits):
+            if len(wlist) > self.max_waits:
+                raise ValueError(f"device {d}: too many waits")
+            waits_arr[d, 0, 0] = len(wlist)
+            for i, (ch, need, row) in enumerate(wlist):
+                if not (0 <= ch < len(self.channels)):
+                    raise ValueError(f"bad channel id {ch}")
+                if not (0 <= row < builders[d].num_tasks):
+                    raise ValueError(
+                        f"device {d}: wait names task {row} out of range"
+                    )
+                waits_arr[d, 1 + i] = (ch, need, row)
+        extra: List[np.ndarray] = [waits_arr]
+        if self.inject:
+            R = self.ring_capacity
+            iring = np.zeros((ndev, R, RING_ROW), np.int32)
+            ictl = np.zeros((ndev, 8), np.int32)
+            for d, rows in enumerate(inject_rows or []):
+                if len(rows) > R:
+                    raise ValueError(f"device {d}: injection ring overflow")
+                for i, spec in enumerate(rows):
+                    fn, args = spec[0], spec[1]
+                    out = spec[2] if len(spec) > 2 else 0
+                    iring[d, i, F_FN] = fn
+                    iring[d, i, F_SUCC0] = NO_TASK
+                    iring[d, i, F_SUCC1] = NO_TASK
+                    for j, a in enumerate(args):
+                        iring[d, i, F_A0 + j] = int(a)
+                    iring[d, i, F_OUT] = out
+                    iring[d, i, F_HOME] = NO_TASK
+                ictl[d, 0] = len(rows)
+                ictl[d, 1] = 1  # closed: single-entry run drains fully
+            extra += [iring, ictl]
+        elif inject_rows:
+            raise ValueError("inject_rows requires inject=True")
+
+        def bump_waits(tasks, succ, ring, counts):
+            # Symmetric-heap layout: host value slots occupy the SAME range
+            # on every device (the region below value_alloc), so a
+            # whole-row-migrated task's host-slot F_OUT means the same
+            # address everywhere and no device's dynamic row blocks overlap
+            # another's host slots.
+            va = max(int(counts[d][4]) for d in range(ndev))
+            for d in range(ndev):
+                counts[d][4] = va
+            if self.migratable:
+                # The migration result-slot region [rbase, num_values)
+                # must sit above every device's host value range and row
+                # blocks, or homed copies' results would alias live slots.
+                blocks = VBLOCK * mk.capacity if mk.uses_row_values else 0
+                for d in range(ndev):
+                    need = int(counts[d][4]) + blocks  # C_VALLOC
+                    if need > self.rbase:
+                        raise ValueError(
+                            f"device {d}: value region [0, {need}) overlaps "
+                            f"the migration result slots at [{self.rbase}, "
+                            f"{mk.num_values}); grow num_values by at least "
+                            f"{need - self.rbase}"
+                        )
+            for d, wlist in enumerate(waits):
+                for (_, _, row) in wlist:
+                    tasks[d, row, F_DEP] += 1
+                bumped = {row for (_, _, row) in wlist}
+                if not bumped:
+                    continue
+                old_n = counts[d][C_TAIL]
+                keep = [x for x in ring[d][:old_n] if x not in bumped]
+                ring[d][: len(keep)] = keep
+                counts[d][C_TAIL] = len(keep)
+
+        key = (quantum, max_rounds)
+        if key not in self._jitted:
+            self._jitted[key] = self._build(quantum, max_rounds)
+        iv_o, data_o, info = execute_partitions(
+            mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,
+            with_rounds=True, mutate=bump_waits, extra_inputs=extra,
+        )
+        info["rounds"] = info.pop("steal_rounds")
+        if info["overflow"]:
+            raise RuntimeError(
+                "resident kernel overflow: task table, value slots, "
+                "outbox, lock queue, or wait table exceeded - raise the "
+                "limits or coarsen"
+            )
+        if info["pending"] != 0:
+            raise RuntimeError(
+                f"resident kernel stalled: {info['pending']} pending after "
+                f"{info['executed']} executed ({info['rounds']} rounds) - "
+                "a wait/lock whose release never comes, or max_rounds too "
+                "small"
+            )
+        return iv_o, data_o, info

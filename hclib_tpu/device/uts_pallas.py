@@ -199,7 +199,7 @@ def _dfs_kernel(
     jax.jit,
     static_argnames=(
         "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
-        "min_idle_div", "interpret",
+        "min_idle_div", "interpret", "vmem_limit_bytes",
     ),
 )
 def _uts_dfs_pallas(
@@ -215,6 +215,7 @@ def _uts_dfs_pallas(
     lanes: tuple,
     min_idle_div: int = 8,
     interpret: bool = False,
+    vmem_limit_bytes: int = 100 * 2**20,
 ):
     S = stack_size
     rows, cols = lanes
@@ -255,7 +256,7 @@ def _uts_dfs_pallas(
         compiler_params=(
             None
             if interpret
-            else pltpu.CompilerParams(vmem_limit_bytes=100 * 2**20)
+            else pltpu.CompilerParams(vmem_limit_bytes=vmem_limit_bytes)
         ),
     )
     nodes, leaves, maxd, ctl = kernel(
@@ -282,6 +283,7 @@ def uts_pallas(
     min_idle_div: int = 8,
     interpret: Optional[bool] = None,
     depth_bound: Optional[int] = None,
+    vmem_limit_bytes: int = 100 * 2**20,
 ) -> dict:
     """uts_vec with the whole traversal fused into one Pallas kernel; same
     exact counts, same host seeding, same result dict.
@@ -292,7 +294,10 @@ def uts_pallas(
     same-shape ``take_along_axis`` in-row lookups (the one gather form
     Mosaic supports); the table's depth cap must fit a 128-lane row.
     EXPDEC's cap comes from ``depth_bound`` (default 8*gen_mx) and the
-    run fails loudly if the tree actually reaches it."""
+    run fails loudly if the tree actually reaches it. The scoped-vmem
+    budget defaults to 100 MiB (sized for v5e's 128 MiB physical VMEM);
+    pass a smaller ``vmem_limit_bytes`` on TPU generations with less
+    (mirrors Megakernel.vmem_limit_bytes)."""
     if lanes[1] != 128:
         raise ValueError("uts_pallas lanes must be (rows, 128)")
     import time
@@ -361,6 +366,7 @@ def uts_pallas(
         lanes=tuple(lanes),
         min_idle_div=min_idle_div,
         interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
     )
     if device is not None:
         args = tuple(

@@ -334,10 +334,13 @@ class ProcWorld:
 
     def barrier(self) -> None:
         self._check_alive()
-        self._barrier_n += 1
-        self._c.wait_at_barrier(
-            f"{self._ns}/b/{self._barrier_n}", self._timeout_ms
-        )
+        # Under _seq_lock: AM handlers may invoke world ops from the
+        # progress thread, and a torn increment would desynchronize
+        # barrier ids across ranks (a wedge, not an error).
+        with self._seq_lock:
+            self._barrier_n += 1
+            bn = self._barrier_n
+        self._c.wait_at_barrier(f"{self._ns}/b/{bn}", self._timeout_ms)
 
     _REDUCE_FNS = {
         "sum": lambda a, b: a + b,
@@ -362,8 +365,9 @@ class ProcWorld:
         self._check_alive()
         arr = np.asarray(arr)
         fn = self._REDUCE_FNS[op]
-        self._ar_epoch += 1
-        e = self._ar_epoch
+        with self._seq_lock:  # see barrier(): epoch ids must not tear
+            self._ar_epoch += 1
+            e = self._ar_epoch
         if self._native_runtime and arr.nbytes >= self.BULK_THRESHOLD:
             want = np.uint8(1 if self._bulk_usable(op) else 0)
             agreed = self._kv_allreduce(e, want, np.minimum,
@@ -428,10 +432,11 @@ class ProcWorld:
         self._c.key_value_set_bytes(key, _pack({}, np.asarray(arr)))
 
     def _ar_recv(self, epoch: int, src: int, rnd: int) -> np.ndarray:
+        # Chunked wait with tombstone detection (_await_key): an allreduce
+        # whose partner died surfaces as a prompt ProcWorldError naming the
+        # dead rank, not a raw DEADLINE_EXCEEDED after the full timeout.
         key = f"{self._ns}/ar/{epoch}/{rnd}/{src}/{self.rank}"
-        b = self._c.blocking_key_value_get_bytes(key, self._timeout_ms)
-        self._c.key_value_delete(key)
-        return _unpack(b)[1]
+        return self._await_key(key, src)
 
     # ---- symmetric heap + one-sided ops (modules/openshmem) ----
 
@@ -785,7 +790,17 @@ class ProcWorldModule(Module):
         key = w._next_send_key(dst, tag)
 
         def test(op):
-            w._deposit(key, arr)  # transient failures retried by _guarded
+            # Transient failures are retried by _guarded, but the deposit
+            # is not idempotent: if the first set committed server-side and
+            # only the RPC response was lost, the retry sees
+            # ALREADY_EXISTS. The slot is ours by construction (claimed
+            # under _seq_lock above), so that means delivered - success.
+            try:
+                w._deposit(key, arr)
+            except Exception as e:
+                if _status(e) == "ALREADY_EXISTS":
+                    return True, None
+                raise
             return True, None
 
         def on_fail():

@@ -8,7 +8,18 @@ locale (modules/mpi, modules/openshmem). TPU-first, the equivalents are:
 with the device mesh replacing the locality-graph's machine JSON.
 """
 
-from .collectives import all_gather, all_to_all, psum, reduce_scatter, ring_permute
+from .collectives import (
+    all_gather,
+    all_to_all,
+    barrier,
+    bcast,
+    exscan,
+    psum,
+    reduce,
+    reduce_scatter,
+    ring_allreduce,
+    ring_permute,
+)
 from .mesh import make_mesh, mesh_locality_graph
 
 __all__ = [
@@ -19,4 +30,9 @@ __all__ = [
     "reduce_scatter",
     "all_to_all",
     "ring_permute",
+    "bcast",
+    "reduce",
+    "exscan",
+    "barrier",
+    "ring_allreduce",
 ]

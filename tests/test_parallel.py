@@ -59,6 +59,36 @@ def test_collectives_on_mesh():
     assert list(np.asarray(r)[:, 0]) == [3, 0, 1, 2]  # rotated shards
 
 
+def test_composed_collectives():
+    """The composed tier (bcast/reduce/exscan/barrier/ring_allreduce -
+    MPI_Bcast/Reduce/Exscan/Barrier parity, hclib_mpi.cpp:220-286): exact
+    against numpy references, including the explicit ring-step allreduce
+    matching psum."""
+    mesh = _mesh(8)
+
+    def step(x):
+        b = collectives.bcast(x[0], "d", root=3)
+        r = collectives.reduce(x[0], "d", root=2)
+        e = collectives.exscan(x[0], "d")
+        t = collectives.barrier("d")
+        ra = collectives.ring_allreduce(x[0], "d")
+        return b[None], r[None], e[None], t[None], ra[None]
+
+    f = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P("d"),), out_specs=(P("d"),) * 5,
+            check_vma=False,
+        )
+    )
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    b, r, e, t, ra = map(np.asarray, f(x))
+    assert (b == x[3]).all()
+    assert (r[2] == x.sum(0)).all() and (r[0] == 0).all()
+    assert np.allclose(e, np.cumsum(x, axis=0) - x)  # exclusive prefix
+    assert (t == 8).all()
+    assert np.allclose(ra, np.tile(x.sum(0), (8, 1)))
+
+
 def test_sharded_megakernel_fib():
     mesh = _mesh(4)
     mk = make_fib_megakernel(capacity=1024, interpret=True)
