@@ -123,6 +123,18 @@ class ICIStealMegakernel:
         self.window = int(window)
         self.scan = int(scan) if scan is not None else 2 * self.window
         self._jitted: Dict[Any, Any] = {}
+        # Power-of-two meshes delegate to the unified resident kernel
+        # (device/resident.py) in its steal-only, whole-row-migration
+        # configuration - this class remains the non-pof2 fallback (and
+        # the named legacy API).
+        self._resident = None
+        if self._pof2:
+            from .resident import ResidentKernel
+
+            self._resident = ResidentKernel(
+                mk, mesh, steal=True, migratable_fns=self.migratable_fns,
+                homed=False, window=self.window, scan=self.scan,
+            )
 
     # -- shared kernel helpers --
 
@@ -691,6 +703,13 @@ class ICIStealMegakernel:
         (ivalues[ndev, V], data, info)."""
         from .sharded import execute_partitions
 
+        if self._resident is not None:
+            iv_o, data_o, info = self._resident.run(
+                builders, data=data, ivalues=ivalues, quantum=quantum,
+                max_rounds=max_rounds,
+            )
+            info["steal_rounds"] = info.pop("rounds")
+            return iv_o, data_o, info
         key = (quantum, max_rounds)
         if key not in self._jitted:
             self._jitted[key] = self._build(quantum, max_rounds)

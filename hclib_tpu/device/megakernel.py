@@ -78,7 +78,7 @@ class KernelContext:
 
     def __init__(self, idx, tasks, succ, ready, counts, ivalues, data,
                  scratch, capacity, free, num_values, vfree,
-                 uses_row_values=False):
+                 uses_row_values=False, tracks_home=False):
         self.idx = idx  # this task's descriptor index
         self._tasks = tasks
         self._succ = succ
@@ -96,6 +96,11 @@ class KernelContext:
         # Free-stack of recycled VBLOCK-word value blocks, same layout.
         self._vfree = vfree
         self._uses_row_values = uses_row_values
+        # Whether this kernel composition can host migrated (homed) rows:
+        # only then do spawn/take_continuation maintain the F_HOME words
+        # (ResidentKernel sets Megakernel.tracks_home; plain megakernels
+        # skip the dead scalar writes - the cost unit on this tier).
+        self._tracks_home = tracks_home
 
     # -- descriptor access --
 
@@ -248,12 +253,13 @@ class KernelContext:
         t[self.idx, F_SUCC0] = jnp.int32(NO_TASK)
         t[self.idx, F_SUCC1] = jnp.int32(NO_TASK)
         t[self.idx, F_CSR_N] = 0
-        # A migrated copy's continuation inherits the home-link as well:
-        # whoever ends the chain forwards the result to the home proxy
-        # (device/resident.py's remote-completion protocol).
-        t[new_idx, F_HOME] = t[self.idx, F_HOME]
-        t[new_idx, F_HROW] = t[self.idx, F_HROW]
-        t[self.idx, F_HOME] = jnp.int32(NO_TASK)
+        if self._tracks_home:
+            # A migrated copy's continuation inherits the home-link as
+            # well: whoever ends the chain forwards the result to the home
+            # proxy (device/resident.py's remote-completion protocol).
+            t[new_idx, F_HOME] = t[self.idx, F_HOME]
+            t[new_idx, F_HROW] = t[self.idx, F_HROW]
+            t[self.idx, F_HOME] = jnp.int32(NO_TASK)
 
     def spawn(
         self,
@@ -263,13 +269,26 @@ class KernelContext:
         succ0=NO_TASK,
         succ1=NO_TASK,
         out=0,
+        nargs: Optional[int] = None,
     ):
         """Allocate + enqueue a new task descriptor; returns its index.
 
         On table overflow the task is dropped and counts[C_OVERFLOW] is set
         (the reference asserts on deque overflow, src/hclib-runtime.c:520-524;
         here the host checks the flag after the kernel returns).
+
+        ``nargs`` (static) bounds how many arg words the new task will ever
+        read (default: all 6 are zeroed). Scalar SMEM writes are the unit
+        of cost on this tier (~1 cycle each), so a spawn-heavy kernel that
+        declares its arity skips up to 6 dead writes per spawn - recycled
+        rows may hold stale words beyond nargs, which a conforming kernel
+        never reads (the same contract C lets the reference's task structs
+        rely on, inc/hclib-task.h:32-44).
         """
+        if nargs is None:
+            nargs = 6
+        if len(args) > nargs:
+            raise ValueError(f"{len(args)} args exceed declared nargs={nargs}")
         nfree = self._free[0]
         use_free = nfree > 0
         a_free = self._free[jnp.maximum(nfree, 1)]
@@ -294,17 +313,21 @@ class KernelContext:
             self._tasks[a_clamped, F_DEP] = jnp.int32(dep_count)
             self._tasks[a_clamped, F_SUCC0] = jnp.int32(succ0)
             self._tasks[a_clamped, F_SUCC1] = jnp.int32(succ1)
-            self._tasks[a_clamped, F_CSR_OFF] = 0
+            # F_CSR_OFF is only ever read under F_CSR_N > 0, so a stale
+            # offset in a recycled row is dead - no write needed.
             self._tasks[a_clamped, F_CSR_N] = 0
-            for i in range(6):
+            for i in range(nargs):
                 self._tasks[a_clamped, F_A0 + i] = (
                     jnp.int32(args[i]) if i < len(args) else 0
                 )
             self._tasks[a_clamped, F_OUT] = jnp.int32(out)
-            # Recycled rows may carry a stale home-link/value-mask from a
-            # previously migrated occupant; fresh spawns are local tasks.
-            self._tasks[a_clamped, F_HOME] = jnp.int32(NO_TASK)
-            self._tasks[a_clamped, F_VMASK] = 0
+            if self._tracks_home:
+                # Recycled rows may carry a stale home-link from a
+                # previously migrated occupant; fresh spawns are local
+                # tasks. (F_VMASK needs no clear: it is only set on wire
+                # copies, and the import path zeroes it after
+                # rehydration.)
+                self._tasks[a_clamped, F_HOME] = jnp.int32(NO_TASK)
 
         @pl.when(ok & (jnp.int32(dep_count) == 0))
         def _():
@@ -400,6 +423,10 @@ class Megakernel:
         # scoped-vmem budget (e.g. 1024x1024 f32 tile pipelines) raise it
         # here; real VMEM is 128 MiB on v5e.
         self.vmem_limit_bytes = vmem_limit_bytes
+        # Set by ResidentKernel when homed migration is configured: the
+        # scheduler then maintains descriptor home-link words on spawn and
+        # continuation transfer (dead writes otherwise - skipped).
+        self.tracks_home = False
         self._jitted: Dict[int, Any] = {}  # fuel -> compiled call
         # Packs counts + ivalues into one array so the host needs a single
         # device->host fetch (transfers are ~67ms each through the axon
@@ -559,7 +586,7 @@ class Megakernel:
             ctx = KernelContext(
                 idx, tasks, succ, ready, counts, ivalues, data, scratch,
                 capacity, free, num_values, vfree,
-                self.uses_row_values,
+                self.uses_row_values, self.tracks_home,
             )
             if ctx_hook is not None:
                 ctx_hook(ctx)

@@ -142,6 +142,22 @@ class PGASMegakernel:
         self.am_window = int(am_window)
         self.outbox = int(outbox)
         self.max_waits = int(max_waits)
+        # Power-of-two meshes delegate to the unified resident kernel
+        # (device/resident.py) in its PGAS-only configuration, which also
+        # upgrades the counting protocol: per-source arrival semaphores
+        # (closing this module's shared-semaphore cross-round aliasing
+        # exposure) and O(ndev log ndev) stat routing instead of the ring
+        # allreduce of an O(ndev^2) matrix. This class remains the
+        # non-pof2 fallback (and the named legacy API).
+        self._resident = None
+        if self.ndev & (self.ndev - 1) == 0:
+            from .resident import ResidentKernel
+
+            self._resident = ResidentKernel(
+                mk, mesh, steal=False, channels=dict(channels or {}),
+                am_window=self.am_window, outbox=self.outbox,
+                max_waits=self.max_waits,
+            )
         # Stat-vector layout (ring-allreduced every round; all entries sum).
         self.ST_AM = 3  # [src * ndev + dst] AM send counts
         self.ST_DATA = 3 + self.ndev * self.ndev  # [dst * nchan + chan]
@@ -660,6 +676,11 @@ class PGASMegakernel:
         """
         from .sharded import execute_partitions
 
+        if self._resident is not None:
+            return self._resident.run(
+                builders, data=data, ivalues=ivalues, waits=waits,
+                quantum=quantum, max_rounds=max_rounds,
+            )
         mk = self.mk
         ndev = self.ndev
         waits = list(waits or [])

@@ -177,6 +177,7 @@ class ResidentKernel:
         *,
         steal: bool = True,
         migratable_fns: Union[Iterable[int], Dict[int, Sequence[int]]] = (),
+        homed: bool = True,
         channels: Optional[Dict[str, Tuple[str, int]]] = None,
         inject: bool = False,
         window: int = 8,
@@ -204,6 +205,11 @@ class ResidentKernel:
         self.ndev = int(np.prod(dims))
         self.nh = self.ndev.bit_length() - 1  # log2 hops (0 for 1 device)
         self.steal = bool(steal)
+        # homed=False restricts migration to the round-3 semantics (only
+        # link-free rows move, whole; no proxies, no result forwarding, no
+        # value-slot reservation) - the configuration the legacy
+        # ICIStealMegakernel wrapper delegates to.
+        self.homed = bool(homed)
         if isinstance(migratable_fns, dict):
             self.migratable: Dict[int, Tuple[int, ...]] = {
                 int(f): tuple(int(i) for i in v)
@@ -211,6 +217,11 @@ class ResidentKernel:
             }
         else:
             self.migratable = {int(f): () for f in migratable_fns}
+        if self.migratable and self.homed:
+            # The scheduler must maintain descriptor home-link words on
+            # spawn/continuation transfer (plain megakernels skip these
+            # scalar writes - see Megakernel.tracks_home).
+            mk.tracks_home = True
         for f, vargs in self.migratable.items():
             if len(vargs) > VBLOCK:
                 raise ValueError(
@@ -246,7 +257,9 @@ class ResidentKernel:
         # its completion hook reads it in the same scheduler step, so the
         # serial scheduler makes reuse race-free (module docstring).
         self.rbase = (
-            mk.num_values - mk.capacity if self.migratable else mk.num_values
+            mk.num_values - mk.capacity
+            if (self.migratable and self.homed)
+            else mk.num_values
         )
         if self.rbase <= 0:
             raise ValueError(
@@ -394,6 +407,12 @@ class ResidentKernel:
             return; target-side arrival is what wait_until observes."""
             if not isinstance(chan, int):
                 raise TypeError("chan must be a static channel id")
+            if not (0 <= chan < len(self.channels)):
+                raise ValueError(
+                    f"channel id {chan} not configured (have "
+                    f"{len(self.channels)}): a kernel using ctx.pgas.put "
+                    "needs its channel declared in ResidentKernel(channels=)"
+                )
             bname, rows = self.channels[chan]
             buf = data[bname]
             rdma = pltpu.make_async_remote_copy(
@@ -475,7 +494,7 @@ class ResidentKernel:
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True, ctx_hook,
-            complete_hook if self.migratable else None,
+            complete_hook if (self.migratable and self.homed) else None,
             value_limit=RBASE,
         )
 
@@ -542,6 +561,8 @@ class ResidentKernel:
                         tasks[row, F_A0 + i] = base + jj
 
                     jj = jj + bit
+                # Cleared HERE (not in spawn): wire copies are the only
+                # writers of F_VMASK, so the import path owns its reset.
                 tasks[row, F_VMASK] = 0
             return row
 
@@ -549,11 +570,29 @@ class ResidentKernel:
 
         wl = sorted(self.migratable)
 
+        def homed_elig_of(cand):
+            """Rows migrate as homed copies when they carry successor
+            links, are already migrated copies, or write a DYNAMIC value
+            slot (>= the symmetric host region): a dynamic out address is
+            only valid on its home device, so the result must forward
+            home rather than land at the same index on the thief (where
+            it could alias a live block)."""
+            return (
+                (tasks[cand, F_SUCC0] != NO_TASK)
+                | (tasks[cand, F_SUCC1] != NO_TASK)
+                | (tasks[cand, F_CSR_N] > 0)
+                | (tasks[cand, F_HOME] >= 0)
+                | (tasks[cand, F_OUT] >= counts[C_VBASE])
+            )
+
         def elig_of(cand):
             d_fn = tasks[cand, F_FN]
             ok = jnp.bool_(False)
             for f in wl:
                 ok = ok | (d_fn == f)
+            if not self.homed:
+                # Round-3 semantics: only link-free rows may move.
+                ok = ok & jnp.logical_not(homed_elig_of(cand))
             return ok
 
         def export(quota):
@@ -577,19 +616,9 @@ class ResidentKernel:
             nsend = jnp.minimum(quota, nelig)
 
             def homed_of(cand):
-                """Rows migrate as homed copies when they carry successor
-                links, are already migrated copies, or write a DYNAMIC
-                value slot (>= the symmetric host region): a dynamic out
-                address is only valid on its home device, so the result
-                must forward home rather than land at the same index on
-                the thief (where it could alias a live block)."""
-                return (
-                    (tasks[cand, F_SUCC0] != NO_TASK)
-                    | (tasks[cand, F_SUCC1] != NO_TASK)
-                    | (tasks[cand, F_CSR_N] > 0)
-                    | (tasks[cand, F_HOME] >= 0)
-                    | (tasks[cand, F_OUT] >= counts[C_VBASE])
-                )
+                if not self.homed:
+                    return jnp.bool_(False)  # eligibility already excluded
+                return homed_elig_of(cand)
 
             def classify(j, carry):
                 se, kp, nw = carry
@@ -1247,7 +1276,7 @@ class ResidentKernel:
             va = max(int(counts[d][4]) for d in range(ndev))
             for d in range(ndev):
                 counts[d][4] = va
-            if self.migratable:
+            if self.migratable and self.homed:
                 # The migration result-slot region [rbase, num_values)
                 # must sit above every device's host value range and row
                 # blocks, or homed copies' results would alias live slots.

@@ -99,14 +99,17 @@ def execute_partitions(
     wait-dependency bumps); ``extra_inputs`` are device_put after the data
     buffers (same leading device axis)."""
     tasks, succ, ring, counts = partition_builders(mk, ndev, builders)
-    if mutate is not None:
-        mutate(tasks, succ, ring, counts)
     if ivalues is None:
         ivalues = np.zeros((ndev, mk.num_values), np.int32)
     else:
         ivalues = np.asarray(ivalues)
         for d in range(ndev):
             mk.widen_value_alloc(counts[d], ivalues[d])
+    # Mutate AFTER preset widening: runners that symmetrize or validate
+    # the per-device value_alloc (ResidentKernel's symmetric-heap layout
+    # and migration result-slot check) must see the final values.
+    if mutate is not None:
+        mutate(tasks, succ, ring, counts)
     for c in counts:
         mk.check_row_values(int(c[C_VALLOC]))
     data = dict(data or {})

@@ -47,13 +47,16 @@ def _fib_kernel(ctx: KernelContext) -> None:
         # the value block OWNED BY SUM'S ROW - no allocator call, and the
         # block recycles with the row when SUM completes (by which point
         # its result is already in the parent's block).
-        sum_idx = ctx.spawn(SUM, dep_count=2, out=ctx.out_slot)
+        # nargs declares each spawn's true arity: the scalar tier's cost IS
+        # its SMEM op count, so dead arg-zeroing writes are skipped (SUM's
+        # two args are set right below via set_arg).
+        sum_idx = ctx.spawn(SUM, dep_count=2, out=ctx.out_slot, nargs=0)
         ctx.take_continuation(sum_idx)
         base = ctx.row_values(sum_idx)
         ctx.set_arg(sum_idx, 0, base)
         ctx.set_arg(sum_idx, 1, base + 1)
-        ctx.spawn(FIB, [n - 1], succ0=sum_idx, out=base)
-        ctx.spawn(FIB, [n - 2], succ0=sum_idx, out=base + 1)
+        ctx.spawn(FIB, [n - 1], succ0=sum_idx, out=base, nargs=1)
+        ctx.spawn(FIB, [n - 2], succ0=sum_idx, out=base + 1, nargs=1)
 
 
 def _sum_kernel(ctx: KernelContext) -> None:

@@ -98,8 +98,10 @@ def test_ici_steal_race_free_under_detector():
         _make_mk(), cpu_mesh(ndev, axis_name="queues"),
         migratable_fns=[BUMP], window=4,
     )
-    # Rebuild with the race detector on.
-    orig = smk._build
+    # Rebuild with the race detector on (pof2 meshes delegate to the
+    # resident kernel, so patch the build that will actually run).
+    target = smk._resident if smk._resident is not None else smk
+    orig = target._build
 
     def build_with_detector(quantum, max_rounds):
         import unittest.mock as m
@@ -112,7 +114,7 @@ def test_ici_steal_race_free_under_detector():
         ):
             return orig(quantum, max_rounds)
 
-    smk._build = build_with_detector
+    target._build = build_with_detector
     iv, _, info = smk.run(_skewed(ndev, ntasks), quantum=4)
     assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
 

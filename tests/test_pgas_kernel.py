@@ -244,7 +244,10 @@ def test_pgas_race_free_under_detector():
 
     mk.kernel_names.append("send_all")
     mk.kernel_fns.append(send_all)
-    orig = pg._build
+    # pof2 meshes delegate to the resident kernel: patch the build that
+    # will actually run.
+    target = pg._resident if pg._resident is not None else pg
+    orig = target._build
 
     def build_with_detector(quantum, max_rounds):
         import unittest.mock as m
@@ -256,7 +259,7 @@ def test_pgas_race_free_under_detector():
         ):
             return orig(quantum, max_rounds)
 
-    pg._build = build_with_detector
+    target._build = build_with_detector
     builders = [TaskGraphBuilder() for _ in range(ndev)]
     for d in range(ndev):
         builders[d].add(SEND)
