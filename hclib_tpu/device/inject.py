@@ -64,6 +64,13 @@ class StreamingMegakernel:
     """Megakernel + injection ring: a resident scheduler whose task supply
     is open-ended (the streaming/AM substrate).
 
+    Relationship to the unified runner: ``ResidentKernel(inject=True)``
+    subsumes this capability on device meshes (injection composes there
+    with stealing and PGAS in one kernel, and ``dryrun_multichip``
+    exercises exactly that). This class remains the single-device,
+    no-mesh specialization whose host loop supports LIVE re-entrant
+    production (inject()/close() from any thread between entries).
+
     ``mk`` supplies kernels/capacities; the injection ring holds
     ``ring_capacity`` rows. The ring is a linear (non-wrapping) append log
     per stream: capacity bounds TOTAL injected tasks per run_stream (keeps

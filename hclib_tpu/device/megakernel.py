@@ -48,13 +48,37 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 
-__all__ = ["KernelContext", "Megakernel", "VBLOCK"]
+__all__ = ["KernelContext", "Megakernel", "VBLOCK", "decode_overflow"]
+
+
+def decode_overflow(mask: int) -> str:
+    """Human-readable exhaustion sources from a C_OVERFLOW bitmask."""
+    names = [
+        (OVF_ROWS, "task-table rows"),
+        (OVF_VALUES, "value slots"),
+        (OVF_ENGINE, "vector-tier lane stacks/step budget"),
+        (OVF_OUTBOX, "AM outbox"),
+        (OVF_WAITS, "wait table"),
+        (OVF_LOCKQ, "lock FIFO"),
+    ]
+    hit = [n for bit, n in names if mask & bit]
+    return " + ".join(hit) if hit else f"unknown (mask {mask})"
 
 # Value slots are allocated in fixed blocks of this many words so freed
 # blocks are interchangeable (alloc_values' k is static per call site, so a
 # shared free stack must hand out uniform sizes). Allocations larger than
 # VBLOCK fall back to exact-size bump allocation without recycling.
 VBLOCK = 4
+
+# C_OVERFLOW is a BITMASK of exhaustion sources so a failed run names
+# what ran out instead of guessing (OVF_* below; legacy paths that write
+# a plain 1 read as OVF_ROWS).
+OVF_ROWS = 1     # task-table rows (spawn/install)
+OVF_VALUES = 2   # value slots (alloc_values/free_values)
+OVF_ENGINE = 4   # vector-tier per-lane stacks / step budget
+OVF_OUTBOX = 8   # resident AM outbox
+OVF_WAITS = 16   # resident wait table
+OVF_LOCKQ = 32   # resident lock FIFO
 
 # counts[] slots
 C_HEAD = 0
@@ -164,7 +188,8 @@ class KernelContext:
             ok = base + k <= self._num_values
             self._counts[C_VALLOC] = jnp.where(ok, base + k, base)
             self._counts[C_OVERFLOW] = jnp.where(
-                ok, self._counts[C_OVERFLOW], 1
+                ok, self._counts[C_OVERFLOW],
+                self._counts[C_OVERFLOW] | OVF_VALUES,
             )
             return jnp.where(ok, base, jnp.maximum(self._num_values - k, 0))
         nfree = self._vfree[0]
@@ -176,7 +201,10 @@ class KernelContext:
         self._counts[C_VALLOC] = jnp.where(
             jnp.logical_not(use_free) & ok, b_new + VBLOCK, b_new
         )
-        self._counts[C_OVERFLOW] = jnp.where(ok, self._counts[C_OVERFLOW], 1)
+        self._counts[C_OVERFLOW] = jnp.where(
+            ok, self._counts[C_OVERFLOW],
+            self._counts[C_OVERFLOW] | OVF_VALUES,
+        )
         return jnp.where(
             use_free,
             b_free,
@@ -219,7 +247,8 @@ class KernelContext:
         # leaks; no corruption).
         self._vfree[nf_c] = jnp.where(ok, base, self._vfree[nf_c])
         self._counts[C_OVERFLOW] = jnp.where(
-            ok, self._counts[C_OVERFLOW], 1
+            ok, self._counts[C_OVERFLOW],
+            self._counts[C_OVERFLOW] | OVF_VALUES,
         )
 
     def push_ready(self, t) -> None:
@@ -237,7 +266,8 @@ class KernelContext:
         """Raise the overflow flag where ``cond`` (host raises after the
         kernel returns)."""
         self._counts[C_OVERFLOW] = jnp.where(
-            cond, 1, self._counts[C_OVERFLOW]
+            cond, self._counts[C_OVERFLOW] | OVF_ENGINE,
+            self._counts[C_OVERFLOW],
         )
 
     def take_continuation(self, new_idx) -> None:
@@ -335,7 +365,7 @@ class KernelContext:
 
         @pl.when(jnp.logical_not(ok))
         def _():
-            self._counts[C_OVERFLOW] = 1
+            self._counts[C_OVERFLOW] = self._counts[C_OVERFLOW] | OVF_ROWS
 
         return a_clamped
 
@@ -680,7 +710,7 @@ class Megakernel:
 
             @pl.when(jnp.logical_not(ok))
             def _():
-                counts[C_OVERFLOW] = 1
+                counts[C_OVERFLOW] = counts[C_OVERFLOW] | OVF_ROWS
 
             return row
 
@@ -871,13 +901,10 @@ class Megakernel:
         }
         if info["overflow"]:
             raise RuntimeError(
-                f"megakernel overflow: task-table capacity={self.capacity} "
-                f"exceeded by the live set, value slots num_values="
-                f"{self.num_values} exhausted, more free_values calls "
-                "than allocated blocks (double-free / host-preset base), "
-                "or a vector-tier task overran its spec (per-lane "
-                "stack_depth too shallow for the subtree, or max_steps "
-                "exhausted); raise the limits, coarsen tasks, or audit frees"
+                f"megakernel overflow: "
+                f"{decode_overflow(int(counts_np[C_OVERFLOW]))} exhausted "
+                f"(capacity={self.capacity}, num_values={self.num_values}); "
+                "raise the limits, coarsen tasks, or audit frees"
             )
         if info["pending"] != 0:
             raise RuntimeError(

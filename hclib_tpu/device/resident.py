@@ -28,8 +28,12 @@ re-designed as a **home-link protocol**:
 - Whoever ends the chain forwards its out-slot value home in a
   **remote-completion active message**; the home device writes the value
   into the proxy's out slot and completes the proxy - firing the real
-  successor edges exactly as if the task had run at home. Chains compose:
-  a proxy that is itself a migrated copy forwards again.
+  successor edges exactly as if the task had run at home.
+- Copies migrate ONCE: re-exporting a homed copy would leave an extra
+  proxy row on every intermediate device until the completion chain
+  unwinds (measured as task-table exhaustion under churny windows), so
+  copies are steal-ineligible; load still spreads through the fresh
+  tasks migrated work spawns on the thief.
 - A migrated kernel's *value-slot arguments* (args that index the local
   ivalues buffer, declared per kernel id in ``migratable_fns``) are
   dereferenced at export - they are final, the row was ready - and
@@ -113,6 +117,9 @@ from .descriptor import (
 )
 from .megakernel import (
     C_EXECUTED,
+    OVF_LOCKQ,
+    OVF_OUTBOX,
+    OVF_WAITS,
     C_HEAD,
     C_OVERFLOW,
     C_PENDING,
@@ -186,6 +193,7 @@ class ResidentKernel:
         outbox: int = 256,
         max_waits: int = 64,
         ring_capacity: int = 256,
+        proxy_cap: Optional[int] = None,
     ) -> None:
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ResidentKernel wants a 1D or 2D mesh")
@@ -252,6 +260,17 @@ class ResidentKernel:
         self.outbox = int(outbox)
         self.max_waits = int(max_waits)
         self.ring_capacity = -(-int(ring_capacity) // 8) * 8
+        # Outstanding-proxy budget: a homed export pins a proxy row until
+        # the migrated SUBTREE completes remotely (its continuation chain
+        # sends the completion), so unthrottled migration of dep-bearing
+        # work can pin O(migrations) rows for O(subtree) time - measured
+        # as task-table exhaustion. Above this many live proxies a device
+        # stops exporting dep-bearing rows (link-free rows still move);
+        # local execution continues, so this throttles, never deadlocks.
+        self.proxy_cap = (
+            int(proxy_cap) if proxy_cap is not None
+            else max(8, mk.capacity // 4)
+        )
         # Migration result slots: one per descriptor row, at the top of the
         # value buffer. The chain-ending task writes its result there and
         # its completion hook reads it in the same scheduler step, so the
@@ -365,7 +384,7 @@ class ResidentKernel:
         me = self._flat_me()
 
         # pstate slots
-        PS_RECV, PS_NWAIT, PS_SENT = 0, 1, 2
+        PS_RECV, PS_NWAIT, PS_SENT, PS_PROXIES = 0, 1, 2, 3
 
         # ---- outbox / active messages ----
 
@@ -400,7 +419,7 @@ class ResidentKernel:
 
             @pl.when(jnp.logical_not(ok))
             def _():
-                counts[C_OVERFLOW] = 1
+                counts[C_OVERFLOW] = counts[C_OVERFLOW] | OVF_OUTBOX
 
         def op_put(dev, chan: int, dst_row, src_row) -> None:
             """One-sided channel write (SHMEM put): local completion on
@@ -444,7 +463,7 @@ class ResidentKernel:
 
             @pl.when(jnp.logical_not(ok))
             def _():
-                counts[C_OVERFLOW] = 1
+                counts[C_OVERFLOW] = counts[C_OVERFLOW] | OVF_WAITS
 
         def op_count(chan: int):
             return chan_tot[chan]
@@ -572,27 +591,46 @@ class ResidentKernel:
 
         def homed_elig_of(cand):
             """Rows migrate as homed copies when they carry successor
-            links, are already migrated copies, or write a DYNAMIC value
-            slot (>= the symmetric host region): a dynamic out address is
-            only valid on its home device, so the result must forward
-            home rather than land at the same index on the thief (where
-            it could alias a live block)."""
+            links or write a DYNAMIC value slot (>= the symmetric host
+            region): a dynamic out address is only valid on its home
+            device, so the result must forward home rather than land at
+            the same index on the thief (where it could alias a live
+            block). (Rows that are already migrated copies never reach
+            this classification - elig_of's migrate-once term excludes
+            F_HOME >= 0 rows from export entirely.)"""
             return (
                 (tasks[cand, F_SUCC0] != NO_TASK)
                 | (tasks[cand, F_SUCC1] != NO_TASK)
                 | (tasks[cand, F_CSR_N] > 0)
-                | (tasks[cand, F_HOME] >= 0)
                 | (tasks[cand, F_OUT] >= counts[C_VBASE])
             )
 
-        def elig_of(cand):
+        def elig_of(cand, allow_homed):
+            """``allow_homed`` is SNAPSHOTTED once per export scan: the
+            proxy counter moves while classify takes rows, and an
+            eligibility that flipped mid-scan would ship fewer rows than
+            the announced count (stale sendbuf entries on the wire)."""
             d_fn = tasks[cand, F_FN]
             ok = jnp.bool_(False)
             for f in wl:
                 ok = ok | (d_fn == f)
+            # Migrate-once: a row that is already a migrated copy (carries
+            # a home-link) never re-exports. Re-stealing would work
+            # protocol-wise (completions chain through intermediate
+            # proxies), but every extra hop leaves ANOTHER proxy row alive
+            # until the completion propagates back - measured as
+            # task-table exhaustion when churny windows bounce tasks
+            # between devices. Bounding chains at length 1 keeps proxy
+            # liveness = in-flight migrations, and thieves still rebalance
+            # through the fresh tasks migrated work spawns locally.
+            ok = ok & (tasks[cand, F_HOME] < 0)
             if not self.homed:
                 # Round-3 semantics: only link-free rows may move.
                 ok = ok & jnp.logical_not(homed_elig_of(cand))
+            else:
+                # Proxy budget: dep-bearing rows stop exporting while too
+                # many migrated subtrees are outstanding (see proxy_cap).
+                ok = ok & (jnp.logical_not(homed_elig_of(cand)) | allow_homed)
             return ok
 
         def export(quota):
@@ -609,8 +647,10 @@ class ResidentKernel:
 
             jax.lax.fori_loop(0, Sn, copy_cand, 0)
 
+            allow_homed = pstate[PS_PROXIES] < self.proxy_cap
+
             def count_elig(j, n):
-                return n + elig_of(candbuf[j]).astype(jnp.int32)
+                return n + elig_of(candbuf[j], allow_homed).astype(jnp.int32)
 
             nelig = jax.lax.fori_loop(0, Sn, count_elig, jnp.int32(0))
             nsend = jnp.minimum(quota, nelig)
@@ -623,26 +663,35 @@ class ResidentKernel:
             def classify(j, carry):
                 se, kp, nw = carry
                 cand = candbuf[j]
-                tk = elig_of(cand) & (se < nsend)
+                tk = elig_of(cand, allow_homed) & (se < nsend)
 
                 @pl.when(tk)
                 def _():
                     for w in range(DESC_WORDS):
                         sendbuf[se, w] = tasks[cand, w]
+                    # The wire's value-mask is OWNED BY EXPORT, never
+                    # copied from the row: spawn leaves F_VMASK unwritten
+                    # (a dead word locally), so a recycled/bump row holds
+                    # garbage there - and a garbage mask would make the
+                    # importer rehydrate ALL SIX args of the copy,
+                    # corrupting its descriptor (observed: FIB(4) arriving
+                    # as FIB(<block address>), spawning unbounded trees).
+                    sendbuf[se, F_VMASK] = 0
                     links = homed_of(cand)
 
                     @pl.when(links)
                     def _():
                         # Homed copy: links stay on the proxy; the copy
-                        # names us as home. (A proxy that is itself a
-                        # migrated copy keeps ITS home-link and forwards
-                        # on completion - chains compose.)
+                        # names us as home. (Copies themselves never
+                        # re-export - migrate-once in elig_of - so every
+                        # home-link points at the row's origin device.)
                         sendbuf[se, F_SUCC0] = jnp.int32(NO_TASK)
                         sendbuf[se, F_SUCC1] = jnp.int32(NO_TASK)
                         sendbuf[se, F_CSR_OFF] = 0
                         sendbuf[se, F_CSR_N] = 0
                         sendbuf[se, F_HOME] = me
                         sendbuf[se, F_HROW] = cand
+                        pstate[PS_PROXIES] = pstate[PS_PROXIES] + 1
 
                     @pl.when(jnp.logical_not(links))
                     def _():
@@ -765,6 +814,7 @@ class ResidentKernel:
                 core.complete(hrow)
                 # The execution was already counted on the thief.
                 counts[C_EXECUTED] = counts[C_EXECUTED] - 1
+                pstate[PS_PROXIES] = pstate[PS_PROXIES] - 1
 
             @pl.when(fn == RC_FADD)
             def _():
@@ -812,7 +862,7 @@ class ResidentKernel:
 
                     @pl.when(jnp.logical_not(okq))
                     def _():
-                        counts[C_OVERFLOW] = 1
+                        counts[C_OVERFLOW] = counts[C_OVERFLOW] | OVF_LOCKQ
 
             @pl.when(fn == RC_UNLOCK)
             def _():
@@ -1009,7 +1059,19 @@ class ResidentKernel:
                 )
                 if self.steal:
                     myb = counts[C_TAIL] - counts[C_HEAD]
-                    quota = jnp.clip((myb - peer_b + 1) // 2, 0, W)
+                    # DEMAND-DRIVEN (the reference steals when a worker
+                    # runs dry, src/hclib-runtime.c:646-694): export only
+                    # to a STARVING partner (ready backlog under one
+                    # quantum). Continuous backlog equalization measured
+                    # pathological on recursive graphs: ready counts don't
+                    # reflect subtree sizes, so busy-busy pairs ping-pong
+                    # "surplus" forever, and every bounced dep-bearing row
+                    # pins a proxy until its subtree completes remotely -
+                    # the table fills with proxies instead of work.
+                    starving = peer_b < jnp.int32(min(quantum, W))
+                    quota = jnp.where(
+                        starving, jnp.clip((myb - peer_b + 1) // 2, 0, W), 0
+                    )
                     sendbuf[W, 0] = 0
 
                     @pl.when(quota > 0)
@@ -1195,11 +1257,12 @@ class ResidentKernel:
             )
 
         nin = 6 + ndata + (2 if self.inject else 0)
+        nout = 3 + ndata
         f = jax.shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(axes),) * nin,
-            out_specs=(P(axes),) * (3 + ndata),
+            out_specs=(P(axes),) * nout,
             check_vma=False,
         )
         return jax.jit(f)
@@ -1310,10 +1373,19 @@ class ResidentKernel:
         )
         info["rounds"] = info.pop("steal_rounds")
         if info["overflow"]:
+            from .megakernel import decode_overflow
+
+            masks = [int(c[C_OVERFLOW]) for c in info["per_device_counts"]]
+            agg = 0
+            for m in masks:
+                agg |= m
             raise RuntimeError(
-                "resident kernel overflow: task table, value slots, "
-                "outbox, lock queue, or wait table exceeded - raise the "
-                "limits or coarsen"
+                f"resident kernel overflow: {decode_overflow(agg)} "
+                f"exhausted (per-device masks {masks}). Note: homed "
+                "migration keeps a PROXY row at home until the remote "
+                "completion lands, so the table must hold live + "
+                "in-flight-proxy rows - raise capacity, shrink the steal "
+                "window, or raise am_window to drain completions faster"
             )
         if info["pending"] != 0:
             raise RuntimeError(

@@ -165,22 +165,34 @@ class WindowedTrials:
 
     def stats(self) -> Dict:
         labeled = self._labeled()
-        fast_vals = [r["value"] for r, lb in labeled if lb == "fast"]
-        all_vals = [r["value"] for r, _ in labeled]
-        pool, label = (
-            (fast_vals, "fast") if fast_vals else (all_vals, "all-throttled")
-        )
+        # Slope-based trials can yield nonpositive values under extreme
+        # clock shear (the two timed legs straddled a window edge);
+        # exclude them from statistics rather than poisoning medians.
+        # n_trials still counts every trial run (the jsonl records them
+        # all), so a dropped trial is visible as n_trials > n_used.
+        fast_vals = [
+            r["value"] for r, lb in labeled if lb == "fast" and r["value"] > 0
+        ]
+        all_vals = [r["value"] for r, _ in labeled if r["value"] > 0]
+        if fast_vals:
+            pool, label = fast_vals, "fast"
+        elif all_vals:
+            pool, label = all_vals, "all-throttled"
+        else:
+            # Every trial was sheared (nonpositive): report 0.0 rather
+            # than None so formatters downstream stay total; the window
+            # label says why.
+            pool, label = [0.0], "all-sheared"
         s = {
             "name": self.name,
             "window": label,
-            "n_trials": len(all_vals),
+            "n_trials": len(labeled),
+            "n_used": len(all_vals),
             "n_fast": len(fast_vals),
-            "best": max(pool) if pool else None,
-            "median": float(np.median(pool)) if pool else None,
+            "best": max(pool),
+            "median": float(np.median(pool)),
             "spread": (
-                round(max(all_vals) / max(min(all_vals), 1e-9), 2)
-                if all_vals
-                else None
+                round(max(all_vals) / min(all_vals), 2) if all_vals else None
             ),
             "probe_best_tflops": round(self.probe.best, 2),
         }

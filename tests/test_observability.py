@@ -123,3 +123,35 @@ def test_stats_format_contains_steals():
     assert "executed=" in text and "steals=" in text
     executed = sum(st.executed for st in rt.worker_stats)
     assert executed >= 51
+
+
+def test_windowed_trials_stats_survive_sheared_trials():
+    """Slope-based trials can land nonpositive under clock shear; stats()
+    must exclude them from the pool but still count them in n_trials, and
+    degrade to a 0.0 'all-sheared' summary (never None) when every trial
+    sheared - bench.py formats median/best unconditionally."""
+    from hclib_tpu.runtime.clockprobe import WindowedTrials
+
+    class FakeProbe:
+        best = 50.0
+
+        def sample(self, note=""):
+            return 50.0
+
+        def is_fast(self, v):
+            return v > 40
+
+    wt = WindowedTrials("sheared", probe=FakeProbe(), log_dir=None)
+    for v in (-1.0, -2.0):
+        wt.run(lambda v=v: v)
+    s = wt.stats()
+    assert s["window"] == "all-sheared"
+    assert s["median"] == 0.0 and s["best"] == 0.0
+    assert s["n_trials"] == 2 and s["n_used"] == 0
+
+    wt2 = WindowedTrials("mixed", probe=FakeProbe(), log_dir=None)
+    for v in (5.0, -1.0, 7.0):
+        wt2.run(lambda v=v: v)
+    s2 = wt2.stats()
+    assert s2["median"] == 6.0
+    assert s2["n_trials"] == 3 and s2["n_used"] == 2 and s2["n_fast"] == 2

@@ -67,7 +67,7 @@ def test_skewed_fib_rebalances_across_devices():
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
         migratable_fns={FIB: (), SUM: (0, 1)},
-        window=8, am_window=16,
+        window=8, am_window=8,
     )
     builders = [TaskGraphBuilder() for _ in range(ndev)]
     builders[0].add(FIB, args=[n], out=0)
@@ -89,7 +89,7 @@ def test_homed_chain_two_devices_exact():
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
         migratable_fns={FIB: (), SUM: (0, 1)},
-        window=16, am_window=16,
+        window=16, am_window=8,
     )
     builders = [TaskGraphBuilder() for _ in range(ndev)]
     builders[0].add(FIB, args=[n], out=0)
@@ -255,94 +255,62 @@ CSECT = 1
 LOCKER = 2
 
 
-def test_remote_fadd_sums_exactly():
-    """Every device fire-and-forget fadds its rank+1 into device 0's slot 5,
-    FADD_PER times: owner-computes atomicity must sum exactly."""
-    ndev, per = 8, 3
+def test_remote_atomics_and_lock():
+    """One kernel, one compile, three protocols at once (interpret-mode
+    compiles dominate suite time, so the AMO family shares a table):
+
+    - fire-and-forget fadd: every device adds its rank+1 into device 0's
+      slot 5, twice - owner-computes atomicity must sum exactly;
+    - fadd_get: device 1 parks a continuation until the owner's reply
+      deposits the OLD value of slot 6 (exact fetch-add semantics);
+    - distributed lock: every device bumps a non-atomic counter pair on
+      device 0 under the lock FIFO; without mutual exclusion the two-AM
+      critical section would tear."""
+    ndev, per = 4, 2
+    qcap = ndev
+    LBASE = 16
+    X, Y = 8, 9
+    ASKER, CONSUME_R, LOCKER_FN, CSECT_FN = 1, 2, 3, 4
 
     def fadd_all(ctx):
         for _ in range(per):
             ctx.pgas.fadd(0, 5, 1 + ctx.pgas.me)
 
+    def asker(ctx):
+        row = ctx.spawn(CONSUME_R, args=[3], dep_count=1)
+        ctx.pgas.fadd_get(0, 6, 10, row, 3)
+
+    def consume_r(ctx):
+        ctx.set_value(4, ctx.value(ctx.arg(0)))
+
+    def locker(ctx):
+        row = ctx.spawn(CSECT_FN, dep_count=1)
+        ctx.pgas.lock(0, LBASE, row, qcap)
+
+    def csect(ctx):
+        ctx.pgas.fadd(0, X, 1)
+        ctx.pgas.fadd(0, Y, 1)
+        ctx.pgas.unlock(0, LBASE, qcap)
+
     mk = Megakernel(
-        kernels=[("fadd_all", fadd_all)],
+        kernels=[("fadd_all", fadd_all), ("asker", asker),
+                 ("consume_r", consume_r), ("locker", locker),
+                 ("csect", csect)],
         capacity=64, num_values=256, succ_capacity=8, interpret=True,
     )
     rk = ResidentKernel(mk, cpu_mesh(ndev, axis_name="q"), steal=False)
     builders = [TaskGraphBuilder() for _ in range(ndev)]
     for d in range(ndev):
         builders[d].add(FADD_ALL)
-    # slot 5 lives on device 0; reserve it so staging covers the preset 0
-    for b in builders:
-        b.reserve_values(8)
-    iv, _, info = rk.run(builders, quantum=8)
-    assert iv[0, 5] == per * sum(1 + d for d in range(ndev))
-    assert info["pending"] == 0
-
-
-def test_fadd_get_returns_old_value():
-    """fadd_get parks a continuation until the owner's reply deposits the
-    OLD value - exact fetch-add semantics, not just accumulation."""
-    ndev = 4
-
-    def asker(ctx):
-        # spawn parked consumer; fadd_get(owner 0, slot 5, delta 10)
-        row = ctx.spawn(1, args=[3], dep_count=1)  # CONSUME_R -> slot 3
-        ctx.pgas.fadd_get(0, 5, 10, row, 3)
-
-    def consume_r(ctx):
-        # reply value already in slot arg0; copy to out for visibility
-        ctx.set_value(4, ctx.value(ctx.arg(0)))
-
-    mk = Megakernel(
-        kernels=[("asker", asker), ("consume_r", consume_r)],
-        capacity=64, num_values=256, succ_capacity=8, interpret=True,
-    )
-    rk = ResidentKernel(mk, cpu_mesh(ndev, axis_name="q"), steal=False)
-    builders = [TaskGraphBuilder() for _ in range(ndev)]
-    builders[1].add(0)  # one asker on device 1
-    for b in builders:
-        b.reserve_values(8)
-    iv0 = np.zeros((ndev, 256), np.int32)
-    iv0[0, 5] = 100
-    iv, _, info = rk.run(builders, ivalues=iv0, quantum=8)
-    assert iv[0, 5] == 110  # owner applied the add
-    assert iv[1, 4] == 100  # asker observed the OLD value
-    assert info["pending"] == 0
-
-
-def test_lock_protects_critical_section():
-    """Every device increments a non-atomic counter pair on device 0 under
-    a distributed lock: read x, write x+1 to both slots via two separate
-    AMs. Without mutual exclusion the interleaving would tear; with the
-    lock FIFO both slots count exactly ndev."""
-    ndev = 8
-    qcap = ndev
-    LBASE = 16
-    X, Y = 8, 9
-
-    def locker(ctx):
-        row = ctx.spawn(CSECT, dep_count=1)
-        ctx.pgas.lock(0, LBASE, row, qcap)
-
-    def csect(ctx):
-        # inside the lock: bump x and y via fire-and-forget AMs, then a
-        # third AM releases the lock AFTER the bumps (FIFO per target
-        # preserves order)
-        ctx.pgas.fadd(0, X, 1)
-        ctx.pgas.fadd(0, Y, 1)
-        ctx.pgas.unlock(0, LBASE, qcap)
-
-    mk = Megakernel(
-        kernels=[("locker", locker), ("csect", csect)],
-        capacity=64, num_values=256, succ_capacity=8, interpret=True,
-    )
-    rk = ResidentKernel(mk, cpu_mesh(ndev, axis_name="q"), steal=False)
-    builders = [TaskGraphBuilder() for _ in range(ndev)]
-    for d in range(ndev):
-        builders[d].add(LOCKER)
+        builders[d].add(LOCKER_FN)
         builders[d].reserve_values(LBASE + lock_block_slots(qcap))
-    iv, _, info = rk.run(builders, quantum=8)
+    builders[1].add(ASKER)
+    iv0 = np.zeros((ndev, 256), np.int32)
+    iv0[0, 6] = 100
+    iv, _, info = rk.run(builders, ivalues=iv0, quantum=8)
+    assert iv[0, 5] == per * sum(1 + d for d in range(ndev))
+    assert iv[0, 6] == 110  # owner applied the fetch-add
+    assert iv[1, 4] == 100  # asker observed the OLD value
     assert iv[0, X] == ndev and iv[0, Y] == ndev, iv[0, :12]
     assert iv[0, LBASE] == 0  # lock released
     assert iv[0, LBASE + 1] == 0  # queue drained
@@ -354,13 +322,20 @@ def test_lock_protects_critical_section():
 
 @pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs TPU")
 def test_resident_compiles_and_runs_on_tpu():
-    """1-device self-loop on the real chip: AMs, fetch-add, lock
-    acquire/release, and a put all compile through Mosaic and run."""
+    """The FULL five-way composition on the real chip (1-device
+    self-loop): work stealing enabled, one-sided put + wait machinery,
+    AMs (fetch-add + lock acquire/release), and an injected task stream,
+    all in one kernel compiled through Mosaic. (The interpret-mode dry
+    run exercises the same class in four-way compositions; stacking every
+    feature's SMEM scratch in one interpreted kernel wedges the Mosaic
+    interpreter on 1-vCPU hosts, so hardware carries the five-way proof.)
+    """
     from jax.sharding import Mesh
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("q",))
     qcap = 2
     LBASE = 16
+    BUMPF = 2
 
     def driver(ctx):
         ctx.pgas.fadd(0, 5, 7)
@@ -372,19 +347,26 @@ def test_resident_compiles_and_runs_on_tpu():
         ctx.pgas.fadd(0, 5, 30)
         ctx.pgas.unlock(0, LBASE, qcap)
 
+    def bump(ctx):
+        ctx.set_value(6, ctx.value(6) + ctx.arg(0))
+
     mk = Megakernel(
-        kernels=[("driver", driver), ("csect", csect)],
+        kernels=[("driver", driver), ("csect", csect), ("bump", bump)],
         data_specs={"heap": jax.ShapeDtypeStruct((ROWS, COLS), np.int32)},
         capacity=64, num_values=256, succ_capacity=8, interpret=False,
     )
     rk = ResidentKernel(
         mk, mesh, channels={"c0": ("heap", 1)}, steal=True,
-        migratable_fns=[0],
+        migratable_fns=[0], inject=True,
     )
     b = TaskGraphBuilder()
     b.add(0)
     b.reserve_values(LBASE + lock_block_slots(qcap))
-    iv, data, info = rk.run([b], data={"heap": _heap(1)}, quantum=8)
+    iv, data, info = rk.run(
+        [b], data={"heap": _heap(1)}, quantum=8,
+        inject_rows=[[(BUMPF, [41]), (BUMPF, [1])]],
+    )
     assert iv[0, 5] == 37
+    assert iv[0, 6] == 42  # injected stream rows ran
     assert (np.asarray(data["heap"])[0, 3] == 2).all()
     assert info["pending"] == 0
