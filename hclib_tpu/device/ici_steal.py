@@ -63,6 +63,7 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 from .megakernel import (
+    interpret_mode,
     C_ALLOC,
     C_EXECUTED,
     C_HEAD,
@@ -663,7 +664,7 @@ class ICIStealMegakernel:
             out_specs=out_specs,
             scratch_shapes=scratch,
             input_output_aliases=aliases,
-            interpret=pltpu.InterpretParams() if mk.interpret else False,
+            interpret=interpret_mode() if mk.interpret else False,
         )
 
         def step(tasks, succ, ring, counts, iv, *data):

@@ -48,7 +48,26 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 
-__all__ = ["KernelContext", "Megakernel", "VBLOCK", "decode_overflow"]
+__all__ = [
+    "KernelContext", "Megakernel", "VBLOCK", "decode_overflow",
+    "interpret_mode",
+]
+
+
+def interpret_mode():
+    """InterpretParams for interpret-mode kernel builds - the single
+    construction point for every pallas_call in the package.
+
+    Always the strict defaults. The fast variants were tried and are a
+    trap on this jax build: ``out_of_bounds_reads="uninitialized"``
+    measured ~20% faster on multi-device kernels but sporadically
+    deadlocks the interpreter's io_callback buffer machinery on 1-vCPU
+    hosts (device threads park in device_put - reproduced in three
+    different tests), and ``dma_execution_mode="eager"`` does the same
+    under shard_map. Keep the defaults until the interpreter's threading
+    is fixed upstream; the race-detector tests construct their own
+    params (detect_races=True) on top of the same defaults."""
+    return pltpu.InterpretParams()
 
 
 def decode_overflow(mask: int) -> str:
@@ -821,6 +840,11 @@ class Megakernel:
                 pltpu.SMEM((self.num_values // VBLOCK + 1,), jnp.int32),
             ],
             input_output_aliases=aliases,
+            # Plain bool on purpose: True selects the fast XLA-backed
+            # pallas interpreter. interpret_mode()'s InterpretParams
+            # would select the far slower thread-per-device Mosaic
+            # interpreter, which only kernels simulating remote DMA +
+            # semaphores need (device/resident.py and friends).
             interpret=self.interpret,
             compiler_params=(
                 pltpu.CompilerParams(

@@ -86,6 +86,7 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 from .megakernel import (
+    interpret_mode,
     C_OVERFLOW,
     C_PENDING,
     C_ROUNDS,
@@ -627,7 +628,7 @@ class PGASMegakernel:
                 pltpu.SemaphoreType.REGULAR,  # ring credit
             ],
             input_output_aliases=aliases,
-            interpret=pltpu.InterpretParams() if mk.interpret else False,
+            interpret=interpret_mode() if mk.interpret else False,
         )
 
         def step(tasks, succ, ring, counts, iv, *data_and_waits):

@@ -116,6 +116,7 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 from .megakernel import (
+    interpret_mode,
     C_EXECUTED,
     OVF_LOCKQ,
     OVF_OUTBOX,
@@ -1233,7 +1234,7 @@ class ResidentKernel:
             out_specs=tuple(out_specs),
             scratch_shapes=scratch,
             input_output_aliases=aliases,
-            interpret=pltpu.InterpretParams() if mk.interpret else False,
+            interpret=interpret_mode() if mk.interpret else False,
         )
         axes = self.axes
 

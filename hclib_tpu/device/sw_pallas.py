@@ -32,6 +32,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
 from ..models.smithwaterman import GAP, MATCH, MISMATCH
 
 __all__ = ["sw_scores_pallas"]
@@ -102,7 +103,9 @@ def _sw_pallas(a_t, b_t, block_b: int = 512, interpret: bool = False):
         out_specs=pl.BlockSpec((1, block_b), lambda g: (0, g),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, B), jnp.int32),
-        interpret=interpret,
+        interpret=interpret,  # bool: the fast XLA-backed interpreter
+        # (InterpretParams would select the slow Mosaic one - only
+        # remote-DMA/semaphore kernels need that; see megakernel.py)
     )(a_t, b_t)
 
 
@@ -130,6 +133,8 @@ def sw_scores_pallas(a_batch, b_batch, block_b: int = 512,
         )
     out = _sw_pallas(
         jnp.asarray(a.T), jnp.asarray(b.T), block_b=block_b,
-        interpret=interpret,
+        interpret=interpret,  # bool: the fast XLA-backed interpreter
+        # (InterpretParams would select the slow Mosaic one - only
+        # remote-DMA/semaphore kernels need that; see megakernel.py)
     )
     return np.asarray(out)[0, :B]

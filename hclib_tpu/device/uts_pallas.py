@@ -40,6 +40,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
 from ..models.uts import FIXED, UTSParams
 from .uts_vec import (
     LANES,
@@ -249,7 +250,9 @@ def _uts_dfs_pallas(
             pltpu.VMEM((winrows, cols), i32),
             pltpu.SemaphoreType.DMA((6,)),
         ],
-        interpret=interpret,
+        interpret=interpret,  # bool: the fast XLA-backed interpreter
+        # (InterpretParams would select the slow Mosaic one - only
+        # remote-DMA/semaphore kernels need that; see megakernel.py)
         # Lane state + refill windows + a (K,128) threshold table overflow
         # the compiler's default 16 MiB scoped-vmem budget at (64,128)
         # lanes; real VMEM is 128 MiB on v5e.
@@ -365,7 +368,9 @@ def uts_pallas(
         max_steps=max_steps,
         lanes=tuple(lanes),
         min_idle_div=min_idle_div,
-        interpret=interpret,
+        interpret=interpret,  # bool: the fast XLA-backed interpreter
+        # (InterpretParams would select the slow Mosaic one - only
+        # remote-DMA/semaphore kernels need that; see megakernel.py)
         vmem_limit_bytes=vmem_limit_bytes,
     )
     if device is not None:

@@ -13,6 +13,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# NOTE: do not be tempted to speed the suite up with non-default
+# InterpretParams (eager DMA / unchecked OOB reads): both variants
+# sporadically deadlock the Mosaic interpreter's io_callback machinery
+# on 1-vCPU hosts (see megakernel.interpret_mode).
 
 import pytest  # noqa: E402
 

@@ -160,11 +160,13 @@ def test_round_robin_partition():
 
 
 def test_graft_entry_dryrun():
+    # 2 devices: every phase still executes end-to-end as a regression
+    # guard; the driver itself runs the full 8-device dry run each round.
     import __graft_entry__ as ge
 
-    if len(jax.devices("cpu")) < 4:
+    if len(jax.devices("cpu")) < 2:
         pytest.skip("needs virtual cpu devices")
-    ge.dryrun_multichip(4)
+    ge.dryrun_multichip(2)
 
 
 def test_graft_entry_compiles():

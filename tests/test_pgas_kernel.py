@@ -1,6 +1,6 @@
 """One-sided device PGAS (device/pgas_kernel.py): put / AM / wait-until on
-data between resident schedulers, on an 8-device simulated mesh (Mosaic TPU
-interpret mode emulates the remote DMAs + semaphores) plus a TPU-gated
+data between resident schedulers, on simulated multi-device meshes (Mosaic
+TPU interpret mode emulates the remote DMAs + semaphores) plus a TPU-gated
 1-device compile.
 
 Reference parity targets: one-sided put + wait-until on user data
@@ -91,9 +91,9 @@ def test_put_wakes_parked_consumer_across_devices():
     consumer task is parked on wait_until(chan 0, need 2) and runs only
     after both arrive - the signal-driven wakeup the reference implements
     as SHMEM wait-sets."""
-    ndev = 8
+    ndev = 4
     mesh = cpu_mesh(ndev, axis_name="queues")
-    mk = _mk()
+    mk = _mk(ndev=ndev, capacity=128)
     pg = PGASMegakernel(
         mk, mesh, channels={"c0": ("heap", 1), "reply": ("heap", 1)}
     )
@@ -121,12 +121,14 @@ def test_am_targets_specific_device_mid_run():
     messages than one round's window cap so the outbox pacing runs):
     device d ends with the sum of all senders' payloads - tasks pushed at
     a *chosen* device, not a steal partner."""
-    ndev = 8
+    ndev = 4
     mesh = cpu_mesh(ndev, axis_name="queues")
-    mk = _mk()
+    mk = _mk(ndev=ndev, capacity=128)
     pg = PGASMegakernel(
         mk, mesh, channels={"c0": ("heap", 1), "reply": ("heap", 1)},
-        am_window=4,
+        # am_window 2 < the 4 messages each sender queues, so the
+        # outbox's capped-head carry-over path actually runs.
+        am_window=2,
     )
 
     SEND = 5
@@ -255,7 +257,10 @@ def test_pgas_race_free_under_detector():
         real = pltpu.InterpretParams
         with m.patch.object(
             pltpu, "InterpretParams",
-            lambda **kw: real(detect_races=True, **kw),
+            # Ignore kwargs: if interpret_mode() ever grows non-default
+            # InterpretParams variants, they must not silently alter
+            # race-detection semantics (same in test_resident/test_ici).
+            lambda **kw: real(detect_races=True),
         ):
             return orig(quantum, max_rounds)
 

@@ -60,10 +60,12 @@ def _fib_mk(capacity=512):
 def test_skewed_fib_rebalances_across_devices():
     """THE round-3 gap: a skewed dynamic fib graph - every task carrying
     successor links - rebalances over the in-kernel steal. Device 0 holds
-    fib(13) (754 tasks); >= 4 of 8 devices must execute work; the value
-    and net executed count must be exact."""
-    ndev, n = 8, 13
-    mk = _fib_mk()
+    fib(10) (177 FIB tasks); >= 4 of 8 devices must execute work; the
+    value and net executed count must be exact. (fib(13)/754 tasks passes
+    identically - interpret-mode wall time scales with task count, so the
+    suite runs the smallest tree that still spreads over half the mesh.)"""
+    ndev, n = 8, 10
+    mk = _fib_mk(capacity=192)
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
         migratable_fns={FIB: (), SUM: (0, 1)},
@@ -84,8 +86,8 @@ def test_homed_chain_two_devices_exact():
     """2-device fib: stolen FIB tasks leave proxies whose successors fire
     only when the remote-completion AM lands; totals and the value must be
     exact even with migration forced aggressively (window > backlog)."""
-    ndev, n = 2, 10
-    mk = _fib_mk(capacity=256)
+    ndev, n = 2, 9
+    mk = _fib_mk(capacity=128)
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
         migratable_fns={FIB: (), SUM: (0, 1)},
@@ -105,8 +107,8 @@ def test_migration_race_free_under_detector():
     (steal + remote completion + value-arg rehydration)."""
     from jax.experimental.pallas import tpu as pltpu
 
-    ndev, n = 2, 8
-    mk = _fib_mk(capacity=128)
+    ndev, n = 2, 7
+    mk = _fib_mk(capacity=64)
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
         migratable_fns={FIB: (), SUM: (0, 1)},
@@ -120,14 +122,17 @@ def test_migration_race_free_under_detector():
         real = pltpu.InterpretParams
         with m.patch.object(
             pltpu, "InterpretParams",
-            lambda **kw: real(detect_races=True, **kw),
+            # Ignore incoming kwargs: the suite's fast-interpret mode
+            # (eager DMA, unchecked OOB) must not leak into race
+            # detection, which needs the async on_wait DMA model.
+            lambda **kw: real(detect_races=True),
         ):
             return orig(quantum, max_rounds)
 
     rk._build = build_with_detector
     builders = [TaskGraphBuilder() for _ in range(ndev)]
     builders[0].add(FIB, args=[n], out=0)
-    iv, _, info = rk.run(builders, quantum=4)
+    iv, _, info = rk.run(builders, quantum=8)
     assert int(iv[:, 0].sum()) == fib_seq(n)
     assert info["executed"] == _exec_count(n)
 
@@ -135,20 +140,20 @@ def test_migration_race_free_under_detector():
 def test_successor_free_rows_still_migrate_whole():
     """Link-free tasks keep the cheap whole-row path (no proxy, no AM):
     the classic skewed-bump workload is exact and spreads."""
-    ndev, ntasks = 8, 120
+    ndev, ntasks = 4, 48
     rk = ResidentKernel(
-        _bump_mk(), cpu_mesh(ndev, axis_name="q"),
+        _bump_mk(capacity=128), cpu_mesh(ndev, axis_name="q"),
         migratable_fns=[BUMP], window=8,
     )
     builders = [TaskGraphBuilder() for _ in range(ndev)]
     for i in range(ntasks):
         builders[0].add(BUMP, args=[i + 1])
-    iv, _, info = rk.run(builders, quantum=4)
+    iv, _, info = rk.run(builders, quantum=8)
     assert info["pending"] == 0
     assert info["executed"] == ntasks
     assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
     per_dev = info["per_device_counts"][:, 5]
-    assert int((per_dev > 0).sum()) >= 4, per_dev
+    assert int((per_dev > 0).sum()) >= 3, per_dev
 
 
 # ------------------------------------------------------------- composition
@@ -192,8 +197,8 @@ def test_steal_pgas_and_injection_coexist():
     a skewed bump load rebalances by stealing, device 0 puts a row into
     device 1 whose parked consumer wakes on arrival, and injected stream
     rows land mid-run on several devices."""
-    ndev, ntasks = 4, 40
-    mk = _compose_mk(ndev)
+    ndev, ntasks = 4, 24
+    mk = _compose_mk(ndev, capacity=128)
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
         migratable_fns=[BUMP],

@@ -29,9 +29,9 @@ def test_uts_pallas_deeper_tree_exact():
 def test_uts_pallas_matches_xla_engine_steps():
     """Identical refill/step semantics: node counts AND step counts match
     the XLA engine exactly (the step fn is literally shared)."""
-    p = UTSParams(shape=FIXED, gen_mx=8, b0=4.0, root_seed=7)
-    rv = uts_vec(p, target_roots=2048, device=_cpu())
-    rp = uts_pallas(p, target_roots=2048, device=_cpu(), interpret=True)
+    p = UTSParams(shape=FIXED, gen_mx=7, b0=4.0, root_seed=7)
+    rv = uts_vec(p, target_roots=1024, device=_cpu())
+    rp = uts_pallas(p, target_roots=1024, device=_cpu(), interpret=True)
     assert rv["nodes"] == rp["nodes"]
     assert rv["leaves"] == rp["leaves"]
     assert rv["max_depth"] == rp["max_depth"]
@@ -68,8 +68,8 @@ def test_uts_pallas_linear_exact():
     realized as in-row take_along_axis lookups (VERDICT round-2 item 7)."""
     from hclib_tpu.models.uts import LINEAR
 
-    p = UTSParams(shape=LINEAR, gen_mx=8, b0=4.0, root_seed=34)
-    r = uts_pallas(p, target_roots=128, device=_cpu(), interpret=True)
+    p = UTSParams(shape=LINEAR, gen_mx=6, b0=4.0, root_seed=34)
+    r = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True)
     assert r["roots"] > 0  # the fused kernel actually ran
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -77,10 +77,15 @@ def test_uts_pallas_linear_exact():
 def test_uts_pallas_cyclic_exact():
     from hclib_tpu.models.uts import CYCLIC
 
-    # gen_mx=2 keeps the depth cap (12) and so the traced stack small -
-    # interpret-mode compile time grows steeply with stack height.
-    p = UTSParams(shape=CYCLIC, gen_mx=2, b0=6.0, root_seed=7)
-    r = uts_pallas(p, target_roots=32, device=_cpu(), interpret=True)
+    # gen_mx=1 keeps the depth cap at 7 (5*gen_mx+2) - interpret-mode
+    # trace time grows steeply with the per-lane stack height - while the
+    # 181-node tree still spans the full cyclic period (depths 0..6), so
+    # every row of the per-depth threshold table is exercised.
+    p = UTSParams(shape=CYCLIC, gen_mx=1, b0=6.0, root_seed=7)
+    # target_roots 8: a larger target lets the host BFS consume the whole
+    # tree before the kernel ever runs (roots == 0 would make this a
+    # host-only test).
+    r = uts_pallas(p, target_roots=8, device=_cpu(), interpret=True)
     assert r["roots"] > 0
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -88,12 +93,13 @@ def test_uts_pallas_cyclic_exact():
 def test_uts_pallas_expdec_exact():
     from hclib_tpu.models.uts import EXPDEC
 
-    p = UTSParams(shape=EXPDEC, gen_mx=6, b0=3.0, root_seed=21)
-    # This tree's true max depth is 13; a 15-bound keeps the interpret-mode
-    # stack (and so trace size) small while still validating - a too-small
-    # bound raises loudly rather than truncating counts.
+    p = UTSParams(shape=EXPDEC, gen_mx=3, b0=3.0, root_seed=502)
+    # This 217-node tree's true max depth is 7; a 9-bound keeps the
+    # interpret-mode stack (and so trace size) small while still
+    # validating - a too-small bound raises loudly rather than truncating
+    # counts.
     r = uts_pallas(
-        p, target_roots=16, device=_cpu(), interpret=True, depth_bound=15
+        p, target_roots=16, device=_cpu(), interpret=True, depth_bound=9
     )
     assert r["roots"] > 0
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
@@ -104,7 +110,7 @@ def test_uts_pallas_depth_varying_matches_xla_engine():
     function of (r, depth): node AND step counts match exactly."""
     from hclib_tpu.models.uts import LINEAR
 
-    p = UTSParams(shape=LINEAR, gen_mx=8, b0=4.0, root_seed=34)
+    p = UTSParams(shape=LINEAR, gen_mx=6, b0=4.0, root_seed=34)
     rv = uts_vec(p, target_roots=64, device=_cpu())
     rp = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True)
     assert rp["roots"] > 0  # the fused kernel actually traversed subtrees

@@ -81,17 +81,20 @@ def test_threshold_table_matches_scalar_formula_per_depth():
     "shape,gen_mx,b0,seed",
     [
         (LINEAR, 8, 4.0, 34),
-        (CYCLIC, 4, 3.0, 502),
-        (EXPDEC, 5, 3.0, 7),
+        (CYCLIC, 1, 6.0, 502),
+        (EXPDEC, 3, 3.0, 502),
     ],
 )
 def test_uts_vec_depth_varying_shapes_exact(shape, gen_mx, b0, seed):
     """LINEAR/EXPDEC/CYCLIC trees count exactly vs the sequential spec
-    (VERDICT r1 item 6; reference trees T5/T2 are these shapes at scale)."""
+    (VERDICT r1 item 6; reference trees T5/T2 are these shapes at scale).
+    Shallow parameterizations on purpose: compile time grows steeply with
+    the per-lane stack height (= depth cap), and the CYCLIC gen_mx=1 tree
+    still spans the full period of its threshold table."""
     p = UTSParams(shape=shape, gen_mx=gen_mx, b0=b0, root_seed=seed)
     # A tight EXPDEC bound keeps the per-lane stack (and with it compile
     # time) small; the engine raises if the tree ever reaches it.
-    kw = {"depth_bound": 20} if shape == EXPDEC else {}
+    kw = {"depth_bound": 9} if shape == EXPDEC else {}
     r = uts_vec(p, target_roots=128, device=_cpu(), **kw)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -99,8 +102,11 @@ def test_uts_vec_depth_varying_shapes_exact(shape, gen_mx, b0, seed):
 def test_uts_vec_expdec_depth_bound_raises():
     """An EXPDEC tree that reaches the configured depth bound must fail
     loudly, never silently truncate."""
-    p = UTSParams(shape=EXPDEC, gen_mx=5, b0=3.0, root_seed=7)
+    p = UTSParams(shape=EXPDEC, gen_mx=3, b0=3.0, root_seed=502)
     _, _, true_maxd = count_seq(p)
+    # target_roots small enough that the engine (not the host BFS) does
+    # the deep traversal - a large target consumes this 217-node tree on
+    # the host and nothing ever reaches the bound.
     with pytest.raises(RuntimeError, match="depth bound"):
-        uts_vec(p, target_roots=128, device=_cpu(),
+        uts_vec(p, target_roots=8, device=_cpu(),
                 depth_bound=max(2, true_maxd - 2))

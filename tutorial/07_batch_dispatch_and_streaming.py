@@ -81,7 +81,7 @@ seed.add(BUMP, args=[1000])
 
 
 def feeder():
-    for i in range(20):
+    for i in range(10):
         sm.inject(BUMP, args=[i + 1])  # thread-safe, any time
         time.sleep(0.002)
     sm.close()  # no more work: the stream drains and returns
@@ -91,7 +91,7 @@ t = threading.Thread(target=feeder)
 t.start()
 iv, sinfo = sm.run_stream(seed)
 t.join()
-assert int(iv[0]) == 1000 + 20 * 21 // 2, iv[0]
+assert int(iv[0]) == 1000 + 10 * 11 // 2, iv[0]
 print(f"streaming: {sinfo['executed']} tasks total, "
       f"{sinfo['injected']} injected while the scheduler ran")
 
