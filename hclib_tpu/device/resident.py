@@ -481,7 +481,10 @@ class ResidentKernel:
 
         def op_cswap(dev, slot, expected, new, row, rslot) -> None:
             """Remote compare-swap; old value replies to (row, rslot)."""
-            op_am(dev, RC_CSWAP, (slot, expected, new, row, rslot))
+            # me is the wire's src word: the owner replies to it. Dropping
+            # it shifted every later arg (the reply went to device=row,
+            # row=rslot, slot=garbage) - caught by the volume stress test.
+            op_am(dev, RC_CSWAP, (slot, expected, new, me, row, rslot))
 
         def op_lock(dev, lbase, row, qcap: int) -> None:
             """Acquire the lock block at ``lbase`` on ``dev``; parked row
