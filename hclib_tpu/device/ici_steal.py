@@ -79,7 +79,7 @@ __all__ = ["ICIStealMegakernel"]
 
 
 class ICIStealMegakernel:
-    """Runs one resident scheduler+steal kernel per device of a 1D or 2D
+    """Runs one resident scheduler+steal kernel per device of a 1D/2D/3D
     mesh.
 
     ``mk`` supplies the kernel table/capacities (as for ShardedMegakernel);
@@ -109,8 +109,8 @@ class ICIStealMegakernel:
         window: int = 8,
         scan: Optional[int] = None,
     ) -> None:
-        if len(mesh.axis_names) not in (1, 2):
-            raise ValueError("ICIStealMegakernel wants a 1D or 2D mesh")
+        if len(mesh.axis_names) not in (1, 2, 3):
+            raise ValueError("ICIStealMegakernel wants a 1D/2D/3D mesh")
         self.mk = mk
         self.mesh = mesh
         self.axes = tuple(mesh.axis_names)
@@ -118,8 +118,8 @@ class ICIStealMegakernel:
         self.dims = tuple(int(d) for d in mesh.devices.shape)
         self.ndev = int(np.prod(self.dims))
         self._pof2 = self.ndev & (self.ndev - 1) == 0
-        if len(self.axes) == 2 and not self._pof2:
-            raise ValueError("2D meshes need power-of-two device counts")
+        if len(self.axes) > 1 and not self._pof2:
+            raise ValueError("2D/3D meshes need power-of-two device counts")
         self.migratable_fns = frozenset(int(f) for f in migratable_fns)
         self.window = int(window)
         self.scan = int(scan) if scan is not None else 2 * self.window
@@ -140,21 +140,18 @@ class ICIStealMegakernel:
     # -- shared kernel helpers --
 
     def _flat_me(self):
-        """Flattened device index (row-major over mesh axes)."""
-        if len(self.axes) == 1:
-            return jax.lax.axis_index(self.axes[0])
-        return (
-            jax.lax.axis_index(self.axes[0]) * self.dims[1]
-            + jax.lax.axis_index(self.axes[1])
-        )
+        """Flattened device index. This class's own kernel bodies only ever
+        run on non-pof2 1D meshes - every pof2 mesh (the only legal
+        multi-axis shape) delegates run() to ResidentKernel, whose
+        addressing handles 1D/2D/3D."""
+        assert len(self.axes) == 1, "multi-axis meshes delegate to resident"
+        return jax.lax.axis_index(self.axes[0])
 
     def _did(self, flat):
-        """Remote-op device_id for a flattened index: the logical id on a
-        1D mesh (DeviceIdType.LOGICAL), the per-axis coordinate tuple on a
-        2D mesh (DeviceIdType.MESH - LOGICAL rejects tuples)."""
-        if len(self.axes) == 1:
-            return flat
-        return (flat // self.dims[1], flat % self.dims[1])
+        """Remote-op device_id for a flattened index (1D: the logical id;
+        see _flat_me for why multi-axis never reaches this)."""
+        assert len(self.axes) == 1
+        return flat
 
     @property
     def _did_type(self):

@@ -78,11 +78,13 @@ latent aliasing hazard in the shared-semaphore drain of the round-3 PGAS
 kernel, where an early next-round arrival from a fast device could
 satisfy a wait for a slower device's still-in-flight message.
 
-Meshes: 1D or 2D, power-of-two per axis (TPU slices are pof2 per axis);
-2D hops decompose into per-axis torus-neighbor transfers exactly as in
-ici_steal (low XOR bits = minor axis). Tested on 8-device 1D and 4x2
-interpret meshes (including under the Mosaic race detector) and
-compiled/run on the real 1-device TPU (self-loop AMs, atomics, locks).
+Meshes: 1D, 2D, or 3D (v4/v5p slices are 3D tori), power-of-two per axis
+(TPU slices are pof2 per axis); multi-axis hops decompose into per-axis
+transfers exactly as in ici_steal (row-major flattening, low XOR bits =
+minor axis, so each hypercube hop flips exactly one mesh coordinate).
+Tested on 8-device 1D, 4x2, and 2x2x2 interpret meshes (including under
+the Mosaic race detector) and compiled/run on the real 1-device TPU
+(self-loop AMs, atomics, locks).
 """
 
 from __future__ import annotations
@@ -166,7 +168,7 @@ def lock_block_slots(qcap: int) -> int:
 
 
 class ResidentKernel:
-    """One resident scheduler per device of a 1D/2D pof2 mesh, composing
+    """One resident scheduler per device of a 1D/2D/3D pof2 mesh, composing
     stealing + PGAS + AM/atomics/locks + injection (see module docstring).
 
     ``migratable_fns``: iterable of kernel-table ids eligible to migrate
@@ -196,8 +198,11 @@ class ResidentKernel:
         ring_capacity: int = 256,
         proxy_cap: Optional[int] = None,
     ) -> None:
-        if len(mesh.axis_names) not in (1, 2):
-            raise ValueError("ResidentKernel wants a 1D or 2D mesh")
+        if len(mesh.axis_names) not in (1, 2, 3):
+            raise ValueError(
+                "ResidentKernel wants a 1D/2D/3D mesh (TPU slices are at "
+                "most 3D tori)"
+            )
         dims = tuple(int(d) for d in mesh.devices.shape)
         for d in dims:
             if d & (d - 1):
@@ -304,17 +309,25 @@ class ResidentKernel:
     # -- mesh addressing (as ici_steal) --
 
     def _flat_me(self):
-        if len(self.axes) == 1:
-            return jax.lax.axis_index(self.axes[0])
-        return (
-            jax.lax.axis_index(self.axes[0]) * self.dims[1]
-            + jax.lax.axis_index(self.axes[1])
-        )
+        # Row-major flattening over the mesh axes; with pof2 dims the XOR
+        # hop bits partition per axis (minor axis = low bits), so every
+        # hypercube hop flips exactly one mesh coordinate - the same
+        # decomposition for 1D, 2D, and 3D tori.
+        f = jax.lax.axis_index(self.axes[0])
+        for ax, d in zip(self.axes[1:], self.dims[1:]):
+            f = f * d + jax.lax.axis_index(ax)
+        return f
 
     def _did(self, flat):
         if len(self.axes) == 1:
             return flat
-        return (flat // self.dims[1], flat % self.dims[1])
+        coords = []
+        rem = flat
+        for d in self.dims[:0:-1]:
+            coords.append(rem % d)
+            rem = rem // d
+        coords.append(rem)
+        return tuple(reversed(coords))
 
     @property
     def _did_type(self):

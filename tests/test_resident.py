@@ -252,6 +252,42 @@ def test_pgas_on_2d_mesh():
     assert info["pending"] == 0
 
 
+def test_steal_and_pgas_on_3d_mesh():
+    """3D torus (v4/v5p slice shape): the hypercube hops decompose over
+    all three axes of a 2x2x2 mesh - a skewed bump load spreads by
+    stealing while puts cross each axis (neighbor along z, y, x and the
+    full diagonal) and wake parked consumers."""
+    cpus = jax.devices("cpu")
+    mesh = make_mesh((2, 2, 2), ("x", "y", "z"), cpus[:8])
+    mk = _compose_mk(8, capacity=128)
+    rk = ResidentKernel(
+        mk, mesh, migratable_fns=[BUMP], channels={"c0": ("heap", 1)},
+        window=4,
+    )
+    ntasks = 24
+    builders = [TaskGraphBuilder() for _ in range(8)]
+    for i in range(ntasks):
+        builders[0].add(BUMP, args=[i + 1])
+    waits = [[] for _ in range(8)]
+    # puts from device 0 along each axis and across all three at once
+    for d in (1, 2, 4, 7):
+        builders[0].add(PUT, args=[d, d % ROWS, d % ROWS])
+        t = builders[d].add(CONSUME, args=[1])
+        waits[d].append((0, 1, t))
+    iv, data, info = rk.run(
+        builders, data={"heap": _heap(8)}, waits=waits, quantum=4,
+    )
+    assert info["pending"] == 0
+    heap = np.asarray(data["heap"])
+    for d in (1, 2, 4, 7):
+        assert (heap[d, d % ROWS] == d % ROWS).all(), heap[d, d % ROWS][:4]
+        assert iv[d, 1] == 1  # parked consumer saw the arrival
+    base = ntasks * (ntasks + 1) // 2
+    assert int(iv[:, 0].sum()) == base
+    per_dev = info["per_device_counts"][:, 5]
+    assert int((per_dev > 0).sum()) >= 3, per_dev
+
+
 # --------------------------------------------------------- atomics + locks
 
 

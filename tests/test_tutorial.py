@@ -12,7 +12,7 @@ LESSONS = sorted(p.name for p in TUTORIAL.glob("0*.py"))
 
 
 def test_tutorial_is_complete():
-    assert len(LESSONS) == 8
+    assert len(LESSONS) == 9
 
 
 @pytest.mark.parametrize("lesson", LESSONS)
