@@ -155,11 +155,10 @@ class ICIStealMegakernel:
 
     @property
     def _did_type(self):
-        return (
-            pltpu.DeviceIdType.LOGICAL
-            if len(self.axes) == 1
-            else pltpu.DeviceIdType.MESH
-        )
+        # 1D-only like _flat_me/_did: multi-axis meshes never reach this
+        # class's kernel bodies (pof2 delegates to ResidentKernel).
+        assert len(self.axes) == 1
+        return pltpu.DeviceIdType.LOGICAL
 
     def _make_xfer(self, core, tasks, ready, counts, free, candbuf, sendbuf):
         """Shared transfer closures for both kernel bodies: paired remote

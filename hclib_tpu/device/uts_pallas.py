@@ -44,13 +44,14 @@ from jax.experimental.pallas import tpu as pltpu
 from ..models.uts import FIXED, UTSParams
 from .uts_vec import (
     LANES,
+    PAD_QUANTUM,
     _host_seed,
     apply_claim,
-    child_threshold_table,
     child_thresholds,
     depth_cap,
     inrow_threshold_table,
     make_traversal,
+    padded_threshold_table,
 )
 
 __all__ = ["uts_pallas"]
@@ -123,16 +124,14 @@ def _monotone_gather(win2d, idx, lanes, winrows):
 def _dfs_kernel(
     S: int,
     lanes: tuple,
-    thresholds: tuple,
-    gen_mx: int,
-    d0: int,
+    thresholds,
     min_idle: int,
     max_steps: int,
     winrows: int,
     # refs
     roots_state_ref,  # ANY (5, Rrows, 128) i32 (u32 bits)
     roots_count_ref,  # ANY (Rrows, 128) i32
-    scal_ref,  # SMEM (1,): R (real root count)
+    scal_ref,  # SMEM (3,): R (real root count), d0, gen_mx
     tab_ref,  # VMEM (K, 128): in-row threshold table ((1,128) dummy when
     # the shape is depth-independent - kernels cannot capture constants)
     nodes_ref, leaves_ref, maxd_ref,  # VMEM lanes, outputs
@@ -142,6 +141,8 @@ def _dfs_kernel(
     rows, cols = lanes
     nlanes = rows * cols
     R = scal_ref[0]
+    d0 = scal_ref[1]
+    gen_mx = scal_ref[2]
 
     def refill(sp, next_root, st0, ch0, cn0, dp0):
         starved = sp < 0
@@ -183,10 +184,9 @@ def _dfs_kernel(
         )
         return sp, next_root, st0, ch0, cn0, dp0
 
-    table = thresholds and isinstance(thresholds[0], tuple)
     run = make_traversal(
         S, lanes, thresholds, gen_mx, min_idle, max_steps, refill, R,
-        inrow_table=tab_ref[...] if table else None,
+        inrow_table=tab_ref[...] if thresholds is None else None,
     )
     sp, next_root, nodes, leaves, maxd, steps = run()
     nodes_ref[...] = nodes
@@ -199,19 +199,17 @@ def _dfs_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
+        "stack_size", "thresholds", "max_steps", "lanes",
         "min_idle_div", "interpret", "vmem_limit_bytes",
     ),
 )
 def _uts_dfs_pallas(
     roots_state,  # (5, Rrows, 128) i32 (u32 bits), padded + aligned
     roots_count,  # (Rrows, 128) i32
-    nroots,  # () i32 - real root count R
+    scal,  # (3,) i32 - [R (real root count), d0, gen_mx]
     tab,  # (K, 128) i32 in-row threshold table ((1, 128) dummy for FIXED)
     stack_size: int,
-    gen_mx: int,
-    d0: int,
-    thresholds: tuple,
+    thresholds,  # static ints (FIXED fast path) or None (runtime table)
     max_steps: int,
     lanes: tuple,
     min_idle_div: int = 8,
@@ -226,7 +224,7 @@ def _uts_dfs_pallas(
     i32 = jnp.int32
     kernel = pl.pallas_call(
         functools.partial(
-            _dfs_kernel, S, lanes, thresholds, gen_mx, d0, min_idle,
+            _dfs_kernel, S, lanes, thresholds, min_idle,
             max_steps, winrows,
         ),
         out_shape=(
@@ -262,9 +260,7 @@ def _uts_dfs_pallas(
             else pltpu.CompilerParams(vmem_limit_bytes=vmem_limit_bytes)
         ),
     )
-    nodes, leaves, maxd, ctl = kernel(
-        roots_state, roots_count, nroots.reshape(1), tab
-    )
+    nodes, leaves, maxd, ctl = kernel(roots_state, roots_count, scal, tab)
     return (
         # Per-lane planes, not totals: totals are summed on the host in
         # int64 so trees beyond 2^31 total nodes (T1XXL's 4.23B) count
@@ -287,6 +283,7 @@ def uts_pallas(
     interpret: Optional[bool] = None,
     depth_bound: Optional[int] = None,
     vmem_limit_bytes: int = 100 * 2**20,
+    stack_pad: Optional[int] = None,
 ) -> dict:
     """uts_vec with the whole traversal fused into one Pallas kernel; same
     exact counts, same host seeding, same result dict.
@@ -328,8 +325,11 @@ def uts_pallas(
     nlanes = rows * cols
     R = int(roots_count.shape[0])
     # Pad so any aligned window [align_down(next_root), +nlanes+ALIGN) is in
-    # bounds (next_root <= R), then lay out as (Rrows, 128) for row-block DMA.
-    rpad = -(-(R + nlanes + ALIGN) // ALIGN) * ALIGN
+    # bounds (next_root <= R), then lay out as (Rrows, 128) for row-block
+    # DMA. PAD_QUANTUM (a multiple of ALIGN) keeps trees with different
+    # root counts on one padded shape, sharing one compiled kernel (R is
+    # a runtime scalar; only the padded shape is static).
+    rpad = -(-(R + nlanes + ALIGN) // PAD_QUANTUM) * PAD_QUANTUM
     pstate = np.zeros((5, rpad), np.int32)
     pstate[:, :R] = roots_state.astype(np.int32)
     pcount = np.zeros(rpad, np.int32)
@@ -350,20 +350,26 @@ def uts_pallas(
         stack_size = max(1, params.gen_mx - d0)
         tabnp = np.zeros((1, cols), np.int32)  # unused dummy input
     else:
-        table = child_threshold_table(params, cap)
-        thr = tuple(tuple(int(x) for x in row) for row in table)
+        # Runtime-table path: the padded in-row table is a kernel INPUT,
+        # so all depth-varying trees with one padded shape + stack height
+        # share a single compiled kernel (see padded_threshold_table).
+        thr = None
         stack_size = max(1, (cap - d0) if bounded else (cap - 1 - d0))
-        tabnp = inrow_threshold_table(thr, cols)
+        tabnp = inrow_threshold_table(
+            padded_threshold_table(params, cap), cols
+        )
+    if stack_pad is not None:
+        # Opt-in compile sharing across tree shapes (taller stacks cost
+        # select/store work per step; the perf path keeps tight heights).
+        stack_size = max(stack_size, int(stack_pad))
     args = (
         jnp.asarray(pstate.reshape(5, rpad // cols, cols)),
         jnp.asarray(pcount.reshape(rpad // cols, cols)),
-        jnp.int32(R),
+        jnp.asarray(np.array([R, d0, params.gen_mx], np.int32)),
         jnp.asarray(tabnp),
     )
     kw = dict(
         stack_size=stack_size,
-        gen_mx=params.gen_mx,
-        d0=d0,
         thresholds=thr,
         max_steps=max_steps,
         lanes=tuple(lanes),
@@ -374,10 +380,7 @@ def uts_pallas(
         vmem_limit_bytes=vmem_limit_bytes,
     )
     if device is not None:
-        args = tuple(
-            a if i == 2 else jax.device_put(a, device)
-            for i, a in enumerate(args)
-        )
+        args = tuple(jax.device_put(a, device) for a in args)
     nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)
     t0 = time.perf_counter()
     nodes, leaves, maxd, steps, unfinished = _uts_dfs_pallas(*args, **kw)

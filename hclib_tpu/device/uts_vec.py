@@ -64,7 +64,8 @@ from ..ops.sha1 import sha1_block as _sha1_block, sha1_child as _sha1_child
 
 __all__ = [
     "uts_vec", "child_thresholds", "child_threshold_table", "depth_cap",
-    "inrow_threshold_table",
+    "inrow_threshold_table", "padded_threshold_table", "MAX_CHILDREN",
+    "PAD_QUANTUM",
     "LANES", "NLANES", "make_count_children", "make_dfs_step",
     "make_refill",
 ]
@@ -72,6 +73,12 @@ __all__ = [
 LANES = (8, 128)
 NLANES = LANES[0] * LANES[1]
 MAX_CHILDREN = 100
+# Root arrays are padded to a multiple of this (shared by both engines):
+# trees with different root counts land on one padded shape and so share
+# one compiled engine (the real count travels as a runtime scalar). A
+# multiple of uts_pallas.ALIGN (1024) so the pallas row-block DMA windows
+# stay aligned.
+PAD_QUANTUM = 4096
 
 
 def _thresholds_for_b(b_i: float) -> List[int]:
@@ -182,40 +189,43 @@ def inrow_threshold_table(thresholds: tuple, cols: int) -> np.ndarray:
 
 
 def make_count_children(
-    thresholds: tuple, gen_mx: int, lanes: tuple, inrow_table=None
+    thresholds, gen_mx, lanes: tuple, inrow_table=None, table=None
 ):
     """Exact geometric child count. ``thresholds`` is either a flat tuple
-    (depth-independent FIXED shape, guarded by gen_mx) or a tuple of
-    per-depth rows from child_threshold_table (-1 padded): the count then
-    comes from a row gather by each lane's depth.
-
-    ``inrow_table`` (a (K, cols) array laid out by inrow_threshold_table,
-    same values as ``thresholds``) selects the Mosaic-compatible
-    formulation for the fused Pallas engine: the per-lane
-    (depth -> threshold) lookup becomes a same-shape ``take_along_axis``
-    per child ordinal - the only gather form Mosaic supports (the default
-    axis-0 ``jnp.take`` per-lane row gather is XLA-only). Same integer
-    thresholds, bit-identical counts."""
-    if thresholds and isinstance(thresholds[0], tuple):
-        tab_np = np.asarray(thresholds, dtype=np.int32)  # (D+1, K)
-        D = tab_np.shape[0] - 1
+    (depth-independent FIXED shape, guarded by the runtime ``gen_mx``
+    scalar) or None: the per-depth threshold table then arrives as a
+    RUNTIME array - ``table`` ((D+1, K), -1 padded; the count is a row
+    gather by each lane's depth) or ``inrow_table`` ((K, cols) laid out by
+    inrow_threshold_table): the Mosaic-compatible formulation for the
+    fused Pallas engine, where the per-lane (depth -> threshold) lookup
+    becomes a same-shape ``take_along_axis`` per child ordinal - the only
+    gather form Mosaic supports. Same integer thresholds, bit-identical
+    counts either way - and because the table VALUES are inputs, trees
+    whose padded table SHAPES match share one compiled engine (the
+    per-shape XLA/Mosaic compile is ~1 min; the suite pads all
+    depth-varying trees to a common shape, see padded_threshold_table)."""
+    if thresholds is None:
         if inrow_table is not None:
-            K = tab_np.shape[1]
+            K = inrow_table.shape[0]
+            cols = lanes[1]
 
             def count_children_inrow(r, depth):
-                dclip = jnp.clip(depth, 0, D)
+                # Depths beyond the real table rows hit the -1 column
+                # padding (inrow tables are padded to the full row width),
+                # so the count is exactly 0 there - no explicit guard.
+                dclip = jnp.clip(depth, 0, cols - 1)
                 cnt = jnp.zeros(lanes, jnp.int32)
                 for k in range(K):
                     row = jnp.broadcast_to(inrow_table[k], lanes)
                     t = jnp.take_along_axis(row, dclip, axis=1)
                     cnt = cnt + ((t >= 0) & (r >= t)).astype(jnp.int32)
-                return jnp.where(depth <= D, cnt, 0)
+                return cnt
 
             return count_children_inrow
-        tab = jnp.asarray(tab_np)
+        D = table.shape[0] - 1
 
-        def count_children(r, depth):
-            rows = jnp.take(tab, jnp.clip(depth, 0, D), axis=0)
+        def count_children_rows(r, depth):
+            rows = jnp.take(table, jnp.clip(depth, 0, D), axis=0)
             cnt = jnp.sum(
                 (rows >= 0) & (r[..., None] >= rows), axis=-1
             ).astype(jnp.int32)
@@ -226,7 +236,7 @@ def make_count_children(
             # grinding a phantom infinite subtree to max_steps.
             return jnp.where(depth <= D, cnt, 0)
 
-        return count_children
+        return count_children_rows
 
     def count_children(r, depth):
         cnt = jnp.zeros(lanes, jnp.int32)
@@ -238,15 +248,15 @@ def make_count_children(
 
 
 def make_dfs_step(
-    S: int, lanes: tuple, thresholds: tuple, gen_mx: int,
-    inrow_table=None,
+    S: int, lanes: tuple, thresholds, gen_mx,
+    inrow_table=None, table=None,
 ):
     """One vectorized DFS expansion step over all lanes (the hot loop body,
     shared by the XLA engine here and the fused Pallas engine in
     uts_pallas.py). Signature:
     (sp, nodes, leaves, maxd, st, ch, cn, dp) -> same tuple."""
     count_children = make_count_children(
-        thresholds, gen_mx, lanes, inrow_table
+        thresholds, gen_mx, lanes, inrow_table, table
     )
 
     def step(sp, nodes, leaves, maxd, st, ch, cn, dp):
@@ -348,13 +358,14 @@ def make_refill(lanes: tuple, d0: int):
 def make_traversal(
     S: int,
     lanes: tuple,
-    thresholds: tuple,
-    gen_mx: int,
+    thresholds,
+    gen_mx,
     min_idle: int,
     max_steps: int,
     refill,
     R,
     inrow_table=None,
+    table=None,
 ):
     """The complete traversal driver shared by both engines: outer loop =
     refill + refill-free inner expansion loop until `min_idle` lanes are
@@ -362,7 +373,7 @@ def make_traversal(
     ch0, cn0, dp0)`` is the only engine-specific part (XLA gather here vs
     in-kernel DMA + matmul gather in uts_pallas). Returns run() ->
     (sp, next_root, nodes, leaves, maxd, steps)."""
-    step = make_dfs_step(S, lanes, thresholds, gen_mx, inrow_table)
+    step = make_dfs_step(S, lanes, thresholds, gen_mx, inrow_table, table)
 
     def inner_cond(carry):
         sp, nodes, leaves, maxd, st, ch, cn, dp, steps, avail = carry
@@ -423,29 +434,44 @@ def make_traversal(
     return run
 
 
+def padded_threshold_table(params: UTSParams, cap: int) -> np.ndarray:
+    """child_threshold_table padded to a COMMON shape: rows (depths) up to
+    a multiple of 16, columns (child ordinals) to MAX_CHILDREN, -1 filled.
+    The table values are runtime inputs to both engines, so every
+    depth-varying tree whose padded shape matches shares ONE compiled
+    engine (per stack height) instead of paying the ~1 min XLA/Mosaic
+    compile per tree - padding costs a few dead compares per step."""
+    t = child_threshold_table(params, cap)
+    rows = -(-(cap + 1) // 16) * 16
+    out = np.full((rows, MAX_CHILDREN), -1, np.int32)
+    out[: t.shape[0], : t.shape[1]] = t
+    return out
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "stack_size", "gen_mx", "d0", "thresholds", "max_steps", "lanes",
-        "min_idle_div",
+        "stack_size", "thresholds", "max_steps", "lanes", "min_idle_div",
     ),
 )
 def _uts_dfs(
-    roots_state,  # (5, R) u32 - subtree roots, all at BFS depth d0
-    roots_count,  # (R,) i32 - exact child counts (all >= 1)
+    roots_state,  # (5, P) u32 - subtree roots, all at BFS depth d0
+    roots_count,  # (P,) i32 - exact child counts (all >= 1)
+    tab,  # (D+1, K) i32 runtime threshold table ((1, 1) dummy for FIXED)
+    gen_mx,  # () i32 - FIXED-shape depth guard (unused on the table path)
+    d0,  # () i32 - BFS depth of the roots
+    nroots,  # () i32 - REAL root count R (arrays are padded to a common
+    # quantum P >= R + nlanes so different trees share one compile AND the
+    # refill window dynamic_slice is always in bounds)
     stack_size: int,
-    gen_mx: int,
-    d0: int,
-    thresholds: tuple,  # static ints: compiled as immediates
+    thresholds,  # static ints (FIXED fast path) or None (runtime table)
     max_steps: int,
     lanes: tuple,
     min_idle_div: int = 8,
 ):
     S = stack_size
     nlanes = lanes[0] * lanes[1]
-    # Root arrays arrive padded by nlanes (see uts_vec) so the refill window
-    # dynamic_slice below is always in bounds; R is the real root count.
-    R = roots_count.shape[0] - nlanes
+    R = nroots
 
     # Refill threshold: the gather+cumsum claim is much more expensive than
     # one SHA-1 step, so the hot expansion loop runs refill-free (inner
@@ -463,7 +489,8 @@ def _uts_dfs(
         )
 
     run = make_traversal(
-        S, lanes, thresholds, gen_mx, refill_min_idle, max_steps, refill, R
+        S, lanes, thresholds, gen_mx, refill_min_idle, max_steps, refill, R,
+        table=tab if thresholds is None else None,
     )
     sp, next_root, nodes, leaves, maxd, steps = run()
     return (
@@ -555,6 +582,7 @@ def uts_vec(
     lanes: Tuple[int, int] = LANES,
     min_idle_div: int = 8,
     depth_bound: Optional[int] = None,
+    stack_pad: Optional[int] = None,
 ) -> dict:
     """Run UTS with the vectorized DFS engine; returns counts + timing info.
 
@@ -586,13 +614,17 @@ def uts_vec(
         return result
     if max_steps is None:
         max_steps = (1 << 31) - 1
-    # Pad by nlanes so the device refill window never runs off the end.
+    # Pad to PAD_QUANTUM (>= R + nlanes): the refill window dynamic_slice
+    # never runs off the end, and trees with different root counts land
+    # on the SAME padded shape, sharing one compiled engine.
     nlanes = lanes[0] * lanes[1]
-    roots_state = np.concatenate(
-        [roots_state, np.zeros((5, nlanes), np.uint32)], axis=1
-    )
-    roots_count = np.concatenate([roots_count, np.zeros(nlanes, np.int32)])
-    args = (jnp.asarray(roots_state), jnp.asarray(roots_count))
+    R = int(roots_count.shape[0])
+    padn = -(-(R + nlanes) // PAD_QUANTUM) * PAD_QUANTUM
+    pstate = np.zeros((5, padn), np.uint32)
+    pstate[:, :R] = roots_state
+    pcount = np.zeros(padn, np.int32)
+    pcount[:R] = roots_count
+    args = (jnp.asarray(pstate), jnp.asarray(pcount))
     derived = depth_cap(params)
     if derived is None:  # EXPDEC: caller-chosen bound, validated below
         cap = depth_bound if depth_bound is not None else 8 * params.gen_mx
@@ -608,19 +640,29 @@ def uts_vec(
     if params.shape == FIXED and not bounded:
         thr = tuple(int(t) for t in child_thresholds(params.b0))
         stack_size = max(1, params.gen_mx - d0)
+        tabnp = np.zeros((1, 1), np.int32)  # unused dummy input
     else:
-        table = child_threshold_table(params, cap)
-        thr = tuple(tuple(int(x) for x in row) for row in table)
+        # Runtime-table path: values are an input, so all trees with the
+        # same padded table shape + stack height share one compile.
+        thr = None
+        tabnp = padded_threshold_table(params, cap)
         # Pushed frames hold non-leaf nodes only; for shapes whose cap is
         # exact the deepest non-leaf sits at cap-2, so the tight height is
         # cap-1-d0 (every extra level costs select/store work per step).
         stack_size = max(
             1, (cap - d0) if bounded else (cap - 1 - d0)
         )
+    if stack_pad is not None:
+        # Opt-in: pad the stack so differently-shaped trees share one
+        # compiled engine (taller stacks cost select/store work per step,
+        # so the perf path keeps the tight height).
+        stack_size = max(stack_size, int(stack_pad))
+    args = args + (
+        jnp.asarray(tabnp), jnp.int32(params.gen_mx), jnp.int32(d0),
+        jnp.int32(R),
+    )
     kw = dict(
         stack_size=stack_size,
-        gen_mx=params.gen_mx,
-        d0=d0,
         thresholds=thr,
         max_steps=max_steps,
         lanes=tuple(lanes),

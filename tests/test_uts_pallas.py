@@ -16,13 +16,15 @@ def _cpu():
 
 
 def test_uts_pallas_t3_exact():
-    r = uts_pallas(T3, target_roots=64, device=_cpu(), interpret=True)
+    r = uts_pallas(T3, target_roots=64, device=_cpu(), interpret=True,
+                   stack_pad=8)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(T3)
 
 
 def test_uts_pallas_deeper_tree_exact():
     p = UTSParams(shape=FIXED, gen_mx=7, b0=4.0, root_seed=19)
-    r = uts_pallas(p, target_roots=256, device=_cpu(), interpret=True)
+    r = uts_pallas(p, target_roots=256, device=_cpu(), interpret=True,
+                   stack_pad=8)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
 
@@ -30,8 +32,9 @@ def test_uts_pallas_matches_xla_engine_steps():
     """Identical refill/step semantics: node counts AND step counts match
     the XLA engine exactly (the step fn is literally shared)."""
     p = UTSParams(shape=FIXED, gen_mx=7, b0=4.0, root_seed=7)
-    rv = uts_vec(p, target_roots=1024, device=_cpu())
-    rp = uts_pallas(p, target_roots=1024, device=_cpu(), interpret=True)
+    rv = uts_vec(p, target_roots=1024, device=_cpu(), stack_pad=8)
+    rp = uts_pallas(p, target_roots=1024, device=_cpu(), interpret=True,
+                    stack_pad=8)
     assert rv["nodes"] == rp["nodes"]
     assert rv["leaves"] == rp["leaves"]
     assert rv["max_depth"] == rp["max_depth"]
@@ -69,7 +72,8 @@ def test_uts_pallas_linear_exact():
     from hclib_tpu.models.uts import LINEAR
 
     p = UTSParams(shape=LINEAR, gen_mx=6, b0=4.0, root_seed=34)
-    r = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True)
+    r = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True,
+                   stack_pad=8)
     assert r["roots"] > 0  # the fused kernel actually ran
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -85,7 +89,8 @@ def test_uts_pallas_cyclic_exact():
     # target_roots 8: a larger target lets the host BFS consume the whole
     # tree before the kernel ever runs (roots == 0 would make this a
     # host-only test).
-    r = uts_pallas(p, target_roots=8, device=_cpu(), interpret=True)
+    r = uts_pallas(p, target_roots=8, device=_cpu(), interpret=True,
+                   stack_pad=8)
     assert r["roots"] > 0
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -99,7 +104,8 @@ def test_uts_pallas_expdec_exact():
     # validating - a too-small bound raises loudly rather than truncating
     # counts.
     r = uts_pallas(
-        p, target_roots=16, device=_cpu(), interpret=True, depth_bound=9
+        p, target_roots=16, device=_cpu(), interpret=True, depth_bound=9,
+        stack_pad=8,
     )
     assert r["roots"] > 0
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
@@ -111,8 +117,9 @@ def test_uts_pallas_depth_varying_matches_xla_engine():
     from hclib_tpu.models.uts import LINEAR
 
     p = UTSParams(shape=LINEAR, gen_mx=6, b0=4.0, root_seed=34)
-    rv = uts_vec(p, target_roots=64, device=_cpu())
-    rp = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True)
+    rv = uts_vec(p, target_roots=64, device=_cpu(), stack_pad=8)
+    rp = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True,
+                    stack_pad=8)
     assert rp["roots"] > 0  # the fused kernel actually traversed subtrees
     assert (rv["nodes"], rv["leaves"], rv["max_depth"], rv["steps"]) == (
         rp["nodes"], rp["leaves"], rp["max_depth"], rp["steps"]

@@ -42,13 +42,13 @@ def test_thresholds_exact_against_scalar_formula():
 
 
 def test_uts_vec_t3_exact():
-    r = uts_vec(T3, target_roots=64, device=_cpu())
+    r = uts_vec(T3, target_roots=64, device=_cpu(), stack_pad=8)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(T3)
 
 
 def test_uts_vec_deeper_tree_exact():
     p = UTSParams(shape=FIXED, gen_mx=7, b0=4.0, root_seed=19)
-    r = uts_vec(p, target_roots=256, device=_cpu())
+    r = uts_vec(p, target_roots=256, device=_cpu(), stack_pad=8)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
 
@@ -95,7 +95,7 @@ def test_uts_vec_depth_varying_shapes_exact(shape, gen_mx, b0, seed):
     # A tight EXPDEC bound keeps the per-lane stack (and with it compile
     # time) small; the engine raises if the tree ever reaches it.
     kw = {"depth_bound": 9} if shape == EXPDEC else {}
-    r = uts_vec(p, target_roots=128, device=_cpu(), **kw)
+    r = uts_vec(p, target_roots=128, device=_cpu(), stack_pad=8, **kw)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
 
@@ -108,5 +108,5 @@ def test_uts_vec_expdec_depth_bound_raises():
     # the deep traversal - a large target consumes this 217-node tree on
     # the host and nothing ever reaches the bound.
     with pytest.raises(RuntimeError, match="depth bound"):
-        uts_vec(p, target_roots=8, device=_cpu(),
+        uts_vec(p, target_roots=8, device=_cpu(), stack_pad=8,
                 depth_bound=max(2, true_maxd - 2))

@@ -47,19 +47,19 @@ def fib(n):
 # the seed task and dispatches the entire recursion tree across lanes.
 mk = Megakernel(
     kernels=[
-        ("vfib", fib_spec(max_n=18, lanes=(1, 8))),
-        ("vnqueens", nqueens_spec(6, lanes=(1, 8))),
+        ("vfib", fib_spec(max_n=14, lanes=(1, 8))),
+        ("vnqueens", nqueens_spec(5, lanes=(1, 8))),
     ],
     capacity=16, num_values=8, succ_capacity=8, interpret=True,
 )
 b = TaskGraphBuilder()
-b.add(0, args=[16], out=0)  # fib(16) - 3193 tasks
-b.add(1, args=[0], out=1)   # 6-queens - 4 solutions
+b.add(0, args=[12], out=0)  # fib(12) - 465 tasks
+b.add(1, args=[0], out=1)   # 5-queens - 10 solutions
 b.reserve_values(2)
 ivalues, _, info = mk.run(b)
-assert int(ivalues[0]) == fib(16), ivalues[0]
-assert int(ivalues[1]) == 4, ivalues[1]
-print(f"batch dispatch: fib(16)={int(ivalues[0])}, 6-queens={int(ivalues[1])}, "
+assert int(ivalues[0]) == fib(12), ivalues[0]
+assert int(ivalues[1]) == 10, ivalues[1]
+print(f"batch dispatch: fib(12)={int(ivalues[0])}, 5-queens={int(ivalues[1])}, "
       f"{info['executed']} tasks through 2 seed descriptors")
 
 # -- 2. streaming injection: the host feeds a running scheduler ---------
@@ -81,7 +81,7 @@ seed.add(BUMP, args=[1000])
 
 
 def feeder():
-    for i in range(10):
+    for i in range(6):
         sm.inject(BUMP, args=[i + 1])  # thread-safe, any time
         time.sleep(0.002)
     sm.close()  # no more work: the stream drains and returns
@@ -91,7 +91,7 @@ t = threading.Thread(target=feeder)
 t.start()
 iv, sinfo = sm.run_stream(seed)
 t.join()
-assert int(iv[0]) == 1000 + 10 * 11 // 2, iv[0]
+assert int(iv[0]) == 1000 + 6 * 7 // 2, iv[0]
 print(f"streaming: {sinfo['executed']} tasks total, "
       f"{sinfo['injected']} injected while the scheduler ran")
 
