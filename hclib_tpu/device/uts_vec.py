@@ -178,9 +178,13 @@ def inrow_threshold_table(thresholds: tuple, cols: int) -> np.ndarray:
     cannot capture array constants)."""
     tab_np = np.asarray(thresholds, dtype=np.int32)  # (D+1, K)
     D = tab_np.shape[0] - 1
-    if D + 1 > cols:
+    if D + 1 >= cols:
+        # STRICTLY below cols: count_children_inrow clips depth to
+        # cols - 1 and relies on that column being -1 padding, so an
+        # over-deep lane counts 0 children (a full table would put live
+        # thresholds there and expand a phantom subtree to max_steps).
         raise NotImplementedError(
-            f"in-row table gather needs depth cap + 1 <= {cols} "
+            f"in-row table gather needs depth cap + 1 < {cols} "
             f"lane columns, got {D + 1}"
         )
     padded = np.full((tab_np.shape[1], cols), -1, np.int32)

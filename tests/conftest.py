@@ -13,6 +13,18 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Persistent XLA compilation cache (works for the CPU backend too): the
+# suite's one-time engine compiles (~40-120 s each for the UTS engines and
+# the big interpret kernels) are disk-cached under the repo, so repeated
+# suite runs on one machine skip them (measured 41 s -> 17 s for a single
+# UTS test). Tutorial subprocesses inherit the env. Cold runs are
+# unaffected; the cache directory is gitignored.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 # NOTE: do not be tempted to speed the suite up with non-default
 # InterpretParams (eager DMA / unchecked OOB reads): both variants
 # sporadically deadlock the Mosaic interpreter's io_callback machinery
