@@ -30,7 +30,35 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 # sporadically deadlock the Mosaic interpreter's io_callback machinery
 # on 1-vCPU hosts (see megakernel.interpret_mode).
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Stack dumps must BYPASS pytest's stderr capture (captured output dies
+# with the os._exit the watchdog fires), so they go to an on-disk log
+# next to this file; the handle stays open for the whole session.
+_WEDGE_LOG = open(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".wedge_traceback.log"),
+    "w",
+)
+
+
+@pytest.fixture(autouse=True)
+def _wedge_watchdog():
+    """Hard per-test ceiling (15 min; the slowest test is ~2 min loaded).
+
+    The Mosaic interpreter's io_callback machinery can SPORADICALLY wedge
+    on 1-vCPU hosts even with the strict default InterpretParams (device
+    threads park in buffer allocation; observed roughly once per hundreds
+    of multi-device kernel runs). pytest-timeout isn't available in this
+    image, and a thread-based timeout can't interrupt parked threads -
+    faulthandler's timer CAN: it dumps every thread's stack (to
+    tests/.wedge_traceback.log, see above) and exits, so a wedged run
+    fails loudly with evidence instead of hanging forever."""
+    faulthandler.dump_traceback_later(900, exit=True, file=_WEDGE_LOG)
+    yield
+    faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(autouse=True)

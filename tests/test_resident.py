@@ -138,6 +138,48 @@ def test_migration_race_free_under_detector():
     assert info["executed"] == _exec_count(n)
 
 
+def test_proxy_cap_throttles_migration_but_stays_exact():
+    """The outstanding-proxy budget (migrate-once hardening): with
+    proxy_cap=1 at most one dep-bearing subtree may be outstanding per
+    device at a time, so exports throttle hard - totals and values must
+    still be exact (throttling must never deadlock or drop work; local
+    execution continues while the budget is spent)."""
+    ndev, n = 2, 8
+    mk = _fib_mk(capacity=96)
+    rk = ResidentKernel(
+        mk, cpu_mesh(ndev, axis_name="q"),
+        migratable_fns={FIB: (), SUM: (0, 1)},
+        window=16, am_window=8, proxy_cap=1,
+    )
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    builders[0].add(FIB, args=[n], out=0)
+    iv, _, info = rk.run(builders, quantum=4)
+    assert info["pending"] == 0
+    assert int(iv[:, 0].sum()) == fib_seq(n)
+    assert info["executed"] == _exec_count(n)
+
+
+def test_homed_fib_migrates_on_3d_mesh():
+    """Dependency-bearing migration across a 3D torus: the home-link
+    protocol's completion AMs route over all three axes of a 2x2x2 mesh
+    (the earlier 3D test moves only link-free rows)."""
+    n = 6
+    mk = _fib_mk(capacity=64)
+    rk = ResidentKernel(
+        mk, make_mesh((2, 2, 2), ("x", "y", "z"), jax.devices("cpu")[:8]),
+        migratable_fns={FIB: (), SUM: (0, 1)},
+        window=8, am_window=8,
+    )
+    builders = [TaskGraphBuilder() for _ in range(8)]
+    builders[0].add(FIB, args=[n], out=0)
+    iv, _, info = rk.run(builders, quantum=4)
+    assert info["pending"] == 0
+    assert int(iv[:, 0].sum()) == fib_seq(n)
+    assert info["executed"] == _exec_count(n)
+    per_dev = info["per_device_counts"][:, 5]
+    assert int((per_dev > 0).sum()) >= 2, per_dev
+
+
 def test_successor_free_rows_still_migrate_whole():
     """Link-free tasks keep the cheap whole-row path (no proxy, no AM):
     the classic skewed-bump workload is exact and spreads."""
