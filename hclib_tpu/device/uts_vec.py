@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -438,6 +439,34 @@ def make_traversal(
     return run
 
 
+def resolve_timing_reps(timing_reps, on_tpu: bool) -> int:
+    """Default timing policy shared by both engines: best-of-3 same-args
+    executions on a real TPU (a single timed execution right after staging
+    reads transient allocator/transfer stalls on the tunnel-attached chip
+    as phantom 4-6x throttling), one execution elsewhere (CPU/interpret
+    runs are deterministic, and correctness callers only need counts)."""
+    if timing_reps is not None:
+        return max(1, int(timing_reps))
+    return 3 if on_tpu else 1
+
+
+def _timed_best(run, reps: int):
+    """Warm once, then return (outputs, dev_nodes, best_dt) over ``reps``
+    timed executions of ``run`` (same compiled kernel, same staged args;
+    the per-run D2H node-plane sum is the only reliable sync through the
+    tunnel and is deliberately inside the timed region for both engines)."""
+    outs = run()
+    dt = None
+    dev_nodes = 0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = run()
+        dev_nodes = int(np.asarray(outs[0]).sum(dtype=np.int64))
+        d = time.perf_counter() - t0
+        dt = d if dt is None else min(dt, d)
+    return outs, dev_nodes, dt
+
+
 def padded_threshold_table(params: UTSParams, cap: int) -> np.ndarray:
     """child_threshold_table padded to a COMMON shape: rows (depths) up to
     a multiple of 16, columns (child ordinals) to MAX_CHILDREN, -1 filled.
@@ -587,6 +616,7 @@ def uts_vec(
     min_idle_div: int = 8,
     depth_bound: Optional[int] = None,
     stack_pad: Optional[int] = None,
+    timing_reps: Optional[int] = None,
 ) -> dict:
     """Run UTS with the vectorized DFS engine; returns counts + timing info.
 
@@ -674,11 +704,14 @@ def uts_vec(
     )
     if device is not None:
         args = tuple(jax.device_put(a, device) for a in args)
-    nodes, leaves, maxd, steps, unfinished = _uts_dfs(*args, **kw)
-    t0 = time.perf_counter()
-    nodes, leaves, maxd, steps, unfinished = _uts_dfs(*args, **kw)
-    dev_nodes = int(np.asarray(nodes).sum(dtype=np.int64))
-    dt = time.perf_counter() - t0
+    on_tpu = (
+        device.platform == "tpu" if device is not None
+        else jax.default_backend() == "tpu"
+    )
+    (nodes, leaves, maxd, steps, unfinished), dev_nodes, dt = _timed_best(
+        lambda: _uts_dfs(*args, **kw),
+        resolve_timing_reps(timing_reps, on_tpu),
+    )
     if bool(unfinished):
         raise RuntimeError(f"uts_vec ran out of steps ({max_steps})")
     if bounded and int(np.asarray(maxd).max()) >= cap:
