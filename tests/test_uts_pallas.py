@@ -54,8 +54,9 @@ def test_uts_pallas_t1xxl_exact_on_tpu():
     """The canonical T1XXL tree: 4,230,646,601 nodes - genuinely beyond
     int32 totals (2^31 = 2.147B), counted exactly because the per-lane
     planes are summed in int64 on the host; an int32 total would wrap.
-    (T1XL's 1.635B, by contrast, still fits int32.) Verified at 527M
-    nodes/s, lane efficiency 0.98."""
+    (T1XL's 1.635B, by contrast, still fits int32.) Verified at 527M+
+    nodes/s, lane efficiency 0.98, under the pre-round-4 single-shot
+    timing; typical best-of-3 rates are higher (see README)."""
     from hclib_tpu.models.uts import T1XXL
 
     r = uts_pallas(
