@@ -45,14 +45,34 @@ def _probe():
     return _PROBE
 
 
-def windowed(name: str, fn, trials: int, spread_seconds: float = 8.0):
+def windowed(
+    name: str,
+    fn,
+    trials: int,
+    spread_seconds: float = 8.0,
+    min_fast: int = 3,
+    max_trials: int = 0,
+):
     """Run ``fn`` (-> value, higher better) ``trials`` times, each
     bracketed by clock-probe samples; returns the WindowedTrials stats
-    dict (median/best over fast windows) and logs the distribution."""
+    dict (median/best over fast windows) and logs the distribution.
+
+    Trustworthy-number policy (VERDICT r4 #4): if fewer than ``min_fast``
+    trials landed in fast clock windows, keep running spread trials (up to
+    ``max_trials``, default 3x ``trials``) until enough do - a median
+    backed by <3 fast windows is weather, not measurement. The cap keeps a
+    fully-throttled chip from stalling the bench; the stats label then
+    says how many fast windows actually back the number."""
     from hclib_tpu.runtime.clockprobe import WindowedTrials
 
     wt = WindowedTrials(name, probe=_probe())
-    for t in range(trials):
+    max_trials = max_trials or 3 * trials
+
+    def n_fast() -> int:
+        return wt.count_fast()
+
+    t = 0
+    while t < trials or (n_fast() < min_fast and t < max_trials):
         if t:
             time.sleep(spread_seconds)
         rec = wt.run(fn)
@@ -61,6 +81,7 @@ def windowed(name: str, fn, trials: int, spread_seconds: float = 8.0):
             f"(probe {rec['probe_pre_tflops']:.0f}/"
             f"{rec['probe_post_tflops']:.0f} TF)"
         )
+        t += 1
     s = wt.stats()
     log(
         f"{name}: median {s['median']:.4g} / best {s['best']:.4g} "
@@ -382,7 +403,7 @@ def bench_native_uts():
 def bench_device_uts():
     """Headline: vectorized-DFS UTS on the canonical T1L tree
     (102,181,082 nodes; BASELINE.json's north-star workload). Returns
-    (rate, tree_label).
+    (rate, tree_label, statistic_tag).
 
     Engine: the fully-fused Pallas kernel (uts_pallas.py, whole traversal
     resident on-core) - ~5x the split-XLA engine; falls back to uts_vec if
@@ -429,19 +450,27 @@ def bench_device_uts():
             if on_tpu:
                 s = windowed(f"UTS {tree} [{name}]", one_trial, trials)
                 # Number of record: median over fast windows. If NO trial
-                # landed in a fast window (the chip can throttle for the
-                # whole bench), the all-trials median is biased far low
-                # (throttled UTS trials measure 4-6x under fast ones) -
-                # report best-observed instead; the window label and full
-                # distribution are in perf-logs either way.
+                # landed in a fast window even after windowed()'s retry
+                # policy (the chip can throttle for the whole bench), the
+                # all-trials median is biased far low (throttled UTS
+                # trials measure 4-6x under fast ones) - report
+                # best-observed instead, and TAG the emitted JSON with the
+                # statistic used so downstream consumers can't conflate
+                # the two (the window label and full distribution are in
+                # perf-logs either way).
                 rate = s["median"] if s["n_fast"] else s["best"]
+                stat = (
+                    f"median-fast-{s['n_fast']}of{s['n_trials']}"
+                    if s["n_fast"] else "best-fallback-all-throttled"
+                )
             else:
                 rate = max(one_trial() for _ in range(trials))
+                stat = f"best-of-{trials}"
             r = holder["r"]
             log(f"device UTS {tree} [{name}]: {r['nodes']} nodes, "
                 f"{rate/1e6:.1f}M nodes/s (lane eff "
-                f"{100.0 * r['lane_efficiency']:.0f}%)")
-            return rate, tree
+                f"{100.0 * r['lane_efficiency']:.0f}%, statistic {stat})")
+            return rate, tree, stat
         except AssertionError:
             raise
         except Exception as e:
@@ -481,7 +510,7 @@ def main() -> None:
         log(f"cholesky bench failed: {e}")
     try:
         native_uts_rate = bench_native_uts()
-        device_uts_rate, tree = bench_device_uts()
+        device_uts_rate, tree, uts_stat = bench_device_uts()
     except Exception as e:
         log(f"uts bench failed: {e}; falling back to fib headline")
         print(
@@ -503,6 +532,7 @@ def main() -> None:
                 "value": round(device_uts_rate),
                 "unit": "nodes/sec",
                 "vs_baseline": round(device_uts_rate / native_uts_rate, 2),
+                "statistic": uts_stat,
             }
         )
     )

@@ -358,8 +358,12 @@ def uts_pallas(
         # share a single compiled kernel (see padded_threshold_table).
         thr = None
         stack_size = max(1, (cap - d0) if bounded else (cap - 1 - d0))
+        # max_rows = cols - 1: the in-row gather clips depth to column
+        # cols - 1 and needs that column to stay -1 padding, so the row
+        # quantization must not round past it (restores depth caps up to
+        # cols - 2 = 126 that the plain 16-row round-up would reject).
         tabnp = inrow_threshold_table(
-            padded_threshold_table(params, cap), cols
+            padded_threshold_table(params, cap, max_rows=cols - 1), cols
         )
     if stack_pad is not None:
         # Opt-in compile sharing across tree shapes (taller stacks cost

@@ -456,6 +456,9 @@ def _timed_best(run, reps: int):
     the per-run D2H node-plane sum is the only reliable sync through the
     tunnel and is deliberately inside the timed region for both engines)."""
     outs = run()
+    # Synchronize the warm execution (dispatch is async; its tail would
+    # otherwise bleed into rep 1's t0 and bias the single-rep rate slow).
+    _ = int(np.asarray(outs[0]).sum(dtype=np.int64))
     dt = None
     dev_nodes = 0
     for _ in range(reps):
@@ -467,16 +470,29 @@ def _timed_best(run, reps: int):
     return outs, dev_nodes, dt
 
 
-def padded_threshold_table(params: UTSParams, cap: int) -> np.ndarray:
+def padded_threshold_table(
+    params: UTSParams, cap: int, max_rows: Optional[int] = None
+) -> np.ndarray:
     """child_threshold_table padded to a COMMON shape: rows (depths) up to
-    a multiple of 16, columns (child ordinals) to MAX_CHILDREN, -1 filled.
-    The table values are runtime inputs to both engines, so every
-    depth-varying tree whose padded shape matches shares ONE compiled
-    engine (per stack height) instead of paying the ~1 min XLA/Mosaic
-    compile per tree - padding costs a few dead compares per step."""
+    a multiple of 16, columns (child ordinals) to the next multiple of 16
+    (capped at MAX_CHILDREN), -1 filled. The table values are runtime
+    inputs to both engines, so every depth-varying tree whose padded shape
+    matches shares ONE compiled engine (per stack height) instead of
+    paying the ~1 min XLA/Mosaic compile per tree - padding costs a few
+    dead compares per step (the per-step table cost scales with the COLUMN
+    count, so quantized widths keep small-ordinal trees cheap while trees
+    in one width class still share a compile).
+
+    ``max_rows`` (uts_pallas passes its lane-column limit) caps the row
+    round-up when the quantized height would cross a consumer's bound but
+    the real cap still fits - so a cap of, say, 120 rides in 121 rows
+    instead of failing at the quantized 128."""
     t = child_threshold_table(params, cap)
     rows = -(-(cap + 1) // 16) * 16
-    out = np.full((rows, MAX_CHILDREN), -1, np.int32)
+    if max_rows is not None and rows > max_rows >= cap + 1:
+        rows = max_rows
+    cols = min(MAX_CHILDREN, -(-t.shape[1] // 16) * 16)
+    out = np.full((rows, max(cols, t.shape[1])), -1, np.int32)
     out[: t.shape[0], : t.shape[1]] = t
     return out
 

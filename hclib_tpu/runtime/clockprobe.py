@@ -163,6 +163,15 @@ class WindowedTrials:
             out.append((r, "fast" if fast else "throttled"))
         return out
 
+    def count_fast(self) -> int:
+        """Trials currently labeled fast (non-sheared) - the statistic
+        bench.py's retry loop stops on; one definition, shared with
+        stats(), so the stopping rule can't diverge from the label."""
+        return sum(
+            1 for r, lb in self._labeled()
+            if lb == "fast" and r["value"] > 0
+        )
+
     def stats(self) -> Dict:
         labeled = self._labeled()
         # Slope-based trials can yield nonpositive values under extreme

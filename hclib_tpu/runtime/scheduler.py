@@ -699,6 +699,25 @@ class Runtime:
     def print_stats(self) -> None:
         print(self.format_stats())
 
+    def stats_dict(self) -> dict:
+        """Worker counters as a JSON-ready dict (steal matrix included) -
+        the machine-readable form of format_stats, consumed by
+        tools/timeline.py's report renderer."""
+        return {
+            "nworkers": self.nworkers,
+            "workers": [
+                {
+                    "executed": st.executed,
+                    "spawned": st.spawned,
+                    "steals": st.steals,
+                    "parks": st.parks,
+                    "yields": st.yields,
+                    "stolen_from": list(st.stolen_from),
+                }
+                for st in self.worker_stats
+            ],
+        }
+
     def format_stats(self) -> str:
         lines = ["hclib_tpu runtime stats:"]
         for w, st in enumerate(self.worker_stats):

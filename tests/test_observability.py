@@ -155,3 +155,69 @@ def test_windowed_trials_stats_survive_sheared_trials():
     s2 = wt2.stats()
     assert s2["median"] == 6.0
     assert s2["n_trials"] == 3 and s2["n_used"] == 2 and s2["n_fast"] == 2
+
+
+def test_timeline_renders_dump_and_reports(tmp_path):
+    """tools/timeline.py turns a dump + info/stats dicts into readable
+    reports (the reference's tools/timeline.py + instrument parser
+    station)."""
+    sys.path.insert(0, "tools")
+    try:
+        import timeline
+    finally:
+        sys.path.pop(0)
+
+    rt = hc.Runtime(nworkers=2, instrument=True)
+
+    def body():
+        with hc.finish():
+            for _ in range(25):
+                hc.async_(lambda: time.sleep(0.0002))
+
+    rt.run(body)
+    stats = rt.stats_dict()
+    path = rt.event_log.dump(str(tmp_path))
+
+    text = timeline.render_dump(path)
+    assert "per-worker timeline" in text
+    assert "task" in text  # the registered event type shows up
+    assert "w0" in text and "w1" in text
+    assert "% busy" in text
+
+    # START/END pairing: spans exist and have nonnegative durations
+    names, by_worker = load_dump(path)
+    spans = [
+        s
+        for w, ev in by_worker.items()
+        for s in timeline.spans_from_events(ev)
+    ]
+    assert len(spans) >= 26
+    assert all(s["t1"] >= s["t0"] for s in spans)
+
+    # host stats report incl. steal matrix layout
+    stext = timeline.render_stats(stats)
+    assert "executed=" in stext and "w0" in stext
+
+    # device report from a resident-style info dict
+    info = {
+        "name": "uts steal",
+        "executed": 1000,
+        "rounds": 7,
+        "seconds": 0.5,
+        "per_device_counts": [
+            [0, 0, 200, 0, 4, 300, 0, 7],
+            [0, 0, 180, 0, 4, 700, 0, 7],
+        ],
+    }
+    dtext = timeline.render_device_report(info)
+    assert "dev0" in dtext and "dev1" in dtext
+    assert "1,000 tasks" in dtext
+    assert "imbalance" in dtext
+
+    # CLI round-trips via files
+    import json as _json
+
+    f = tmp_path / "info.json"
+    f.write_text(_json.dumps(info))
+    rc = timeline.main([str(path), "--device", str(f)])
+    assert rc == 0

@@ -1,0 +1,255 @@
+"""Render instrumentation dumps and device counters into human-readable
+per-worker timelines and reports.
+
+The analogue of the reference's trace station (tools/timeline.py renders
+worker timelines from binary logs; tools/hclib_instrument_parser.c decodes
+the per-thread dumps) for this runtime's two observability sources:
+
+1. **Host event dumps** (`runtime/instrument.py`, live - the reference's
+   recorder is stubbed): ``python tools/timeline.py hclib.<ts>.dump/``
+   pairs START/END records per worker, draws a density timeline (one row
+   per worker, one column per time bucket, shade = busy fraction), and
+   tabulates per-event-type counts/durations.
+
+2. **Device per-round counters** (megakernel/resident ``info`` dicts with
+   ``per_device_counts``): ``python tools/timeline.py --device info.json``
+   renders a per-device report (executed / rounds / backlog bars) so a
+   multi-chip run's load balance is readable at a glance. JSON files are
+   produced by ``tools/perf_regression.py --multichip`` and by any caller
+   that saves a run's ``info``.
+
+Both modes print plain text (no plotting deps); the module's render
+functions return the string so tests can assert on content.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(_HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(_HERE))
+
+SHADES = " .:-=*#%@"  # density ramp for timeline cells (ASCII-safe)
+
+
+def _shade(frac: float) -> str:
+    i = int(round(max(0.0, min(1.0, frac)) * (len(SHADES) - 1)))
+    return SHADES[i]
+
+
+def _bar(value: float, vmax: float, width: int = 40) -> str:
+    n = 0 if vmax <= 0 else int(round(width * value / vmax))
+    return "#" * n + "." * (width - n)
+
+
+def spans_from_events(events: np.ndarray) -> List[Dict]:
+    """Pair START/END records (by event type + correlation id) into spans.
+
+    Unmatched STARTs are kept open-ended (end = last timestamp seen);
+    SINGLE records become zero-length marks. Returns a list of dicts
+    {type, id, t0, t1} with nanosecond timestamps."""
+    from hclib_tpu.runtime.instrument import END, SINGLE, START
+
+    open_: Dict[tuple, int] = {}
+    spans: List[Dict] = []
+    last_ts = 0
+    for rec in events:
+        ts = int(rec["ts_ns"])
+        last_ts = max(last_ts, ts)
+        key = (int(rec["type"]), int(rec["id"]))
+        tr = int(rec["transition"])
+        if tr == START:
+            open_[key] = ts
+        elif tr == END:
+            t0 = open_.pop(key, ts)
+            spans.append({"type": key[0], "id": key[1], "t0": t0, "t1": ts})
+        elif tr == SINGLE:
+            spans.append({"type": key[0], "id": key[1], "t0": ts, "t1": ts})
+    for (etype, eid), t0 in open_.items():
+        spans.append({"type": etype, "id": eid, "t0": t0, "t1": last_ts,
+                      "open": True})
+    return spans
+
+
+def render_dump(path: str, width: int = 72) -> str:
+    """Per-worker density timeline + per-event-type table for one dump dir."""
+    from hclib_tpu.runtime.instrument import load_dump
+
+    names, by_worker = load_dump(path)
+    all_spans = {w: spans_from_events(ev) for w, ev in by_worker.items()}
+    ts = [s["t0"] for sp in all_spans.values() for s in sp] + [
+        s["t1"] for sp in all_spans.values() for s in sp
+    ]
+    out = [f"dump: {path}"]
+    if not ts:
+        out.append("(no events recorded)")
+        return "\n".join(out)
+    t_lo, t_hi = min(ts), max(ts)
+    total = max(t_hi - t_lo, 1)
+    out.append(
+        f"{sum(len(v) for v in by_worker.values())} events, "
+        f"{len(by_worker)} workers, span {total / 1e6:.3f} ms"
+    )
+    out.append("")
+    out.append("per-worker timeline (shade = busy fraction per bucket):")
+    bucket = total / width
+    for w in sorted(all_spans):
+        busy = np.zeros(width)
+        nspans = 0
+        for s in all_spans[w]:
+            nspans += 1
+            b0 = (s["t0"] - t_lo) / bucket
+            b1 = max((s["t1"] - t_lo) / bucket, b0 + 1e-9)
+            for b in range(int(b0), min(int(np.ceil(b1)), width)):
+                # overlap of [b0, b1) with bucket b
+                busy[b] += max(
+                    0.0, min(b1, b + 1) - max(b0, b)
+                )
+        row = "".join(_shade(f) for f in busy)
+        frac = sum(
+            s["t1"] - s["t0"] for s in all_spans[w]
+        ) / total
+        out.append(f"  w{w:<3d}|{row}| {100 * frac:5.1f}% busy, {nspans} spans")
+    out.append(
+        f"      +{'-' * width}+  0 = {0.0:.3f} ms .. {total / 1e6:.3f} ms"
+    )
+    out.append("")
+    out.append("per-event-type summary:")
+    out.append(
+        f"  {'type':<20} {'count':>8} {'total ms':>10} {'mean us':>10} "
+        f"{'max us':>10}"
+    )
+    for tid in sorted({s['type'] for sp in all_spans.values() for s in sp}):
+        durs = np.array(
+            [
+                (s["t1"] - s["t0"]) / 1e3
+                for sp in all_spans.values()
+                for s in sp
+                if s["type"] == tid
+            ]
+        )
+        name = names[tid] if tid < len(names) else f"type{tid}"
+        out.append(
+            f"  {name:<20} {len(durs):>8} {durs.sum() / 1e3:>10.3f} "
+            f"{durs.mean():>10.2f} {durs.max():>10.2f}"
+        )
+    return "\n".join(out)
+
+
+def render_device_report(info: Dict, width: int = 40) -> str:
+    """Per-device load report from a megakernel/resident ``info`` dict.
+
+    Understands the ``per_device_counts`` layout (8 ints per device:
+    head, tail, alloc, pending, value_alloc, executed, overflow, rounds)
+    plus optional top-level fields (rounds, executed, seconds, name)."""
+    counts = info.get("per_device_counts")
+    out = []
+    name = info.get("name", "device run")
+    hdr = f"{name}: {info.get('executed', '?')} tasks"
+    if info.get("rounds") is not None:
+        hdr += f", {info['rounds']} rounds"
+    if info.get("seconds") is not None:
+        hdr += f", {info['seconds']:.3f} s"
+        if info.get("executed") and info["seconds"] > 0:
+            hdr += f" ({info['executed'] / info['seconds']:,.0f} tasks/s)"
+    out.append(hdr)
+    if not counts:
+        out.append("(no per_device_counts in info)")
+        return "\n".join(out)
+    counts = np.asarray(counts)
+    ex = counts[:, 5]
+    vmax = ex.max()
+    out.append("per-device executed (load balance):")
+    for d in range(counts.shape[0]):
+        extras = []
+        if counts[d, 3]:
+            extras.append(f"pending={counts[d, 3]}")
+        if counts[d, 6]:
+            extras.append(f"OVERFLOW=0x{counts[d, 6]:x}")
+        out.append(
+            f"  dev{d:<2d}|{_bar(ex[d], vmax, width)}| {ex[d]:>9,}"
+            + (" " + " ".join(extras) if extras else "")
+        )
+    tot = int(ex.sum())
+    imb = float(vmax) * len(ex) / tot if tot else 0.0
+    out.append(
+        f"  total {tot:,} tasks; imbalance max/mean = {imb:.2f}x; "
+        f"rows alloc'd per device: {counts[:, 2].tolist()}"
+    )
+    extra = info.get("migrated")
+    if extra is not None:
+        out.append(f"  migrated rows: {extra}")
+    return "\n".join(out)
+
+
+def render_stats(stats: Dict, width: int = 40) -> str:
+    """Worker-stats report (executed/spawned/steals + steal matrix) from
+    ``Runtime.stats_dict()`` output or its saved JSON."""
+    workers = stats.get("workers", [])
+    out = [
+        f"host runtime: {stats.get('nworkers', len(workers))} workers, "
+        f"{sum(w.get('executed', 0) for w in workers)} tasks executed"
+    ]
+    vmax = max((w.get("executed", 0) for w in workers), default=0)
+    for i, w in enumerate(workers):
+        out.append(
+            f"  w{i:<3d}|{_bar(w.get('executed', 0), vmax, width)}| "
+            f"executed={w.get('executed', 0):<8} "
+            f"spawned={w.get('spawned', 0):<8} steals={w.get('steals', 0)}"
+        )
+    mats = [w.get("stolen_from") for w in workers]
+    if any(mats) and len(workers) > 1:
+        out.append("steal matrix (row = thief, col = victim, shade = count):")
+        m = np.asarray([x or [0] * len(workers) for x in mats], dtype=float)
+        peak = m.max() or 1.0
+        for i, row in enumerate(m):
+            out.append(
+                f"  w{i:<3d}|" + "".join(_shade(v / peak) for v in row) + "|"
+            )
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="render hclib_tpu traces/counters as text timelines"
+    )
+    ap.add_argument("path", nargs="?", help="instrument dump directory")
+    ap.add_argument(
+        "--device", action="append", default=[],
+        help="JSON file holding a run info dict (per_device_counts)",
+    )
+    ap.add_argument(
+        "--stats", action="append", default=[],
+        help="JSON file holding Runtime.stats_dict() output",
+    )
+    ap.add_argument("--width", type=int, default=72)
+    args = ap.parse_args(argv)
+    shown = False
+    if args.path:
+        print(render_dump(args.path, width=args.width))
+        shown = True
+    bar_width = min(args.width, 60)
+    for f in args.device:
+        with open(f) as fh:
+            print(render_device_report(json.load(fh), width=bar_width))
+        shown = True
+    for f in args.stats:
+        with open(f) as fh:
+            print(render_stats(json.load(fh), width=bar_width))
+        shown = True
+    if not shown:
+        ap.print_help()
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
