@@ -1,35 +1,37 @@
 """Tiled Cholesky inside the megakernel: MXU tile tasks on a DDF DAG.
 
 Same dependency structure as the host model (models/cholesky.py; reference
-test/cholesky/cholesky.cpp), with the four tile kernels designed for the TPU
+test/cholesky/cholesky.cpp), with the tile kernels designed for the TPU
 compute units rather than translated from LAPACK:
 
-- POTRF (VPU + MXU): ``factor_and_inv`` - the serial masked rank-1 sweep
-  runs only on 128x128 diagonal base blocks (row j equals column j by
-  symmetry, so both outer-product factors come from cheap masked
-  reductions); larger tiles recurse by 2x2 blocking with panels, trailing
-  updates, and the inverse assembled as MXU block algebra, and inv(L) of a
-  base block comes from Newton-Schulz iterations (exact for triangular
-  matrices after ceil(log2 T) steps).
+- POTRF (VPU + MXU): ``factor_and_inv`` - serial math confined to 8x8
+  diagonal micro-blocks; panels, trailing updates, and the inverse are MXU
+  block algebra (ops/tiles.py). Writes L_kk (f32) and inv(L_kk) PRE-SPLIT
+  to bf16 hi/lo.
 - TRSM (MXU): with inv(L_kk) available, the triangular solve is one
-  dot_general: A_ik <- A_ik inv(L_kk)^T.
+  3-pass matmul: A_ik <- A_ik inv(L_kk)^T. The default graph runs it as a
+  COLUMN STREAM (one task per step k): inv's split stays resident while
+  the A_ik tiles double-buffer through, and each result is stored twice -
+  f32 (the factor output) and bf16 hi/lo (the ``lsp`` operand cache).
 - UPDROW (MXU, row-fused trailing update): one task per (row i, step k)
   performs A_ij -= L_ik L_jk^T for all j in (k, i] (the SYRK j = i case
-  included), loading L_ik once and double-buffering the (A_ij, L_jk) tile
-  streams so the next pair's DMA rides under the current GEMM - the
-  HBM-bandwidth half of the workload overlaps the MXU half instead of
-  serializing 4 transfers around every matmul. Tile-level tasks (the
-  reference's granularity, test/cholesky/cholesky.cpp) spend ~half their
-  wall on un-overlapped DMA; row fusion is the TPU-first regrouping: the
-  DAG keeps real parallelism across rows while each task gets a
-  long-enough tile stream to pipeline.
+  included). Both L operands stream from ``lsp`` ALREADY SPLIT, so the
+  hot loop is exactly the three MXU passes plus one subtract - no VPU
+  split work (splitting both operands per iteration measured ~15% of the
+  stream's wall clock). L_ik stays resident for the row; (A_ij, L_jk)
+  pairs double-buffer so the next pair's DMA rides under the current
+  GEMM.
 
-f32 data, MXU matmuls at ~f32 accuracy via the 3-pass bf16 hi/lo split
-(ops/tiles.mm_nt).
+Why 3 passes: f32 data, MXU matmuls at ~f32 accuracy via the bf16 hi/lo
+split (ops/tiles.mm_nt_split). This sets the physics of the benchmark: a
+3-pass f32-accurate GEMM can never exceed 1/3 of the chip's bf16 matmul
+clock, so the meaningful utilization number is (achieved f32-effective
+FLOP/s) / (probe/3) - bench.py prints both.
 """
 
 from __future__ import annotations
 
+import functools as _ft
 import time
 from typing import Optional, Tuple
 
@@ -39,17 +41,24 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..ops.tiles import dma_copy as _dma, factor_and_inv, mm_nt as _mm_nt
+from ..ops.tiles import (
+    dma_copy as _dma,
+    factor_and_inv,
+    mm_nt_rsplit as _mm_nt_rsplit,
+    mm_nt_split as _mm_nt_split,
+    split_bf16 as _split,
+)
 from .descriptor import TaskGraphBuilder
 from .megakernel import KernelContext, Megakernel
 
 __all__ = ["device_cholesky", "build_cholesky_graph", "make_cholesky_megakernel"]
 
-T = 128  # default tile edge (MXU-native); 256 amortizes scheduling
+T = 128  # default tile edge (MXU-native); 256+ amortizes scheduling
 
 POTRF = 0
 TRSM = 1
 UPDROW = 2
+TRSMCOL = 3
 
 
 def _load_all(pairs, sems) -> None:
@@ -65,52 +74,160 @@ def _load_all(pairs, sems) -> None:
         cp.wait()
 
 
-def _potrf_kernel(ctx: KernelContext, ts: int = T) -> None:
+def _potrf_kernel(ctx: KernelContext, ts: int = T, fbase: int = 128) -> None:
     k = ctx.arg(0)
-    tiles, linv = ctx.data["tiles"], ctx.data["linv"]
-    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    tiles, linvsp = ctx.data["tiles"], ctx.data["linvsp"]
+    va = ctx.scratch["va"]
+    rvh, rvl = ctx.scratch["rvh"], ctx.scratch["rvl"]
     sem = ctx.scratch["sems"]
     _dma(tiles.at[k, k], va, sem.at[0])
-    l, inv = factor_and_inv(va[:], ts)
+    l, inv = factor_and_inv(va[:], ts, base=fbase)
     va[:] = l
-    vb[:] = inv
-    _load_all([(va, tiles.at[k, k]), (vb, linv.at[k])], sem)
+    ih, il = _split(inv)
+    rvh[:] = ih
+    rvl[:] = il
+    _load_all(
+        [(va, tiles.at[k, k]), (rvh, linvsp.at[k, 0]), (rvl, linvsp.at[k, 1])],
+        sem,
+    )
 
 
 def _trsm_kernel(ctx: KernelContext, ts: int = T) -> None:
+    """Tile-at-a-time TRSM (the unfused graph's form): one 3-pass matmul
+    against the resident inverse split, stored f32 + split."""
     i, k = ctx.arg(0), ctx.arg(1)
-    tiles, linv = ctx.data["tiles"], ctx.data["linv"]
-    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    tiles, linvsp, lsp = ctx.data["tiles"], ctx.data["linvsp"], ctx.data["lsp"]
+    f32a, f32b = ctx.scratch["f32a"], ctx.scratch["f32b"]
+    bfh, bfl = ctx.scratch["bfh"], ctx.scratch["bfl"]
+    rvh, rvl = ctx.scratch["rvh"], ctx.scratch["rvl"]
     sem = ctx.scratch["sems"]
-    _load_all([(tiles.at[i, k], va), (linv.at[k], vb)], sem)
-    va[:] = _mm_nt(va[:], vb[:])  # A_ik inv(L_kk)^T
-    _dma(va, tiles.at[i, k], sem.at[0])
+    _load_all(
+        [(tiles.at[i, k], f32a.at[0]), (linvsp.at[k, 0], rvh),
+         (linvsp.at[k, 1], rvl)],
+        sem,
+    )
+    s = _mm_nt_rsplit(f32a[0], rvh[:], rvl[:])  # A_ik inv(L_kk)^T
+    f32b[0] = s
+    sh, sl = _split(s)
+    bfh[0] = sh
+    bfl[0] = sl
+    _load_all(
+        [(f32b.at[0], tiles.at[i, k]), (bfh.at[0], lsp.at[i, k, 0]),
+         (bfl.at[0], lsp.at[i, k, 1])],
+        sem,
+    )
+
+
+def _trsmcol_kernel(ctx: KernelContext, ts: int = T, nt: int = 0) -> None:
+    """Column-fused TRSM stream (one task per step k): inv(L_kk)'s split
+    stays resident; the A_ik tiles (i = k+1 .. nt-1) double-buffer
+    through, each result stored back f32 AND bf16 hi/lo (the ``lsp``
+    operand cache the trailing updates stream from). On a single core the
+    DAG's TRSM tiles run back-to-back anyway; fusing them removes
+    per-tile dispatch and lets every load/store ride under a neighbor's
+    matmul."""
+    k = ctx.arg(0)
+    tiles, linvsp, lsp = ctx.data["tiles"], ctx.data["linvsp"], ctx.data["lsp"]
+    f32a, f32b = ctx.scratch["f32a"], ctx.scratch["f32b"]
+    bfh, bfl = ctx.scratch["bfh"], ctx.scratch["bfl"]
+    rvh, rvl = ctx.scratch["rvh"], ctx.scratch["rvl"]
+    sem = ctx.scratch["sems"]
+    sl = ctx.scratch["sload"]  # (2, 3) load sems (only [:, 0] used here)
+    ss = ctx.scratch["sstore"]  # (2, 3): per-slot {f32, hi, lo} store sems
+    _load_all([(linvsp.at[k, 0], rvh), (linvsp.at[k, 1], rvl)], sem)
+    nj = nt - 1 - k  # i walks k+1 .. nt-1
+
+    def start_load(slot, i) -> None:
+        pltpu.make_async_copy(
+            tiles.at[i, k], f32a.at[slot], sl.at[slot, 0]
+        ).start()
+
+    def start_stores(slot, i) -> None:
+        pltpu.make_async_copy(
+            f32b.at[slot], tiles.at[i, k], ss.at[slot, 0]
+        ).start()
+        pltpu.make_async_copy(
+            bfh.at[slot], lsp.at[i, k, 0], ss.at[slot, 1]
+        ).start()
+        pltpu.make_async_copy(
+            bfl.at[slot], lsp.at[i, k, 1], ss.at[slot, 2]
+        ).start()
+
+    def wait_stores(slot, i) -> None:
+        pltpu.make_async_copy(
+            f32b.at[slot], tiles.at[i, k], ss.at[slot, 0]
+        ).wait()
+        pltpu.make_async_copy(
+            bfh.at[slot], lsp.at[i, k, 0], ss.at[slot, 1]
+        ).wait()
+        pltpu.make_async_copy(
+            bfl.at[slot], lsp.at[i, k, 1], ss.at[slot, 2]
+        ).wait()
+
+    start_load(0, k + 1)
+
+    def body(t, _):
+        i = k + 1 + t
+        cur = t % 2
+        nxt = 1 - cur
+
+        @pl.when(t + 1 < nj)
+        def _():
+            # f32a[nxt] was an INPUT at t-1 (read synchronously by that
+            # iteration's matmul), so prefetching over it is safe.
+            start_load(nxt, i + 1)
+
+        pltpu.make_async_copy(tiles.at[i, k], f32a.at[cur], sl.at[cur, 0]).wait()
+        s = _mm_nt_rsplit(f32a[cur], rvh[:], rvl[:])
+        # Slot cur's OUTPUT buffers last stored at t-2 (dst row i-2);
+        # those transfers must land before this compute overwrites them.
+        @pl.when(t >= 2)
+        def _():
+            wait_stores(cur, i - 2)
+
+        f32b[cur] = s
+        sh, slo = _split(s)
+        bfh[cur] = sh
+        bfl[cur] = slo
+        start_stores(cur, i)
+        return 0
+
+    jax.lax.fori_loop(0, nj, body, 0)
+    last = (nj - 1) % 2
+
+    @pl.when(nj >= 2)
+    def _():
+        wait_stores(1 - last, k + nj - 1)
+
+    wait_stores(last, k + nj)
 
 
 def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
     """Row-fused trailing update: A_ij -= L_ik L_jk^T for j in (k, i].
 
-    L_ik stays resident in VMEM for the whole row; the (A_ij, L_jk) pairs
-    stream through two double-buffered slots - iteration t starts the DMAs
-    for t+1 before computing t, and store-backs ride their own semaphores
-    so a slot is only reused once its previous store completed. Every
+    L_ik's split stays resident in VMEM for the whole row; the
+    (A_ij, L_jk-split) streams double-buffer through two slots -
+    iteration t starts the DMAs for t+1 before computing t, and
+    store-backs ride their own semaphores so a slot is only reused once
+    its previous store completed. The SYRK j = i case needs no special
+    path: lsp[j, k] at j = i IS the resident L_ik (same bits). Every
     started DMA is waited exactly once (the epilogue drains the last two
     stores)."""
     i, k = ctx.arg(0), ctx.arg(1)
-    tiles = ctx.data["tiles"]
-    vl = ctx.scratch["vl"]
-    ab, lb = ctx.scratch["ab"], ctx.scratch["lb"]
-    sl = ctx.scratch["sload"]  # (2, 2): [slot, {A, L}]
-    ss = ctx.scratch["sstore"]  # (2,): per-slot store sems
+    tiles, lsp = ctx.data["tiles"], ctx.data["lsp"]
+    f32a = ctx.scratch["f32a"]
+    bfh, bfl = ctx.scratch["bfh"], ctx.scratch["bfl"]
+    rvh, rvl = ctx.scratch["rvh"], ctx.scratch["rvl"]
     sem = ctx.scratch["sems"]
-    _dma(tiles.at[i, k], vl, sem.at[0])  # L_ik, resident for the row
+    sl = ctx.scratch["sload"]  # (2, 3): per-slot {A, L-hi, L-lo}
+    ss = ctx.scratch["sstore"]  # (2, 3): [slot, 0] = A store-back
+    _load_all([(lsp.at[i, k, 0], rvh), (lsp.at[i, k, 1], rvl)], sem)
     nj = i - k  # j walks k+1 .. i
 
     def start_loads(slot, j) -> None:
-        pltpu.make_async_copy(tiles.at[i, j], ab.at[slot], sl.at[slot, 0]).start()
-        # j == i loads tiles[i, k] = L_ik again: harmless, keeps the DMA
-        # count per iteration uniform (the compute selects vl for SYRK).
-        pltpu.make_async_copy(tiles.at[j, k], lb.at[slot], sl.at[slot, 1]).start()
+        pltpu.make_async_copy(tiles.at[i, j], f32a.at[slot], sl.at[slot, 0]).start()
+        pltpu.make_async_copy(lsp.at[j, k, 0], bfh.at[slot], sl.at[slot, 1]).start()
+        pltpu.make_async_copy(lsp.at[j, k, 1], bfl.at[slot], sl.at[slot, 2]).start()
 
     start_loads(0, k + 1)
 
@@ -126,42 +243,52 @@ def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
             @pl.when(t >= 1)
             def _():
                 pltpu.make_async_copy(
-                    ab.at[nxt], tiles.at[i, j - 1], ss.at[nxt]
+                    f32a.at[nxt], tiles.at[i, j - 1], ss.at[nxt, 0]
                 ).wait()
 
             start_loads(nxt, j + 1)
 
-        pltpu.make_async_copy(tiles.at[i, j], ab.at[cur], sl.at[cur, 0]).wait()
-        pltpu.make_async_copy(tiles.at[j, k], lb.at[cur], sl.at[cur, 1]).wait()
-        rhs = jnp.where(j == i, vl[:], lb[cur])
-        ab[cur] = ab[cur] - _mm_nt(vl[:], rhs)
-        pltpu.make_async_copy(ab.at[cur], tiles.at[i, j], ss.at[cur]).start()
+        pltpu.make_async_copy(tiles.at[i, j], f32a.at[cur], sl.at[cur, 0]).wait()
+        pltpu.make_async_copy(lsp.at[j, k, 0], bfh.at[cur], sl.at[cur, 1]).wait()
+        pltpu.make_async_copy(lsp.at[j, k, 1], bfl.at[cur], sl.at[cur, 2]).wait()
+        f32a[cur] = f32a[cur] - _mm_nt_split(
+            rvh[:], rvl[:], bfh[cur], bfl[cur]
+        )
+        pltpu.make_async_copy(f32a.at[cur], tiles.at[i, j], ss.at[cur, 0]).start()
         return 0
 
     jax.lax.fori_loop(0, nj, body, 0)
-    # Drain the last two stores. The wait descriptors name the transfers
-    # these semaphores actually signal: slot `last` stored tiles[i, i]
-    # (j = i at t = nj-1), slot `1-last` stored tiles[i, i-1] (t = nj-2).
+    # Drain the last two stores: slot `last` stored tiles[i, i] (j = i at
+    # t = nj-1), slot `1-last` stored tiles[i, i-1] (t = nj-2).
     last = (nj - 1) % 2
 
     @pl.when(nj >= 2)
     def _():
         pltpu.make_async_copy(
-            ab.at[1 - last], tiles.at[i, i - 1], ss.at[1 - last]
+            f32a.at[1 - last], tiles.at[i, i - 1], ss.at[1 - last, 0]
         ).wait()
 
-    pltpu.make_async_copy(ab.at[last], tiles.at[i, i], ss.at[last]).wait()
+    pltpu.make_async_copy(f32a.at[last], tiles.at[i, i], ss.at[last, 0]).wait()
 
 
-def build_cholesky_graph(nt: int) -> TaskGraphBuilder:
+def build_cholesky_graph(nt: int, fused_trsm: bool = True) -> TaskGraphBuilder:
     """Static DAG: POTRF / TRSM tile tasks + row-fused trailing updates.
 
-    Dependency shape (R = UPDROW row task):
-      POTRF(k)  <- R(k, k-1)             (its diagonal tile's last writer)
-      TRSM(i,k) <- POTRF(k), R(i, k-1)   (tile (i,k)'s last writer)
-      R(i, k)   <- TRSM(j,k) for k<j<=i  (the L_jk operands; TRSM(i,k)
-                                          transitively carries R(i,k-1),
-                                          the last writer of row i's tiles)
+    Dependency shape (R = UPDROW row task, C = TRSMCOL column stream):
+      POTRF(k)  <- R(k, k-1)              (its diagonal tile's last writer)
+      C(k)      <- POTRF(k), R(i, k-1) for all i > k   (fused default:
+                   the stream reads every tile (i, k), whose last writers
+                   are the step-(k-1) row updates)
+      R(i, k)   <- C(k)                   (the L operands; C(k) carries
+                                           R(i, k-1) transitively)
+    or, with ``fused_trsm=False`` (tile-level TRSM, the reference's
+    granularity, test/cholesky/cholesky.cpp):
+      TRSM(i,k) <- POTRF(k), R(i, k-1)
+      R(i, k)   <- TRSM(j,k) for k<j<=i
+
+    The fused graph keeps the full cross-row parallelism of the trailing
+    updates (the FLOPs); it serializes only the column solves, which a
+    single core runs back-to-back in either form.
     """
     b = TaskGraphBuilder()
     P = {}
@@ -173,54 +300,73 @@ def build_cholesky_graph(nt: int) -> TaskGraphBuilder:
 
     for k in range(nt):
         P[k] = b.add(POTRF, args=[k], deps=dep(R.get((k, k - 1))))
-        for i in range(k + 1, nt):
-            S[(i, k)] = b.add(
-                TRSM, args=[i, k], deps=dep(P[k], R.get((i, k - 1)))
-            )
-        for i in range(k + 1, nt):
-            R[(i, k)] = b.add(
-                UPDROW,
-                args=[i, k],
-                deps=[S[(j, k)] for j in range(k + 1, i + 1)],
-            )
+        if fused_trsm:
+            if k + 1 < nt:
+                prev = [R[(i, k - 1)] for i in range(k + 1, nt)] if k else []
+                col = b.add(TRSMCOL, args=[k], deps=[P[k]] + prev)
+                for i in range(k + 1, nt):
+                    R[(i, k)] = b.add(UPDROW, args=[i, k], deps=[col])
+        else:
+            for i in range(k + 1, nt):
+                S[(i, k)] = b.add(
+                    TRSM, args=[i, k], deps=dep(P[k], R.get((i, k - 1)))
+                )
+            for i in range(k + 1, nt):
+                R[(i, k)] = b.add(
+                    UPDROW,
+                    args=[i, k],
+                    deps=[S[(j, k)] for j in range(k + 1, i + 1)],
+                )
     return b
 
 
 def make_cholesky_megakernel(
-    nt: int, interpret: Optional[bool] = None, tile: int = T
+    nt: int,
+    interpret: Optional[bool] = None,
+    tile: int = T,
+    factor_base: Optional[int] = None,
 ) -> Megakernel:
-    import functools as _ft
-
+    if factor_base is None:
+        # 256 measured ~25% faster than 128 for 512 tiles (fewer
+        # recursion levels; the serial 8x8 chain count is identical).
+        factor_base = min(tile, 256)
     tile_spec = jax.ShapeDtypeStruct((nt, nt, tile, tile), jnp.float32)
-    linv_spec = jax.ShapeDtypeStruct((nt, tile, tile), jnp.float32)
-    # POTRF + TRSM tile tasks + one row-update task per (row, step).
+    linvsp_spec = jax.ShapeDtypeStruct((nt, 2, tile, tile), jnp.bfloat16)
+    lsp_spec = jax.ShapeDtypeStruct((nt, nt, 2, tile, tile), jnp.bfloat16)
+    # POTRF + TRSM tile tasks (or column streams) + one row-update task
+    # per (row, step): capacity covers the larger (unfused) form.
     ntasks = nt + 2 * (nt * (nt - 1) // 2)
     capacity = max(64, ntasks)
     return Megakernel(
         kernels=[
-            ("potrf", _ft.partial(_potrf_kernel, ts=tile)),
+            ("potrf", _ft.partial(_potrf_kernel, ts=tile, fbase=factor_base)),
             ("trsm", _ft.partial(_trsm_kernel, ts=tile)),
             ("updrow", _ft.partial(_updrow_kernel, ts=tile)),
+            ("trsmcol", _ft.partial(_trsmcol_kernel, ts=tile, nt=nt)),
         ],
-        data_specs={"tiles": tile_spec, "linv": linv_spec},
+        data_specs={
+            "tiles": tile_spec, "linvsp": linvsp_spec, "lsp": lsp_spec,
+        },
         scratch_specs={
             "va": pltpu.VMEM((tile, tile), jnp.float32),
-            "vb": pltpu.VMEM((tile, tile), jnp.float32),
-            "vl": pltpu.VMEM((tile, tile), jnp.float32),
-            "ab": pltpu.VMEM((2, tile, tile), jnp.float32),
-            "lb": pltpu.VMEM((2, tile, tile), jnp.float32),
+            "f32a": pltpu.VMEM((2, tile, tile), jnp.float32),
+            "f32b": pltpu.VMEM((2, tile, tile), jnp.float32),
+            "bfh": pltpu.VMEM((2, tile, tile), jnp.bfloat16),
+            "bfl": pltpu.VMEM((2, tile, tile), jnp.bfloat16),
+            "rvh": pltpu.VMEM((tile, tile), jnp.bfloat16),
+            "rvl": pltpu.VMEM((tile, tile), jnp.bfloat16),
             "sems": pltpu.SemaphoreType.DMA((3,)),
-            "sload": pltpu.SemaphoreType.DMA((2, 2)),
-            "sstore": pltpu.SemaphoreType.DMA((2,)),
+            "sload": pltpu.SemaphoreType.DMA((2, 3)),
+            "sstore": pltpu.SemaphoreType.DMA((2, 3)),
         },
         capacity=capacity,
         num_values=8,
         succ_capacity=max(64, 4 * ntasks + nt * nt * nt // 2),
         interpret=interpret,
-        # 7 tile buffers + compiler stack temporaries (factor_and_inv block
-        # values, bf16 split operands): past the 16 MiB scoped default once
-        # tile >= 768.
-        vmem_limit_bytes=max(16 * tile * tile * 4, 16 * 1024 * 1024),
+        # 8 f32-equivalent tile buffers + compiler stack temporaries
+        # (factor_and_inv block values, bf16 split operands): past the
+        # 16 MiB scoped default once tile >= 512.
+        vmem_limit_bytes=max(24 * tile * tile * 4, 16 * 1024 * 1024),
     )
 
 
@@ -234,11 +380,22 @@ def _from_tiles(tiles: np.ndarray, nt: int, ts: int = T) -> np.ndarray:
     return np.asarray(tiles).swapaxes(1, 2).reshape(nt * ts, nt * ts)
 
 
+def cholesky_buffers(a: np.ndarray, nt: int, tile: int = T) -> dict:
+    """The three data buffers a Cholesky run needs: f32 tiles plus the
+    bf16 split caches (inverse + subdiagonal L operands)."""
+    return {
+        "tiles": _to_tiles(a, nt, tile),
+        "linvsp": jnp.zeros((nt, 2, tile, tile), jnp.bfloat16),
+        "lsp": jnp.zeros((nt, nt, 2, tile, tile), jnp.bfloat16),
+    }
+
+
 def device_cholesky(
     a: np.ndarray,
     interpret: Optional[bool] = None,
     mk: Optional[Megakernel] = None,
     tile: int = T,
+    fused_trsm: bool = True,
 ) -> Tuple[np.ndarray, dict]:
     """Factor SPD ``a`` ((nt*tile)^2) on-device; returns (L, info)."""
     n = a.shape[0]
@@ -247,11 +404,9 @@ def device_cholesky(
     nt = n // tile
     if mk is None:
         mk = make_cholesky_megakernel(nt, interpret, tile=tile)
-    b = build_cholesky_graph(nt)
-    tiles = _to_tiles(a, nt, tile)
-    linv = np.zeros((nt, tile, tile), dtype=np.float32)
+    b = build_cholesky_graph(nt, fused_trsm=fused_trsm)
     t0 = time.perf_counter()
-    _, data, info = mk.run(b, data={"tiles": tiles, "linv": linv})
+    _, data, info = mk.run(b, data=cholesky_buffers(a, nt, tile))
     dt = time.perf_counter() - t0
     L = np.tril(_from_tiles(data["tiles"], nt, tile))
     info = dict(info)

@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from .descriptor import (
     DESC_WORDS,
@@ -64,14 +64,10 @@ from .descriptor import (
 )
 from .megakernel import (
     interpret_mode,
-    C_ALLOC,
-    C_EXECUTED,
     C_HEAD,
-    C_OVERFLOW,
     C_PENDING,
     C_ROUNDS,
     C_TAIL,
-    C_VALLOC,
     Megakernel,
 )
 

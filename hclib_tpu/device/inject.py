@@ -46,14 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .descriptor import DESC_WORDS, NO_TASK, TaskGraphBuilder
-from .megakernel import (
-    C_ALLOC,
-    C_EXECUTED,
-    C_OVERFLOW,
-    C_PENDING,
-    C_VALLOC,
-    Megakernel,
-)
+from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
 
 __all__ = ["StreamingMegakernel", "RING_ROW"]
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import functools
 import types
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +43,6 @@ from .descriptor import (
     F_OUT,
     F_SUCC0,
     F_SUCC1,
-    F_VMASK,
     NO_TASK,
     TaskGraphBuilder,
 )
@@ -449,13 +448,44 @@ class Megakernel:
         interpret: Optional[bool] = None,
         uses_row_values: bool = False,
         vmem_limit_bytes: Optional[int] = None,
+        auto_route: Optional[Dict[str, Any]] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
+        # Auto-routing to the batch-dispatch tier: ``auto_route`` maps a
+        # kernel NAME to the VectorTaskSpec describing that task family
+        # (recursive + reduction-shaped; see device/vector_engine.py).
+        # Tasks with that F_FN are then dispatched as whole subtrees
+        # across the VPU lanes instead of one descriptor at a time - a
+        # user keeps the scalar kernel as the semantic definition and
+        # never pays the scalar tier's ~30 SMEM ops/task (~100 ns) for a
+        # family shape the vector tier handles. The routed entry is a
+        # drop-in at the DAG level: its subtree's reduction lands in the
+        # task's F_OUT value slot and its successors fire on completion,
+        # so irregular DAGs mix routed and scalar tasks freely. The spec
+        # must compute the same out value as the scalar kernel's subtree
+        # would; ``info['executed']`` counts expanded subtree nodes.
+        self.auto_route = dict(auto_route or {})
+        unknown = set(self.auto_route) - {name for name, _ in kernels}
+        if unknown:
+            raise ValueError(
+                f"auto_route names unknown kernels: {sorted(unknown)}"
+            )
+        not_specs = [
+            n for n, s in self.auto_route.items() if not _is_vector_spec(s)
+        ]
+        if not_specs:
+            raise ValueError(
+                f"auto_route values must be VectorTaskSpecs; "
+                f"{sorted(not_specs)} are not"
+            )
         self.kernel_names = [name for name, _ in kernels]
+        routed = [
+            (name, self.auto_route.get(name, fn)) for name, fn in kernels
+        ]
         self.kernel_fns = [
             _wrap_vector_spec(fn, interpret) if _is_vector_spec(fn) else fn
-            for _, fn in kernels
+            for _, fn in routed
         ]
         self.fn_id = {name: i for i, name in enumerate(self.kernel_names)}
         self.data_specs = dict(data_specs or {})

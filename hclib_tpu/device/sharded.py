@@ -23,7 +23,7 @@ termination becomes a collective.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -25,8 +25,6 @@ for the MATCH=2 / MISMATCH=-1 / GAP=1 scheme.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

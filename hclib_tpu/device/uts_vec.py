@@ -53,14 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.uts import (
-    CYCLIC,
-    EXPDEC,
-    FIXED,
-    LINEAR,
-    UTSParams,
-    _branching,
-)
+from ..models.uts import CYCLIC, FIXED, LINEAR, UTSParams, _branching
 from ..ops.sha1 import sha1_block as _sha1_block, sha1_child as _sha1_child
 
 __all__ = [

@@ -42,7 +42,7 @@ pending/executed protocol (megakernel.py wires a VectorTaskSpec into the
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .descriptor import NO_TASK, TaskGraphBuilder
+from .descriptor import TaskGraphBuilder
 from .megakernel import VBLOCK, KernelContext, Megakernel
 
 __all__ = [

@@ -31,7 +31,7 @@ import numpy as np
 from ..runtime.locality import Locale
 from ..runtime.module import Module
 from ..runtime.promise import Future, Promise
-from ..runtime.scheduler import async_, current_runtime, finish
+from ..runtime.scheduler import async_, finish
 from .common import PendingList, PendingOp
 from .world import World, current_world
 

@@ -16,12 +16,12 @@ transfer as a one-sided get.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
 from ..runtime.promise import Future
-from ..runtime.scheduler import async_future, current_runtime
+from ..runtime.scheduler import async_future
 from .am import async_remote
 from .oneside import SymArray, iget, iput
 from .world import World, current_world

@@ -30,80 +30,117 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
     "factor_tile", "tri_inverse", "factor_and_inv", "mm_nt", "dma_copy",
+    "split_bf16", "mm_nt_split", "mm_nt_rsplit",
 ]
 
 
 PANEL = 8  # factor-panel width: one sublane group
 
 
+def _chol8_and_inv(d8):
+    """Serial lower-Cholesky + inverse of an (8, 8) block, fully unrolled
+    with static slices (the only truly sequential math in the tile
+    factorization; everything around it is MXU block algebra). Returns
+    (L8, inv(L8))."""
+    rows8 = jax.lax.broadcasted_iota(jnp.int32, (PANEL, PANEL), 0)
+    cols8 = jax.lax.broadcasted_iota(jnp.int32, (PANEL, PANEL), 1)
+    s8 = d8
+    lcols = []
+    for q in range(PANEL):
+        dq = jax.lax.slice(s8, (q, q), (q + 1, q + 1))
+        colq = jax.lax.slice(s8, (0, q), (PANEL, q + 1))
+        c = jnp.where(rows8[:, :1] >= q, colq * jax.lax.rsqrt(dq), 0.0)
+        lcols.append(c)
+        s8 = jnp.where(
+            (rows8 > q) & (cols8 > q), s8 - c * jnp.transpose(c), s8
+        )
+    l8 = jnp.concatenate(lcols, axis=1)
+    # Forward substitution, unrolled: row i of inv solves L X = I.
+    xrows = []
+    for i in range(PANEL):
+        acc = (cols8[:1] == i).astype(d8.dtype)
+        for j in range(i):
+            lij = jax.lax.slice(l8, (i, j), (i + 1, j + 1))
+            acc = acc - lij * xrows[j]
+        dii = jax.lax.slice(l8, (i, i), (i + 1, i + 1))
+        xrows.append(acc / dii)
+    return l8, jnp.concatenate(xrows, axis=0)
+
+
 def factor_tile(t, ts: int):
     """Panel-blocked lower-Cholesky of a symmetric (ts, ts) tile.
 
-    Exploits symmetry: for the 8-column panel J, the rows s[J, :] ARE the
-    columns s[:, J] transposed, so the whole panel factorization runs on
-    one (8, ts) sublane block with VPU broadcast rank-1 updates (no
-    reductions over the full plane, no dynamic indexing - the panel loop
-    is fully unrolled, all slices static). The trailing matrix then takes
-    ONE rank-8 MXU update per panel (3-pass bf16 split, ~f32 exact),
-    replacing 8 full-plane rank-1 sweep iterations - about an order of
-    magnitude fewer vector ops than the naive masked rank-1 sweep, which
-    dominated the whole Cholesky wall clock at 32 sweeps per n=4096.
+    Exploits symmetry: for the 8-row panel J, the rows s[J, :] ARE the
+    columns s[:, J] transposed, so each panel factorization runs on one
+    (8, ts) sublane block. The serial math is confined to the panel's
+    8x8 diagonal block (_chol8_and_inv, static slices on (8, 8) arrays);
+    the rest of the panel's U rows come from ONE (8, 8) @ (8, ts)
+    triangular-solve matmul (U_panel = inv(L8) @ S_panel), and the
+    trailing matrix takes one rank-8 MXU update per panel (3-pass bf16
+    split, ~f32 exact). This replaces the earlier formulation's 8
+    full-width masked rank-1 micro-iterations per panel - whose chained
+    (8, ts) reductions, not FLOPs, dominated the POTRF tasks' wall clock
+    (measured 138 us/task at tile 512, ~31% of the whole n=8192
+    factorization).
 
-    Builds U = L^T row-by-row (static sublane writes) and transposes once.
+    Builds U = L^T row-by-row and transposes once at the end.
     """
     assert ts % PANEL == 0, ts
     rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
     lanep = jax.lax.broadcasted_iota(jnp.int32, (PANEL, ts), 1)
-    prow = jax.lax.broadcasted_iota(jnp.int32, (PANEL, ts), 0)
     s = t
     pans = []
     npanels = ts // PANEL
     for p in range(npanels):
         j0 = p * PANEL
         pan = jax.lax.slice(s, (j0, 0), (j0 + PANEL, ts))
-
-        # All extraction is mask+reduce on the single (PANEL, ts) block,
-        # so the 8 micro-iterations share one rolled fori_loop body
-        # (unrolling them bloated the kernel ~8x and the register/spill
-        # pressure cost far more than the loop saves).
-        def micro(q, pan):
-            j = j0 + q
-            rowq = jnp.sum(
-                jnp.where(prow == q, pan, 0.0), axis=0, keepdims=True
-            )
-            diag = jnp.sum(jnp.where(lanep[:1] == j, rowq, 0.0))
-            lrow = jnp.where(lanep[:1] >= j, rowq * jax.lax.rsqrt(diag), 0.0)
-            # In-panel rank-1 coefficients = pan's own column j (symmetry),
-            # scaled like lrow.
-            coeff = jnp.sum(
-                jnp.where(lanep == j, pan, 0.0), axis=1, keepdims=True
-            ) * jax.lax.rsqrt(diag)
-            return jnp.where(
-                prow == q, lrow, jnp.where(prow > q, pan - coeff * lrow, pan)
-            )
-
-        pan = jax.lax.fori_loop(0, PANEL, micro, pan)
-        pans.append(pan)
+        d8 = jax.lax.slice(pan, (0, j0), (PANEL, j0 + PANEL))
+        l8, i8 = _chol8_and_inv(d8)
+        # U rows of this panel: inv(L8) @ S[j0:j0+8, :], valid for
+        # columns > the diagonal block; the block itself is exactly L8^T
+        # (spliced in via static concatenate + mask - Mosaic lowers
+        # neither dynamic_update_slice nor pad), columns left of the
+        # panel are zeroed.
+        u = mm_nn(i8, pan)
+        parts = []
+        if j0:
+            parts.append(jnp.zeros((PANEL, j0), t.dtype))
+        parts.append(jnp.transpose(l8))
+        if ts - j0 - PANEL:
+            parts.append(jnp.zeros((PANEL, ts - j0 - PANEL), t.dtype))
+        l8w = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        u = jnp.where((lanep >= j0) & (lanep < j0 + PANEL), l8w, u)
+        u = jnp.where(lanep >= j0, u, 0.0)
+        pans.append(u)
         if p + 1 < npanels:
             # Rank-8 trailing update in one contraction over the panel:
-            # s[m, n] -= sum_q L[m, j0+q] L[n, j0+q] = (pan^T pan)[m, n].
-            upd8 = _mm_tn(pan, pan)
+            # s[m, n] -= sum_q L[m, j0+q] L[n, j0+q] = (u^T u)[m, n].
+            upd8 = _mm_tn(u, u)
             edge = j0 + PANEL - 1
             s = jnp.where((rows > edge) & (cols > edge), s - upd8, s)
     return jnp.transpose(jnp.concatenate(pans, axis=0))
 
 
 def tri_inverse(l, ts: int):
-    """inv(L) for lower-triangular L via Newton-Schulz (exact in log2 ts)."""
+    """inv(L) for lower-triangular L via Newton-Schulz (exact in log2 ts).
+
+    L is constant across the iterations, so its bf16 hi/lo split is
+    hoisted out of the loop (each iteration then splits only the two
+    fresh operands x and Lx)."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (ts, ts), 1)
     dg = jnp.sum(jnp.where(rows == cols, l, 0.0), axis=1, keepdims=True)
     x = jnp.where(rows == cols, 1.0 / dg, 0.0)
     steps = max(1, int(np.ceil(np.log2(ts))))
+    lh, ll = split_bf16(l)
     for _ in range(steps):
-        lx = mm_nn(l, x)
-        x = 2.0 * x - mm_nn(x, lx)
+        xh, xl = split_bf16(x)
+        lx = _d_nn(lh, xh) + _d_nn(lh, xl) + _d_nn(ll, xh)
+        lxh, lxl = split_bf16(lx)
+        x = 2.0 * x - (
+            _d_nn(xh, lxh) + _d_nn(xh, lxl) + _d_nn(xl, lxh)
+        )
     return x
 
 
@@ -141,6 +178,28 @@ def factor_and_inv(t, ts: int, base: int = 128):
     return l, inv
 
 
+def split_bf16(x):
+    """bf16 hi/lo decomposition of an f32 array: x ~= hi + lo with the
+    lo term holding the next ~8 mantissa bits. The shared building block
+    of every 3-pass ~f32 matmul here; task kernels also use it to STORE
+    operands pre-split (hclib_tpu/device/cholesky.py keeps the L tiles in
+    split form so the trailing-update hot loop runs zero VPU splits)."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _d_nt(x, y):
+    return jax.lax.dot_general(
+        x, y, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _d_nn(x, y):
+    return jnp.dot(x, y, preferred_element_type=jnp.float32)
+
+
 def mm_nt(a, b):
     """a @ b^T without materializing the transpose, at ~f32 accuracy via a
     hand-rolled 3-pass bf16 split (hi/lo decomposition of each operand;
@@ -148,24 +207,29 @@ def mm_nt(a, b):
     bf16 pass, ~3 decimal digits worse residuals) and HIGHEST (6 passes,
     2x slower than this with no measurable residual gain on Cholesky:
     7.7e-7 vs 8.8e-7 at n=1024)."""
-    dims = (((1,), (1,)), ((), ()))
-    return _split3(
-        lambda x, y: jax.lax.dot_general(
-            x, y, dimension_numbers=dims,
-            preferred_element_type=jnp.float32,
-        ),
-        a, b,
-    )
+    return _split3(_d_nt, a, b)
+
+
+def mm_nt_split(ah, al, bh, bl):
+    """a @ b^T with BOTH operands already bf16 hi/lo split: the three MXU
+    passes and nothing else - the hot-loop form for kernels that stream
+    pre-split operands (identical rounding to mm_nt on the unsplit
+    values)."""
+    return _d_nt(ah, bh) + _d_nt(ah, bl) + _d_nt(al, bh)
+
+
+def mm_nt_rsplit(a, bh, bl):
+    """a @ b^T with only the RIGHT operand pre-split (a is split here)."""
+    ah, al = split_bf16(a)
+    return _d_nt(ah, bh) + _d_nt(ah, bl) + _d_nt(al, bh)
 
 
 def _split3(d, a, b):
     """The shared 3-pass bf16 hi/lo split: decompose both operands, sum the
     three passes whose products are above f32 noise (lo x lo is not).
     ``d`` supplies the contraction (NT / TN / NN variants below)."""
-    ah = a.astype(jnp.bfloat16)
-    al = (a - ah.astype(jnp.float32)).astype(jnp.bfloat16)
-    bh = b.astype(jnp.bfloat16)
-    bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
+    ah, al = split_bf16(a)
+    bh, bl = split_bf16(b)
     return d(ah, bh) + d(ah, bl) + d(al, bh)
 
 

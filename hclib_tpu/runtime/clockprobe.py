@@ -163,14 +163,19 @@ class WindowedTrials:
             out.append((r, "fast" if fast else "throttled"))
         return out
 
-    def count_fast(self) -> int:
-        """Trials currently labeled fast (non-sheared) - the statistic
-        bench.py's retry loop stops on; one definition, shared with
-        stats(), so the stopping rule can't diverge from the label."""
-        return sum(
-            1 for r, lb in self._labeled()
+    def _fast_values(self):
+        """The fast-window (non-sheared) trial values - the ONE definition
+        both stats() and count_fast build on, so bench.py's retry stopping
+        rule can't diverge from the n_fast the stats label reports."""
+        return [
+            r["value"] for r, lb in self._labeled()
             if lb == "fast" and r["value"] > 0
-        )
+        ]
+
+    def count_fast(self) -> int:
+        """Trials currently labeled fast (the statistic bench.py's retry
+        loop stops on)."""
+        return len(self._fast_values())
 
     def stats(self) -> Dict:
         labeled = self._labeled()
@@ -179,9 +184,7 @@ class WindowedTrials:
         # exclude them from statistics rather than poisoning medians.
         # n_trials still counts every trial run (the jsonl records them
         # all), so a dropped trial is visible as n_trials > n_used.
-        fast_vals = [
-            r["value"] for r, lb in labeled if lb == "fast" and r["value"] > 0
-        ]
+        fast_vals = self._fast_values()
         all_vals = [r["value"] for r, _ in labeled if r["value"] > 0]
         if fast_vals:
             pool, label = fast_vals, "fast"

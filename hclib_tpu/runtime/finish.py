@@ -11,7 +11,7 @@ and reaching zero fires the completion promise / parked-context event
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional
+from typing import Optional
 
 from .promise import Promise
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from .locality import Locale
-from .module import MAY_USE, mem_fns_for
+from .module import mem_fns_for
 from .promise import Future
 from .scheduler import async_future
 

@@ -23,7 +23,7 @@ flag words and waiter queues.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 __all__ = ["Promise", "Future", "PromiseError"]
 

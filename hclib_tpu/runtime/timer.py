@@ -10,7 +10,6 @@ IDLE while parked/waiting, OVH otherwise.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List
 

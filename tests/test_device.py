@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 from jax.experimental import pallas as pl
 
-from hclib_tpu.device.descriptor import NO_TASK, TaskGraphBuilder
+from hclib_tpu.device.descriptor import TaskGraphBuilder
 from hclib_tpu.device.workloads import (
     SUM,
     device_arrayadd,

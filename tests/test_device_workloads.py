@@ -13,11 +13,16 @@ on_tpu = jax.default_backend() == "tpu"
 
 
 def test_cholesky_graph_structure():
+    # fused default: 4 potrf + 3 column TRSM streams + 6 row updates
     b = build_cholesky_graph(4)
-    # 4 potrf + 6 trsm + 6 row-fused trailing updates
-    assert b.num_tasks == 4 + 6 + 6
+    assert b.num_tasks == 4 + 3 + 6
     _, _, ring, counts = b.finalize(capacity=32, succ_capacity=128)
     assert counts[1] == 1  # only potrf(0) initially ready
+    # tile-level TRSM (the reference's granularity): one task per tile
+    b2 = build_cholesky_graph(4, fused_trsm=False)
+    assert b2.num_tasks == 4 + 6 + 6
+    _, _, _, counts2 = b2.finalize(capacity=32, succ_capacity=128)
+    assert counts2[1] == 1
 
 
 def test_device_cholesky_interpret():

@@ -1,10 +1,8 @@
 """forasync (1D/2D/3D, flat + recursive) and locality-graph tests, mirroring
 test/c/forasync*{Ch,Rec} and the locality-graph loader."""
 
-import json
 import threading
 
-import pytest
 
 import hclib_tpu as hc
 from hclib_tpu.runtime.locality import graph_from_dict

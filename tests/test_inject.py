@@ -7,7 +7,6 @@ import threading
 import time
 
 import jax
-import numpy as np
 import pytest
 
 from hclib_tpu.device.descriptor import TaskGraphBuilder

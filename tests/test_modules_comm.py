@@ -7,7 +7,6 @@ modules/openshmem-am/test/, modules/upcxx/test/) against the new API, runnable
 single-host - the multi-node behavior the reference leaves untested.
 """
 
-import threading
 
 import numpy as np
 import pytest
@@ -18,7 +17,6 @@ from hclib_tpu.modules import (
     DistLock,
     OneSidedModule,
     SharedArray,
-    TpuModule,
     async_remote,
     remote_finish,
     set_world,
@@ -26,7 +24,7 @@ from hclib_tpu.modules import (
 )
 from hclib_tpu.modules import comm as C
 from hclib_tpu.modules import oneside as O
-from hclib_tpu.modules.pgas import GlobalRef, async_after
+from hclib_tpu.modules.pgas import async_after
 from hclib_tpu.parallel.mesh import cpu_mesh, mesh_locality_graph
 
 

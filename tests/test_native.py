@@ -259,3 +259,18 @@ def test_typed_cpp_promise_future():
 
     with NativeRuntime(nworkers=2) as r:
         assert r._lib.hcn_typed_promise_demo(r._handle) == 42002
+
+
+def test_lint_clean():
+    """The static-check gate (tools/lint.py - the reference's astyle +
+    cppcheck station): the whole tree must pass, so style violations fail
+    a plain pytest run locally, not just CI."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "lint.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, f"lint violations:\n{r.stdout}"

@@ -8,11 +8,10 @@ import sys
 import time
 
 import numpy as np
-import pytest
 
 import hclib_tpu as hc
 from hclib_tpu.runtime.instrument import END, START, load_dump, register_event_type
-from hclib_tpu.runtime.timer import IDLE, SEARCH, WORK, StateTimer
+from hclib_tpu.runtime.timer import IDLE, WORK, StateTimer
 
 
 def test_event_log_records_and_dumps(tmp_path):
@@ -161,11 +160,16 @@ def test_timeline_renders_dump_and_reports(tmp_path):
     """tools/timeline.py turns a dump + info/stats dicts into readable
     reports (the reference's tools/timeline.py + instrument parser
     station)."""
-    sys.path.insert(0, "tools")
+    import os
+
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    sys.path.insert(0, tools)
     try:
         import timeline
     finally:
-        sys.path.pop(0)
+        sys.path.remove(tools)
 
     rt = hc.Runtime(nworkers=2, instrument=True)
 

@@ -10,7 +10,7 @@ from hclib_tpu.device.descriptor import TaskGraphBuilder
 from hclib_tpu.device.sharded import ShardedMegakernel, round_robin_partition
 from hclib_tpu.device.workloads import FIB, make_fib_megakernel
 from hclib_tpu.parallel import collectives
-from hclib_tpu.parallel.mesh import cpu_mesh, make_mesh, mesh_locality_graph
+from hclib_tpu.parallel.mesh import cpu_mesh, mesh_locality_graph
 
 
 def _mesh(n):

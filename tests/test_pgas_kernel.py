@@ -137,7 +137,6 @@ def test_am_targets_specific_device_mid_run():
         # AM a bump at every device (including self: loopback rides the
         # same inbox path).
         me = ctx.pgas.me
-        import jax.numpy as jnp
 
         for d in range(ndev):
             ctx.pgas.am(d, BUMP, args=[0, 1 + me])

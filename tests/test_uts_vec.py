@@ -18,7 +18,6 @@ from hclib_tpu.models.uts import (
     UTSParams,
     count_seq,
     num_children,
-    root_state,
 )
 
 

@@ -7,13 +7,12 @@ scalar<->vector bridge (a vector task firing scalar successors)."""
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from hclib_tpu.device.descriptor import TaskGraphBuilder
 from hclib_tpu.device.megakernel import Megakernel
 from hclib_tpu.device.vector_engine import fib_spec, make_subtree_runner
-from hclib_tpu.device.workloads import VFIB, device_vfib, make_vfib_megakernel
+from hclib_tpu.device.workloads import device_vfib
 
 
 def fib(n):
@@ -139,3 +138,56 @@ def test_device_nqueens_tpu():
 
     v, info = device_nqueens(10)
     assert v == 724
+
+
+def test_auto_route_irregular_dag_gets_fast_path():
+    """auto_route: a scalar fib kernel's family is routed to the
+    batch-dispatch tier by NAME (VERDICT r4 #3) - an irregular DAG mixing
+    scalar tasks and a routed recursive family runs the family's whole
+    subtree on the VPU lanes (executed counts the expanded tree, not one
+    descriptor) while dependencies and out slots behave exactly as on the
+    scalar tier."""
+    from hclib_tpu.device.workloads import _fib_kernel, _sum_kernel
+
+    def seedv(ctx):
+        ctx.set_value(0, 7)
+
+    def consume(ctx):
+        ctx.set_value(2, ctx.value(1) + ctx.value(0))
+
+    mk = Megakernel(
+        kernels=[
+            ("seed", seedv),
+            ("fib", _fib_kernel),   # scalar definition of the family
+            ("sum", _sum_kernel),
+            ("consume", consume),
+        ],
+        auto_route={"fib": fib_spec(max_n=14, lanes=(1, 8))},
+        capacity=32,
+        num_values=16,
+        succ_capacity=16,
+        interpret=True,
+    )
+    b = TaskGraphBuilder()
+    t0 = b.add(0)                        # scalar: writes value 0
+    t1 = b.add(1, args=[12], deps=[t0], out=1)  # routed family subtree
+    b.add(3, deps=[t1])                  # scalar: reads family's out
+    b.reserve_values(3)
+    ivalues, _, info = mk.run(b)
+    assert ivalues[1] == fib(12)
+    assert ivalues[2] == fib(12) + 7
+    # Proof the fast path ran: executed counts the whole expanded
+    # recursion tree (465 nodes for fib(12)), not 3 descriptors - and no
+    # SUM continuation descriptors were ever spawned.
+    assert info["executed"] == tree_tasks(12) + 2
+    assert info["allocated"] == 3
+    assert info["pending"] == 0
+
+
+def test_auto_route_unknown_name_rejected():
+    with pytest.raises(ValueError, match="auto_route"):
+        Megakernel(
+            kernels=[("a", lambda ctx: None)],
+            auto_route={"b": fib_spec(max_n=4, lanes=(1, 8))},
+            interpret=True,
+        )

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Static-check gate (the reference's astyle + cppcheck station,
+tools/astyle/run.sh + tools/cppcheck/run.sh - README.md:116-129).
+
+No third-party linters exist in this environment, so this is a small
+stdlib checker tuned to the rules the tree actually follows:
+
+Python (ast-based, so no false positives from strings/comments):
+  - parses (syntax gate)
+  - no unused imports (``from __future__ import annotations`` and
+    ``__init__.py`` re-exports are exempt; a ``# noqa`` on the import
+    line opts out)
+  - no bare ``except:``
+  - no mutable default arguments
+  - no tabs, no trailing whitespace, lines <= 96 chars
+
+C++ (native/src):
+  - no tabs, no trailing whitespace, lines <= 100 chars
+
+Usage: ``python tools/lint.py [paths...]`` (default: the whole repo).
+Exit 1 on any violation; the violations print as ``path:line: message``.
+CI runs this before the test suite; tests/test_native.py runs it too so
+a plain ``pytest`` catches violations locally.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+PY_MAX_LINE = 96
+CC_MAX_LINE = 100
+SKIP_DIRS = {
+    ".git", ".jax_cache", "__pycache__", ".pytest_cache", ".hypothesis",
+    "perf-logs", ".claude", "build", "dist", ".eggs",
+}
+
+
+def _files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith((".py", ".cpp", ".cc", ".hpp", ".h")):
+                    yield os.path.join(root, f)
+
+
+def _check_whitespace(
+    path: str, src: str, max_line: int
+) -> List[Tuple[int, str]]:
+    out = []
+    for i, line in enumerate(src.splitlines(), 1):
+        if "\t" in line:
+            out.append((i, "tab character"))
+        if line != line.rstrip():
+            out.append((i, "trailing whitespace"))
+        if len(line) > max_line:
+            out.append((i, f"line too long ({len(line)} > {max_line})"))
+    return out
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # attribute roots resolve through Name nodes already; nothing
+            # extra needed, but keep the branch for clarity
+            pass
+    return used
+
+
+def _check_python(path: str, src: str) -> List[Tuple[int, str]]:
+    out = _check_whitespace(path, src, PY_MAX_LINE)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        out.append((e.lineno or 0, f"syntax error: {e.msg}"))
+        return out
+    lines = src.splitlines()
+
+    def noqa(lineno: int) -> bool:
+        return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append((node.lineno, "bare except:"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    out.append(
+                        (node.lineno,
+                         f"mutable default argument in {node.name}()")
+                    )
+    if os.path.basename(path) != "__init__.py":
+        used = _used_names(tree)
+        # Names referenced only inside docstring doctests or __all__
+        # strings count as used (modules re-export through __all__).
+        exported = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                exported |= {
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    if (
+                        name not in used
+                        and name not in exported
+                        and not noqa(node.lineno)
+                    ):
+                        out.append((node.lineno, f"unused import '{name}'"))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    if (
+                        name not in used
+                        and name not in exported
+                        and not noqa(node.lineno)
+                    ):
+                        out.append((node.lineno, f"unused import '{name}'"))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = argv or [repo]
+    bad = 0
+    for path in _files(paths):
+        with open(path, errors="replace") as f:
+            src = f.read()
+        if path.endswith(".py"):
+            problems = _check_python(path, src)
+        else:
+            problems = _check_whitespace(path, src, CC_MAX_LINE)
+        for lineno, msg in sorted(problems):
+            print(f"{os.path.relpath(path, repo)}:{lineno}: {msg}")
+            bad += 1
+    if bad:
+        print(f"lint: {bad} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,7 @@ Usage:
   python tools/perf_regression.py --quick       # tiny sizes (CI/smoke)
   python tools/perf_regression.py --trials 5 --tolerance 0.2
   python tools/perf_regression.py --device      # + TPU device suite
+  python tools/perf_regression.py --multichip   # 8-device mesh at scale
 Exit code 1 if any app regressed beyond tolerance vs the previous log.
 
 ``--device`` adds the TPU engines (megakernel fib scalar + batch tiers,
@@ -23,6 +24,16 @@ Cholesky GFLOP/s, Smith-Waterman GCUPS, UTS nodes/s) - the numbers of
 record bench.py reports, guarded here so no TPU claim floats free of a
 harness. Device entries record a RATE (higher is better); host entries
 record wall time.
+
+``--multichip`` runs the benchmark-scale multi-device acceptance
+workloads (hclib_tpu/device/stress.py) on a virtual 8-device CPU mesh:
+a >=100k-task maximally-skewed fib forest through the sharded steal
+runner, and the unified resident kernel (dependency-bearing migration +
+remote atomics) under Mosaic-interpreter-scale load. Each run's exact
+totals are asserted inside the workload; wall time and tasks/s are
+recorded like any other app, and the per-device load reports are written
+next to the log as ``<ts>.<name>.json`` (render them with
+``python tools/timeline.py --device <file>``).
 """
 
 from __future__ import annotations
@@ -98,7 +109,14 @@ def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
         reverse=True,
     ):
         with open(os.path.join(log_dir, name)) as f:
-            log = json.load(f)
+            try:
+                log = json.load(f)
+            except ValueError:
+                continue
+        # Skip non-harness JSONs sharing the directory (per-workload
+        # info side files, clock logs): only real logs carry "apps".
+        if not isinstance(log, dict) or "apps" not in log:
+            continue
         if bool(log.get("quick")) == quick:
             return log.get("apps", {})
     return {}
@@ -109,6 +127,8 @@ def main(argv=None) -> int:
     ap.add_argument("--quick", action="store_true", help="tiny inputs (smoke)")
     ap.add_argument("--device", action="store_true",
                     help="also run the TPU device suite (rates)")
+    ap.add_argument("--multichip", action="store_true",
+                    help="also run the 8-device mesh acceptance workloads")
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--tolerance", type=float, default=0.25,
                     help="allowed fractional slowdown vs previous log")
@@ -116,6 +136,21 @@ def main(argv=None) -> int:
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
     args = ap.parse_args(argv)
+
+    if args.multichip:
+        # Must land before jax initializes: the mesh workloads need the
+        # CPU backend with 8 virtual devices.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+        )
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
     wanted = {a for a in args.apps.split(",") if a}
     prev = _latest_log(args.log_dir, args.quick)
@@ -173,8 +208,62 @@ def main(argv=None) -> int:
                         line += "  REGRESSED"
                 print(line, flush=True)
 
+    ts = int(time.time())
+    if args.multichip:
+        from hclib_tpu.device import stress
+
+        mc = [
+            ("mc-forest-steal", lambda: stress.forest_steal(
+                ndev=8,
+                roots=24 if args.quick else 160,
+                n=9 if args.quick else 12,
+                capacity=1024 if args.quick else 4096,
+            )),
+            ("mc-unified-resident", lambda: stress.unified_load(
+                ndev=8,
+                n=8 if args.quick else 11,
+                fadds=8 if args.quick else 32,
+                capacity=256 if args.quick else 1024,
+            )),
+        ]
+        os.makedirs(args.log_dir, exist_ok=True)
+        for name, fn in mc:
+            if wanted and name not in wanted:
+                continue
+            try:
+                info = fn()  # exact totals asserted inside
+            except Exception as e:
+                print(f"{name:20s} FAILED: {e}", file=sys.stderr)
+                failures.append(f"{name}: failed ({e})")
+                continue
+            rate = info["tasks_per_sec"]
+            results[name] = {
+                "rate": rate, "unit": "tasks/s",
+                "tasks": info["tasks"], "seconds": info["seconds"],
+                "devices_used": info["devices_used"],
+                "imbalance": round(info["imbalance"], 3),
+            }
+            with open(os.path.join(
+                    args.log_dir, f"{ts}.{name}.json"), "w") as f:
+                json.dump(info, f, indent=1)
+            line = (
+                f"{name:20s} {info['tasks']:>8,} tasks in "
+                f"{info['seconds']:7.2f} s  ({rate:12,.0f} tasks/s, "
+                f"{info['devices_used']} devices, imbalance "
+                f"{info['imbalance']:.2f}x)"
+            )
+            if name in prev and "rate" in prev[name]:
+                ratio = rate / prev[name]["rate"]
+                line += f"  vs prev {ratio:5.2f}x"
+                if ratio < 1 - args.tolerance:
+                    failures.append(
+                        f"{name}: {1/ratio:.2f}x slower than previous log"
+                    )
+                    line += "  REGRESSED"
+            print(line, flush=True)
+
     os.makedirs(args.log_dir, exist_ok=True)
-    out_path = os.path.join(args.log_dir, f"{int(time.time())}.json")
+    out_path = os.path.join(args.log_dir, f"{ts}.json")
     with open(out_path, "w") as f:
         json.dump({"quick": args.quick, "apps": results}, f, indent=1)
     print(f"log written: {out_path}")

@@ -42,7 +42,6 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import numpy as np
 
 from hclib_tpu.device.descriptor import TaskGraphBuilder
 from hclib_tpu.device.megakernel import Megakernel, VBLOCK
