@@ -325,6 +325,7 @@ def make_cholesky_megakernel(
     interpret: Optional[bool] = None,
     tile: int = T,
     factor_base: Optional[int] = None,
+    fused_only: bool = False,
 ) -> Megakernel:
     if factor_base is None:
         # 256 measured ~25% faster than 128 for 512 tiles (fewer
@@ -334,8 +335,14 @@ def make_cholesky_megakernel(
     linvsp_spec = jax.ShapeDtypeStruct((nt, 2, tile, tile), jnp.bfloat16)
     lsp_spec = jax.ShapeDtypeStruct((nt, nt, 2, tile, tile), jnp.bfloat16)
     # POTRF + TRSM tile tasks (or column streams) + one row-update task
-    # per (row, step): capacity covers the larger (unfused) form.
-    ntasks = nt + 2 * (nt * (nt - 1) // 2)
+    # per (row, step): capacity covers the larger (unfused) form unless
+    # ``fused_only`` - SMEM windows pad task-table scalars to ~32 B/word,
+    # so large-nt kernels (nt >= 32) only fit the 1 MB SMEM budget with
+    # the fused graph's smaller table.
+    if fused_only:
+        ntasks = nt + (nt - 1) + nt * (nt - 1) // 2
+    else:
+        ntasks = nt + 2 * (nt * (nt - 1) // 2)
     capacity = max(64, ntasks)
     return Megakernel(
         kernels=[
@@ -361,7 +368,10 @@ def make_cholesky_megakernel(
         },
         capacity=capacity,
         num_values=8,
-        succ_capacity=max(64, 4 * ntasks + nt * nt * nt // 2),
+        succ_capacity=max(
+            64,
+            4 * ntasks + (nt * nt if fused_only else nt * nt * nt // 2),
+        ),
         interpret=interpret,
         # 8 f32-equivalent tile buffers + compiler stack temporaries
         # (factor_and_inv block values, bf16 split operands): past the

@@ -108,7 +108,7 @@ def forest_steal(
 
 def unified_load(
     ndev: int = 8,
-    n: int = 11,
+    n: int = 10,
     fadds: int = 32,
     capacity: int = 1024,
     quantum: int = 32,

@@ -56,6 +56,10 @@ def _chol8_and_inv(d8):
         )
     l8 = jnp.concatenate(lcols, axis=1)
     # Forward substitution, unrolled: row i of inv solves L X = I.
+    # (A Newton-Schulz inverse on the (8, 8) block was measured: it
+    # shortens the serial chain and buys ~2.6% end-to-end, but costs
+    # accuracy the n=8192 residual gate cannot spare - 9.84e-7 vs this
+    # form's 9.26e-7 against the 1e-6 bound.)
     xrows = []
     for i in range(PANEL):
         acc = (cols8[:1] == i).astype(d8.dtype)

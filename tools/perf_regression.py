@@ -221,7 +221,7 @@ def main(argv=None) -> int:
             )),
             ("mc-unified-resident", lambda: stress.unified_load(
                 ndev=8,
-                n=8 if args.quick else 11,
+                n=8 if args.quick else 10,
                 fadds=8 if args.quick else 32,
                 capacity=256 if args.quick else 1024,
             )),
