@@ -91,6 +91,20 @@ def windowed(
     return s
 
 
+# Reps gaps under this are transfer/clock jitter, not measurement: any
+# slope computed from them is nonsense (observed: 7e12 tasks/s from a
+# near-zero denominator). The -1.0 sentinel is what WindowedTrials
+# excludes from statistics - ONE policy for every slope bench here.
+_SHEAR_GAP_S = 5e-3
+
+
+def _slope_or_sheared(gap_seconds: float, units: float) -> float:
+    """units/sec over a reps gap, or the sheared-trial sentinel."""
+    if gap_seconds < _SHEAR_GAP_S:
+        return -1.0
+    return units / gap_seconds
+
+
 def _slope_harness(mk, builder, expect_value, fuel, reps_pair, label):
     """Shared steady-state harness: re-run the staged graph R times inside
     one kernel launch for two R values; per-task cost is the slope between
@@ -132,13 +146,7 @@ def _slope_harness(mk, builder, expect_value, fuel, reps_pair, label):
             dt = time.perf_counter() - t0
             points.append((dt, n))
         (d1, n1), (d2, n2) = points
-        if d2 - d1 < 5e-3:
-            # The reps gap vanished inside transfer/clock jitter: any
-            # slope from it is nonsense (observed: 7e12 tasks/s from a
-            # near-zero denominator). Mark the trial sheared (negative
-            # values are excluded from windowed stats but still counted).
-            return -1.0
-        return (n2 - n1) / (d2 - d1)
+        return _slope_or_sheared(d2 - d1, n2 - n1)
 
     return one_trial
 
@@ -150,10 +158,8 @@ def _graph_slope_trial(jits, fresh, reps_pair, units_per_graph):
     fib benches use _slope_harness, which also owns graph STAGING): run
     the compiled reps-variants on fresh device buffers, sync via a D2H
     read of the counts word (the only reliable sync through the tunnel),
-    and return units_per_graph over the per-graph slope. Gap under 5 ms
-    is transfer/clock shear, not measurement (observed: absurd rates from
-    a near-zero denominator) - the trial returns -1.0, which windowed
-    stats exclude."""
+    and return units_per_graph over the per-graph slope, with the shared
+    shear guard (_slope_or_sheared)."""
     from hclib_tpu.device.megakernel import C_EXECUTED
 
     r1, r2 = reps_pair
@@ -167,10 +173,9 @@ def _graph_slope_trial(jits, fresh, reps_pair, units_per_graph):
             outs = jits[r](*args)
             _ = int(np.asarray(outs[2])[C_EXECUTED])
             t[r] = time.perf_counter() - t0
-        gap = t[r2] - t[r1]
-        if gap < 5e-3:
-            return -1.0
-        return units_per_graph * (r2 - r1) / gap
+        return _slope_or_sheared(
+            t[r2] - t[r1], units_per_graph * (r2 - r1)
+        )
 
     return one_trial
 
@@ -334,39 +339,26 @@ def bench_device_sw_wave(trials: int = 3, spread_seconds: float = 8.0):
 
     if jax.default_backend() != "tpu":
         return None
-    from hclib_tpu.device.descriptor import TaskGraphBuilder
     from hclib_tpu.device.smithwaterman import (
         T as SWT,
-        WAVE_FN,
-        WAVE_R,
+        build_sw_wave_graph,
         make_sw_wave_megakernel,
+        sw_wave_buffers,
     )
     from hclib_tpu.models.smithwaterman import random_seq
 
     n = m = 8192
     nt = n // SWT
     mk = make_sw_wave_megakernel(nt, nt, interpret=False, with_h=False)
-    builder = TaskGraphBuilder()
-    prev: list = []
-    for w in range(2 * nt - 1):
-        lo, hi = max(0, w - (nt - 1)), min(nt - 1, w)
-        this = [
-            builder.add(WAVE_FN, args=[w, base, min(WAVE_R, hi + 1 - base)],
-                        deps=prev)
-            for base in range(lo, hi + 1, WAVE_R)
-        ]
-        prev = this
+    builder = build_sw_wave_graph(nt, nt)
     a, b_ = random_seq(n, 5), random_seq(m, 6)
-    i32 = np.int32
     tasks, succ, ring, counts = builder.finalize(
         capacity=mk.capacity, succ_capacity=mk.succ_capacity
     )
+    bufs = sw_wave_buffers(a, b_)
     host = (
-        tasks, succ, ring, counts, np.zeros(mk.num_values, i32),
-        np.asarray(a, i32).reshape(nt, 1, SWT),
-        np.asarray(b_, i32).reshape(nt, 1, SWT),
-        np.zeros((nt, nt, 1, SWT), i32),
-        np.zeros((nt, nt, 1, SWT), i32),
+        tasks, succ, ring, counts, np.zeros(mk.num_values, np.int32),
+        bufs["aseq"], bufs["bseq"], bufs["bot"], bufs["right"],
     )
 
     def fresh():

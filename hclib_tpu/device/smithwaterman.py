@@ -34,7 +34,10 @@ from ..models.smithwaterman import GAP, MATCH, MISMATCH
 from .descriptor import TaskGraphBuilder
 from .megakernel import KernelContext, Megakernel
 
-__all__ = ["device_sw", "make_sw_megakernel", "device_sw_wave", "make_sw_wave_megakernel"]
+__all__ = [
+    "device_sw", "make_sw_megakernel", "device_sw_wave",
+    "make_sw_wave_megakernel", "build_sw_wave_graph", "sw_wave_buffers",
+]
 
 T = 128
 TILE_FN = 0
@@ -338,6 +341,39 @@ def make_sw_wave_megakernel(
     )
 
 
+def build_sw_wave_graph(nt_i: int, nt_j: int) -> TaskGraphBuilder:
+    """Wave-chunk task DAG: up to WAVE_R tiles of one anti-diagonal per
+    task, consecutive anti-diagonals chained by dependencies (shared by
+    device_sw_wave and the bench so both stage the SAME graph)."""
+    builder = TaskGraphBuilder()
+    prev_wave: list = []
+    for w in range(nt_i + nt_j - 1):
+        lo = max(0, w - (nt_j - 1))
+        hi = min(nt_i - 1, w)
+        this_wave = []
+        for base in range(lo, hi + 1, WAVE_R):
+            cnt = min(WAVE_R, hi + 1 - base)
+            this_wave.append(
+                builder.add(WAVE_FN, args=[w, base, cnt], deps=prev_wave)
+            )
+        prev_wave = this_wave
+    return builder
+
+
+def sw_wave_buffers(a: np.ndarray, b: np.ndarray) -> dict:
+    """Host data buffers for the wave engine (without the optional H
+    matrix): sequences in row-tile layout + the boundary channels."""
+    n, m = len(a), len(b)
+    nt_i, nt_j = n // T, m // T
+    i32 = np.int32
+    return {
+        "aseq": np.asarray(a, i32).reshape(nt_i, 1, T),
+        "bseq": np.asarray(b, i32).reshape(nt_j, 1, T),
+        "bot": np.zeros((nt_i, nt_j, 1, T), i32),
+        "right": np.zeros((nt_i, nt_j, 1, T), i32),
+    }
+
+
 def device_sw_wave(
     a: np.ndarray,
     b: np.ndarray,
@@ -355,25 +391,9 @@ def device_sw_wave(
     nt_i, nt_j = n // T, m // T
     if mk is None:
         mk = make_sw_wave_megakernel(nt_i, nt_j, interpret, with_h=with_h)
-    builder = TaskGraphBuilder()
-    prev_wave: list = []
-    for w in range(nt_i + nt_j - 1):
-        lo = max(0, w - (nt_j - 1))
-        hi = min(nt_i - 1, w)
-        this_wave = []
-        for base in range(lo, hi + 1, WAVE_R):
-            cnt = min(WAVE_R, hi + 1 - base)
-            this_wave.append(
-                builder.add(WAVE_FN, args=[w, base, cnt], deps=prev_wave)
-            )
-        prev_wave = this_wave
+    builder = build_sw_wave_graph(nt_i, nt_j)
     i32 = np.int32
-    data = {
-        "aseq": np.asarray(a, i32).reshape(nt_i, 1, T),
-        "bseq": np.asarray(b, i32).reshape(nt_j, 1, T),
-        "bot": np.zeros((nt_i, nt_j, 1, T), i32),
-        "right": np.zeros((nt_i, nt_j, 1, T), i32),
-    }
+    data = sw_wave_buffers(a, b)
     if "htiles" in mk.data_specs:
         data["htiles"] = np.zeros((nt_i, nt_j, T, T), i32)
     t0 = time.perf_counter()

@@ -287,6 +287,7 @@ def uts_pallas(
     vmem_limit_bytes: int = 100 * 2**20,
     stack_pad: Optional[int] = None,
     timing_reps: Optional[int] = None,
+    table_cols: Optional[int] = None,
 ) -> dict:
     """uts_vec with the whole traversal fused into one Pallas kernel; same
     exact counts, same host seeding, same result dict.
@@ -362,8 +363,13 @@ def uts_pallas(
         # cols - 1 and needs that column to stay -1 padding, so the row
         # quantization must not round past it (restores depth caps up to
         # cols - 2 = 126 that the plain 16-row round-up would reject).
+        # table_cols (like stack_pad) opts into a shared width class so
+        # different trees reuse one compiled engine.
         tabnp = inrow_threshold_table(
-            padded_threshold_table(params, cap, max_rows=cols - 1), cols
+            padded_threshold_table(
+                params, cap, max_rows=cols - 1, min_cols=table_cols
+            ),
+            cols,
         )
     if stack_pad is not None:
         # Opt-in compile sharing across tree shapes (taller stacks cost

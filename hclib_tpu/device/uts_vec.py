@@ -464,7 +464,10 @@ def _timed_best(run, reps: int):
 
 
 def padded_threshold_table(
-    params: UTSParams, cap: int, max_rows: Optional[int] = None
+    params: UTSParams,
+    cap: int,
+    max_rows: Optional[int] = None,
+    min_cols: Optional[int] = None,
 ) -> np.ndarray:
     """child_threshold_table padded to a COMMON shape: rows (depths) up to
     a multiple of 16, columns (child ordinals) to the next multiple of 16
@@ -479,12 +482,19 @@ def padded_threshold_table(
     ``max_rows`` (uts_pallas passes its lane-column limit) caps the row
     round-up when the quantized height would cross a consumer's bound but
     the real cap still fits - so a cap of, say, 120 rides in 121 rows
-    instead of failing at the quantized 128."""
+    instead of failing at the quantized 128. ``min_cols`` widens the
+    ordinal padding (capped at MAX_CHILDREN) so callers can opt INTO a
+    shared width class across trees whose natural widths differ - the
+    test suite pads every depth-varying tree to one (rows, cols) class
+    and so pays ONE engine trace instead of one per tree; perf callers
+    omit it and keep the tightest class."""
     t = child_threshold_table(params, cap)
     rows = -(-(cap + 1) // 16) * 16
     if max_rows is not None and rows > max_rows >= cap + 1:
         rows = max_rows
     cols = min(MAX_CHILDREN, -(-t.shape[1] // 16) * 16)
+    if min_cols is not None:
+        cols = min(MAX_CHILDREN, max(cols, int(min_cols)))
     out = np.full((rows, max(cols, t.shape[1])), -1, np.int32)
     out[: t.shape[0], : t.shape[1]] = t
     return out
@@ -626,6 +636,7 @@ def uts_vec(
     depth_bound: Optional[int] = None,
     stack_pad: Optional[int] = None,
     timing_reps: Optional[int] = None,
+    table_cols: Optional[int] = None,
 ) -> dict:
     """Run UTS with the vectorized DFS engine; returns counts + timing info.
 
@@ -688,7 +699,8 @@ def uts_vec(
         # Runtime-table path: values are an input, so all trees with the
         # same padded table shape + stack height share one compile.
         thr = None
-        tabnp = padded_threshold_table(params, cap)
+        # table_cols (like stack_pad) opts into a shared width class.
+        tabnp = padded_threshold_table(params, cap, min_cols=table_cols)
         # Pushed frames hold non-leaf nodes only; for shapes whose cap is
         # exact the deepest non-leaf sits at cap-2, so the tight height is
         # cap-1-d0 (every extra level costs select/store work per step).

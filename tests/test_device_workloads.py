@@ -34,10 +34,15 @@ def test_device_cholesky_interpret():
 
 
 def test_device_cholesky_interpret_blocked_potrf():
-    """tile=256 > the 128 factor base exercises the recursive 2x2 blocked
-    factor_and_inv path (panel/update/inverse as block algebra)."""
+    """tile=256 with factor_base=128 exercises the recursive 2x2 blocked
+    factor_and_inv path (panel/update/inverse as block algebra) - the
+    default base of min(tile, 256) would factor a 256 tile directly."""
+    from hclib_tpu.device.cholesky import make_cholesky_megakernel
+
     a = make_spd(512).astype(np.float32)
-    L, info = device_cholesky(a, interpret=True, tile=256)
+    mk = make_cholesky_megakernel(2, interpret=True, tile=256,
+                                  factor_base=128)
+    L, info = device_cholesky(a, interpret=True, tile=256, mk=mk)
     rel = np.max(np.abs(L @ L.T - a)) / np.max(np.abs(a))
     assert rel < 1e-5
     assert info["executed"] == 4

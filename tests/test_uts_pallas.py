@@ -1,5 +1,10 @@
 """Fused-Pallas UTS engine (device/uts_pallas.py): exactness vs the
-sequential spec and vs the XLA engine, in interpret mode on CPU."""
+sequential spec and vs the XLA engine, in interpret mode on CPU.
+
+Every depth-varying test passes stack_pad=10 + table_cols=100 so all of
+them (LINEAR / CYCLIC / EXPDEC) land on ONE padded (16, 100)-table,
+stack-10 engine and the suite pays a single ~1 min trace instead of one
+per tree - the compile-sharing knobs exist precisely for this."""
 
 import os
 
@@ -75,7 +80,7 @@ def test_uts_pallas_linear_exact():
 
     p = UTSParams(shape=LINEAR, gen_mx=6, b0=4.0, root_seed=34)
     r = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True,
-                   stack_pad=8)
+                   stack_pad=10, table_cols=100)
     assert r["roots"] > 0  # the fused kernel actually ran
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -92,7 +97,7 @@ def test_uts_pallas_cyclic_exact():
     # tree before the kernel ever runs (roots == 0 would make this a
     # host-only test).
     r = uts_pallas(p, target_roots=8, device=_cpu(), interpret=True,
-                   stack_pad=8)
+                   stack_pad=10, table_cols=100)
     assert r["roots"] > 0
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
@@ -107,7 +112,7 @@ def test_uts_pallas_expdec_exact():
     # counts.
     r = uts_pallas(
         p, target_roots=16, device=_cpu(), interpret=True, depth_bound=9,
-        stack_pad=8,
+        stack_pad=10, table_cols=100,
     )
     assert r["roots"] > 0
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
@@ -121,7 +126,7 @@ def test_uts_pallas_depth_varying_matches_xla_engine():
     p = UTSParams(shape=LINEAR, gen_mx=6, b0=4.0, root_seed=34)
     rv = uts_vec(p, target_roots=64, device=_cpu(), stack_pad=8)
     rp = uts_pallas(p, target_roots=64, device=_cpu(), interpret=True,
-                    stack_pad=8)
+                    stack_pad=10, table_cols=100)
     assert rp["roots"] > 0  # the fused kernel actually traversed subtrees
     assert (rv["nodes"], rv["leaves"], rv["max_depth"], rv["steps"]) == (
         rp["nodes"], rp["leaves"], rp["max_depth"], rp["steps"]

@@ -94,7 +94,10 @@ def test_uts_vec_depth_varying_shapes_exact(shape, gen_mx, b0, seed):
     # A tight EXPDEC bound keeps the per-lane stack (and with it compile
     # time) small; the engine raises if the tree ever reaches it.
     kw = {"depth_bound": 9} if shape == EXPDEC else {}
-    r = uts_vec(p, target_roots=128, device=_cpu(), stack_pad=8, **kw)
+    # stack_pad + table_cols land every parameterization on ONE
+    # padded-shape engine (one XLA compile for the whole matrix).
+    r = uts_vec(p, target_roots=128, device=_cpu(), stack_pad=10,
+                table_cols=100, **kw)
     assert (r["nodes"], r["leaves"], r["max_depth"]) == count_seq(p)
 
 
