@@ -110,5 +110,5 @@ def test_uts_vec_expdec_depth_bound_raises():
     # the deep traversal - a large target consumes this 217-node tree on
     # the host and nothing ever reaches the bound.
     with pytest.raises(RuntimeError, match="depth bound"):
-        uts_vec(p, target_roots=8, device=_cpu(), stack_pad=8,
-                depth_bound=max(2, true_maxd - 2))
+        uts_vec(p, target_roots=8, device=_cpu(), stack_pad=10,
+                table_cols=100, depth_bound=max(2, true_maxd - 2))
