@@ -328,9 +328,12 @@ def make_cholesky_megakernel(
     fused_only: bool = False,
 ) -> Megakernel:
     if factor_base is None:
-        # 256 measured ~25% faster than 128 for 512 tiles (fewer
-        # recursion levels; the serial 8x8 chain count is identical).
-        factor_base = min(tile, 256)
+        # In-kernel A/B at n=8192 (fast windows, interleaved): base 128
+        # = 7.36 ms vs base 256 = 7.92-8.02 ms, every trial - the deeper
+        # recursion's extra block algebra is cheaper than factor_tile +
+        # Newton-Schulz on 256-wide planes. (A plain-jit microbench had
+        # suggested the opposite; it was clock-window noise.)
+        factor_base = min(tile, 128)
     tile_spec = jax.ShapeDtypeStruct((nt, nt, tile, tile), jnp.float32)
     linvsp_spec = jax.ShapeDtypeStruct((nt, 2, tile, tile), jnp.bfloat16)
     lsp_spec = jax.ShapeDtypeStruct((nt, nt, 2, tile, tile), jnp.bfloat16)
