@@ -52,6 +52,7 @@ from .runtime import (  # noqa: F401
     num_workers,
     register_dist_func,
     register_module,
+    run_on_main,
     start_finish,
     unregister_all_modules,
     yield_,

@@ -31,6 +31,7 @@ from .scheduler import (
     launch,
     num_workers,
     start_finish,
+    run_on_main,
     yield_,
 )
 from .task import Task

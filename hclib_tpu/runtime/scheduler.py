@@ -243,6 +243,14 @@ class Runtime:
         self._watchdog_s = watchdog_s
         self._watchdog_thread: Optional[threading.Thread] = None
         self.stall_reports = 0
+        # Main-thread-affine execution (hclib_run_on_main_ctx,
+        # src/hclib-runtime.c:1340-1358): workers queue requests; the
+        # launch thread services them in its help loops and while joining
+        # workers at finalize (the reference's :1420-1423 loop).
+        self._main_ident: Optional[int] = None
+        self._main_ctx_q: List[tuple] = []
+        self._main_ctx_lock = threading.Lock()
+        self._main_park_evt: Optional[threading.Event] = None
 
     # ------------------------------------------------------------------ spawn
 
@@ -501,7 +509,21 @@ class Runtime:
             _tls.identity = None
             if self._idmgr.release(wid):
                 self._spawn_thread()
+        is_main = threading.get_ident() == self._main_ident
+        if is_main:
+            # Publish the park event so run_on_main can wake this thread;
+            # under the SAME lock, self-wake if requests raced in before
+            # the publication (no missed wakeup, no deadlock). Spurious
+            # wakes are safe: every park caller loops on its condition.
+            with self._main_ctx_lock:
+                self._main_park_evt = armed
+                if self._main_ctx_q:
+                    armed.set()
         armed.wait()
+        if is_main:
+            with self._main_ctx_lock:
+                self._main_park_evt = None
+            self._service_main_ctx()
         _tls.identity = self._idmgr.acquire(priority=True)
         if st is not None and _tls.identity is not None:
             from .timer import OVH
@@ -517,11 +539,56 @@ class Runtime:
         except BaseException as e:
             self._record_error(e)
 
+    def _service_main_ctx(self) -> None:
+        """Run queued main-thread-affine requests (no-op off-main)."""
+        if threading.get_ident() != self._main_ident:
+            return
+        while True:
+            with self._main_ctx_lock:
+                if not self._main_ctx_q:
+                    return
+                fn, args, box, prom = self._main_ctx_q.pop(0)
+            try:
+                box["value"] = fn(*args)
+            except BaseException as e:  # caller re-raises
+                box["error"] = e
+            prom.put(None)
+
+    def run_on_main(self, fn: Callable[..., Any], *args: Any) -> Any:
+        """Execute ``fn`` on the launch (main) thread and return its result
+        (hclib_run_on_main_ctx, src/hclib-runtime.c:1340-1358) - for
+        main-thread-affine operations (GUI toolkits, signal setup, some
+        foreign runtimes). From the main thread it runs inline; from a
+        worker it blocks (helping with other tasks meanwhile) until the
+        main thread services the request - in its help loops while the
+        program runs, or in the finalize join loop (the reference
+        services requests there too, :1420-1423). ``fn``'s exception
+        re-raises in the caller."""
+        if threading.get_ident() == self._main_ident:
+            return fn(*args)
+        box: dict = {}
+        prom = Promise()
+        with self._main_ctx_lock:
+            # _main_ident is cleared under this lock at finalize (after
+            # failing queued requests), so checking it HERE means a late
+            # caller raises instead of enqueueing into a dead launch.
+            if self._main_ident is None:
+                raise RuntimeError("run_on_main requires an active launch")
+            self._main_ctx_q.append((fn, args, box, prom))
+            evt = self._main_park_evt
+        if evt is not None:
+            evt.set()  # wake a parked main thread (loops re-check)
+        self.wait_on(prom)
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
     def help_finish(self, fin: Finish) -> None:
         """Help-first drain of a finish scope (help_finish:
         src/hclib-runtime.c:1067-1119)."""
         wid = _tls.identity
         while not fin.quiesced():
+            self._service_main_ctx()
             task = self._find_task(wid) if wid is not None else None
             if task is None:
                 self._park(lambda ev, f=fin: f.arm_event() if not f.quiesced() else None)
@@ -540,6 +607,7 @@ class Runtime:
         help with non-blocking tasks, else park on the promise."""
         wid = _tls.identity
         while not promise.satisfied():
+            self._service_main_ctx()
             task = self._find_task(wid) if wid is not None else None
             if task is None:
                 self._park(lambda ev, p=promise: ev if p._register_ctx(ev) else None)
@@ -642,6 +710,7 @@ class Runtime:
         for _ in range(self.nworkers):
             self._spawn_thread()
         _tls.identity = self._idmgr.acquire(priority=True)
+        self._main_ident = threading.get_ident()
         call_post_init(self)
         self.root_finish = Finish()
         prev_finish = _tls.current_finish
@@ -665,7 +734,27 @@ class Runtime:
             with self._work_cv:
                 self._work_cv.notify_all()
             for t in self._threads:
-                t.join(timeout=5.0)
+                # Service main-ctx requests while joining: an escaping
+                # task may still be blocked in run_on_main (the reference
+                # services these in its finalize loop,
+                # src/hclib-runtime.c:1420-1423).
+                deadline = time.monotonic() + 5.0
+                while t.is_alive() and time.monotonic() < deadline:
+                    self._service_main_ctx()
+                    t.join(timeout=0.05)
+            with self._main_ctx_lock:
+                # Close the launch under the queue's lock, failing any
+                # request that raced past the join loop - late callers
+                # get an error instead of hanging on a promise nobody
+                # will ever service (and no stale fn can leak into a
+                # later launch's queue).
+                self._main_ident = None
+                stranded, self._main_ctx_q = self._main_ctx_q, []
+            for _, _, box, prom in stranded:
+                box["error"] = RuntimeError(
+                    "run_on_main request outlived the launch"
+                )
+                prom.put(None)
             call_finalize(self)
             if _tls.identity is not None:
                 _tls.identity = None
@@ -859,6 +948,11 @@ class finish:
 
 def yield_(at: Optional[Locale] = None) -> bool:
     return current_runtime().yield_(at)
+
+
+def run_on_main(fn: Callable[..., Any], *args: Any) -> Any:
+    """Execute ``fn`` on the launch thread (hclib_run_on_main_ctx)."""
+    return current_runtime().run_on_main(fn, *args)
 
 
 def current_worker() -> int:

@@ -296,3 +296,67 @@ def test_recursive_spawn_tree():
 
     hc.launch(main, nworkers=4)
     assert box[0] == 1024
+
+
+def test_run_on_main_executes_on_launch_thread():
+    """hclib_run_on_main_ctx parity (src/hclib-runtime.c:1340-1358):
+    workers hand main-thread-affine functions to the launch thread and
+    block for the result; from the main thread it runs inline; errors
+    re-raise in the caller."""
+    import threading
+
+    main_ident = threading.get_ident()
+    seen = []
+
+    def body():
+        # inline from the main thread
+        assert hc.run_on_main(threading.get_ident) == main_ident
+
+        def from_worker():
+            seen.append(hc.run_on_main(threading.get_ident))
+            seen.append(hc.run_on_main(lambda a, b: a + b, 20, 22))
+
+        with hc.finish():
+            hc.async_(from_worker)
+
+        def boom():
+            def raiser():
+                raise ValueError("main-ctx boom")
+
+            try:
+                hc.run_on_main(raiser)
+            except ValueError as e:
+                seen.append(str(e))
+
+        with hc.finish():
+            hc.async_(boom)
+
+    hc.launch(body, nworkers=2)
+    assert seen[0] == main_ident
+    assert seen[1] == 42
+    assert seen[2] == "main-ctx boom"
+
+
+def test_run_on_main_from_escaping_task_at_finalize():
+    """An escaping task still blocked in run_on_main when the root finish
+    drains is serviced by the finalize join loop (the reference's
+    src/hclib-runtime.c:1420-1423)."""
+    import threading
+    import time as _time
+
+    main_ident = threading.get_ident()
+    got = []
+
+    def body():
+        started = threading.Event()
+
+        def late():
+            started.set()
+            _time.sleep(0.15)  # root finish drains before this fires
+            got.append(hc.current_runtime().run_on_main(threading.get_ident))
+
+        hc.current_runtime().spawn(late, escaping=True)
+        started.wait(5.0)  # a worker is executing it when the root drains
+
+    hc.launch(body, nworkers=2)
+    assert got == [main_ident]
