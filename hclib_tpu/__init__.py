@@ -18,8 +18,12 @@ Layers:
 from .runtime import (  # noqa: F401
     FLAT,
     RECURSIVE,
+    CancelScope,
+    CancelledError,
+    FaultPlan,
     Finish,
     Future,
+    InjectedFault,
     Locale,
     LocalityGraph,
     MaxReducer,
@@ -28,7 +32,9 @@ from .runtime import (  # noqa: F401
     Promise,
     PromiseError,
     Reducer,
+    RetryPolicy,
     Runtime,
+    StallError,
     SumReducer,
     Task,
     WSDeque,

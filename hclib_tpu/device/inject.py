@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -45,6 +46,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime.resilience import CancelledError, StallError
 from .descriptor import DESC_WORDS, NO_TASK, TaskGraphBuilder
 from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
 
@@ -80,6 +82,30 @@ class StreamingMegakernel:
         self._lock = threading.Lock()
         self._pending_rows: List[np.ndarray] = []
         self._closed = False
+        self._abort_reason: Optional[str] = None
+
+    # ---- lifecycle (resilience: the ring must never stay open) ----
+
+    def __enter__(self) -> "StreamingMegakernel":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # Guarantee close() even when the producer body raised: an open
+        # ring would leave run_stream (on any thread) re-entering forever
+        # waiting for a close that never comes.
+        self.close()
+        return False
+
+    def abort(self, reason: str = "aborted") -> None:
+        """Host-side abort flag: stop accepting injections and make the
+        driving run_stream raise ``CancelledError`` at its next entry
+        boundary (the in-kernel scheduler always runs bounded quanta, so
+        the kernel itself drains and exits; remaining queued rows are
+        dropped with the stream)."""
+        with self._lock:
+            if self._abort_reason is None:
+                self._abort_reason = str(reason)
+            self._closed = True
 
     # ---- producer side (host; any thread) ----
 
@@ -117,7 +143,10 @@ class StreamingMegakernel:
         row[F_HOME] = NO_TASK  # injected tasks are local to their device
         with self._lock:
             if self._closed:
-                raise RuntimeError("stream closed")
+                reason = self._abort_reason
+                raise RuntimeError(
+                    "stream closed" + (f" ({reason})" if reason else "")
+                )
             self._pending_rows.append(row)
 
     def close(self) -> None:
@@ -276,12 +305,36 @@ class StreamingMegakernel:
         quantum: int = 1 << 10,
         max_rounds: int = 64,
         poll_interval_s: float = 0.001,
+        deadline_s: Optional[float] = None,
     ) -> Tuple[np.ndarray, dict]:
         """Run the stream to completion: entries re-enter the resident
         scheduler while the host (any thread) injects; returns after
-        close() once everything drained. Returns (ivalues, info)."""
-        import time
+        close() once everything drained. Returns (ivalues, info).
 
+        Resilience: ``deadline_s`` bounds the whole stream - past it the
+        ring is closed and a structured ``StallError`` raises instead of
+        re-entering forever (e.g. a producer that never calls close()).
+        ``abort()`` from any thread raises ``CancelledError`` at the next
+        entry boundary. ANY exception escaping this driver closes the
+        ring, so concurrent producers fail fast on their next inject()
+        instead of queueing rows nobody will ever drain."""
+        try:
+            return self._run_stream(
+                builder, ivalues, data, quantum, max_rounds,
+                poll_interval_s, deadline_s,
+            )
+        except BaseException:
+            with self._lock:
+                self._closed = True
+            raise
+
+    def _run_stream(
+        self, builder, ivalues, data, quantum, max_rounds,
+        poll_interval_s, deadline_s,
+    ) -> Tuple[np.ndarray, dict]:
+        deadline = (
+            None if deadline_s is None else time.monotonic() + deadline_s
+        )
         mk = self.mk
         tasks, succ, ring0, counts = builder.finalize(
             capacity=mk.capacity, succ_capacity=mk.succ_capacity
@@ -311,6 +364,14 @@ class StreamingMegakernel:
             with self._lock:
                 rows, self._pending_rows = self._pending_rows, []
                 closed = self._closed
+                abort_reason = self._abort_reason
+            if abort_reason is not None:
+                raise CancelledError(f"stream aborted: {abort_reason}")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise StallError(
+                    f"run_stream deadline of {deadline_s}s exceeded "
+                    f"(injected={injected}, closed={closed})",
+                )
             for row in rows:
                 if injected >= self.ring_capacity:
                     raise RuntimeError(

@@ -961,9 +961,12 @@ class Megakernel:
                 "raise the limits, coarsen tasks, or audit frees"
             )
         if info["pending"] != 0:
-            raise RuntimeError(
+            from ..runtime.resilience import StallError
+
+            raise StallError(
                 f"megakernel stalled with {info['pending']} pending tasks "
                 f"after {info['executed']} executed (dependency cycle or fuel "
-                f"{fuel} exhausted)"
+                f"{fuel} exhausted)",
+                stats=info,
             )
         return ivalues_np, data_out, info

@@ -57,13 +57,18 @@ def fib_ddf(n: int, cutoff: int = 2) -> hc.Future:
     )
 
 
-def run(n: int = 25, variant: str = "finish", nworkers=None, cutoff: int = 2) -> dict:
-    """Launch, compute fib(n), return {value, tasks, seconds, tasks_per_sec}."""
+def run(n: int = 25, variant: str = "finish", nworkers=None, cutoff: int = 2,
+        **launch_kwargs) -> dict:
+    """Launch, compute fib(n), return {value, tasks, seconds, tasks_per_sec}.
+    Extra keywords (deadline_s, fault_plan, default_retry, ...) pass through
+    to ``hclib_tpu.launch`` - the chaos harness injects faults this way."""
     t0 = time.perf_counter()
     if variant == "finish":
-        value = hc.launch(fib_finish, n, cutoff, nworkers=nworkers)
+        value = hc.launch(fib_finish, n, cutoff, nworkers=nworkers,
+                          **launch_kwargs)
     elif variant == "ddf":
-        value = hc.launch(lambda: fib_ddf(n, cutoff).wait(), nworkers=nworkers)
+        value = hc.launch(lambda: fib_ddf(n, cutoff).wait(),
+                          nworkers=nworkers, **launch_kwargs)
     else:
         raise ValueError(f"unknown fib variant {variant!r}")
     dt = time.perf_counter() - t0

@@ -118,11 +118,14 @@ def count_seq(params: UTSParams) -> Tuple[int, int, int]:
     return nodes, leaves, max_depth
 
 
-def count_parallel(params: UTSParams, nworkers=None, grain: int = 1) -> Tuple[int, int, int]:
+def count_parallel(params: UTSParams, nworkers=None, grain: int = 1,
+                   **launch_kwargs) -> Tuple[int, int, int]:
     """Task-parallel traversal. grain=1 spawns one async per node (the
     reference's per-node tasking); grain>1 makes each task expand up to
     ``grain`` nodes depth-first locally before spawning the rest of its
-    frontier as new tasks (amortizes task overhead, keeps stealable slack)."""
+    frontier as new tasks (amortizes task overhead, keeps stealable slack).
+    Extra keywords (deadline_s, fault_plan, default_retry, ...) pass through
+    to ``hclib_tpu.launch`` - the chaos harness injects faults this way."""
 
     def main():
         nodes = hc.SumReducer()
@@ -153,12 +156,13 @@ def count_parallel(params: UTSParams, nworkers=None, grain: int = 1) -> Tuple[in
             hc.async_(visit, root_state(params.root_seed), 0)
         return nodes.gather(), leaves.gather(), depth_r.gather()
 
-    return hc.launch(main, nworkers=nworkers)
+    return hc.launch(main, nworkers=nworkers, **launch_kwargs)
 
 
-def run(params: UTSParams = T3, nworkers=None) -> dict:
+def run(params: UTSParams = T3, nworkers=None, **launch_kwargs) -> dict:
     t0 = time.perf_counter()
-    nodes, leaves, max_depth = count_parallel(params, nworkers=nworkers)
+    nodes, leaves, max_depth = count_parallel(params, nworkers=nworkers,
+                                              **launch_kwargs)
     dt = time.perf_counter() - t0
     return {
         "nodes": nodes,

@@ -133,6 +133,7 @@ class ProcWorld:
         poll_interval_s: float = 0.002,
         timeout_s: float = 60.0,
         retry_s: Optional[float] = None,
+        fault_plan=None,
         _client=None,
         _rank: Optional[int] = None,
         _size: Optional[int] = None,
@@ -177,6 +178,9 @@ class ProcWorld:
         self._heap_lock = threading.Lock()
         self._handlers: Dict[str, Callable] = {}
         self._applied = 0  # ops applied by the progress thread, in order
+        # Chaos (runtime/resilience.FaultPlan): may kill this rank's
+        # progress engine on cue, exercising tombstones + reply poisoning.
+        self._fault_plan = fault_plan
         self._stop = threading.Event()
         self._dead: Optional[BaseException] = None
         self.last_allreduce_path: Optional[str] = None
@@ -211,6 +215,21 @@ class ProcWorld:
         except Exception:
             return None
         return b.decode(errors="replace") if b is not None else None
+
+    def _raise_if_peer_dead(self, rank: int, context: str = "") -> None:
+        """The ONE tombstone protocol for every wait loop (recv/_await_key,
+        barrier, allreduce, module futures): raise ProcWorldError when this
+        rank's own engine died, or when ``rank`` published a tombstone -
+        never leave a waiter to run out its full timeout against a peer
+        that is already known dead."""
+        self._check_alive()
+        if rank == self.rank:
+            return
+        tomb = self._peer_dead(rank)
+        if tomb is not None:
+            raise ProcWorldError(
+                f"rank {rank}'s progress engine died{context}: {tomb}"
+            )
 
     # ---- reply-key plumbing ----
 
@@ -253,17 +272,22 @@ class ProcWorld:
         while True:
             self._check_alive()
             try:
+                # Try the key FIRST: a reply the peer deposited before
+                # dying is valid (only unapplied ops get poisoned) and
+                # must win over its tombstone. The tombstone is consulted
+                # when a chunk comes back empty/transient, so a dead peer
+                # still surfaces within one chunk.
                 b = self._c.blocking_key_value_get_bytes(key, chunk_ms)
             except Exception as e:
                 st = _status(e)
                 if st not in _TRANSIENT:
                     raise
-                tomb = self._peer_dead(target)
-                if tomb is not None:
-                    raise ProcWorldError(
-                        f"rank {target}'s progress engine died; "
-                        f"op {key} will never complete: {tomb}"
-                    ) from e
+                try:
+                    self._raise_if_peer_dead(
+                        target, context=f"; op {key} will never complete"
+                    )
+                except ProcWorldError as pe:
+                    raise pe from e
                 if time.monotonic() >= deadline:
                     raise
                 continue
@@ -340,7 +364,20 @@ class ProcWorld:
         with self._seq_lock:
             self._barrier_n += 1
             bn = self._barrier_n
-        self._c.wait_at_barrier(f"{self._ns}/b/{bn}", self._timeout_ms)
+        try:
+            self._c.wait_at_barrier(f"{self._ns}/b/{bn}", self._timeout_ms)
+        except Exception as e:
+            # A barrier has no single target: on failure, scan every peer
+            # for a tombstone so the error NAMES the dead rank instead of
+            # reading as an anonymous DEADLINE_EXCEEDED.
+            for r in range(self.size):
+                if r == self.rank:
+                    continue
+                try:
+                    self._raise_if_peer_dead(r, context=f" (barrier {bn})")
+                except ProcWorldError as pe:
+                    raise pe from e
+            raise
 
     _REDUCE_FNS = {
         "sum": lambda a, b: a + b,
@@ -575,7 +612,15 @@ class ProcWorld:
         me = self.rank
         backoff = 0.005
         retry_deadline = None  # armed on the first consecutive transient
+        fp = self._fault_plan
         while not self._stop.is_set():
+            if fp is not None and fp.on_procworld_poll(me, self._applied):
+                from ..runtime.resilience import InjectedFault
+
+                self._die(InjectedFault(
+                    f"chaos: rank {me} progress engine killed by FaultPlan"
+                ))
+                return
             key = f"{self._ns}/op/{me}/{self._applied}"
             try:
                 b = self._c.key_value_try_get_bytes(key)
@@ -752,20 +797,21 @@ class ProcWorldModule(Module):
                 return True, val
             now = time.monotonic()
             err = None
-            if w.dead is not None:
+            if now >= state["tomb_at"]:
+                # Tombstone polls are KV RPCs: throttle to 2/s. Same
+                # protocol as the blocking waits (_raise_if_peer_dead):
+                # local engine death and peer tombstones both fail fast.
+                state["tomb_at"] = now + 0.5
+                try:
+                    w._raise_if_peer_dead(
+                        target, context="; op will never complete"
+                    )
+                except ProcWorldError as pe:
+                    err = pe
+            elif w.dead is not None:
                 err = ProcWorldError(
                     f"rank {w.rank}: local progress engine died"
                 )
-            elif now >= state["tomb_at"]:
-                # Tombstone polls are KV RPCs: throttle to 2/s.
-                state["tomb_at"] = now + 0.5
-                if target != w.rank:
-                    tomb = w._peer_dead(target)
-                    if tomb is not None:
-                        err = ProcWorldError(
-                            f"rank {target}'s progress engine died; "
-                            f"op will never complete: {tomb}"
-                        )
             if err is None and now >= deadline:
                 err = ProcWorldError(
                     f"op to rank {target} timed out after {w._timeout_s}s"

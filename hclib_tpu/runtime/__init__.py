@@ -18,6 +18,14 @@ from .mem import allocate_at, async_copy, free_at, memset_at
 from .module import Module, register_module, unregister_all_modules
 from .promise import Future, Promise, PromiseError
 from .reducers import MaxReducer, OrReducer, Reducer, SumReducer
+from .resilience import (
+    CancelScope,
+    CancelledError,
+    FaultPlan,
+    InjectedFault,
+    RetryPolicy,
+    StallError,
+)
 from .scheduler import (
     Runtime,
     async_,

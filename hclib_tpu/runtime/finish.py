@@ -14,12 +14,14 @@ import threading
 from typing import Optional
 
 from .promise import Promise
+from .resilience import CancelScope
 
 __all__ = ["Finish"]
 
 
 class Finish:
-    __slots__ = ("parent", "_lock", "counter", "on_zero", "_zero_event")
+    __slots__ = ("parent", "_lock", "counter", "on_zero", "_zero_event",
+                 "scope")
 
     def __init__(self, parent: Optional["Finish"] = None) -> None:
         self.parent = parent
@@ -29,6 +31,11 @@ class Finish:
         # escaping continuation), cf. finish_dep.
         self.on_zero: Optional[Promise] = None
         self._zero_event: Optional[threading.Event] = None
+        # Cancellation chains along the finish tree (resilience.py):
+        # cancelling a scope cancels every descendant by inheritance.
+        self.scope = CancelScope(
+            parent=None if parent is None else parent.scope
+        )
 
     def check_in(self) -> None:
         """A child task is spawned under this scope (check_in_finish)."""
@@ -52,11 +59,16 @@ class Finish:
         return self.counter == 0
 
     def arm_event(self) -> Optional[threading.Event]:
-        """Arm a parked-context event; returns None if already quiescent."""
+        """Arm a parked-context event; returns None if already quiescent.
+
+        A cached event that is already set (a cancel-wake sets parked
+        events spuriously; waiters re-check and re-park) is replaced with
+        a fresh one, so a spurious set can never turn later parks into a
+        busy spin."""
         with self._lock:
             if self.counter == 0:
                 return None
-            if self._zero_event is None:
+            if self._zero_event is None or self._zero_event.is_set():
                 self._zero_event = threading.Event()
             return self._zero_event
 

@@ -78,6 +78,15 @@ class Promise:
         abort the process; a Python framework must propagate)."""
         self._satisfy(_UNSET, error)
 
+    def poison_if_unset(self, error: BaseException) -> bool:
+        """Best-effort poison for cancellation/teardown paths: no-op (False)
+        when already satisfied - losing the race to a normal put is fine."""
+        try:
+            self._satisfy(_UNSET, error)
+            return True
+        except PromiseError:
+            return False
+
     def _satisfy(self, value: Any, error: Optional[BaseException]) -> None:
         with self._lock:
             if self._satisfied:
@@ -113,6 +122,16 @@ class Promise:
             self._ctx_waiters.append(event)
             return True
 
+    def _unregister_ctx(self, event: threading.Event) -> None:
+        """Withdraw a parked-context waiter that gave up (wait timeout,
+        cancellation): repeated timed waits on a long-unsatisfied promise
+        must not accumulate abandoned events."""
+        with self._lock:
+            try:
+                self._ctx_waiters.remove(event)
+            except ValueError:
+                pass
+
     def get(self) -> Any:
         if not self._satisfied:
             raise PromiseError("promise value read before put()")
@@ -136,17 +155,20 @@ class Future:
         """Non-blocking read; requires the promise to be satisfied."""
         return self.promise.get()
 
-    def wait(self) -> Any:
+    def wait(self, timeout: Optional[float] = None) -> Any:
         """Block the current execution context until satisfied.
 
         Equivalent to hclib_future_wait (reference: src/hclib-runtime.c:983):
-        help-first runs other tasks inline, then parks the context.
+        help-first runs other tasks inline, then parks the context. With
+        ``timeout`` (seconds), raises ``StallError`` instead of blocking
+        past it - the promise itself stays unsatisfied and may still be
+        waited on again.
         """
         if self.promise.satisfied():
             return self.promise.get()
         from . import scheduler
 
-        scheduler.current_runtime().wait_on(self.promise)
+        scheduler.current_runtime().wait_on(self.promise, timeout=timeout)
         return self.promise.get()
 
 

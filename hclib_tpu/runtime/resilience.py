@@ -1,0 +1,378 @@
+"""Resilience: cancellation, deadlines, task retry, and deterministic chaos.
+
+The reference documents that help-first blocking can deadlock
+(test/deadlock/README) but ships no detection or recovery: a stalled
+program hangs until the operator kills it, and a failed comm peer wedges
+every rank blocked on it. This module gives the runtime a failure model
+with *bounded latency*:
+
+- ``CancelScope`` - every ``Finish`` carries one, chained parent-to-child
+  exactly like the finish tree. Cancelling a scope makes (a) queued tasks
+  of that scope (and descendants) drop instead of run, (b) spawns into it
+  raise, and (c) blocked helpers/waiters wake and raise ``CancelledError``.
+  Scope checks are epoch-guarded: until the first cancel anywhere in the
+  process they cost one module-global int read, so the hot path pays
+  nothing for the capability.
+
+- ``StallError`` - the structured form of "this would have hung": raised
+  by ``Runtime.run(deadline_s=...)``, ``Future.wait(timeout=...)``,
+  ``end_finish(timeout=...)``, the watchdog's escalation ladder, and the
+  device layer's stall/deadline detectors. Carries a stats snapshot so
+  the failure is diagnosable post-mortem.
+
+- ``RetryPolicy`` - per-spawn (or runtime-default) retry with exponential
+  backoff and deterministic jitter. Tasks that exhaust their attempts are
+  *quarantined*: recorded in ``Runtime.stats_dict()['resilience']`` with
+  the terminal error, and optionally swallowed (``quarantine=True``) so
+  one poison task cannot take down a batch run.
+
+- ``FaultPlan`` - seeded, deterministic fault injection: task exceptions,
+  delayed steals, worker death, and procworld peer crashes fire at points
+  decided by ``hash(seed, site, n)`` where ``n`` is a per-site event
+  counter. The *decision table* is a pure function of the seed, so the
+  same seed yields the same failure trace (``FaultPlan.trace``) and every
+  recovery path above is exercisable in CI on cue.
+
+Wake protocol: cancellation must unpark blocked contexts promptly without
+per-park polling (thousands of contexts may be parked). ``CancelScope.
+cancel`` bumps the global epoch and invokes a waker the active runtime
+registered (``set_cancel_waker``); the runtime sets every parked event,
+and each woken context re-checks its own condition - spurious wakes are
+safe because every park caller loops.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "CancelScope",
+    "CancelledError",
+    "StallError",
+    "InjectedFault",
+    "RetryPolicy",
+    "FaultPlan",
+]
+
+LOG = logging.getLogger("hclib_tpu.resilience")
+
+
+class CancelledError(RuntimeError):
+    """The enclosing scope was cancelled; a control signal, not a fault
+    (the runtime does not record it as the run's first error)."""
+
+
+class StallError(RuntimeError):
+    """A bounded-latency failure: deadline exceeded, wait timed out, or
+    the watchdog escalated a stall. ``stats`` is the runtime (or device)
+    counter snapshot at detection time."""
+
+    def __init__(self, message: str, stats: Optional[dict] = None) -> None:
+        super().__init__(message)
+        self.stats = stats or {}
+
+
+class InjectedFault(Exception):
+    """A fault injected by a FaultPlan (retryable under the default
+    RetryPolicy, like any plain Exception)."""
+
+
+# ---------------------------------------------------------------- epoch/waker
+
+# Fast path: until any scope in the process is ever cancelled, cancelled()
+# is a single int comparison. One active runtime at a time (enforced in
+# Runtime.run), so a module-level waker suffices.
+_cancel_epoch = 0
+_waker_lock = threading.Lock()
+_waker = None
+
+
+def set_cancel_waker(fn) -> None:
+    """Register the active runtime's unpark-everything hook (None clears)."""
+    global _waker
+    with _waker_lock:
+        _waker = fn
+
+
+def any_cancelled() -> bool:
+    """True once any scope has been cancelled since the last epoch reset
+    (i.e. within the current launch)."""
+    return _cancel_epoch != 0
+
+
+def reset_cancel_epoch() -> None:
+    """Restore the cancelled() fast path for a fresh launch. Scopes from a
+    finished runtime are unreachable by live tasks, and without this reset
+    one cancel anywhere would tax every later launch in the process with
+    parent-chain walks on each spawn/execute/park check."""
+    global _cancel_epoch
+    _cancel_epoch = 0
+
+
+class CancelScope:
+    """Cancellation flag chained along the finish tree.
+
+    ``cancelled()`` consults self and every ancestor, so cancelling a
+    scope implicitly cancels all descendants - no child registry, no
+    per-finish bookkeeping that could leak across millions of finishes.
+    """
+
+    __slots__ = ("parent", "reason", "_cancelled")
+
+    def __init__(self, parent: Optional["CancelScope"] = None) -> None:
+        self.parent = parent
+        self.reason: Any = None
+        self._cancelled = False
+
+    def cancel(self, reason: Any = None) -> None:
+        """Cancel this scope (and, by inheritance, its descendants).
+        Idempotent; the first reason wins. Wakes every parked context of
+        the active runtime so blocked waiters notice promptly."""
+        global _cancel_epoch
+        if self._cancelled:
+            return
+        if reason is not None:
+            self.reason = reason
+        self._cancelled = True
+        _cancel_epoch += 1
+        with _waker_lock:
+            w = _waker
+        if w is not None:
+            try:
+                w()
+            except Exception:  # a dying runtime must not break cancel()
+                pass
+
+    def cancelled(self) -> bool:
+        if _cancel_epoch == 0:
+            return False
+        s: Optional[CancelScope] = self
+        while s is not None:
+            if s._cancelled:
+                return True
+            s = s.parent
+        return False
+
+    def describe(self) -> str:
+        s: Optional[CancelScope] = self
+        while s is not None:
+            if s._cancelled:
+                r = s.reason
+                if r is None:
+                    return "scope cancelled"
+                return f"scope cancelled: {r}"
+            s = s.parent
+        return "scope not cancelled"
+
+    def cancel_reason(self) -> Any:
+        """The reason of the nearest cancelled scope on the parent chain."""
+        s: Optional[CancelScope] = self
+        while s is not None:
+            if s._cancelled:
+                return s.reason
+            s = s.parent
+        return None
+
+
+# ------------------------------------------------------------- deterministic
+
+def _hash01(seed: int, site: str, n: int) -> float:
+    """Uniform [0, 1) as a pure function of (seed, site, n) - platform- and
+    run-independent, unlike random.Random under thread interleaving."""
+    h = hashlib.blake2b(f"{seed}/{site}/{n}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "little") / 2.0**64
+
+
+# ------------------------------------------------------------------- retry
+
+class RetryPolicy:
+    """Per-spawn retry: up to ``max_attempts`` total executions, delayed by
+    ``backoff_s * multiplier**(attempt-1)`` with deterministic +/-``jitter``
+    fraction. ``retry_on`` restricts which exception types retry
+    (cancellation and stalls never do). With ``quarantine=True`` a task
+    that exhausts its attempts is recorded in the runtime's quarantine and
+    *swallowed* (its result promise is poisoned, the run continues);
+    otherwise the terminal error propagates to ``launch`` as usual."""
+
+    __slots__ = (
+        "max_attempts", "backoff_s", "multiplier", "jitter", "retry_on",
+        "quarantine", "seed", "_n", "_lock",
+    )
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        backoff_s: float = 0.01,
+        multiplier: float = 2.0,
+        jitter: float = 0.1,
+        retry_on: Tuple[type, ...] = (Exception,),
+        quarantine: bool = False,
+        seed: int = 0,
+    ) -> None:
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.retry_on = tuple(retry_on)
+        self.quarantine = bool(quarantine)
+        self.seed = int(seed)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def should_retry(self, attempt: int, exc: BaseException) -> bool:
+        """True if execution ``attempt`` (0-based) failing with ``exc``
+        warrants another attempt."""
+        if isinstance(exc, (CancelledError, StallError)):
+            return False
+        return attempt + 1 < self.max_attempts and isinstance(
+            exc, self.retry_on
+        )
+
+    def delay_s(self, attempt: int) -> float:
+        """Backoff before execution ``attempt`` (1-based retry index)."""
+        d = self.backoff_s * (self.multiplier ** max(0, attempt - 1))
+        if self.jitter:
+            with self._lock:
+                self._n += 1
+                n = self._n
+            u = _hash01(self.seed, "retry-jitter", n)
+            d *= 1.0 + self.jitter * (2.0 * u - 1.0)
+        return max(0.0, d)
+
+
+# -------------------------------------------------------------------- chaos
+
+class FaultPlan:
+    """Seeded deterministic fault injection across all three layers.
+
+    Sites (each with an independent monotone event counter ``n``):
+
+    - ``task``: before each task body execution, fail with
+      ``InjectedFault`` when ``hash01(seed, 'task', n) < task_failure_rate``
+      (at most ``max_task_failures`` total when set).
+    - ``steal``: after each successful steal, sleep ``steal_delay_s`` when
+      ``hash01(seed, 'steal', n) < steal_delay_rate``.
+    - worker death: the pool thread bound to identity ``kill_worker`` dies
+      after its ``kill_worker_after``-th scheduling poll; the runtime
+      re-binds the orphaned identity to a fresh thread (the recovery under
+      test) and counts it in ``stats_dict()['resilience']['worker_deaths']``.
+    - procworld: rank ``peer_crash_rank``'s progress engine suffers a
+      fatal ``InjectedFault`` once it has applied ``peer_crash_after``
+      ops, exercising tombstones + reply poisoning on its peers.
+
+    Every decision is a pure function of ``(seed, site, n)``, so the
+    decision table - and therefore ``trace``, the list of faults that
+    fired - is reproducible for a given seed and workload. Thread
+    interleaving may reorder ``trace`` between runs; compare
+    ``trace_key()`` (sorted) for determinism assertions.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        task_failure_rate: float = 0.0,
+        max_task_failures: Optional[int] = None,
+        steal_delay_rate: float = 0.0,
+        steal_delay_s: float = 0.002,
+        kill_worker: Optional[int] = None,
+        kill_worker_after: int = 100,
+        peer_crash_rank: Optional[int] = None,
+        peer_crash_after: int = 0,
+    ) -> None:
+        self.seed = int(seed)
+        self.task_failure_rate = float(task_failure_rate)
+        self.max_task_failures = max_task_failures
+        self.steal_delay_rate = float(steal_delay_rate)
+        self.steal_delay_s = float(steal_delay_s)
+        self.kill_worker = kill_worker
+        self.kill_worker_after = int(kill_worker_after)
+        self.peer_crash_rank = peer_crash_rank
+        self.peer_crash_after = int(peer_crash_after)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._fired: Set[Tuple[str, int]] = set()
+        self._task_faults = 0
+        self.trace: List[Tuple[str, int]] = []
+
+    def _next(self, site: str) -> int:
+        with self._lock:
+            n = self._counters.get(site, 0)
+            self._counters[site] = n + 1
+            return n
+
+    # -- scheduler hooks --
+
+    def on_task(self, task: Any) -> None:
+        """Called before each task body execution; may raise InjectedFault."""
+        if self.task_failure_rate <= 0.0:
+            return
+        n = self._next("task")
+        if _hash01(self.seed, "task", n) >= self.task_failure_rate:
+            return
+        with self._lock:
+            if (
+                self.max_task_failures is not None
+                and self._task_faults >= self.max_task_failures
+            ):
+                return
+            self._task_faults += 1
+            self.trace.append(("task", n))
+        raise InjectedFault(f"chaos: injected task failure (task #{n})")
+
+    def on_steal(self, wid: int) -> None:
+        """Called after each successful steal; may sleep (delayed steal)."""
+        if self.steal_delay_rate <= 0.0:
+            return
+        n = self._next("steal")
+        if _hash01(self.seed, "steal", n) < self.steal_delay_rate:
+            with self._lock:
+                self.trace.append(("steal", n))
+            time.sleep(self.steal_delay_s)
+
+    def on_worker_poll(self, wid: int) -> bool:
+        """Called per scheduling-loop iteration; True = this thread dies."""
+        if self.kill_worker is None or wid != self.kill_worker:
+            return False
+        key = ("kill_worker", wid)
+        with self._lock:
+            if key in self._fired:
+                return False
+        n = self._next(f"worker/{wid}")
+        if n + 1 < self.kill_worker_after:
+            return False
+        with self._lock:
+            if key in self._fired:
+                return False
+            self._fired.add(key)
+            self.trace.append(key)
+        return True
+
+    # -- procworld hook --
+
+    def on_procworld_poll(self, rank: int, applied: int) -> bool:
+        """Called per progress-loop iteration; True = fatal engine crash."""
+        if self.peer_crash_rank is None or rank != self.peer_crash_rank:
+            return False
+        if applied < self.peer_crash_after:
+            return False
+        key = ("peer_crash", rank)
+        with self._lock:
+            if key in self._fired:
+                return False
+            self._fired.add(key)
+            self.trace.append(key)
+        return True
+
+    # -- reproducibility --
+
+    def trace_key(self) -> Tuple[Tuple[str, int], ...]:
+        """Order-independent fingerprint of the faults that fired (thread
+        interleaving may reorder ``trace`` itself between identical runs)."""
+        with self._lock:
+            return tuple(sorted(self.trace))

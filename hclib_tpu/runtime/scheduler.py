@@ -34,10 +34,17 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import resilience
 from .deque import WSDeque
 from .finish import Finish
 from .locality import Locale, LocalityGraph, generate_default_graph, load_locality_file
 from .promise import Future, Promise
+from .resilience import (
+    CancelledError,
+    FaultPlan,
+    RetryPolicy,
+    StallError,
+)
 from .task import Task
 
 __all__ = [
@@ -57,6 +64,9 @@ __all__ = [
 
 _THREAD_STACK = 1 << 21  # 2 MB: room for deep inline help recursion
 _MAX_THREADS = 4096
+# Quarantine keeps at most this many terminal-failure records (plus a
+# total count) so a hot poison task can't grow stats without bound.
+_QUARANTINE_KEEP = 32
 
 
 class _Context(threading.local):
@@ -149,13 +159,18 @@ class _IdentityManager:
 
     def release(self, wid: int) -> bool:
         """Returns True if a spare thread should be spawned to keep the
-        worker count constant (no thread is waiting to claim the identity)."""
+        worker count constant. A waiter can absorb exactly ONE identity:
+        comparing free identities against waiter count (not testing
+        waiters == 0) closes the leak where two near-simultaneous
+        releases both saw the same single waiter, neither spawned a
+        spare, and the second identity sat unclaimed forever while every
+        live thread was a parked blocked context (chaos-surfaced wedge)."""
         with self._cv:
             self._free.append(wid)
             self._cv.notify_all()
             return (
-                self._priority_waiters == 0
-                and self._normal_waiters == 0
+                len(self._free)
+                > self._priority_waiters + self._normal_waiters
                 and not self._shutdown
             )
 
@@ -174,6 +189,9 @@ class Runtime:
         instrument: Optional[bool] = None,
         timer: Optional[bool] = None,
         watchdog_s: Optional[float] = None,
+        watchdog_escalate: Optional[bool] = None,
+        fault_plan: Optional[FaultPlan] = None,
+        default_retry: Optional[RetryPolicy] = None,
     ) -> None:
         if nworkers is None:
             env = os.environ.get("HCLIB_TPU_WORKERS") or os.environ.get("HCLIB_WORKERS")
@@ -226,8 +244,13 @@ class Runtime:
         if timer is None:
             timer = bool(os.environ.get("HCLIB_TPU_TIMER"))
         if watchdog_s is None:
-            env = os.environ.get("HCLIB_TPU_WATCHDOG_S")
+            env = os.environ.get("HCLIB_TPU_WATCHDOG_S") or os.environ.get(
+                "HCLIB_TPU_WATCHDOG"
+            )
             watchdog_s = float(env) if env else 0.0
+        if watchdog_escalate is None:
+            env = os.environ.get("HCLIB_TPU_WATCHDOG_ESCALATE")
+            watchdog_escalate = env != "0" if env is not None else True
         self.event_log = None
         self._ev_task = None
         if instrument:
@@ -241,8 +264,33 @@ class Runtime:
 
             self.state_timer = StateTimer(nworkers)
         self._watchdog_s = watchdog_s
+        self._watchdog_escalate = watchdog_escalate
         self._watchdog_thread: Optional[threading.Thread] = None
         self.stall_reports = 0
+        # Resilience (runtime/resilience.py): chaos plan, default retry
+        # policy, deadline, parked-context wake registry, and counters.
+        self._fault_plan = fault_plan
+        self._default_retry = default_retry
+        self._deadline_timer: Optional[threading.Timer] = None
+        # Event twin of the _shutdown flag so sleepers (watchdog) notice
+        # shutdown promptly instead of finishing a full sleep interval.
+        self._shutdown_evt = threading.Event()
+        # Armed park events of every blocked context; a cancel sets them
+        # all (spurious wakes are safe - park callers loop and re-check).
+        # Refcounted: contexts blocked on the same finish share one event,
+        # and registration/removal must be O(1) - thousands of contexts
+        # can park and wake in one cancellation wave.
+        self._parked_lock = threading.Lock()
+        self._parked_events: Dict[threading.Event, int] = {}
+        self._res_lock = threading.Lock()
+        self.cancelled_tasks = 0
+        self.task_retries = 0
+        # Deferred-retry timers pending fire; nonzero means an active
+        # backoff cycle, which the watchdog must not read as a stall.
+        self._deferred_pending = 0
+        self.worker_deaths = 0
+        self.quarantined = 0
+        self._quarantine: List[dict] = []
         # Main-thread-affine execution (hclib_run_on_main_ctx,
         # src/hclib-runtime.c:1340-1358): workers queue requests; the
         # launch thread services them in its help loops and while joining
@@ -264,8 +312,14 @@ class Runtime:
         non_blocking: bool = False,
         escaping: bool = False,
         result_promise: Optional[Promise] = None,
+        retry: Optional[RetryPolicy] = None,
     ) -> Task:
         fin = None if escaping else _tls.current_finish
+        if fin is not None and fin.scope.cancelled():
+            # Spawning into a cancelled scope raises so runaway spawn
+            # trees (recursive fib/UTS bodies) unwind promptly instead of
+            # flooding the deques with tasks that would only be dropped.
+            raise CancelledError(fin.scope.describe())
         task = Task(
             fn,
             args,
@@ -275,6 +329,7 @@ class Runtime:
             locale=locale,
             non_blocking=non_blocking,
             result_promise=result_promise,
+            retry=retry if retry is not None else self._default_retry,
         )
         if fin is not None:
             fin.check_in()
@@ -343,6 +398,8 @@ class Runtime:
                     st.stolen_from[v] += 1
                     with self._work_cv:
                         self._pending -= 1
+                    if self._fault_plan is not None:
+                        self._fault_plan.on_steal(wid)
                     return t
         return None
 
@@ -364,15 +421,28 @@ class Runtime:
             from .timer import WORK
 
             st.set_state(wid, WORK)
+        # Completion is tracked in a LOCAL, not on the task: a deferred
+        # task's backoff timer can fire and the re-execution complete on
+        # another worker before this frame's finally runs - reading the
+        # (shared, by-then-reset) task state here would double check_out
+        # and corrupt the finish counter.
+        completed = True
         try:
-            task.run()
+            completed = self._run_task_body(task)
         finally:
             _tls.current_finish, _tls.current_task = prev_finish, prev_task
-            if task.finish is not None:
+            if task.finish is not None and completed:
+                # A deferred (backoff-retried) task has NOT completed: its
+                # finish stays checked in until the re-enqueued attempt
+                # finishes for real.
                 task.finish.check_out()
             wid = _tls.identity
             if wid is not None:
-                self.worker_stats[wid].executed += 1
+                if completed:
+                    # A deferred-retry frame did NOT complete the task;
+                    # counting it would inflate executed (and every
+                    # tasks/sec figure derived from it) per attempt.
+                    self.worker_stats[wid].executed += 1
                 if ev is not None:
                     from .instrument import END
 
@@ -382,13 +452,165 @@ class Runtime:
 
                     st.set_state(wid, OVH)
 
+    # ----------------------------------------------------------- resilience
+
+    def _run_task_body(self, task: Task) -> bool:
+        """Execute the task body under the resilience policies: skip (and
+        poison) when the scope is cancelled, inject planned faults, retry
+        per the task's RetryPolicy (inline when backoff is zero, deferred
+        re-enqueue otherwise), and quarantine terminal failures.
+
+        Returns False when the task was DEFERRED for a delayed retry (the
+        caller must then skip check_out: the task has not completed, and
+        once the timer is armed another worker may already be re-running
+        it); True on every completed path."""
+        scope = task.finish.scope if task.finish is not None else None
+        fp = self._fault_plan
+        while True:
+            if scope is not None and scope.cancelled():
+                with self._res_lock:
+                    self.cancelled_tasks += 1
+                if task.result_promise is not None:
+                    task.result_promise.poison_if_unset(
+                        CancelledError(scope.describe())
+                    )
+                return True
+            try:
+                if fp is not None:
+                    fp.on_task(task)
+                result = task.fn(*task.args, **task.kwargs)
+            except BaseException as e:
+                pol = task.retry
+                if (
+                    pol is not None
+                    and (scope is None or not scope.cancelled())
+                    and pol.should_retry(task.attempt, e)
+                ):
+                    task.attempt += 1
+                    with self._res_lock:
+                        self.task_retries += 1
+                    delay = pol.delay_s(task.attempt)
+                    if delay <= 0.0:
+                        continue
+                    self._defer(task, delay)
+                    return False
+                if (
+                    pol is not None and pol.quarantine
+                    and not isinstance(e, CancelledError)
+                ):
+                    # Poison-task containment: dependents fail via the
+                    # poisoned promise, the run itself continues; the
+                    # failure survives in stats_dict()['resilience'].
+                    # (quarantine=False exhaustion is NOT recorded here -
+                    # that error propagates and fails the run, and stats
+                    # claiming containment would misreport it.)
+                    self._quarantine_task(task, e)
+                    if task.result_promise is not None:
+                        task.result_promise.poison_if_unset(e)
+                    return True
+                if task.result_promise is not None:
+                    # Wake dependents with a failure instead of stranding
+                    # them on a never-satisfied promise.
+                    task.result_promise.poison_if_unset(e)
+                raise
+            else:
+                if task.result_promise is not None:
+                    task.result_promise.put(result)
+                return True
+
+    def _defer(self, task: Task, delay: float) -> None:
+        """Re-enqueue ``task`` after ``delay`` seconds (retry backoff)."""
+        with self._res_lock:
+            self._deferred_pending += 1
+        t = threading.Timer(delay, self._fire_deferred, args=(task,))
+        t.daemon = True
+        t.start()
+
+    def _fire_deferred(self, task: Task) -> None:
+        with self._res_lock:
+            self._deferred_pending -= 1
+        self._enqueue(task)
+
+    def _quarantine_task(self, task: Task, err: BaseException) -> None:
+        name = getattr(task.fn, "__name__", repr(task.fn))
+        with self._res_lock:
+            self.quarantined += 1
+            if len(self._quarantine) < _QUARANTINE_KEEP:
+                self._quarantine.append({
+                    "fn": name,
+                    "attempts": task.attempt + 1,
+                    "error": repr(err),
+                })
+        from .resilience import LOG
+
+        LOG.warning(
+            "task %s quarantined after %d attempts: %r",
+            name, task.attempt + 1, err,
+        )
+
+    def _raise_if_cancelled(self, scope) -> None:
+        if scope is not None and scope.cancelled():
+            raise CancelledError(scope.describe())
+
+    def _wake_parked(self) -> None:
+        """Unpark every blocked context (cancel waker): spurious wakes are
+        safe - park callers loop and re-check their own condition."""
+        with self._parked_lock:
+            evs = list(self._parked_events)
+        for ev in evs:
+            ev.set()
+        with self._work_cv:
+            self._work_cv.notify_all()
+
+    def _on_deadline(self, deadline_s: float) -> None:
+        """Runtime deadline fired: cancel the root scope with a structured
+        StallError; everything blocked wakes and the error surfaces at
+        launch exit in bounded time."""
+        if self._shutdown:
+            return
+        if self.root_finish is None:
+            # The launch is still initializing (module post_init can block
+            # for seconds, e.g. a comm world connecting): re-arm until the
+            # root scope exists so the bound still lands instead of the
+            # one-shot timer silently expiring into an unbounded run.
+            t = threading.Timer(0.05, self._on_deadline, args=(deadline_s,))
+            t.daemon = True
+            t.start()
+            self._deadline_timer = t
+            return
+        if self.root_finish.quiesced():
+            # The program finished right at the boundary (help_finish
+            # returned, shutdown not yet flagged): a completed run must
+            # not be retroactively failed.
+            return
+        err = StallError(
+            f"runtime deadline of {deadline_s}s exceeded with work "
+            f"outstanding (backlog={self.backlog()})",
+            stats=self.stats_dict(),
+        )
+        self._record_error(err)
+        resilience.LOG.error("deadline exceeded: cancelling root scope")
+        self.root_finish.scope.cancel(err)
+
     # ------------------------------------------------------------- work loop
 
-    def _core_work_loop(self, wid: int) -> None:
+    def _core_work_loop(self, wid: int) -> Tuple[bool, int]:
         """Drain/steal/execute until shutdown or a resumed context needs this
-        identity (core_work_loop: src/hclib-runtime.c:705-724)."""
+        identity (core_work_loop: src/hclib-runtime.c:705-724). Returns
+        (died, wid): ``died`` when a FaultPlan killed this thread (the
+        caller re-binds the orphaned identity to a fresh thread), and the
+        identity this thread holds NOW - an executed task that blocked
+        released the entry identity and re-acquired, possibly a different
+        one, so the caller must release the current binding, not its
+        stale argument (releasing the stale one double-frees an identity
+        another thread owns and leaks this thread's real one: a
+        chaos-surfaced wedge)."""
         _tls.identity = wid
+        fp = self._fault_plan
         while not self._shutdown:
+            if fp is not None and fp.on_worker_poll(wid):
+                _tls.identity = None
+                return True, wid  # chaos: this worker thread dies here
             if self._idmgr.has_priority_waiter:
                 break  # hand the identity to a resumed context
             task = self._find_task(wid)
@@ -399,6 +621,9 @@ class Runtime:
                     # A task failing on a pool thread must not kill the
                     # worker or vanish: record it for launch() to re-raise.
                     self._record_error(e)
+                # The task may have blocked and re-bound this thread to a
+                # different identity: refresh before scanning again.
+                wid = _tls.identity
                 continue
             if self._run_idle_fns(wid):
                 continue
@@ -420,6 +645,7 @@ class Runtime:
                     # pre-lock set) instead of being the latency floor.
                     self._work_cv.wait(0.01 if self._idle_fns else 0.2)
         _tls.identity = None
+        return False, wid
 
     def _wake_workers(self) -> None:
         """Wake idle workers (a resumed context needs an identity: the
@@ -428,6 +654,13 @@ class Runtime:
             self._work_cv.notify_all()
 
     def _record_error(self, e: BaseException) -> None:
+        if isinstance(e, CancelledError) and resilience.any_cancelled():
+            # Fallout of a real cancellation is a control signal: the
+            # cause (deadline StallError, user cancel) is recorded by
+            # whoever cancelled, and the per-task CancelledError must not
+            # mask it. A CancelledError raised by user code while NOTHING
+            # was cancelled this launch is an ordinary failure - record it.
+            return
         with self._first_error_lock:
             if self._first_error is None:
                 self._first_error = e
@@ -450,7 +683,17 @@ class Runtime:
             wid = self._idmgr.acquire(priority=False)
             if wid is None:
                 return
-            self._core_work_loop(wid)
+            died, wid = self._core_work_loop(wid)
+            if died:
+                # Chaos worker death: the thread is gone, but the worker
+                # identity (deques, stats) survives - release it and spawn
+                # a replacement thread so the worker count heals, the
+                # recovery path FaultPlan.kill_worker exists to exercise.
+                with self._res_lock:
+                    self.worker_deaths += 1
+                if self._idmgr.release(wid):
+                    self._spawn_thread()
+                return
             if self._shutdown:
                 self._idmgr.release(wid)
                 return
@@ -489,11 +732,32 @@ class Runtime:
     def _inline_safe(self, task: Task, fin: Optional[Finish]) -> bool:
         """Reference rule (src/hclib-runtime.c:673-689): run inline iff the
         task can't block this stack indefinitely - it is declared non-blocking
-        or belongs to the very finish scope we are draining."""
-        return task.non_blocking or (fin is not None and task.finish is fin)
+        or belongs to the very finish scope we are draining. A task whose
+        scope is already cancelled is trivially inline-safe: its body is
+        skipped, so any context may drain it (lets yield_/help loops clear
+        a cancelled backlog without parking)."""
+        if task.non_blocking or (fin is not None and task.finish is fin):
+            return True
+        return task.finish is not None and task.finish.scope.cancelled()
 
-    def _park(self, register: Callable[[threading.Event], Optional[threading.Event]]) -> None:
-        """Release identity, sleep until the event fires, re-bind an identity."""
+    def _park(
+        self,
+        register: Callable[[threading.Event], Optional[threading.Event]],
+        check: Optional[Callable[[], bool]] = None,
+        deadline: Optional[float] = None,
+        unregister: Optional[Callable[[threading.Event], None]] = None,
+    ) -> None:
+        """Release identity, sleep until the event fires, re-bind an identity.
+
+        ``check`` returning True abandons the park (the caller's loop
+        re-checks its condition and typically raises - used for scope
+        cancellation); ``deadline`` (monotonic) bounds the sleep for timed
+        waits. Cancellation wakes are event-driven (the registered event is
+        in ``_parked_events`` and ``_wake_parked`` sets it), so unbounded
+        parks never poll. ``unregister`` runs on every exit so the waiter
+        the ``register`` callback added (e.g. to a promise's ctx list) is
+        withdrawn when the park is abandoned - without it, repeated timed
+        waits on one promise would leak an Event per attempt."""
         ev = threading.Event()
         armed = register(ev)
         if armed is None:
@@ -519,7 +783,30 @@ class Runtime:
                 self._main_park_evt = armed
                 if self._main_ctx_q:
                     armed.set()
-        armed.wait()
+        with self._parked_lock:
+            self._parked_events[armed] = self._parked_events.get(armed, 0) + 1
+        try:
+            # Re-check AFTER registration: a cancel between the caller's
+            # loop-head check and this park would otherwise have fired
+            # _wake_parked before our event was registered (missed wakeup).
+            # One wait suffices: every asynchronous wake source (quiesce,
+            # promise put, cancel) sets the registered event, and a timed
+            # wait returns at its deadline on its own - the caller's loop
+            # re-checks its condition either way. No polling.
+            if not (check is not None and check()):
+                if deadline is None:
+                    armed.wait()
+                else:
+                    armed.wait(max(0.0, deadline - time.monotonic()))
+        finally:
+            with self._parked_lock:
+                n = self._parked_events.get(armed, 0) - 1
+                if n <= 0:
+                    self._parked_events.pop(armed, None)
+                else:
+                    self._parked_events[armed] = n
+            if unregister is not None:
+                unregister(armed)
         if is_main:
             with self._main_ctx_lock:
                 self._main_park_evt = None
@@ -583,47 +870,100 @@ class Runtime:
             raise box["error"]
         return box["value"]
 
-    def help_finish(self, fin: Finish) -> None:
+    def help_finish(self, fin: Finish, timeout: Optional[float] = None) -> None:
         """Help-first drain of a finish scope (help_finish:
-        src/hclib-runtime.c:1067-1119)."""
+        src/hclib-runtime.c:1067-1119). Raises ``CancelledError`` when the
+        scope (or an ancestor) is cancelled; with ``timeout``, cancels the
+        scope and raises ``StallError`` if it fails to quiesce in time.
+
+        Help-first caveat: the timeout bounds THIS context's join wait. A
+        child of this scope inlined onto this stack that then blocks on an
+        unrelated, untimed condition parks beyond the timeout's reach -
+        the runtime-level ``deadline_s``/watchdog still bounds those."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         wid = _tls.identity
+        scope = fin.scope
         while not fin.quiesced():
+            self._raise_if_cancelled(scope)
+            if deadline is not None and time.monotonic() >= deadline:
+                err = StallError(
+                    f"finish scope failed to quiesce within {timeout}s "
+                    f"({fin.counter} tasks outstanding)",
+                    stats=self.stats_dict(),
+                )
+                scope.cancel(err)
+                raise err
             self._service_main_ctx()
             task = self._find_task(wid) if wid is not None else None
             if task is None:
-                self._park(lambda ev, f=fin: f.arm_event() if not f.quiesced() else None)
+                self._park(
+                    lambda ev, f=fin: f.arm_event() if not f.quiesced() else None,
+                    check=scope.cancelled,
+                    deadline=deadline,
+                )
                 wid = _tls.identity
                 continue
             if self._inline_safe(task, fin):
                 self._execute_recording(task)
+                # An inline same-finish task can itself block (nested
+                # finish), re-binding this thread to another identity.
+                wid = _tls.identity
             else:
                 # The reference swaps to a fresh fiber seeded with this task;
                 # we re-enqueue it and park - another thread runs it.
-                self._requeue_and_park(task, lambda ev, f=fin: _arm_finish(f, ev))
+                self._requeue_and_park(
+                    task, lambda ev, f=fin: _arm_finish(f, ev),
+                    check=scope.cancelled, deadline=deadline,
+                )
                 wid = _tls.identity
 
-    def wait_on(self, promise: Promise) -> None:
+    def wait_on(self, promise: Promise, timeout: Optional[float] = None) -> None:
         """Future-wait (hclib_future_wait: src/hclib-runtime.c:983-1025):
-        help with non-blocking tasks, else park on the promise."""
+        help with non-blocking tasks, else park on the promise. Raises
+        ``CancelledError`` when the waiting context's scope is cancelled;
+        with ``timeout``, raises ``StallError`` past it (the promise stays
+        unsatisfied and may be waited on again)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         wid = _tls.identity
+        fin = _tls.current_finish
+        scope = fin.scope if fin is not None else None
+        check = scope.cancelled if scope is not None else None
         while not promise.satisfied():
+            self._raise_if_cancelled(scope)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise StallError(
+                    f"Promise.wait timed out after {timeout}s",
+                    stats=self.stats_dict(),
+                )
             self._service_main_ctx()
             task = self._find_task(wid) if wid is not None else None
             if task is None:
-                self._park(lambda ev, p=promise: ev if p._register_ctx(ev) else None)
+                self._park(
+                    lambda ev, p=promise: ev if p._register_ctx(ev) else None,
+                    check=check, deadline=deadline,
+                    unregister=promise._unregister_ctx,
+                )
                 wid = _tls.identity
                 continue
             if self._inline_safe(task, None):
                 self._execute_recording(task)
+                wid = _tls.identity  # non-blocking, but stay consistent
             else:
                 self._requeue_and_park(
-                    task, lambda ev, p=promise: ev if p._register_ctx(ev) else None
+                    task,
+                    lambda ev, p=promise: ev if p._register_ctx(ev) else None,
+                    check=check, deadline=deadline,
+                    unregister=promise._unregister_ctx,
                 )
                 wid = _tls.identity
 
-    def _requeue_and_park(self, task: Task, register) -> None:
+    def _requeue_and_park(
+        self, task: Task, register, check=None, deadline=None,
+        unregister=None,
+    ) -> None:
         self._enqueue(task)
-        self._park(register)
+        self._park(register, check=check, deadline=deadline,
+                   unregister=unregister)
 
     def _find_task_at(self, wid: int, locale: Locale) -> Optional[Task]:
         """Pop/steal only at one locale (yield_at semantics: a comm worker
@@ -662,38 +1002,89 @@ class Runtime:
     def _watchdog_main(self) -> None:
         """Stall detector (SURVEY §5: the reference documents that help-first
         blocking can deadlock, test/deadlock/README, but detects nothing).
-        If no task executes across a full interval while work is outstanding,
-        emit one diagnostic report per stall episode."""
-        import sys
 
-        last_executed = -1
-        reported = False
-        while not self._shutdown:
-            time.sleep(self._watchdog_s)
-            if self._shutdown:
-                return
+        Escalation ladder, one rung per consecutive stalled interval (no
+        task executed while work is outstanding):
+
+        1. report   - logging.warning + 'stall' instrument event
+        2. dump     - logging.error with the full format_stats() snapshot
+        3. escalate - cancel the root scope with a structured StallError
+                      (``watchdog_escalate=False`` stops at rung 2)
+
+        Progress at any point resets the ladder. The Event-based sleep
+        notices runtime shutdown promptly instead of finishing a full
+        ``watchdog_s`` interval (``_shutdown_evt`` is set in run())."""
+        log = resilience.LOG
+        ev_stall = None
+        if self.event_log is not None:
+            from .instrument import register_event_type
+
+            ev_stall = register_event_type("stall")
+        last_progress = -1
+        strikes = 0
+        while not self._shutdown_evt.wait(self._watchdog_s):
             executed = sum(st.executed for st in self.worker_stats)
-            outstanding = self.root_finish is not None and not self.root_finish.quiesced()
-            if executed == last_executed and outstanding:
-                if not reported:
-                    reported = True
-                    self.stall_reports += 1
-                    print(
-                        f"hclib_tpu watchdog: no task executed in "
-                        f"{self._watchdog_s:.1f}s with work outstanding "
-                        f"(executed={executed} backlog={self.backlog()} "
-                        f"pending={self._pending})\n{self.format_stats()}",
-                        file=sys.stderr,
+            # Retries count as progress: an active backoff cycle (deferred
+            # re-enqueues pending on timers) is not a stall.
+            progress = executed + self.cancelled_tasks + self.task_retries
+            outstanding = (
+                self.root_finish is not None
+                and not self.root_finish.quiesced()
+            )
+            if self._deferred_pending > 0:
+                # A retry backoff timer is armed: the run is waiting on
+                # purpose, not stalled - even when the backoff spans
+                # several watchdog intervals.
+                last_progress = progress
+                strikes = 0
+                continue
+            if progress == last_progress and outstanding:
+                strikes += 1
+                self.stall_reports += 1
+                if self.event_log is not None:
+                    from .instrument import SINGLE
+
+                    self.event_log.record(0, ev_stall, SINGLE, strikes)
+                head = (
+                    f"hclib_tpu watchdog: no task executed in "
+                    f"{self._watchdog_s:.1f}s with work outstanding "
+                    f"(executed={executed} backlog={self.backlog()} "
+                    f"pending={self._pending} strike={strikes})"
+                )
+                if strikes == 1:
+                    log.warning("%s", head)
+                elif strikes == 2:
+                    log.error("%s\n%s", head, self.format_stats())
+                if strikes >= 3 and self._watchdog_escalate:
+                    err = StallError(
+                        f"watchdog: stalled for "
+                        f"{strikes * self._watchdog_s:.1f}s with work "
+                        f"outstanding; cancelling root scope",
+                        stats=self.stats_dict(),
                     )
+                    self._record_error(err)
+                    log.error("%s - escalating: cancelling root scope", head)
+                    if self.root_finish is not None:
+                        self.root_finish.scope.cancel(err)
+                    return
             else:
-                reported = False
-            last_executed = executed
+                strikes = 0
+            last_progress = progress
 
     # ------------------------------------------------------------ lifecycle
 
-    def run(self, fn: Callable[..., Any], *args: Any) -> Any:
+    def run(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        deadline_s: Optional[float] = None,
+    ) -> Any:
         """Launch: bind the caller as a worker, run ``fn`` under the root
-        finish, drain, shut down (hclib_launch: src/hclib-runtime.c:1460-1478)."""
+        finish, drain, shut down (hclib_launch: src/hclib-runtime.c:1460-1478).
+
+        With ``deadline_s``, the whole launch is bounded: past the deadline
+        the root scope is cancelled and a structured ``StallError`` (with a
+        stats snapshot) raises here instead of the program hanging."""
         global _global_runtime
         if _global_runtime is not None:
             raise RuntimeError("an hclib_tpu runtime is already active")
@@ -702,11 +1093,22 @@ class Runtime:
         from .module import call_pre_init, call_post_init, call_finalize
 
         call_pre_init(self)
+        # A cancel in some EARLIER launch must not slow this one down:
+        # restore the epoch-guarded fast path (scopes of dead runtimes
+        # are unreachable by live tasks).
+        resilience.reset_cancel_epoch()
+        resilience.set_cancel_waker(self._wake_parked)
         if self._watchdog_s > 0:
             self._watchdog_thread = threading.Thread(
                 target=self._watchdog_main, daemon=True, name="hclib-watchdog"
             )
             self._watchdog_thread.start()
+        if deadline_s is not None:
+            self._deadline_timer = threading.Timer(
+                deadline_s, self._on_deadline, args=(deadline_s,)
+            )
+            self._deadline_timer.daemon = True
+            self._deadline_timer.start()
         for _ in range(self.nworkers):
             self._spawn_thread()
         _tls.identity = self._idmgr.acquire(priority=True)
@@ -725,11 +1127,29 @@ class Runtime:
                 err[0] = e
 
         try:
-            self.spawn(root)
-            self.help_finish(self.root_finish)
+            try:
+                # spawn is inside the handler too: a deadline firing this
+                # early cancels the root scope and makes spawn itself
+                # raise CancelledError - the recorded StallError must
+                # still win.
+                self.spawn(root)
+                self.help_finish(self.root_finish)
+            except CancelledError as ce:
+                # Root cancellation: surface the CAUSE (deadline/watchdog
+                # StallError, a task's recorded failure) when one exists;
+                # a bare user cancel propagates as CancelledError itself.
+                with self._first_error_lock:
+                    fe = self._first_error
+                if fe is None:
+                    raise
+                raise fe from ce
         finally:
             _tls.current_finish = prev_finish
             self._shutdown = True
+            self._shutdown_evt.set()
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+            resilience.set_cancel_waker(None)
             self._idmgr.shutdown()
             with self._work_cv:
                 self._work_cv.notify_all()
@@ -773,6 +1193,10 @@ class Runtime:
                 # event_log.dump() with their own directory.
                 self.last_dump_path = self.event_log.dump()
         if err[0] is not None:
+            if isinstance(err[0], CancelledError) and self._first_error is not None:
+                # The root body tripped over the cancellation (e.g. a spawn
+                # into the cancelled root scope); the recorded cause wins.
+                raise self._first_error from err[0]
             raise err[0]
         if self._first_error is not None:
             raise self._first_error
@@ -792,6 +1216,16 @@ class Runtime:
         """Worker counters as a JSON-ready dict (steal matrix included) -
         the machine-readable form of format_stats, consumed by
         tools/timeline.py's report renderer."""
+        with self._res_lock:
+            quarantine = [dict(q) for q in self._quarantine]
+            res = {
+                "cancelled_tasks": self.cancelled_tasks,
+                "retries": self.task_retries,
+                "worker_deaths": self.worker_deaths,
+                "quarantined": self.quarantined,
+                "quarantine": quarantine,
+                "stall_reports": self.stall_reports,
+            }
         return {
             "nworkers": self.nworkers,
             "workers": [
@@ -805,6 +1239,7 @@ class Runtime:
                 }
                 for st in self.worker_stats
             ],
+            "resilience": res,
         }
 
     def format_stats(self) -> str:
@@ -813,6 +1248,15 @@ class Runtime:
             lines.append(
                 f"  worker {w}: executed={st.executed} spawned={st.spawned} "
                 f"steals={st.steals} parks={st.parks} yields={st.yields}"
+            )
+        if (
+            self.cancelled_tasks or self.task_retries or self.worker_deaths
+            or self.quarantined or self.stall_reports
+        ):
+            lines.append(
+                f"  resilience: cancelled={self.cancelled_tasks} "
+                f"retries={self.task_retries} deaths={self.worker_deaths} "
+                f"quarantined={self.quarantined} stalls={self.stall_reports}"
             )
         return "\n".join(lines)
 
@@ -834,6 +1278,10 @@ def launch(
     instrument: Optional[bool] = None,
     timer: Optional[bool] = None,
     watchdog_s: Optional[float] = None,
+    watchdog_escalate: Optional[bool] = None,
+    deadline_s: Optional[float] = None,
+    fault_plan: Optional[FaultPlan] = None,
+    default_retry: Optional[RetryPolicy] = None,
 ) -> Any:
     """Run ``fn`` inside a fresh runtime; returns its result."""
     return Runtime(
@@ -843,7 +1291,10 @@ def launch(
         instrument=instrument,
         timer=timer,
         watchdog_s=watchdog_s,
-    ).run(fn, *args)
+        watchdog_escalate=watchdog_escalate,
+        fault_plan=fault_plan,
+        default_retry=default_retry,
+    ).run(fn, *args, deadline_s=deadline_s)
 
 
 def async_(
@@ -853,6 +1304,7 @@ def async_(
     await_: Sequence[Future] = (),
     non_blocking: bool = False,
     escaping: bool = False,
+    retry: Optional[RetryPolicy] = None,
     **kwargs: Any,
 ) -> None:
     """Spawn a task under the current finish scope (hclib::async family,
@@ -865,6 +1317,7 @@ def async_(
         waiting_on=await_,
         non_blocking=non_blocking,
         escaping=escaping,
+        retry=retry,
     )
 
 
@@ -874,6 +1327,7 @@ def async_future(
     at: Optional[Locale] = None,
     await_: Sequence[Future] = (),
     non_blocking: bool = False,
+    retry: Optional[RetryPolicy] = None,
     **kwargs: Any,
 ) -> Future:
     """Spawn and return a future satisfied with the task's return value
@@ -887,6 +1341,7 @@ def async_future(
         waiting_on=await_,
         non_blocking=non_blocking,
         result_promise=p,
+        retry=retry,
     )
     return p.future
 
@@ -897,14 +1352,16 @@ def start_finish() -> Finish:
     return fin
 
 
-def end_finish(fin: Optional[Finish] = None) -> None:
+def end_finish(
+    fin: Optional[Finish] = None, timeout: Optional[float] = None
+) -> None:
     cur = _tls.current_finish
     if fin is None:
         fin = cur
     if fin is None:
         raise RuntimeError("end_finish with no open finish scope")
     try:
-        current_runtime().help_finish(fin)
+        current_runtime().help_finish(fin, timeout=timeout)
     finally:
         # Pop the scope even if draining failed, so later spawns don't check
         # into a dead finish.
@@ -929,10 +1386,12 @@ def end_finish_nonblocking(fin: Optional[Finish] = None) -> Future:
 
 class finish:
     """``with hclib_tpu.finish():`` context manager (hclib::finish,
-    inc/hclib-async.h:550-563)."""
+    inc/hclib-async.h:550-563). ``timeout`` (seconds) bounds the join:
+    past it the scope is cancelled and ``StallError`` raises."""
 
-    def __init__(self) -> None:
+    def __init__(self, timeout: Optional[float] = None) -> None:
         self._fin: Optional[Finish] = None
+        self._timeout = timeout
 
     def __enter__(self) -> Finish:
         self._fin = start_finish()
@@ -942,7 +1401,14 @@ class finish:
         # Drain children even when the body raised, so the scope's tasks are
         # not left running; task failures during the drain are recorded by
         # the runtime and re-raised at launch exit, never swallowed.
-        end_finish(self._fin)
+        try:
+            end_finish(self._fin, timeout=self._timeout)
+        except (CancelledError, StallError):
+            if exc is None:
+                raise
+            # The body already failed with its own (more informative)
+            # exception; the cancellation / timeout still took effect
+            # (the scope is cancelled either way) and must not mask it.
         return False
 
 

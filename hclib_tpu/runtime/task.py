@@ -25,6 +25,8 @@ class Task:
         "locale",
         "non_blocking",
         "result_promise",
+        "retry",
+        "attempt",
     )
 
     def __init__(
@@ -37,6 +39,7 @@ class Task:
         locale: Any = None,
         non_blocking: bool = False,
         result_promise: Any = None,
+        retry: Any = None,
     ) -> None:
         self.fn = fn
         self.args = tuple(args)
@@ -51,19 +54,13 @@ class Task:
         # When set, the task's return value is put() here on completion
         # (hclib_async_future trampoline, src/hclib.c:59-81).
         self.result_promise = result_promise
-
-    def run(self) -> Any:
-        try:
-            result = self.fn(*self.args, **self.kwargs)
-        except BaseException as e:
-            if self.result_promise is not None:
-                # Wake dependents with a failure instead of stranding them
-                # on a never-satisfied promise (which would hang the finish).
-                self.result_promise.poison(e)
-            raise
-        if self.result_promise is not None:
-            self.result_promise.put(result)
-        return result
+        # Resilience (runtime/resilience.py): optional RetryPolicy and the
+        # 0-based execution attempt. Execution itself lives in the
+        # scheduler (Runtime._run_task_body), the ONE place that handles
+        # result-promise put/poison, cancellation skip, retry, and
+        # quarantine.
+        self.retry = retry
+        self.attempt = 0
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         name = getattr(self.fn, "__name__", repr(self.fn))

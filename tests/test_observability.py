@@ -84,17 +84,22 @@ def test_runtime_timer_marks_work_and_search():
     assert sum(t["WORK"] for t in totals) > 0
 
 
-def test_watchdog_reports_stall(capsys):
+def test_watchdog_reports_stall(caplog):
     """A task that sleeps while holding the only path to progress triggers
-    the stall report (the hazard test/deadlock/README documents)."""
-    rt = hc.Runtime(nworkers=1, watchdog_s=0.2)
+    the stall report (the hazard test/deadlock/README documents), routed
+    through logging so tests can assert on it (escalation to StallError
+    is covered in test_resilience.py)."""
+    import logging
+
+    rt = hc.Runtime(nworkers=1, watchdog_s=0.2, watchdog_escalate=False)
 
     def body():
         time.sleep(0.7)  # outstanding work, no task transitions
 
-    rt.run(body)
+    with caplog.at_level(logging.WARNING, logger="hclib_tpu.resilience"):
+        rt.run(body)
     assert rt.stall_reports >= 1
-    assert "watchdog" in capsys.readouterr().err
+    assert any("watchdog" in r.message for r in caplog.records)
 
 
 def test_watchdog_quiet_on_healthy_run():

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Seeded chaos sweep over the resilience subsystem (ISSUE 1, CI tooling).
+
+Runs every failure-injection scenario the runtime claims to survive -
+injected task faults under retry, worker death mid-UTS, runtime deadlines,
+poison-task quarantine, and a procworld peer crash - across one or more
+seeds, and exits nonzero if any scenario fails OR hangs.
+
+Hang enforcement is the tool's own: ``faulthandler.dump_traceback_later``
+arms a process-wide timer that dumps every thread's stack and hard-exits
+(status 1) if the sweep overruns ``--timeout-s``, so a regression that
+re-introduces an unbounded wait fails CI loudly instead of wedging it.
+Each launch additionally runs under its own ``deadline_s`` (the feature
+under test bounding the test).
+
+Usage:
+    python tools/chaos_soak.py                    # fast smoke (tier-1)
+    python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
+
+One JSON line per scenario; a summary line last.
+"""
+
+from __future__ import annotations
+
+import argparse
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import hclib_tpu as hc  # noqa: E402
+from hclib_tpu.models import fib, uts  # noqa: E402
+from hclib_tpu.modules.procworld import (  # noqa: E402
+    ProcWorld,
+    ProcWorldError,
+)
+
+
+class _FakeKV:
+    """Minimal coordination-service stand-in (threads as ranks) so the
+    procworld crash scenario runs in one process with no cluster - the
+    same seam tests/test_procworld_unit.py uses."""
+
+    def __init__(self) -> None:
+        self._kv = {}
+        self._ctr = {}
+        self._cv = threading.Condition()
+
+    def key_value_set_bytes(self, key, val):
+        with self._cv:
+            self._kv[key] = bytes(val)
+            self._cv.notify_all()
+
+    def key_value_try_get_bytes(self, key):
+        with self._cv:
+            if key in self._kv:
+                return self._kv[key]
+        raise RuntimeError(f"NOT_FOUND: key {key} not found")
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        f"DEADLINE_EXCEEDED: GetKeyValue() timed out "
+                        f"with key: {key}"
+                    )
+                self._cv.wait(left)
+            return self._kv[key]
+
+    def key_value_delete(self, key):
+        with self._cv:
+            self._kv.pop(key, None)
+
+    def key_value_increment(self, key, n):
+        with self._cv:
+            self._ctr[key] = self._ctr.get(key, 0) + n
+            return self._ctr[key]
+
+    def wait_at_barrier(self, bid, timeout_ms, *a, **k):
+        raise RuntimeError("UNIMPLEMENTED: no barriers in the soak fake")
+
+
+# ------------------------------------------------------------- scenarios
+
+def scenario_fib_retry(seed: int, scale: str) -> dict:
+    """Injected task faults healed by runtime-default retry."""
+    n = 12 if scale == "smoke" else 18
+    plan = hc.FaultPlan(
+        seed=seed, task_failure_rate=0.15, max_task_failures=50
+    )
+    out = fib.run(
+        n, "finish", nworkers=2,
+        fault_plan=plan,
+        default_retry=hc.RetryPolicy(max_attempts=8, backoff_s=0.0005,
+                                     jitter=0, seed=seed),
+        deadline_s=60.0,
+    )
+    faults = len(plan.trace_key())
+    assert faults > 0, "plan injected nothing; scenario is vacuous"
+    return {"value": out["value"], "faults": faults}
+
+
+def scenario_uts_kill_worker(seed: int, scale: str) -> dict:
+    """Worker thread death mid-UTS; identity re-binds, traversal exact.
+    The kill fires on worker 1's first scheduling poll; on a loaded
+    1-vCPU host the short tree can drain before that thread is ever
+    scheduled, so the kill is raced over a few attempts - every attempt
+    must stay exact, and the kill must land within the attempt budget."""
+    params = uts.T3
+    plan = hc.FaultPlan(
+        seed=seed, kill_worker=1, kill_worker_after=1,
+        steal_delay_rate=0.05, steal_delay_s=0.001,
+    )
+    expect = uts.count_seq(params)[0]
+    attempts = 0
+    for attempts in range(1, 6):
+        nodes, leaves, depth = uts.count_parallel(
+            params, nworkers=4, grain=1,
+            fault_plan=plan, deadline_s=120.0,
+        )
+        assert nodes == expect, f"UTS corrupted: {nodes} != {expect}"
+        if ("kill_worker", 1) in plan.trace_key():
+            break
+    assert ("kill_worker", 1) in plan.trace_key(), "worker never died"
+    return {"nodes": expect, "attempts": attempts,
+            "trace": len(plan.trace_key())}
+
+
+def scenario_deadline(seed: int, scale: str) -> dict:
+    """A wedged program surfaces as StallError in bounded time."""
+    t0 = time.monotonic()
+    try:
+        hc.launch(
+            lambda: hc.Promise().future.wait(), nworkers=2, deadline_s=0.5
+        )
+    except hc.StallError:
+        dt = time.monotonic() - t0
+        assert dt < 10.0, f"deadline enforcement took {dt:.1f}s"
+        return {"bounded_s": round(dt, 3)}
+    raise AssertionError("wedged launch returned without StallError")
+
+
+def scenario_quarantine(seed: int, scale: str) -> dict:
+    """Poison tasks quarantine; the rest of the batch completes."""
+    n = 64 if scale == "smoke" else 512
+    done = []
+    lock = threading.Lock()
+    poison = {i for i in range(n) if i % 13 == seed % 13}
+
+    def body(i):
+        if i in poison:
+            raise ValueError(f"poison item {i}")
+        with lock:
+            done.append(i)
+
+    rt = hc.Runtime(
+        nworkers=4,
+        default_retry=hc.RetryPolicy(max_attempts=2, backoff_s=0,
+                                     jitter=0, quarantine=True),
+    )
+    rt.run(lambda: hc.forasync(body, [n], tile=1), deadline_s=60.0)
+    res = rt.stats_dict()["resilience"]
+    assert len(done) == n - len(poison), (len(done), n, len(poison))
+    assert res["quarantined"] == len(poison), res
+    return {"completed": len(done), "quarantined": res["quarantined"]}
+
+
+def scenario_procworld_crash(seed: int, scale: str) -> dict:
+    """Peer progress-engine crash: the blocked waiter gets a structured
+    ProcWorldError (tombstone/poison), never its full timeout."""
+    kv = _FakeKV()
+    plan = hc.FaultPlan(seed=seed, peer_crash_rank=1, peer_crash_after=0)
+    a = ProcWorld(_client=kv, _rank=0, _size=2, timeout_s=20.0)
+    b = ProcWorld(_client=kv, _rank=1, _size=2, timeout_s=20.0,
+                  fault_plan=plan)
+    try:
+        import numpy as np
+
+        with b._heap_lock:
+            b._heap["x"] = np.zeros(2, np.int32)
+        t0 = time.monotonic()
+        try:
+            a.get(1, "x")
+        except ProcWorldError:
+            dt = time.monotonic() - t0
+            assert dt < 15.0, f"peer-death detection took {dt:.1f}s"
+            return {"detected_s": round(dt, 3)}
+        raise AssertionError("get() against crashed peer succeeded")
+    finally:
+        a.close()
+        b.close()
+
+
+SCENARIOS = [
+    ("fib_retry", scenario_fib_retry),
+    ("uts_kill_worker", scenario_uts_kill_worker),
+    ("deadline", scenario_deadline),
+    ("quarantine", scenario_quarantine),
+    ("procworld_crash", scenario_procworld_crash),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="number of seeds (starting at --seed-base)")
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--scale", choices=("smoke", "soak"), default="smoke")
+    ap.add_argument("--timeout-s", type=float, default=300.0,
+                    help="hard whole-sweep ceiling; overrun = exit 1 "
+                         "with all-thread stack dumps")
+    args = ap.parse_args(argv)
+
+    # The tool's own hang enforcement: dump + hard-exit on overrun.
+    faulthandler.dump_traceback_later(args.timeout_s, exit=True)
+    failures = 0
+    t0 = time.monotonic()
+    for seed in range(args.seed_base, args.seed_base + args.seeds):
+        for name, fn in SCENARIOS:
+            row = {"scenario": name, "seed": seed, "scale": args.scale}
+            ts = time.monotonic()
+            try:
+                row.update(fn(seed, args.scale))
+                row["ok"] = True
+            except Exception as e:  # scenario failed; keep sweeping
+                failures += 1
+                row["ok"] = False
+                row["error"] = f"{type(e).__name__}: {e}"
+            row["seconds"] = round(time.monotonic() - ts, 3)
+            print(json.dumps(row), flush=True)
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps({
+        "summary": True, "failures": failures,
+        "scenarios": len(SCENARIOS) * args.seeds,
+        "seconds": round(time.monotonic() - t0, 3),
+    }), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
