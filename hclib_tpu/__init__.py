@@ -20,6 +20,7 @@ from .runtime import (  # noqa: F401
     RECURSIVE,
     CancelScope,
     CancelledError,
+    DeviceFaultPlan,
     FaultPlan,
     Finish,
     Future,

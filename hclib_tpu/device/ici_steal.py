@@ -53,6 +53,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jaxcompat import shard_map
 from .descriptor import (
     DESC_WORDS,
     F_CSR_N,
@@ -104,6 +105,7 @@ class ICIStealMegakernel:
         migratable_fns: Iterable[int] = (),
         window: int = 8,
         scan: Optional[int] = None,
+        fault_plan=None,
     ) -> None:
         if len(mesh.axis_names) not in (1, 2, 3):
             raise ValueError("ICIStealMegakernel wants a 1D/2D/3D mesh")
@@ -123,7 +125,9 @@ class ICIStealMegakernel:
         # Power-of-two meshes delegate to the unified resident kernel
         # (device/resident.py) in its steal-only, whole-row-migration
         # configuration - this class remains the non-pof2 fallback (and
-        # the named legacy API).
+        # the named legacy API). Seeded device fault injection
+        # (DeviceFaultPlan) lives in the resident kernel's exchange
+        # protocol; the non-pof2 ring supports the abort word only.
         self._resident = None
         if self._pof2:
             from .resident import ResidentKernel
@@ -131,6 +135,13 @@ class ICIStealMegakernel:
             self._resident = ResidentKernel(
                 mk, mesh, steal=True, migratable_fns=self.migratable_fns,
                 homed=False, window=self.window, scan=self.scan,
+                fault_plan=fault_plan,
+            )
+        elif fault_plan is not None and fault_plan.enabled():
+            raise ValueError(
+                "DeviceFaultPlan injection needs a power-of-two mesh (the "
+                "resident kernel's credited hypercube exchange); the "
+                "non-pof2 ring supports only the abort word"
             )
 
     # -- shared kernel helpers --
@@ -257,7 +268,7 @@ class ICIStealMegakernel:
     def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
         mk = self.mk
         ndata = len(mk.data_specs)
-        n_in = 5 + ndata
+        n_in = 6 + ndata  # + abort word (last input)
         in_refs = refs[:n_in]
         out_refs = refs[n_in : n_in + 4 + ndata]
         rest = refs[n_in + 4 + ndata :]
@@ -265,8 +276,9 @@ class ICIStealMegakernel:
         scratch_refs = rest[:nscratch]
         (
             free, vfree, candbuf, sendbuf, inbox, statsnd, statrcv,
-            dsems, csems,
+            abuf, dsems, csems, asem,
         ) = rest[nscratch:]
+        abort_in = in_refs[n_in - 1]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
@@ -294,16 +306,21 @@ class ICIStealMegakernel:
             core, tasks, ready, counts, free, candbuf, sendbuf
         )
 
-        def allreduce(r):
-            """Ring-allreduce of (pending, backlog): every device learns
-            the global totals in ndev-1 hops (the done-flag join,
-            src/hclib-runtime.c:403-421, as an in-kernel collective)."""
+        def allreduce(r, local_abort):
+            """Ring-allreduce of (pending, backlog, abort): every device
+            learns the global totals in ndev-1 hops (the done-flag join,
+            src/hclib-runtime.c:403-421, as an in-kernel collective). The
+            abort word rides the same fold so a host abort exits the
+            WHOLE ring in lockstep one round later - a divergent exit
+            would strand neighbors in the paired exchanges."""
             cur_p = counts[C_PENDING]
             cur_b = counts[C_TAIL] - counts[C_HEAD]
-            tot_p, tot_b = cur_p, cur_b
+            cur_a = local_abort.astype(jnp.int32)
+            tot_p, tot_b, tot_a = cur_p, cur_b, cur_a
             for k in range(ndev - 1):
                 statsnd[0] = cur_p
                 statsnd[1] = cur_b
+                statsnd[2] = cur_a
                 if k > 0:
                     pltpu.semaphore_wait(csems.at[0], 1)
                 else:
@@ -317,6 +334,7 @@ class ICIStealMegakernel:
                 )
                 cur_p = statrcv[0]
                 cur_b = statrcv[1]
+                cur_a = statrcv[2]
                 # Consumed: free the writer (our left neighbor) to send its
                 # next step into our statrcv.
                 pltpu.semaphore_signal(
@@ -325,7 +343,8 @@ class ICIStealMegakernel:
                 )
                 tot_p = tot_p + cur_p
                 tot_b = tot_b + cur_b
-            return tot_p, tot_b
+                tot_a = tot_a + cur_a
+            return tot_p, tot_b, tot_a
 
         def exchange(r, tot_b):
             """One steal hop: send surplus rows to the device at distance
@@ -365,8 +384,13 @@ class ICIStealMegakernel:
         def body(carry):
             r, done = carry
             core.sched(quantum)
-            tot_p, tot_b = allreduce(r)
-            done = tot_p == 0
+            # Host abort word: re-read from HBM inside the round loop, so
+            # an abort stops a running quantum stream within one round.
+            cpa = pltpu.make_async_copy(abort_in, abuf, asem.at[0])
+            cpa.start()
+            cpa.wait()
+            tot_p, tot_b, tot_a = allreduce(r, abuf[0] != 0)
+            done = (tot_p == 0) | (tot_a > 0)
 
             @pl.when(jnp.logical_not(done))
             def _():
@@ -597,7 +621,8 @@ class ICIStealMegakernel:
         ndata = len(mk.data_specs)
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
-        in_specs = [smem()] * 5 + [anyspace()] * ndata
+        # Trailing abort-word input (HBM: the kernel re-reads it per round).
+        in_specs = [smem()] * 5 + [anyspace()] * ndata + [anyspace()]
         out_specs = tuple([smem()] * 4 + [anyspace()] * ndata)
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -644,10 +669,12 @@ class ICIStealMegakernel:
             body = self._kernel
             scratch = base_scratch + [
                 pltpu.SMEM((W + 1, DESC_WORDS), jnp.int32),  # inbox
-                pltpu.SMEM((2,), jnp.int32),  # statsnd
-                pltpu.SMEM((2,), jnp.int32),  # statrcv
+                pltpu.SMEM((4,), jnp.int32),  # statsnd (+ abort word)
+                pltpu.SMEM((4,), jnp.int32),  # statrcv
+                pltpu.SMEM((8,), jnp.int32),  # abuf (abort staging)
                 pltpu.SemaphoreType.DMA((4,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
+                pltpu.SemaphoreType.DMA((1,)),  # asem
             ]
         kern = pl.pallas_call(
             functools.partial(body, quantum, max_rounds),
@@ -659,10 +686,12 @@ class ICIStealMegakernel:
             interpret=interpret_mode() if mk.interpret else False,
         )
 
-        def step(tasks, succ, ring, counts, iv, *data):
+        def step(tasks, succ, ring, counts, iv, *rest):
+            data = rest[:ndata]
+            abort = rest[ndata]
             outs = kern(
                 tasks[0], succ[0], ring[0], counts[0], iv[0],
-                *[d[0] for d in data]
+                *[d[0] for d in data], abort[0]
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
             data_o = outs[4:]
@@ -674,8 +703,8 @@ class ICIStealMegakernel:
                 *[d[None] for d in data_o],
             )
 
-        nin = 5 + ndata
-        f = jax.shard_map(
+        nin = 6 + ndata
+        f = shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(self.axes),) * nin,
@@ -691,30 +720,42 @@ class ICIStealMegakernel:
         ivalues: Optional[np.ndarray] = None,
         quantum: int = 64,
         max_rounds: int = 1 << 14,
+        abort=None,
     ):
         """Execute all partitions fully on-device; returns
-        (ivalues[ndev, V], data, info)."""
+        (ivalues[ndev, V], data, info). ``abort``: host abort word (truthy
+        or per-device flags) - the round loops observe it within one round
+        and the mesh exits in lockstep with ``info['aborted']`` instead of
+        running the workload out."""
         from .sharded import execute_partitions
 
         if self._resident is not None:
             iv_o, data_o, info = self._resident.run(
                 builders, data=data, ivalues=ivalues, quantum=quantum,
-                max_rounds=max_rounds,
+                max_rounds=max_rounds, abort=abort,
             )
             info["steal_rounds"] = info.pop("rounds")
             return iv_o, data_o, info
         key = (quantum, max_rounds)
         if key not in self._jitted:
             self._jitted[key] = self._build(quantum, max_rounds)
+        from .sharded import abort_words
+
+        abort_arr = abort_words(abort, self.ndev)
         iv_o, data_o, info = execute_partitions(
             self.mk, self.mesh, self.ndev, self._jitted[key], builders,
-            data, ivalues, with_rounds=True,
+            data, ivalues, with_rounds=True, extra_inputs=[abort_arr],
         )
+        info.pop("extra_outputs", None)
+        info["aborted"] = bool(abort_arr[:, 0].any()) and info["pending"] != 0
         if info["overflow"]:
             raise RuntimeError("ici steal: task-table overflow")
-        if info["pending"] != 0:
-            raise RuntimeError(
+        if info["pending"] != 0 and not info["aborted"]:
+            from ..runtime.resilience import StallError
+
+            raise StallError(
                 f"ici steal stalled: {info['pending']} pending after "
-                f"{info['executed']} executed ({info['steal_rounds']} rounds)"
+                f"{info['executed']} executed ({info['steal_rounds']} rounds)",
+                stats=info,
             )
         return iv_o, data_o, info

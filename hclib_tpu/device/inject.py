@@ -10,7 +10,14 @@ ring** in HBM that the scheduler polls *from inside the kernel*:
 - ring[R, 256] int32: descriptor rows padded to 1024 B so any row offset is
   a legal dynamic DMA offset (Mosaic wants coarse alignment); row words
   0..15 are the standard descriptor ABI (device/descriptor.py).
-- ctl[8] int32: [0]=tail (total rows ever appended), [1]=close flag.
+- ctl[8] int32: [0]=tail (total rows ever appended), [1]=close flag,
+  [2]=device-consumed cursor (echoed back), [3]=host abort word - polled
+  by the kernel INSIDE its round loop, [4] echoes the round the abort was
+  observed. This driver uploads a fresh ctl copy per entry, so an abort
+  lands at the next ENTRY boundary and the in-kernel poll then bounds the
+  final entry to about one round; the per-round ctl re-read is the device
+  half a zero-copy pinned-host producer would need for true mid-quantum
+  aborts (same status as the ring's pinned-production mode above).
 - Write ordering (the fence contract): the producer writes descriptor rows
   FIRST, then bumps tail - release semantics. The kernel reads tail, then
   DMAs only rows below it - acquire semantics; a row is never read before
@@ -46,6 +53,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime import resilience
 from ..runtime.resilience import CancelledError, StallError
 from .descriptor import DESC_WORDS, NO_TASK, TaskGraphBuilder
 from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
@@ -83,6 +91,16 @@ class StreamingMegakernel:
         self._pending_rows: List[np.ndarray] = []
         self._closed = False
         self._abort_reason: Optional[str] = None
+        self._abort_t: Optional[float] = None
+        # Abort-latency accounting (surfaced by stats_dict): filled by the
+        # run_stream driver when the abort entry returns.
+        self._stats: Dict[str, Any] = {
+            "aborts": 0,
+            "abort_reason": None,
+            "abort_observed_round": None,
+            "abort_latency_s": None,
+            "abort_drain_executed": None,
+        }
 
     # ---- lifecycle (resilience: the ring must never stay open) ----
 
@@ -97,15 +115,26 @@ class StreamingMegakernel:
         return False
 
     def abort(self, reason: str = "aborted") -> None:
-        """Host-side abort flag: stop accepting injections and make the
-        driving run_stream raise ``CancelledError`` at its next entry
-        boundary (the in-kernel scheduler always runs bounded quanta, so
-        the kernel itself drains and exits; remaining queued rows are
-        dropped with the stream)."""
+        """Host-side abort: stop accepting injections and stop the running
+        stream. At its next entry boundary the driving run_stream
+        publishes the ctl abort word and runs ONE final kernel entry - the
+        round loop polls the word and exits within a bounded number of
+        inner iterations, remaining rows dropped - then raises
+        ``CancelledError``. Abort latency (wall time, observed round,
+        tasks drained after the abort) is surfaced by ``stats_dict()``.
+        (The in-kernel per-round poll is what a zero-copy pinned-host
+        producer would need to land an abort mid-entry; this driver's
+        per-entry ctl upload bounds latency at one entry + one round.)"""
         with self._lock:
             if self._abort_reason is None:
                 self._abort_reason = str(reason)
+                self._abort_t = time.monotonic()
             self._closed = True
+
+    def stats_dict(self) -> dict:
+        """Resilience counters for this stream (abort latency included)."""
+        with self._lock:
+            return dict(self._stats)
 
     # ---- producer side (host; any thread) ----
 
@@ -216,33 +245,43 @@ class StreamingMegakernel:
             return consumed, close
 
         def cond(carry):
-            r, consumed, done = carry
+            r, consumed, done, abr = carry
             return jnp.logical_not(done) & (r < max_rounds)
 
         def body(carry):
-            r, consumed, _ = carry
+            r, consumed, _, abr = carry
             core.sched(quantum)
             consumed, close = poll(consumed)
+            # Host abort word (ctl[3]): re-read by the same acquire DMA as
+            # the ring tail, so the abort lands INSIDE the round loop - a
+            # running stream stops within one quantum + poll of the write,
+            # pending work and unconsumed rows abandoned where they stand.
+            aborted = ctlbuf[3] != 0
+            abr = jnp.where(aborted & (abr < 0), r, abr)
             # Nothing runnable and nothing new: exit. The host re-enters
             # while the stream is open; a closed, drained stream is final.
             idle = counts[C_PENDING] == 0
-            done = idle & (consumed == ctlbuf[0])
-            return r + 1, consumed, done
+            done = (idle & (consumed == ctlbuf[0])) | aborted
+            return r + 1, consumed, done, abr
 
         # Initial ctl fetch: the consumed cursor (slot 2) persists across
         # entries through the host-echoed ctl.
         cp0 = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
         cp0.start()
         cp0.wait()
-        _, consumed, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), ctlbuf[2], jnp.bool_(False))
+        _, consumed, _, abr = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), ctlbuf[2], jnp.bool_(False),
+                         jnp.int32(-1))
         )
         # Report progress: consumed count rides the aliased ctl output
-        # (slot 2); tail/close echo through.
+        # (slot 2); tail/close/abort echo through; slot 4 reports the round
+        # the abort word was first observed (-1: never).
         ctl_out[0] = ctlbuf[0]
         ctl_out[1] = ctlbuf[1]
         ctl_out[2] = consumed
-        for i in range(3, 8):
+        ctl_out[3] = ctlbuf[3]
+        ctl_out[4] = abr
+        for i in range(5, 8):
             ctl_out[i] = 0
 
     def _build(self, quantum: int, max_rounds: int):
@@ -306,6 +345,7 @@ class StreamingMegakernel:
         max_rounds: int = 64,
         poll_interval_s: float = 0.001,
         deadline_s: Optional[float] = None,
+        cancel_scope=None,
     ) -> Tuple[np.ndarray, dict]:
         """Run the stream to completion: entries re-enter the resident
         scheduler while the host (any thread) injects; returns after
@@ -314,10 +354,23 @@ class StreamingMegakernel:
         Resilience: ``deadline_s`` bounds the whole stream - past it the
         ring is closed and a structured ``StallError`` raises instead of
         re-entering forever (e.g. a producer that never calls close()).
-        ``abort()`` from any thread raises ``CancelledError`` at the next
-        entry boundary. ANY exception escaping this driver closes the
-        ring, so concurrent producers fail fast on their next inject()
-        instead of queueing rows nobody will ever drain."""
+        ``abort()`` from any thread stops the stream mid-quantum via the
+        ctl abort word (see ``abort``) and raises ``CancelledError``;
+        ``cancel_scope`` ties the stream to a host finish scope - the
+        scope cancelling (e.g. root-finish cancellation, the watchdog's
+        last rung) aborts the stream the same way, through a registered
+        abort hook, so a device stream never outlives its cancelled scope.
+        ANY exception escaping this driver closes the ring, so concurrent
+        producers fail fast on their next inject() instead of queueing
+        rows nobody will ever drain."""
+        unregister = None
+        if cancel_scope is not None:
+            # Register-then-replay (the one implementation, in
+            # runtime/resilience.py): a cancel() racing this registration
+            # still aborts the stream.
+            unregister = resilience.bind_abort_to_scope(
+                self.abort, cancel_scope
+            )
         try:
             return self._run_stream(
                 builder, ivalues, data, quantum, max_rounds,
@@ -327,6 +380,9 @@ class StreamingMegakernel:
             with self._lock:
                 self._closed = True
             raise
+        finally:
+            if unregister is not None:
+                unregister()
 
     def _run_stream(
         self, builder, ivalues, data, quantum, max_rounds,
@@ -366,6 +422,37 @@ class StreamingMegakernel:
                 closed = self._closed
                 abort_reason = self._abort_reason
             if abort_reason is not None:
+                # Publish the ctl abort word and run ONE final entry: the
+                # kernel polls the word inside its round loop and exits
+                # within one quantum's worth of inner iterations, pending
+                # work abandoned where it stands and queued rows dropped.
+                # Then surface latency and raise.
+                e0 = int(state[2][C_EXECUTED])
+                ctl[0] = injected
+                ctl[1] = 1
+                ctl[3] = 1
+                outs = jitted(
+                    jnp.asarray(state[0]), jnp.asarray(succ),
+                    jnp.asarray(state[1]), jnp.asarray(state[2]),
+                    jnp.asarray(state[3]), jnp.asarray(ring),
+                    jnp.asarray(ctl), *[jnp.asarray(d) for d in data_np],
+                )
+                counts_ab = np.asarray(outs[2])
+                ctl_ab = np.asarray(outs[4])
+                with self._lock:
+                    t0 = self._abort_t
+                    self._stats.update({
+                        "aborts": self._stats["aborts"] + 1,
+                        "abort_reason": abort_reason,
+                        "abort_observed_round": int(ctl_ab[4]),
+                        "abort_latency_s": (
+                            None if t0 is None
+                            else round(time.monotonic() - t0, 6)
+                        ),
+                        "abort_drain_executed": (
+                            int(counts_ab[C_EXECUTED]) - e0
+                        ),
+                    })
                 raise CancelledError(f"stream aborted: {abort_reason}")
             if deadline is not None and time.monotonic() >= deadline:
                 raise StallError(

@@ -49,8 +49,28 @@ from .descriptor import (
 
 __all__ = [
     "KernelContext", "Megakernel", "VBLOCK", "decode_overflow",
-    "interpret_mode",
+    "interpret_mode", "fault_mix",
 ]
+
+
+def fault_mix(seed: int, site: int, r, k: int, g):
+    """Deterministic per-mille hash of (seed, site, round, hop, device) for
+    in-kernel fault predicates (the scalar-core analogue of the host
+    FaultPlan's blake2b decision table). ``r`` and ``g`` may be traced
+    int32; ``seed``/``site``/``k`` are static. Every device of a lockstep
+    mesh evaluates the identical value, so seeded injection, its detection,
+    and its recovery all agree on the schedule - the property that makes a
+    chaos run reproducible byte-for-byte from the seed."""
+    x = (
+        r * jnp.int32(-1640531527)          # 0x9E3779B9: round stride
+        + g * jnp.int32(69069)
+        + jnp.int32((k * 40503 + site * 2654435761 + seed * 2246822519)
+                    & 0x7FFFFFFF)
+    )
+    x = x ^ (x >> 13)
+    x = x * jnp.int32(1274126177)
+    x = x ^ (x >> 16)
+    return (x & jnp.int32(0x7FFFFFFF)) % 1000
 
 
 def interpret_mode():

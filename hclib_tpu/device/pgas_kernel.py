@@ -71,6 +71,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jaxcompat import shard_map
 from .descriptor import (
     DESC_WORDS,
     F_A0,
@@ -159,9 +160,11 @@ class PGASMegakernel:
                 am_window=self.am_window, outbox=self.outbox,
                 max_waits=self.max_waits,
             )
-        # Stat-vector layout (ring-allreduced every round; all entries sum).
-        self.ST_AM = 3  # [src * ndev + dst] AM send counts
-        self.ST_DATA = 3 + self.ndev * self.ndev  # [dst * nchan + chan]
+        # Stat-vector layout (ring-allreduced every round; all entries
+        # sum). Slot 3 folds the per-device abort word so a host abort
+        # exits the whole ring in lockstep one round later.
+        self.ST_AM = 4  # [src * ndev + dst] AM send counts
+        self.ST_DATA = 4 + self.ndev * self.ndev  # [dst * nchan + chan]
         self.S = self.ST_DATA + self.ndev * self.nchan
         self._jitted: Dict[Any, Any] = {}
 
@@ -170,7 +173,7 @@ class PGASMegakernel:
     def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
         mk = self.mk
         ndata = len(mk.data_specs)
-        n_in = 6 + ndata  # + waits_in
+        n_in = 7 + ndata  # + waits_in + abort word (last)
         in_refs = refs[:n_in]
         out_refs = refs[n_in : n_in + 4 + ndata]
         rest = refs[n_in + 4 + ndata :]
@@ -180,9 +183,10 @@ class PGASMegakernel:
             free, vfree,
             outq_tgt, outq_desc, ambuf, obctl, inbox, am_sent, am_recv, sent_round,
             data_sent, chan_recv, pstate, wait_tab,
-            statsnd, statrcv, statacc,
-            dsems, am_sem, chan_sems, csem,
+            statsnd, statrcv, statacc, abuf,
+            dsems, am_sem, chan_sems, csem, asem,
         ) = rest[nscratch:]
+        abort_in = in_refs[n_in - 1]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         waits_in = in_refs[5 + ndata]  # waits ride after the data inputs
         tasks, ready, counts, ivalues = out_refs[:4]
@@ -378,6 +382,7 @@ class PGASMegakernel:
             statsnd[0] = counts[C_PENDING]
             statsnd[1] = pstate[PS_RECV]
             statsnd[2] = obctl[1] - obctl[0]
+            statsnd[3] = (abuf[0] != 0).astype(jnp.int32)
 
             def fill_am(t, _):
                 statsnd[ST_AM + me * ndev + t] = am_sent[t]
@@ -542,16 +547,21 @@ class PGASMegakernel:
         def body(carry):
             r, done = carry
             core.sched(quantum)
+            # Host abort word: re-read from HBM inside the round loop and
+            # folded below, so an abort stops the mesh within one round.
+            cpa = pltpu.make_async_copy(abort_in, abuf, asem.at[0])
+            cpa.start()
+            cpa.wait()
             drain_outbox()
             stat_allreduce(r)
             tot_sent = jax.lax.fori_loop(
-                3, S, lambda i, a: a + statacc[i], jnp.int32(0)
+                ST_AM, S, lambda i, a: a + statacc[i], jnp.int32(0)
             )
             done = (
                 (statacc[0] == 0)
                 & (statacc[2] == 0)
                 & (tot_sent == statacc[1])
-            )
+            ) | (statacc[3] > 0)
             # Unconditional: on the done round every delta is zero, and on
             # a max_rounds cutoff this leaves no arrival semaphore
             # unconsumed for announced messages.
@@ -581,6 +591,7 @@ class PGASMegakernel:
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [smem()]
+        in_specs += [anyspace()]  # abort word (HBM: re-read per round)
         out_specs = tuple([smem()] * 4 + [anyspace()] * ndata)
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -622,10 +633,12 @@ class PGASMegakernel:
                 pltpu.SMEM((self.S,), jnp.int32),  # statsnd
                 pltpu.SMEM((self.S,), jnp.int32),  # statrcv
                 pltpu.SMEM((self.S,), jnp.int32),  # statacc
+                pltpu.SMEM((8,), jnp.int32),  # abuf (abort staging)
                 pltpu.SemaphoreType.DMA((4,)),
                 pltpu.SemaphoreType.DMA(()),  # am arrival
                 pltpu.SemaphoreType.DMA((nchan,)),  # channel arrivals
                 pltpu.SemaphoreType.REGULAR,  # ring credit
+                pltpu.SemaphoreType.DMA((1,)),  # asem
             ],
             input_output_aliases=aliases,
             interpret=interpret_mode() if mk.interpret else False,
@@ -634,9 +647,10 @@ class PGASMegakernel:
         def step(tasks, succ, ring, counts, iv, *data_and_waits):
             data_in = data_and_waits[:ndata]
             waits = data_and_waits[ndata]
+            abort = data_and_waits[ndata + 1]
             outs = kern(
                 tasks[0], succ[0], ring[0], counts[0], iv[0],
-                *[d[0] for d in data_in], waits[0],
+                *[d[0] for d in data_in], waits[0], abort[0],
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
             data_o = outs[4:]
@@ -648,8 +662,8 @@ class PGASMegakernel:
                 *[d[None] for d in data_o],
             )
 
-        nin = 6 + ndata
-        f = jax.shard_map(
+        nin = 7 + ndata
+        f = shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
@@ -666,6 +680,7 @@ class PGASMegakernel:
         waits: Optional[Sequence[Sequence[Tuple[int, int, int]]]] = None,
         quantum: int = 64,
         max_rounds: int = 1 << 14,
+        abort=None,
     ):
         """Execute all partitions fully on-device.
 
@@ -674,13 +689,16 @@ class PGASMegakernel:
         dependency satisfied when ``need`` messages have landed on the
         channel. Returns (ivalues[ndev, V], data, info); ``data`` values
         carry a leading device axis (per-device symmetric-heap instances).
+        ``abort``: host abort word (truthy or per-device flags) - the
+        round loops observe it within one round and the mesh exits in
+        lockstep with ``info['aborted']`` instead of draining.
         """
         from .sharded import execute_partitions
 
         if self._resident is not None:
             return self._resident.run(
                 builders, data=data, ivalues=ivalues, waits=waits,
-                quantum=quantum, max_rounds=max_rounds,
+                quantum=quantum, max_rounds=max_rounds, abort=abort,
             )
         mk = self.mk
         ndev = self.ndev
@@ -720,21 +738,30 @@ class PGASMegakernel:
         key = (quantum, max_rounds)
         if key not in self._jitted:
             self._jitted[key] = self._build(quantum, max_rounds)
+        from .sharded import abort_words
+
+        abort_arr = abort_words(abort, ndev)
         iv_o, data_o, info = execute_partitions(
             mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,
-            with_rounds=True, mutate=bump_waits, extra_inputs=[waits_arr],
+            with_rounds=True, mutate=bump_waits,
+            extra_inputs=[waits_arr, abort_arr],
         )
         info["rounds"] = info.pop("steal_rounds")
+        info.pop("extra_outputs", None)
+        info["aborted"] = bool(abort_arr[:, 0].any()) and info["pending"] != 0
         if info["overflow"]:
             raise RuntimeError(
                 "pgas kernel overflow: task table, value slots, outbox, or "
                 "wait table exceeded - raise the limits or coarsen"
             )
-        if info["pending"] != 0:
-            raise RuntimeError(
+        if info["pending"] != 0 and not info["aborted"]:
+            from ..runtime.resilience import StallError
+
+            raise StallError(
                 f"pgas kernel stalled: {info['pending']} pending after "
                 f"{info['executed']} executed ({info['rounds']} rounds) - "
                 "a wait-until whose messages never arrive, or max_rounds "
-                "too small"
+                "too small",
+                stats=info,
             )
         return iv_o, data_o, info

@@ -100,6 +100,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jaxcompat import shard_map
 from .descriptor import (
     DESC_WORDS,
     F_A0,
@@ -117,7 +118,9 @@ from .descriptor import (
     NUM_ARGS,
     TaskGraphBuilder,
 )
+from ..runtime.resilience import DeviceFaultPlan, StallError
 from .megakernel import (
+    fault_mix,
     interpret_mode,
     C_EXECUTED,
     OVF_LOCKQ,
@@ -135,6 +138,7 @@ from .megakernel import (
 
 __all__ = [
     "ResidentKernel",
+    "decode_fault_stats",
     "RC_COMPLETE",
     "RC_FADD",
     "RC_FADD_R",
@@ -167,6 +171,42 @@ def lock_block_slots(qcap: int) -> int:
     return 3 + 2 * int(qcap)
 
 
+# Per-device fault/abort stats row (an extra SMEM output of every run; the
+# device-side fault trace - byte-reproducible from a DeviceFaultPlan seed).
+FS_DROPPED = 0      # credits I (as granter) dropped
+FS_REGEN = 1        # starved-channel waits I skipped (credit regeneration)
+FS_DUPED = 2        # duplicate credits I signalled
+FS_DELAYED = 3      # hops where my export quota was zeroed (delay fault)
+FS_DEAD_ROUND = 4   # round I first quarantined a dead peer (-1: none)
+FS_QMASK = 5        # bitmask of peers I consider dead
+FS_REHOMED = 6      # rows I exported while dead (queue re-homing)
+FS_ABORT_ROUND = 7  # round the folded abort word was observed (-1: none)
+FS_STARVED = 8      # ((hop << 8) | granter) + 1 of my starved channel
+FS_HB = 9           # my final heartbeat
+FS_WORDS = 16
+
+
+def decode_fault_stats(row) -> Dict[str, Any]:
+    """Human shape of one device's FS_* stats row."""
+    row = [int(x) for x in row]
+    st = row[FS_STARVED]
+    return {
+        "credits_dropped": row[FS_DROPPED],
+        "credits_regenerated": row[FS_REGEN],
+        "credits_duplicated": row[FS_DUPED],
+        "xfers_delayed": row[FS_DELAYED],
+        "dead_detected_round": row[FS_DEAD_ROUND],
+        "quarantined": [d for d in range(31) if (row[FS_QMASK] >> d) & 1],
+        "rehomed_rows": row[FS_REHOMED],
+        "abort_round": row[FS_ABORT_ROUND],
+        "starved_channel": (
+            None if st == 0
+            else {"hop": (st - 1) >> 8, "granter": (st - 1) & 0xFF}
+        ),
+        "heartbeat": row[FS_HB],
+    }
+
+
 class ResidentKernel:
     """One resident scheduler per device of a 1D/2D/3D pof2 mesh, composing
     stealing + PGAS + AM/atomics/locks + injection (see module docstring).
@@ -178,6 +218,17 @@ class ResidentKernel:
     ``channels``: as PGASMegakernel - ``{name: (data_buffer, rows)}``.
     ``inject=True`` adds a per-device host injection ring (rows published
     before entry are discovered by the in-kernel poll).
+
+    **Device resilience** (ISSUE 2): every run polls a host-writable abort
+    word (HBM, one per device) inside the round loop and folds it into the
+    termination collective, so ``run(abort=...)`` stops a running mesh
+    within one round in lockstep (``info['aborted']``, per-device abort
+    round in ``info['fault_stats']``). ``fault_plan`` (a seeded
+    ``DeviceFaultPlan``) compiles deterministic fault injection INTO the
+    kernel - dropped/duplicated steal credits with timeout + regeneration,
+    delayed transfers, and a dead chip with heartbeat detection,
+    quarantine, and task re-homing; see the class docstring in
+    runtime/resilience.py. Zero-cost when None.
     """
 
     def __init__(
@@ -197,6 +248,7 @@ class ResidentKernel:
         max_waits: int = 64,
         ring_capacity: int = 256,
         proxy_cap: Optional[int] = None,
+        fault_plan: Optional[DeviceFaultPlan] = None,
     ) -> None:
         if len(mesh.axis_names) not in (1, 2, 3):
             raise ValueError(
@@ -291,18 +343,56 @@ class ResidentKernel:
                 "migration needs num_values > capacity (one result slot "
                 "per row is reserved at the top of the value buffer)"
             )
+        # Compiled-in fault injection (None = no fault code emitted).
+        self.plan = (
+            fault_plan
+            if fault_plan is not None and fault_plan.enabled()
+            else None
+        )
+        if self.plan is not None:
+            if not self.steal:
+                raise ValueError(
+                    "DeviceFaultPlan faults target the steal exchange "
+                    "(credits, dead-chip re-homing): needs steal=True"
+                )
+            if self.ndev > 31:
+                raise ValueError(
+                    f"DeviceFaultPlan supports at most 31 devices (the "
+                    f"quarantine bitmask is one int32 stats word), got "
+                    f"{self.ndev}"
+                )
+            if self.plan.dead_device is not None and not (
+                0 <= self.plan.dead_device < self.ndev
+            ):
+                raise ValueError(
+                    f"dead_device {self.plan.dead_device} out of range for "
+                    f"a {self.ndev}-device mesh"
+                )
         # Stat-vector layout (exchanged every hop). Words [0, SX_AM) are
         # recursive-doubling SUMS; [SX_AM, S_BL) route by the hypercube
         # XOR all-to-all (slot p ends holding source me^p's count);
         # [S_BL] is the sender's CURRENT backlog, read raw per hop.
+        # SF_ABORT/SF_WEDGE fold the per-device abort word and the
+        # starved-channel wedge flag, so a local abort (or an unrecoverable
+        # dropped credit) exits the WHOLE mesh in lockstep one fold later -
+        # a divergent exit would strand partners in the paired exchanges.
         self.SF_PEND = 0
         self.SF_RECV = 1
         self.SF_OUTB = 2
         self.SF_SENT = 3
         self.SF_INJ = 4
-        self.SX_AM = 5
-        self.SX_DATA = 5 + self.ndev
-        self.S_BL = self.SX_DATA + self.ndev * self.nchan
+        self.SF_ABORT = 5
+        self.SF_WEDGE = 6
+        self.SX_AM = 7
+        self.SX_DATA = self.SX_AM + self.ndev
+        nxt = self.SX_DATA + self.ndev * self.nchan
+        if self.plan is not None:
+            # Heartbeat section (dead-chip detection): routed by the same
+            # XOR all-to-all - slot p of device v ends holding v^p's
+            # heartbeat, so every device observes every peer every round.
+            self.SX_HB = nxt
+            nxt += self.ndev
+        self.S_BL = nxt
         self.S = self.S_BL + 1
         self._jitted: Dict[Any, Any] = {}
 
@@ -342,9 +432,9 @@ class ResidentKernel:
     def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
         mk = self.mk
         ndata = len(mk.data_specs)
-        n_in = 6 + ndata + (2 if self.inject else 0)
+        n_in = 7 + ndata + (2 if self.inject else 0)  # + abort word (last)
         in_refs = refs[:n_in]
-        n_out = 4 + ndata + (1 if self.inject else 0)
+        n_out = 5 + ndata + (1 if self.inject else 0)  # + fstats (last)
         out_refs = refs[n_in : n_in + n_out]
         rest = refs[n_in + n_out :]
         nscratch = len(mk.scratch_specs)
@@ -368,16 +458,28 @@ class ResidentKernel:
         (ssems, rsems, csems, am_sems, chan_sems) = take(5)
         if self.inject:
             (isem,) = take(1)
+        (abuf, asem) = take(2)  # abort-word staging + its DMA semaphore
+        plan = self.plan
+        if plan is not None:
+            # Fault-layer state (per steal channel k / per peer device):
+            # pair_down[k] = last round of the current starvation window,
+            # owed[k] = dropped credits not yet compensated by a skipped
+            # wait, cbal[k] = live credit balance (signals in - waits
+            # done; the exit drain consumes exactly this), hb_seen/
+            # hb_round/deadmask = heartbeat detection + quarantine.
+            (pair_down, owed, cbal, hb_seen, hb_round, deadmask) = take(6)
         assert not tail, f"{len(tail)} unconsumed scratch refs"
 
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         waits_in = in_refs[5 + ndata]
         if self.inject:
             iring, ictl = in_refs[6 + ndata], in_refs[7 + ndata]
+        abort_in = in_refs[n_in - 1]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
         if self.inject:
             ctl_out = out_refs[4 + ndata]
+        fstats = out_refs[n_out - 1]
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
 
         ndev = self.ndev
@@ -393,12 +495,62 @@ class ResidentKernel:
             self.SF_PEND, self.SF_RECV, self.SF_OUTB, self.SF_SENT,
             self.SF_INJ,
         )
+        SF_ABORT, SF_WEDGE = self.SF_ABORT, self.SF_WEDGE
         SX_AM, SX_DATA, S_BL, S = self.SX_AM, self.SX_DATA, self.S_BL, self.S
         did_type = self._did_type
         me = self._flat_me()
 
         # pstate slots
         PS_RECV, PS_NWAIT, PS_SENT, PS_PROXIES = 0, 1, 2, 3
+        PS_HB, PS_WEDGE = 4, 5
+
+        # ---- compiled-in fault predicates (None plan emits nothing) ----
+
+        if plan is not None:
+            def _pred(site, millis, exact, r, k, g):
+                """Does fault ``site`` fire at (round r, hop k, granter g)?
+                Pure in (seed, site, r, k, g): every device of the
+                lockstep mesh computes the identical answer, for any
+                (k, g) - injector, victim, and bystanders agree."""
+                p = jnp.bool_(False)
+                if millis > 0:
+                    p = fault_mix(plan.seed, site, r, k, g) < millis
+                for (rr, kk, gg) in exact:
+                    if kk == k:
+                        p = p | ((r == jnp.int32(rr)) & (g == jnp.int32(gg)))
+                return p
+
+            def pred_drop(r, k, g):
+                return _pred(0, plan.drop_millis, plan.drop_credit_at,
+                             r, k, g)
+
+            def pred_dup(r, k, g):
+                return _pred(1, plan.dup_millis, plan.dup_credit_at,
+                             r, k, g)
+
+            def pred_delay(r, k, g):
+                return _pred(2, plan.delay_millis, (), r, k, g)
+
+            def is_dead(r):
+                """Is THIS device the plan's dead chip at round r?"""
+                if plan.dead_device is None:
+                    return jnp.bool_(False)
+                return (me == jnp.int32(plan.dead_device)) & (
+                    r >= jnp.int32(plan.dead_round)
+                )
+
+            if plan.drops_credits() and plan.credit_timeout == 0:
+                # Regeneration disabled: ANY drop wedges the mesh. Every
+                # device evaluates the all-pairs drop schedule, so all
+                # skip the row exchanges of the following round in
+                # lockstep (a starved writer must never reach its wait)
+                # and exit together at the next fold.
+                def any_drop(r):
+                    p = jnp.bool_(False)
+                    for k in range(nh):
+                        for g in range(ndev):
+                            p = p | pred_drop(r, k, jnp.int32(g))
+                    return p
 
         # ---- outbox / active messages ----
 
@@ -559,6 +711,23 @@ class ResidentKernel:
                 chan_tot[c] = 0
             for i in range(8):
                 pstate[i] = 0
+            for i in range(FS_WORDS):
+                fstats[i] = 0
+            fstats[FS_DEAD_ROUND] = -1
+            fstats[FS_ABORT_ROUND] = -1
+            if plan is not None:
+                for k in range(nh):
+                    pair_down[k] = -1
+                    owed[k] = 0
+                    cbal[k] = 0
+
+                def zf(i, _):
+                    hb_seen[i] = 0
+                    hb_round[i] = 0
+                    deadmask[i] = 0
+                    return 0
+
+                jax.lax.fori_loop(0, ndev, zf, 0)
             pstate[PS_NWAIT] = waits_in[0, 0]
             obctl[0] = 0
             obctl[1] = 0
@@ -1015,17 +1184,21 @@ class ResidentKernel:
 
         # ---- the fold + steal hops ----
 
-        def fold_and_steal(r, inj_backlog):
+        def fold_and_steal(r, inj_backlog, am_dead, local_abort):
             statacc[SF_PEND] = counts[C_PENDING]
             statacc[SF_RECV] = pstate[PS_RECV]
             statacc[SF_OUTB] = obctl[1] - obctl[0]
             statacc[SF_SENT] = pstate[PS_SENT]
             statacc[SF_INJ] = inj_backlog
+            statacc[SF_ABORT] = local_abort.astype(jnp.int32)
+            statacc[SF_WEDGE] = pstate[PS_WEDGE]
 
             def f1(p, _):
                 statacc[SX_AM + p] = am_sent[me ^ p]
                 for c in range(nchan):
                     statacc[SX_DATA + p * nchan + c] = data_sent[me ^ p, c]
+                if plan is not None:
+                    statacc[self.SX_HB + p] = pstate[PS_HB]
                 return 0
 
             jax.lax.fori_loop(0, ndev, f1, 0)
@@ -1052,7 +1225,7 @@ class ResidentKernel:
                 )
                 rdma.start()
                 rdma.wait()
-                for i in range(SX_AM):  # the five scalar sums
+                for i in range(SX_AM):  # the scalar sums (incl abort/wedge)
                     statacc[i] = statacc[i] + statrcv[k][i]
 
                 def mrg(p, _, k=k):
@@ -1064,6 +1237,10 @@ class ResidentKernel:
                         for c in range(nchan):
                             statacc[SX_DATA + p * nchan + c] = statrcv[k][
                                 SX_DATA + p * nchan + c
+                            ]
+                        if plan is not None:
+                            statacc[self.SX_HB + p] = statrcv[k][
+                                self.SX_HB + p
                             ]
 
                     return 0
@@ -1089,28 +1266,187 @@ class ResidentKernel:
                     quota = jnp.where(
                         starving, jnp.clip((myb - peer_b + 1) // 2, 0, W), 0
                     )
-                    sendbuf[W, 0] = 0
+                    if plan is None:
+                        sendbuf[W, 0] = 0
 
-                    @pl.when(quota > 0)
+                        @pl.when(quota > 0)
+                        def _():
+                            sendbuf[W, 0] = export(quota)
+
+                        @pl.when(r > 0)
+                        def _(k=k):
+                            pltpu.semaphore_wait(csems.at[2 * k + 1], 1)
+
+                        rdma2 = pltpu.make_async_remote_copy(
+                            src_ref=sendbuf, dst_ref=inboxes[k],
+                            send_sem=ssems.at[1], recv_sem=rsems.at[2 * k + 1],
+                            device_id=pdev, device_id_type=did_type,
+                        )
+                        rdma2.start()
+                        rdma2.wait()
+                        import_rows(inboxes[k])
+                        pltpu.semaphore_signal(
+                            csems.at[2 * k + 1], inc=1, device_id=pdev,
+                            device_id_type=did_type,
+                        )
+                    else:
+                        # ---- faulty row exchange. Granter ids are
+                        # ABSOLUTE device ids, so both endpoints (and any
+                        # bystander) evaluate identical predicates: my
+                        # partner grants my channel's credits, I grant
+                        # theirs.
+                        drop_mine = pred_drop(r, k, partner)
+                        drop_theirs = pred_drop(r, k, me)
+                        dup_mine = jnp.logical_not(drop_mine) & pred_dup(
+                            r, k, partner
+                        )
+                        dup_theirs = jnp.logical_not(drop_theirs) & pred_dup(
+                            r, k, me
+                        )
+                        delay_me = pred_delay(r, k, me)
+                        # A starvation window downs the PAIR's hop-k row
+                        # exchange (both sides skip: the paired DMA needs
+                        # both writers) - the visible cost of credit
+                        # detection latency. A global wedge (timeout 0)
+                        # downs every exchange until the lockstep exit.
+                        down = (r <= pair_down[k]) | (
+                            pstate[PS_WEDGE] != 0
+                        )
+                        quota = jnp.where(delay_me, 0, quota)
+                        if plan.dead_device is not None:
+                            # Quarantine: no work to a dead partner; the
+                            # dead chip itself re-homes its whole backlog
+                            # regardless of demand.
+                            quota = jnp.where(
+                                deadmask[partner] != 0, 0, quota
+                            )
+                            quota = jnp.where(
+                                am_dead, jnp.clip(myb, 0, W), quota
+                            )
+
+                        @pl.when(jnp.logical_not(down))
+                        def _(k=k, quota=quota, partner=partner, pdev=pdev,
+                              drop_mine=drop_mine, drop_theirs=drop_theirs,
+                              dup_mine=dup_mine, dup_theirs=dup_theirs,
+                              delay_me=delay_me):
+                            fstats[FS_DELAYED] = fstats[
+                                FS_DELAYED
+                            ] + delay_me.astype(jnp.int32)
+                            sendbuf[W, 0] = 0
+
+                            @pl.when(quota > 0)
+                            def _():
+                                sendbuf[W, 0] = export(quota)
+
+                            if plan.dead_device is not None:
+                                fstats[FS_REHOMED] = fstats[
+                                    FS_REHOMED
+                                ] + jnp.where(am_dead, sendbuf[W, 0], 0)
+                            # Credit wait, with REGENERATION: one wait is
+                            # skipped per owed (dropped) credit. Safe: the
+                            # partner consumed our inbox before dropping
+                            # its signal, so the write below cannot
+                            # overwrite an unconsumed transfer.
+                            skip = owed[k] > 0
+
+                            @pl.when((r > 0) & jnp.logical_not(skip))
+                            def _(k=k):
+                                pltpu.semaphore_wait(csems.at[2 * k + 1], 1)
+                                cbal[k] = cbal[k] - 1
+
+                            @pl.when((r > 0) & skip)
+                            def _(k=k):
+                                owed[k] = owed[k] - 1
+                                fstats[FS_REGEN] = fstats[FS_REGEN] + 1
+
+                            rdma2 = pltpu.make_async_remote_copy(
+                                src_ref=sendbuf, dst_ref=inboxes[k],
+                                send_sem=ssems.at[1],
+                                recv_sem=rsems.at[2 * k + 1],
+                                device_id=pdev, device_id_type=did_type,
+                            )
+                            rdma2.start()
+                            rdma2.wait()
+                            import_rows(inboxes[k])
+
+                            # FAULT SITE: the credit I owe my partner
+                            # after consuming its transfer.
+                            @pl.when(jnp.logical_not(drop_theirs))
+                            def _(k=k):
+                                pltpu.semaphore_signal(
+                                    csems.at[2 * k + 1], inc=1,
+                                    device_id=pdev, device_id_type=did_type,
+                                )
+
+                            @pl.when(dup_theirs)
+                            def _(k=k):
+                                pltpu.semaphore_signal(
+                                    csems.at[2 * k + 1], inc=1,
+                                    device_id=pdev, device_id_type=did_type,
+                                )
+                                fstats[FS_DUPED] = fstats[FS_DUPED] + 1
+
+                            @pl.when(drop_theirs)
+                            def _():
+                                fstats[FS_DROPPED] = fstats[FS_DROPPED] + 1
+
+                            # Deterministic mirror of the partner's signal
+                            # decisions: the live balance the exit drain
+                            # consumes (signals in - waits done).
+                            cbal[k] = cbal[k] + jnp.where(
+                                drop_mine, 0, 1 + dup_mine.astype(jnp.int32)
+                            )
+
+                            @pl.when(drop_mine)
+                            def _(k=k, partner=partner):
+                                owed[k] = owed[k] + 1
+                                if plan.credit_timeout == 0:
+                                    st = (jnp.int32(k << 8) | partner) + 1
+                                    fstats[FS_STARVED] = jnp.where(
+                                        fstats[FS_STARVED] == 0, st,
+                                        fstats[FS_STARVED],
+                                    )
+
+                            if plan.credit_timeout > 0:
+
+                                @pl.when(drop_mine | drop_theirs)
+                                def _(k=k):
+                                    pair_down[k] = r + jnp.int32(
+                                        plan.credit_timeout
+                                    )
+
+            if plan is not None and plan.dead_device is not None:
+                # Heartbeat detection (GENUINE, not oracle-driven: it
+                # observes only the folded heartbeat words): quarantine
+                # any peer whose heartbeat has not advanced for
+                # heartbeat_timeout rounds. Quarantined ids leave the
+                # eligibility side of the steal exchange next round.
+                def det(p, _):
+                    src = me ^ p
+                    hb = statacc[self.SX_HB + p]
+                    changed = hb != hb_seen[src]
+                    hb_seen[src] = hb
+                    hb_round[src] = jnp.where(changed, r, hb_round[src])
+                    stale = (
+                        r - hb_round[src]
+                        >= jnp.int32(plan.heartbeat_timeout)
+                    ) & (src != me)
+                    newly = stale & (deadmask[src] == 0)
+
+                    @pl.when(newly)
                     def _():
-                        sendbuf[W, 0] = export(quota)
+                        deadmask[src] = 1
+                        fstats[FS_QMASK] = fstats[FS_QMASK] | (
+                            jnp.int32(1) << src
+                        )
+                        fstats[FS_DEAD_ROUND] = jnp.where(
+                            fstats[FS_DEAD_ROUND] < 0, r,
+                            fstats[FS_DEAD_ROUND],
+                        )
 
-                    @pl.when(r > 0)
-                    def _(k=k):
-                        pltpu.semaphore_wait(csems.at[2 * k + 1], 1)
+                    return 0
 
-                    rdma2 = pltpu.make_async_remote_copy(
-                        src_ref=sendbuf, dst_ref=inboxes[k],
-                        send_sem=ssems.at[1], recv_sem=rsems.at[2 * k + 1],
-                        device_id=pdev, device_id_type=did_type,
-                    )
-                    rdma2.start()
-                    rdma2.wait()
-                    import_rows(inboxes[k])
-                    pltpu.semaphore_signal(
-                        csems.at[2 * k + 1], inc=1, device_id=pdev,
-                        device_id_type=did_type,
-                    )
+                jax.lax.fori_loop(0, ndev, det, 0)
 
         # ---- the round loop ----
 
@@ -1130,20 +1466,48 @@ class ResidentKernel:
 
         def body(carry):
             r, done, consumed = carry
-            core.sched(quantum)
+            # Dead chip: the scalar-core scheduler is wedged (fuel 0, no
+            # heartbeat tick) but the wire - exchanges, drains, re-homing
+            # exports - stays up, like a real chip whose ICI router
+            # outlives its core.
+            am_dead = is_dead(r) if plan is not None else jnp.bool_(False)
+            core.sched(jnp.where(am_dead, 0, quantum))
+            pstate[PS_HB] = pstate[PS_HB] + jnp.where(am_dead, 0, 1)
             if self.inject:
                 consumed = poll(consumed)
                 inj_backlog = ctlbuf[0] - consumed
             else:
                 inj_backlog = jnp.int32(0)
+            # Host abort word: re-read from HBM every round, folded into
+            # the termination collective below so the whole mesh exits in
+            # lockstep within one fold of the write landing.
+            cpa = pltpu.make_async_copy(abort_in, abuf, asem.at[0])
+            cpa.start()
+            cpa.wait()
+            local_abort = abuf[0] != 0
             drain_outbox()
-            fold_and_steal(r, inj_backlog)
+            fold_and_steal(r, inj_backlog, am_dead, local_abort)
+            aborted = statacc[SF_ABORT] > 0
+            fstats[FS_ABORT_ROUND] = jnp.where(
+                aborted & (fstats[FS_ABORT_ROUND] < 0), r,
+                fstats[FS_ABORT_ROUND],
+            )
             done = (
                 (statacc[SF_PEND] == 0)
                 & (statacc[SF_OUTB] == 0)
                 & (statacc[SF_INJ] == 0)
                 & (statacc[SF_SENT] == statacc[SF_RECV])
-            )
+            ) | aborted | (statacc[SF_WEDGE] > 0)
+            if plan is not None and (
+                plan.drops_credits() and plan.credit_timeout == 0
+            ):
+                # Unrecoverable drop anywhere this round: every device
+                # raises the wedge flag for the next fold and skips all
+                # row exchanges meanwhile (a starved writer must never
+                # reach its wait).
+                pstate[PS_WEDGE] = pstate[PS_WEDGE] | any_drop(r).astype(
+                    jnp.int32
+                )
             # Unconditional: on the done round every delta is zero; on a
             # max_rounds cutoff this consumes every announced arrival.
             drain_receives()
@@ -1160,11 +1524,25 @@ class ResidentKernel:
             ctl_out[2] = consumed
             for i in range(3, 8):
                 ctl_out[i] = 0
+        if plan is not None:
+            fstats[FS_HB] = pstate[PS_HB]
         # Credit drain: every executed round ran every hop, and the first
         # send of each credited channel never waited - exactly one
-        # outstanding credit per used channel once any round ran.
+        # outstanding credit per used channel once any round ran. Under a
+        # fault plan the row channels drain their TRACKED balance instead
+        # (signals received minus waits done): drops, dups, regeneration,
+        # and down rounds all move it, and it must reach zero here or the
+        # kernel cannot exit - the protocol's own conservation check.
         for k in range(2 * nh):
             if not self.steal and k % 2 == 1:
+                continue
+            if plan is not None and k % 2 == 1:
+
+                def one(i, _, k=k):
+                    pltpu.semaphore_wait(csems.at[k], 1)
+                    return 0
+
+                jax.lax.fori_loop(0, cbal[k // 2], one, 0)
                 continue
 
             @pl.when(r >= 1)
@@ -1183,6 +1561,7 @@ class ResidentKernel:
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [smem()]
         if self.inject:
             in_specs += [anyspace(), anyspace()]  # iring, ictl (HBM)
+        in_specs += [anyspace()]  # abort word (HBM: re-read every round)
         out_specs = [smem()] * 4 + [anyspace()] * ndata
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -1197,6 +1576,9 @@ class ResidentKernel:
         if self.inject:
             out_specs.append(smem())
             out_shape.append(jax.ShapeDtypeStruct((8,), jnp.int32))
+        # Per-device fault/abort stats (FS_* words), always last.
+        out_specs.append(smem())
+        out_shape.append(jax.ShapeDtypeStruct((FS_WORDS,), jnp.int32))
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
             aliases[5 + i] = 4 + i
@@ -1243,6 +1625,20 @@ class ResidentKernel:
         ]
         if self.inject:
             scratch += [pltpu.SemaphoreType.DMA((2,))]  # isem
+        scratch += [
+            pltpu.SMEM((8,), jnp.int32),  # abuf (abort-word staging)
+            pltpu.SemaphoreType.DMA((1,)),  # asem
+        ]
+        if self.plan is not None:
+            nhk = max(1, nh)
+            scratch += [
+                pltpu.SMEM((nhk,), jnp.int32),  # pair_down
+                pltpu.SMEM((nhk,), jnp.int32),  # owed
+                pltpu.SMEM((nhk,), jnp.int32),  # cbal
+                pltpu.SMEM((ndev,), jnp.int32),  # hb_seen
+                pltpu.SMEM((ndev,), jnp.int32),  # hb_round
+                pltpu.SMEM((ndev,), jnp.int32),  # deadmask
+            ]
         kern = pl.pallas_call(
             functools.partial(self._kernel, quantum, max_rounds),
             out_shape=tuple(out_shape),
@@ -1265,17 +1661,19 @@ class ResidentKernel:
             )
             counts_o, iv_o = outs[2], outs[3]
             data_o = outs[4 : 4 + ndata]
+            fstats_o = outs[-1]
             gcounts = jax.lax.psum(counts_o, axes)
             return (
                 counts_o[None],
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
+                fstats_o[None],
             )
 
-        nin = 6 + ndata + (2 if self.inject else 0)
-        nout = 3 + ndata
-        f = jax.shard_map(
+        nin = 7 + ndata + (2 if self.inject else 0)
+        nout = 4 + ndata
+        f = shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(axes),) * nin,
@@ -1293,6 +1691,7 @@ class ResidentKernel:
         inject_rows: Optional[Sequence[Sequence[Tuple]]] = None,
         quantum: int = 64,
         max_rounds: int = 1 << 14,
+        abort=None,
     ):
         """Execute all partitions fully on-device.
 
@@ -1302,6 +1701,17 @@ class ResidentKernel:
         before entry (requires ``inject=True``); the in-kernel poll
         discovers and installs them mid-run. Returns
         (ivalues[ndev, V], data, info).
+
+        ``abort``: the host abort word - truthy (or a per-device sequence
+        of flags) makes every round loop observe the abort inside one
+        round and the mesh exit in lockstep with ``info['aborted']``
+        (pending work abandoned, no stall raise). The kernels re-read the
+        word from HBM every round, which is what a host with in-place
+        device-buffer write access would need to stop a mesh mid-run;
+        through this driver the word is uploaded at entry.
+        ``info['fault_stats']`` carries
+        each device's FS_* trace (abort round, credits dropped/regenerated/
+        duplicated, quarantine mask, re-homed rows, heartbeat).
         """
         from .sharded import execute_partitions
 
@@ -1346,6 +1756,11 @@ class ResidentKernel:
             extra += [iring, ictl]
         elif inject_rows:
             raise ValueError("inject_rows requires inject=True")
+        from .sharded import abort_words
+
+        abort_arr = abort_words(abort, ndev)
+        abort_requested = bool(abort_arr[:, 0].any())
+        extra += [abort_arr]
 
         def bump_waits(tasks, succ, ring, counts):
             # Symmetric-heap layout: host value slots occupy the SAME range
@@ -1389,6 +1804,10 @@ class ResidentKernel:
             with_rounds=True, mutate=bump_waits, extra_inputs=extra,
         )
         info["rounds"] = info.pop("steal_rounds")
+        frows = info.pop("extra_outputs")[-1]
+        fs = [decode_fault_stats(frows[d]) for d in range(ndev)]
+        info["fault_stats"] = fs
+        info["aborted"] = any(f["abort_round"] >= 0 for f in fs)
         if info["overflow"]:
             from .megakernel import decode_overflow
 
@@ -1404,11 +1823,32 @@ class ResidentKernel:
                 "in-flight-proxy rows - raise capacity, shrink the steal "
                 "window, or raise am_window to drain completions faster"
             )
-        if info["pending"] != 0:
-            raise RuntimeError(
+        starved = [(d, f["starved_channel"]) for d, f in enumerate(fs)
+                   if f["starved_channel"] is not None]
+        if starved and info["pending"] != 0:
+            d, ch = starved[0]
+            raise StallError(
+                f"ici steal credit starved: device {d}'s hop-{ch['hop']} "
+                f"channel lost a flow-control credit from granter device "
+                f"{ch['granter']} with regeneration disabled "
+                f"(credit_timeout=0); mesh exited in lockstep with "
+                f"{info['pending']} pending",
+                stats=info,
+            )
+        if info["pending"] != 0 and not (abort_requested or info["aborted"]):
+            suspects = sorted({
+                p for f in fs for p in f["quarantined"]
+            })
+            suspect = (
+                f" suspect chip: device {suspects[0]} (quarantined by "
+                f"heartbeat timeout; its unmigratable work cannot re-home)."
+                if suspects else ""
+            )
+            raise StallError(
                 f"resident kernel stalled: {info['pending']} pending after "
                 f"{info['executed']} executed ({info['rounds']} rounds) - "
                 "a wait/lock whose release never comes, or max_rounds too "
-                "small"
+                f"small.{suspect}",
+                stats=info,
             )
         return iv_o, data_o, info

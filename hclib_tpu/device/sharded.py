@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..jaxcompat import shard_map
 from .descriptor import (
     DESC_WORDS,
     F_CSR_N,
@@ -56,7 +57,32 @@ __all__ = [
     "ShardedMegakernel",
     "round_robin_partition",
     "partition_builders",
+    "abort_words",
 ]
+
+
+def abort_words(abort, ndev: int) -> np.ndarray:
+    """Normalize a runner's ``abort=`` argument (None / truthy scalar /
+    per-device sequence of flags) into the (ndev, 8) int32 abort-word
+    array the round-loop kernels re-read from HBM. One definition so the
+    length validation applies to every runner."""
+    arr = np.zeros((ndev, 8), np.int32)
+    if abort is None:
+        return arr
+    if isinstance(abort, np.ndarray) and abort.ndim == 0:
+        abort = bool(abort)  # 0-d array: a scalar flag, not a sequence
+    flags = (
+        list(abort)
+        if isinstance(abort, (list, tuple, np.ndarray))
+        else [abort] * ndev
+    )
+    if len(flags) != ndev:
+        raise ValueError(
+            f"abort wants {ndev} per-device flags, got {len(flags)}"
+        )
+    for d, f in enumerate(flags):
+        arr[d, 0] = 1 if f else 0
+    return arr
 
 
 def partition_builders(
@@ -125,7 +151,8 @@ def execute_partitions(
         *[put(x) for x in extra_inputs],
     )
     counts_o, iv_o, gcounts = outs[0], outs[1], outs[2]
-    data_o = dict(zip(mk.data_specs.keys(), outs[3:]))
+    nd = len(mk.data_specs)
+    data_o = dict(zip(mk.data_specs.keys(), outs[3 : 3 + nd]))
     g = np.asarray(gcounts)[0]  # identical on every row
     info = {
         "executed": int(g[C_EXECUTED]),
@@ -133,6 +160,9 @@ def execute_partitions(
         "overflow": bool(g[C_OVERFLOW]),
         "per_device_counts": np.asarray(counts_o),
     }
+    # Runner-specific trailing outputs (e.g. the resident kernel's
+    # per-device fault/abort stats) ride after the data buffers.
+    info["extra_outputs"] = [np.asarray(x) for x in outs[3 + nd :]]
     if with_rounds:
         info["steal_rounds"] = int(np.asarray(counts_o)[0][C_ROUNDS])
     return np.asarray(iv_o), data_o, info
@@ -189,7 +219,7 @@ class ShardedMegakernel:
             )
 
         nin = 5 + ndata
-        f = jax.shard_map(
+        f = shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
@@ -347,7 +377,7 @@ class ShardedMegakernel:
             )
 
         nin = 5 + ndata
-        f = jax.shard_map(
+        f = shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
@@ -391,6 +421,7 @@ class ShardedMegakernel:
             self.mk, self.mesh, self.ndev, self._jitted[key], builders,
             data, ivalues, with_rounds=steal,
         )
+        info.pop("extra_outputs", None)  # internal plumbing, no trailing
         if info["overflow"]:
             raise RuntimeError("sharded megakernel task-table overflow")
         if info["pending"] != 0:

@@ -1,0 +1,93 @@
+"""Version-compatibility shims for the jax API surface this package uses.
+
+The package targets jax >= 0.5 but must *degrade structurally* on older
+builds (part of the resilience story: a missing API surfaces as a skipped
+capability or a clear error naming the requirement, never an
+``AttributeError`` deep inside a kernel build):
+
+- ``shard_map``: moved to ``jax.shard_map`` (with ``check_vma``) in 0.5;
+  older builds carry it at ``jax.experimental.shard_map`` (``check_rep``).
+- ``distributed_is_initialized``: ``jax.distributed.is_initialized`` does
+  not exist on 0.4.x; the private global state carries the same fact.
+- ``has_mosaic_interpret``: the Mosaic TPU interpret mode
+  (``pltpu.InterpretParams`` - simulated remote DMA + semaphores on CPU)
+  appeared after 0.4.x. Kernels that simulate an ICI mesh need it; callers
+  and tests gate on this instead of crashing mid-trace.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "shard_map",
+    "axis_size",
+    "distributed_is_initialized",
+    "is_multiprocess_capability_error",
+    "has_mosaic_interpret",
+]
+
+
+def axis_size(axis) -> int:
+    """``jax.lax.axis_size`` where available; older builds derive it from
+    the bound mesh axis env (same value, the public pre-0.5 idiom)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    from jax._src import core as _core
+
+    return _core.get_axis_env().axis_size(axis)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` where available, else the 0.4.x
+    ``jax.experimental.shard_map`` spelling (``check_vma`` -> ``check_rep``:
+    same replication-check knob, renamed upstream)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` on any supported jax."""
+    d = jax.distributed
+    if hasattr(d, "is_initialized"):
+        return bool(d.is_initialized())
+    try:  # 0.4.x: the distributed client exists iff initialize() ran
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
+def is_multiprocess_capability_error(e: BaseException) -> bool:
+    """True for errors a backend raises LOCALLY, at dispatch, because it
+    cannot run multiprocess device computations at all (CPU pre-gloo
+    jaxlib). Deterministic on every rank - the one failure class a
+    committed collective may jointly degrade from. Matched by the two
+    SPECIFIC messages of that class (the raw XLA dispatch error and this
+    package's structured wrapper), never by a bare status prefix: an
+    unrelated rank-local UNIMPLEMENTED must stay fatal, or one rank would
+    solo-fallback while its peers sit in the device collective."""
+    msg = str(e)
+    return (
+        "Multiprocess computations aren't implemented" in msg
+        or "bulk device collectives are unavailable" in msg
+    )
+
+
+def has_mosaic_interpret() -> bool:
+    """True when the Mosaic TPU interpret mode (``pltpu.InterpretParams``)
+    exists - required by every kernel that simulates remote DMA +
+    semaphores on CPU (device/resident.py and friends)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return hasattr(pltpu, "InterpretParams")

@@ -66,6 +66,13 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+# A backend that cannot run multiprocess computations fails LOCALLY at
+# dispatch, identically on every rank of a committed collective - the one
+# failure class where a joint fallback is safe (see allreduce). Anything
+# raised mid-collective stays fatal.
+from ..jaxcompat import (
+    is_multiprocess_capability_error as _bulk_capability_error,
+)
 from ..runtime.module import Module
 
 __all__ = ["ProcWorld", "ProcWorldError", "ProcWorldModule"]
@@ -115,6 +122,46 @@ def _status(e: BaseException) -> str:
     return head if head in _GRPC_STATUSES else "UNKNOWN"
 
 
+class _ClientCompat:
+    """Adapter for older ``DistributedRuntimeClient`` builds (jaxlib
+    0.4.x) that lack ``key_value_try_get_bytes``: emulated with a
+    non-blocking parent-directory listing (one RPC; a blocking-get
+    emulation measured orders slower under progress-loop polling). Every
+    other method proxies through unchanged. (The op queue itself needs no
+    atomic increment on any build - per-source sequencing, see
+    ``_post_op``.)
+
+    Known limit: a directory listing transfers its VALUES, so probing a
+    deep per-source op backlog re-downloads queued payloads - O(backlog)
+    bytes per idle probe on these legacy builds. A hint-key protocol was
+    tried and reverted: these clients' ``key_value_set`` is INSERT-only
+    (ALREADY_EXISTS on overwrite), so no cheap mutable counter exists.
+    The progress loop drains each source to its first miss, which keeps
+    probes per APPLIED op at one; only sustained deep backlogs on 0.4.x
+    pay the listing cost."""
+
+    __slots__ = ("_c",)
+
+    def __init__(self, c) -> None:
+        self._c = c
+
+    def __getattr__(self, name):
+        return getattr(self._c, name)
+
+    def key_value_try_get_bytes(self, key):
+        parent = key.rsplit("/", 1)[0] + "/"
+        for k, v in self._c.key_value_dir_get_bytes(parent):
+            if k == key:
+                return v
+        raise RuntimeError(f"NOT_FOUND: {key} (dir-scan emulation)")
+
+
+def _adapt_client(c):
+    return c if hasattr(c, "key_value_try_get_bytes") else _ClientCompat(c)
+
+
+
+
 class ProcWorld:
     """Rank-per-process communication world (requires an initialized
     jax.distributed runtime; see parallel/multihost.init_multihost).
@@ -151,12 +198,14 @@ class ProcWorld:
             import jax
             from jax._src import distributed
 
-            if not jax.distributed.is_initialized():
+            from ..jaxcompat import distributed_is_initialized
+
+            if not distributed_is_initialized():
                 raise RuntimeError(
                     "ProcWorld needs jax.distributed initialized "
                     "(parallel.multihost.init_multihost)"
                 )
-            self._c = distributed.global_state.client
+            self._c = _adapt_client(distributed.global_state.client)
             self.rank = jax.process_index()
             self.size = jax.process_count()
             self._native_runtime = True
@@ -177,7 +226,13 @@ class ProcWorld:
         self._heap: Dict[str, np.ndarray] = {}
         self._heap_lock = threading.Lock()
         self._handlers: Dict[str, Callable] = {}
-        self._applied = 0  # ops applied by the progress thread, in order
+        self._applied = 0  # total ops applied by the progress thread
+        # Per-source op cursors: the op queue is sequenced per (src, dst)
+        # stream (see _post_op), so the consumer tracks one dense cursor
+        # per source and the producer needs no service-side increment.
+        self._op_seq: Dict[int, int] = {}
+        self._applied_src = [0] * self.size
+        self._bulk_broken: Optional[str] = None  # see _bulk_usable
         # Chaos (runtime/resilience.FaultPlan): may kill this rank's
         # progress engine on cue, exercising tombstones + reply poisoning.
         self._fault_plan = fault_plan
@@ -411,10 +466,23 @@ class ProcWorld:
                                         round_base=100)
             if int(agreed) == 1:
                 # All ranks committed to the device collective; a failure
-                # inside it is fatal (raise), never a silent solo fallback.
+                # inside it is fatal (raise), never a silent solo fallback
+                # - EXCEPT a deterministic local capability error: a
+                # backend that cannot run multiprocess computations at all
+                # (CPU pre-gloo jaxlib) rejects the dispatch on EVERY rank
+                # before any cross-rank rendezvous, so a collective
+                # fallback to the KV path is consistent, and later epochs
+                # vote KV outright (_bulk_broken).
                 from ..parallel.multihost import bulk_allreduce
 
-                out = bulk_allreduce(arr, op)
+                try:
+                    out = bulk_allreduce(arr, op)
+                except Exception as exc:
+                    if not _bulk_capability_error(exc):
+                        raise
+                    self._bulk_broken = f"{type(exc).__name__}: {exc}"
+                    self.last_allreduce_path = "kv-fallback"
+                    return self._kv_allreduce(e, arr, fn, round_base=0)
                 self.last_allreduce_path = "bulk"
                 return out
         self.last_allreduce_path = "kv"
@@ -424,6 +492,8 @@ class ProcWorld:
         """Local probe: can this rank run the device-collective path?"""
         if op not in ("sum", "max", "min"):
             return False
+        if self._bulk_broken is not None:
+            return False  # backend proved incapable; degrade permanently
         try:
             import jax
 
@@ -496,12 +566,17 @@ class ProcWorld:
         if dst == self.rank:
             self._apply(meta, arr)  # loopback: apply inline
             return
-        # Global per-target sequencing: increment-then-set; the target's
-        # progress thread applies strictly in sequence order, so a visible
-        # gap (incremented but not yet set) just parks the queue briefly.
-        seq = self._c.key_value_increment(f"{self._ns}/opseq/{dst}", 1) - 1
+        # Per-source sequencing: each (src -> dst) op stream carries its
+        # own dense local counter, so posting needs no atomic-increment
+        # primitive (absent on older jaxlib clients). Per-source FIFO is
+        # the guarantee that matters; the old global counter's
+        # cross-source arbitration was race-decided anyway, and
+        # fences/barriers provide real cross-rank ordering.
+        with self._seq_lock:
+            seq = self._op_seq.get(dst, 0)
+            self._op_seq[dst] = seq + 1
         self._c.key_value_set_bytes(
-            f"{self._ns}/op/{dst}/{seq}", _pack(meta, arr)
+            f"{self._ns}/op/{dst}/{self.rank}/{seq}", _pack(meta, arr)
         )
 
     def put(self, dst: int, name: str, arr, offset: int = 0) -> None:
@@ -621,43 +696,62 @@ class ProcWorld:
                     f"chaos: rank {me} progress engine killed by FaultPlan"
                 ))
                 return
-            key = f"{self._ns}/op/{me}/{self._applied}"
-            try:
-                b = self._c.key_value_try_get_bytes(key)
-            except Exception as e:
-                st = _status(e)
-                if st == "NOT_FOUND":
-                    b = None
-                elif st in _TRANSIENT:
-                    # The service may be mid-restart (multi-controller
-                    # startup on some PJRT platforms churns the channel):
-                    # back off and retry for up to retry_s before giving up.
-                    now = time.monotonic()
-                    if retry_deadline is None:
-                        retry_deadline = now + self._retry_s
-                    if now < retry_deadline:
-                        self._stop.wait(backoff)
-                        backoff = min(backoff * 2, 0.25)
-                        continue
-                    self._die(e)
-                    return
-                else:
-                    self._die(e)
-                    return
-            retry_deadline = None
-            backoff = 0.005
-            if b is None:
-                time.sleep(self._poll_s)
-                continue
-            meta, arr = _unpack(b)
-            self._c.key_value_delete(key)
-            self._applied += 1
-            try:
-                self._apply(meta, arr)
-            except Exception:  # pragma: no cover - keep the engine alive
-                import traceback
+            progressed = False
+            transient = False
+            for src in range(self.size):
+                # Drain this source to its first miss before moving on:
+                # one probe per APPLIED op (a probe-per-source-per-op
+                # sweep would multiply RPC cost by the world size).
+                while not self._stop.is_set():
+                    key = (
+                        f"{self._ns}/op/{me}/{src}/"
+                        f"{self._applied_src[src]}"
+                    )
+                    try:
+                        b = self._c.key_value_try_get_bytes(key)
+                    except Exception as e:
+                        st = _status(e)
+                        if st == "NOT_FOUND":
+                            b = None
+                        elif st in _TRANSIENT:
+                            # The service may be mid-restart
+                            # (multi-controller startup on some PJRT
+                            # platforms churns the channel): back off and
+                            # retry for up to retry_s before giving up.
+                            now = time.monotonic()
+                            if retry_deadline is None:
+                                retry_deadline = now + self._retry_s
+                            if now < retry_deadline:
+                                self._stop.wait(backoff)
+                                backoff = min(backoff * 2, 0.25)
+                                transient = True
+                                break
+                            self._die(e)
+                            return
+                        else:
+                            self._die(e)
+                            return
+                    retry_deadline = None
+                    backoff = 0.005
+                    if b is None:
+                        break
+                    meta, arr = _unpack(b)
+                    self._c.key_value_delete(key)
+                    self._applied_src[src] += 1
+                    self._applied += 1
+                    progressed = True
+                    try:
+                        self._apply(meta, arr)
+                    except Exception:  # pragma: no cover - engine lives
+                        import traceback
 
-                traceback.print_exc()
+                        traceback.print_exc()
+                if self._stop.is_set():
+                    return
+                if transient:
+                    break
+            if not progressed and not transient:
+                time.sleep(self._poll_s)
 
     def _die(self, err: BaseException) -> None:
         """Fatal engine failure: publish a tombstone and poison the reply
@@ -679,29 +773,30 @@ class ProcWorld:
             pass
         poison = _pack({"poisoned": f"rank {self.rank}: {_status(err)}"},
                        None)
-        seq = self._applied
-        misses = 0
-        while misses < 4:  # tolerate small increment-then-set gaps
-            try:
-                b = self._c.key_value_try_get_bytes(
-                    f"{self._ns}/op/{self.rank}/{seq}"
-                )
-            except Exception as e:
-                if _status(e) != "NOT_FOUND":
-                    return  # service gone: nothing more we can do
-                b = None
-            if b is None:
-                misses += 1
+        for src in range(self.size):
+            # Per-source queues are dense (set-only, posted in order), so
+            # the first miss ends a source's scan; a producer racing its
+            # next set loses only that op's poisoning - its caller still
+            # fails fast on the tombstone.
+            seq = self._applied_src[src]
+            while True:
+                try:
+                    b = self._c.key_value_try_get_bytes(
+                        f"{self._ns}/op/{self.rank}/{src}/{seq}"
+                    )
+                except Exception as e:
+                    if _status(e) != "NOT_FOUND":
+                        return  # service gone: nothing more we can do
+                    b = None
+                if b is None:
+                    break
                 seq += 1
-                continue
-            misses = 0
-            seq += 1
-            try:
-                meta, _ = _unpack(b)
-                if "reply" in meta:
-                    self._c.key_value_set_bytes(meta["reply"], poison)
-            except Exception:
-                return
+                try:
+                    meta, _ = _unpack(b)
+                    if "reply" in meta:
+                        self._c.key_value_set_bytes(meta["reply"], poison)
+                except Exception:
+                    return
 
     def close(self) -> None:
         """Stop the progress engine (pending remote ops stay queued in the

@@ -41,6 +41,7 @@ __all__ = [
     "async_device",
     "forasync_device",
     "device_stream",
+    "abort_on_cancel",
     "NUM_STREAMS",
 ]
 
@@ -212,6 +213,39 @@ def _active_module() -> TpuModule:
         if isinstance(m, TpuModule):
             return m
     raise RuntimeError("no TpuModule registered")
+
+
+def abort_on_cancel(stream, scope=None):
+    """Tie a running device stream's kill switch to host cancellation:
+    when a ``CancelScope`` cancels (``scope=None``: any scope - e.g.
+    root-finish cancellation, the watchdog's last rung, a deadline),
+    ``stream.abort()`` fires, the stream's in-kernel abort word lands in
+    its round loop, and the running quantum stops within a bounded number
+    of inner iterations instead of draining. ``stream`` is anything with
+    ``abort(reason)`` (StreamingMegakernel; any adapter for the mesh
+    runners' ``run(abort=...)`` word).
+
+    Returns an unregister callable; use as a context manager::
+
+        with abort_on_cancel(sm, scope=fin.scope):
+            sm.run_stream(b)
+    """
+    from ..runtime.resilience import bind_abort_to_scope
+
+    unregister = bind_abort_to_scope(stream.abort, scope)
+
+    class _Unreg:
+        def __call__(self) -> None:
+            unregister()
+
+        def __enter__(self) -> "_Unreg":
+            return self
+
+        def __exit__(self, *exc) -> bool:
+            self()
+            return False
+
+    return _Unreg()
 
 
 def get_closest_tpu_locale() -> Locale:

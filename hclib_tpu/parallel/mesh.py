@@ -21,7 +21,9 @@ from jax.sharding import Mesh
 
 from ..runtime.locality import Locale, LocalityGraph
 
-__all__ = ["make_mesh", "mesh_locality_graph", "cpu_mesh"]
+__all__ = [
+    "make_mesh", "mesh_locality_graph", "cpu_mesh", "quarantine_locales",
+]
 
 
 def make_mesh(
@@ -95,3 +97,30 @@ def mesh_locality_graph(mesh: Mesh, nworkers: Optional[int] = None) -> LocalityG
         for w in range(nworkers)
     ]
     return LocalityGraph(nworkers, locales, pop_paths, steal_paths)
+
+
+def quarantine_locales(graph: LocalityGraph, ordinals) -> int:
+    """Host-side mirror of the device-mesh quarantine mask: remove the
+    named device ordinals' ``tpu``/``hbm`` locales from every worker's
+    pop/steal path (in place), so host workers stop routing work at a chip
+    the device layer declared dead (heartbeat timeout, ROADMAP device
+    chaos). The locales stay in the graph - marked special ``"DEAD"`` -
+    for diagnostics; only the scheduling paths forget them. Returns the
+    number of path entries removed. Idempotent."""
+    ordinals = set(ordinals)  # once: the input may be a one-shot iterable
+    dead_ids = set()
+    for loc in graph.locales:
+        if (
+            loc.type in ("tpu", "hbm")
+            and loc.metadata.get("ordinal") in ordinals
+        ):
+            dead_ids.add(loc.id)
+            if "DEAD" not in loc.special:
+                loc.mark_special("DEAD")
+    removed = 0
+    for paths in (graph.pop_paths, graph.steal_paths):
+        for w, path in enumerate(paths):
+            keep = [l for l in path if l not in dead_ids]
+            removed += len(path) - len(keep)
+            paths[w] = keep
+    return removed

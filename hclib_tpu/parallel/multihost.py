@@ -30,6 +30,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..jaxcompat import distributed_is_initialized, shard_map
 from .mesh import make_mesh
 
 __all__ = [
@@ -68,7 +69,7 @@ def init_multihost(
         return
     import jax
 
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         _initialized = True  # wired by someone else: adopt
         return
     explicit = any(
@@ -181,7 +182,23 @@ def sync_global(tag: int = 0) -> None:
     if is_multihost():
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(f"hclib_tpu_sync_{tag}")
+        from ..jaxcompat import is_multiprocess_capability_error
+
+        try:
+            multihost_utils.sync_global_devices(f"hclib_tpu_sync_{tag}")
+        except Exception as e:
+            if not is_multiprocess_capability_error(e):
+                raise
+            # The backend cannot run multiprocess device computations at
+            # all (CPU pre-gloo jaxlib): every rank fails this dispatch
+            # locally and identically, so all jointly degrade to the
+            # coordination-service barrier - the same rendezvous with no
+            # device computation in it.
+            from jax._src import distributed
+
+            distributed.global_state.client.wait_at_barrier(
+                f"hclib_tpu_sync_{tag}", 120_000
+            )
         return
     import jax
 
@@ -222,7 +239,21 @@ def bulk_allreduce(arr: np.ndarray, op: str = "sum") -> np.ndarray:
     garr = jax.make_array_from_single_device_arrays(
         (nproc,) + arr.shape, sharding, [local]
     )
-    out = jitted(garr)
+    from ..jaxcompat import is_multiprocess_capability_error
+
+    try:
+        out = jitted(garr)
+    except Exception as e:
+        if not is_multiprocess_capability_error(e):
+            raise
+        # Structured degradation signal: ProcWorld.allreduce recognizes
+        # the UNIMPLEMENTED status and jointly falls back to its KV path;
+        # direct callers get an error naming the missing capability
+        # instead of a dispatch-internal message.
+        raise RuntimeError(
+            "UNIMPLEMENTED: bulk device collectives are unavailable on "
+            f"this backend/jaxlib ({e})"
+        ) from e
     return np.asarray(out.addressable_data(0))
 
 
@@ -257,7 +288,7 @@ def _local_barrier(devs):
         return jax.lax.psum(v, "all")
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh, in_specs=P("all"), out_specs=P(), check_vma=False
         ),
         out_shardings=NamedSharding(mesh, P()),

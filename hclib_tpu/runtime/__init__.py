@@ -21,6 +21,7 @@ from .reducers import MaxReducer, OrReducer, Reducer, SumReducer
 from .resilience import (
     CancelScope,
     CancelledError,
+    DeviceFaultPlan,
     FaultPlan,
     InjectedFault,
     RetryPolicy,

@@ -45,9 +45,10 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "CancelScope",
@@ -56,6 +57,10 @@ __all__ = [
     "InjectedFault",
     "RetryPolicy",
     "FaultPlan",
+    "DeviceFaultPlan",
+    "register_abort_hook",
+    "unregister_abort_hook",
+    "bind_abort_to_scope",
 ]
 
 LOG = logging.getLogger("hclib_tpu.resilience")
@@ -89,6 +94,11 @@ class InjectedFault(Exception):
 _cancel_epoch = 0
 _waker_lock = threading.Lock()
 _waker = None
+# Abort hooks: device-side kill switches (StreamingMegakernel.abort and
+# friends) registered while a device stream is live, so cancelling ANY
+# scope propagates INTO running device kernels (the abort word lands in
+# the kernel's round loop) instead of waiting for the stream to drain.
+_abort_hooks: List[Any] = []
 
 
 def set_cancel_waker(fn) -> None:
@@ -96,6 +106,56 @@ def set_cancel_waker(fn) -> None:
     global _waker
     with _waker_lock:
         _waker = fn
+
+
+def register_abort_hook(fn) -> None:
+    """Register a device-abort hook fired on every scope cancel (e.g. a
+    bound ``StreamingMegakernel.abort``); see ``modules.tpu.abort_on_cancel``
+    for the scope-filtered wrapper. Hooks must be idempotent and fast."""
+    with _waker_lock:
+        _abort_hooks.append(fn)
+
+
+def unregister_abort_hook(fn) -> None:
+    with _waker_lock:
+        try:
+            _abort_hooks.remove(fn)
+        except ValueError:
+            pass
+
+
+def bind_abort_to_scope(abort_fn, scope: Optional["CancelScope"] = None):
+    """Couple a device kill switch (``abort_fn(reason)``) to host
+    cancellation: fires when ``scope`` cancels, or - with ``scope=None`` -
+    when ANY scope cancels. The ONE implementation of the
+    register-then-replay protocol: the hook is registered first and then
+    replayed once, so a cancel() that landed before (or concurrently
+    with) registration still aborts - cancel() only notifies hooks it
+    saw. Returns an unregister callable. ``abort_fn`` must be idempotent
+    (StreamingMegakernel.abort is)."""
+
+    def hook() -> None:
+        if scope is None:
+            if not any_cancelled():
+                return
+            reason = "scope cancelled"
+        elif scope.cancelled():
+            r = scope.cancel_reason()
+            reason = (
+                "scope cancelled" if r is None
+                else f"scope cancelled: {r}"
+            )
+        else:
+            return
+        abort_fn(reason)
+
+    register_abort_hook(hook)
+    hook()  # replay once: close the check/register race
+
+    def unregister() -> None:
+        unregister_abort_hook(hook)
+
+    return unregister
 
 
 def any_cancelled() -> bool:
@@ -141,10 +201,16 @@ class CancelScope:
         _cancel_epoch += 1
         with _waker_lock:
             w = _waker
+            hooks = list(_abort_hooks)
         if w is not None:
             try:
                 w()
             except Exception:  # a dying runtime must not break cancel()
+                pass
+        for h in hooks:  # device kill switches (abort words) fire too
+            try:
+                h()
+            except Exception:
                 pass
 
     def cancelled(self) -> bool:
@@ -244,6 +310,126 @@ class RetryPolicy:
             u = _hash01(self.seed, "retry-jitter", n)
             d *= 1.0 + self.jitter * (2.0 * u - 1.0)
         return max(0.0, d)
+
+
+# ------------------------------------------------------------- device chaos
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+class DeviceFaultPlan:
+    """Seeded deterministic fault injection for the interpret-mode ICI mesh
+    kernels (``device/resident.py`` and the wrappers that delegate to it).
+
+    Unlike the host ``FaultPlan`` (hooks called from Python), this plan is
+    **compiled into the kernel**: every fault decision is a pure function of
+    ``(seed, site, round, hop, device)`` evaluated by the SAME scalar-core
+    hash on every device of the lockstep SPMD mesh, so injector, victim, and
+    every bystander agree on the schedule - injection, detection, and
+    recovery stay in lockstep and the fault trace (``fault_stats`` in the
+    run's info dict) is byte-reproducible from the seed. A ``None`` plan
+    compiles none of this (zero cost when disabled).
+
+    Faults (sites):
+
+    - **dropped steal credit** (``drop_credit_rate`` /
+      ``drop_credit_at=[(round, hop, granter_dev), ...]``): the granter
+      skips the flow-control credit it owes its hop partner after consuming
+      the partner's row transfer. The starved writer stalls the channel for
+      ``credit_timeout`` rounds (the pair skips that hop's row exchange -
+      the visible cost of detection latency), then *regenerates* the credit
+      and resumes; with ``credit_timeout=0`` regeneration is disabled and
+      the mesh exits in lockstep with a ``StallError`` naming the starved
+      channel instead of hanging.
+    - **duplicated steal credit** (``dup_credit_rate`` /
+      ``dup_credit_at``): the granter signals twice; the protocol must
+      tolerate the surplus (writes stay round-paced, so no overwrite) and
+      the exit credit drain must still balance every semaphore to zero.
+    - **delayed neighbor xfer** (``delay_xfer_rate``): the sender withholds
+      its export quota for that (round, hop) - rows migrate a round late.
+    - **dead chip** (``dead_device``, ``dead_round``): from ``dead_round``
+      on, device ``dead_device`` stops executing tasks and freezes the
+      heartbeat word it folds into the per-round stat exchange (the ICI
+      wire and DMA engine stay up - the realistic TPU failure is a wedged
+      scalar-core scheduler, not a powered-off chip). Survivors detect the
+      frozen heartbeat after ``heartbeat_timeout`` rounds and *quarantine*
+      the device id from their steal-eligibility masks; the dead chip's
+      recovery path re-homes its queued tasks to its hop partners so the
+      surviving mesh drains the workload (totals conserved). Work that
+      cannot re-home (non-migratable kernels) surfaces as a ``StallError``
+      naming the suspect chip.
+
+    ``credit_timeout`` / ``heartbeat_timeout`` default from the
+    ``HCLIB_TPU_CREDIT_TIMEOUT`` / ``HCLIB_TPU_HEARTBEAT_TIMEOUT`` env vars
+    (both in ROUNDS of the kernel's exchange schedule, default 2).
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        drop_credit_rate: float = 0.0,
+        drop_credit_at: Sequence[Tuple[int, int, int]] = (),
+        dup_credit_rate: float = 0.0,
+        dup_credit_at: Sequence[Tuple[int, int, int]] = (),
+        delay_xfer_rate: float = 0.0,
+        dead_device: Optional[int] = None,
+        dead_round: int = 0,
+        credit_timeout: Optional[int] = None,
+        heartbeat_timeout: Optional[int] = None,
+    ) -> None:
+        for name, r in (
+            ("drop_credit_rate", drop_credit_rate),
+            ("dup_credit_rate", dup_credit_rate),
+            ("delay_xfer_rate", delay_xfer_rate),
+        ):
+            if not (0.0 <= r <= 1.0):
+                raise ValueError(f"{name} must be in [0, 1], got {r}")
+        self.seed = int(seed)
+        # Rates quantized to per-mille for the in-kernel integer compare.
+        self.drop_millis = int(round(drop_credit_rate * 1000))
+        self.dup_millis = int(round(dup_credit_rate * 1000))
+        self.delay_millis = int(round(delay_xfer_rate * 1000))
+        self.drop_credit_at = tuple(
+            (int(r), int(k), int(g)) for (r, k, g) in drop_credit_at
+        )
+        self.dup_credit_at = tuple(
+            (int(r), int(k), int(g)) for (r, k, g) in dup_credit_at
+        )
+        self.dead_device = None if dead_device is None else int(dead_device)
+        self.dead_round = int(dead_round)
+        self.credit_timeout = (
+            _env_int("HCLIB_TPU_CREDIT_TIMEOUT", 2)
+            if credit_timeout is None
+            else int(credit_timeout)
+        )
+        if self.credit_timeout < 0:
+            raise ValueError("credit_timeout must be >= 0 (0 = no regen)")
+        self.heartbeat_timeout = max(1, (
+            _env_int("HCLIB_TPU_HEARTBEAT_TIMEOUT", 2)
+            if heartbeat_timeout is None
+            else int(heartbeat_timeout)
+        ))
+
+    def drops_credits(self) -> bool:
+        return bool(self.drop_millis or self.drop_credit_at)
+
+    def dups_credits(self) -> bool:
+        return bool(self.dup_millis or self.dup_credit_at)
+
+    def enabled(self) -> bool:
+        return (
+            self.drops_credits()
+            or self.dups_credits()
+            or self.delay_millis > 0
+            or self.dead_device is not None
+        )
 
 
 # -------------------------------------------------------------------- chaos

@@ -31,19 +31,36 @@ def main() -> int:
     nlocal = len(mh.local_devices())
     assert ndev == n * nlocal, (ndev, n, nlocal)
 
-    # Cross-process barrier (multihost path: coordination-service barrier).
+    # Cross-process barrier (multihost path: coordination-service barrier,
+    # either through sync_global_devices or - on a backend that cannot run
+    # multiprocess device computations - its structured KV-barrier
+    # degradation; both are real rendezvous).
     mh.sync_global(tag=1)
 
-    # bulk_allreduce: a real XLA all-reduce across processes.
+    # bulk_allreduce: a real XLA all-reduce across processes. A backend
+    # without multiprocess device computations (CPU pre-gloo jaxlib) must
+    # raise the STRUCTURED capability error, never a dispatch-internal
+    # one; capable backends must produce exact sums.
+    def bulk(a, **kw):
+        try:
+            return mh.bulk_allreduce(a, **kw)
+        except RuntimeError as e:
+            assert str(e).startswith("UNIMPLEMENTED:"), e
+            return None
+
     arr = np.arange(6, dtype=np.int64) + 100 * pid
-    s = mh.bulk_allreduce(arr)
-    want = np.arange(6) * n + 100 * sum(range(n))
-    assert (s == want).all(), (s, want)
-    mx = mh.bulk_allreduce(np.float32([pid + 1, 2 * pid]), op="max")
-    assert mx[0] == n and mx[1] == 2 * (n - 1), mx
-    # Repeat with the same shape: hits the cached compiled reducer.
-    s2 = mh.bulk_allreduce(np.arange(6, dtype=np.int64))
-    assert (s2 == np.arange(6) * n).all(), s2
+    s = bulk(arr)
+    if s is not None:
+        want = np.arange(6) * n + 100 * sum(range(n))
+        assert (s == want).all(), (s, want)
+        mx = mh.bulk_allreduce(np.float32([pid + 1, 2 * pid]), op="max")
+        assert mx[0] == n and mx[1] == 2 * (n - 1), mx
+        # Repeat with the same shape: hits the cached compiled reducer.
+        s2 = mh.bulk_allreduce(np.arange(6, dtype=np.int64))
+        assert (s2 == np.arange(6) * n).all(), s2
+    else:
+        print(f"rank {pid}: bulk degraded (no multiprocess backend)",
+              flush=True)
 
     mh.sync_global(tag=2)
     mh.shutdown()

@@ -74,7 +74,12 @@ def main() -> int:
     big = np.full((1 << 15,), pid + 1, np.float32)  # 128 KiB
     s3 = w.allreduce(big)
     assert (s3 == sum(range(1, n + 1))).all(), s3[:4]
-    assert w.last_allreduce_path == "bulk", w.last_allreduce_path
+    # Strict on capable backends; a backend that cannot run multiprocess
+    # computations (CPU pre-gloo jaxlib) records the degradation and the
+    # KV fallback must still have produced the exact sum above.
+    expect_path = "kv-fallback" if w._bulk_broken else "bulk"
+    assert w.last_allreduce_path == expect_path, (
+        w.last_allreduce_path, w._bulk_broken)
     small = w.allreduce(np.int32(1))
     assert int(small) == n and w.last_allreduce_path == "kv"
 

@@ -9,6 +9,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from hclib_tpu.device.descriptor import TaskGraphBuilder
 from hclib_tpu.device.sharded import ShardedMegakernel, round_robin_partition
 from hclib_tpu.device.workloads import FIB, make_fib_megakernel
+from hclib_tpu.jaxcompat import shard_map
 from hclib_tpu.parallel import collectives
 from hclib_tpu.parallel.mesh import cpu_mesh, mesh_locality_graph
 
@@ -45,7 +46,7 @@ def test_collectives_on_mesh():
         return s[None], g[None], r[None]
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             step, mesh=mesh, in_specs=(P("d"),), out_specs=(P("d"),) * 3,
             check_vma=False,
         )
@@ -75,7 +76,7 @@ def test_composed_collectives():
         return b[None], r[None], e[None], t[None], ra[None]
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             step, mesh=mesh, in_specs=(P("d"),), out_specs=(P("d"),) * 5,
             check_vma=False,
         )

@@ -13,11 +13,20 @@ re-introduces an unbounded wait fails CI loudly instead of wedging it.
 Each launch additionally runs under its own ``deadline_s`` (the feature
 under test bounding the test).
 
+``--mesh`` adds the seeded DEVICE chaos scenarios (ISSUE 2): a dead chip
+on an 8-device interpret mesh whose queue re-homes to the survivors, and
+a dropped ICI steal credit healed by timeout + regeneration. They need
+the Mosaic TPU interpret mode (jax >= 0.5); on older builds they report
+as skipped, not failed.
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
+    python tools/chaos_soak.py --mesh --seeds 1   # device-mesh chaos (CI)
 
-One JSON line per scenario; a summary line last.
+One JSON line per scenario; a machine-readable summary line last (seed
+base/count, faults injected, recoveries, failures, wall time) so CI and
+BENCH tooling can diff soak runs across PRs.
 """
 
 from __future__ import annotations
@@ -31,6 +40,17 @@ import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Before jax initializes: the mesh scenarios want 8 virtual CPU devices
+# (same configuration tests/conftest.py pins).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import hclib_tpu as hc  # noqa: E402
 from hclib_tpu.models import fib, uts  # noqa: E402
@@ -104,7 +124,13 @@ def scenario_fib_retry(seed: int, scale: str) -> dict:
     )
     faults = len(plan.trace_key())
     assert faults > 0, "plan injected nothing; scenario is vacuous"
-    return {"value": out["value"], "faults": faults}
+    want = fib.fib_seq(n)
+    assert out["value"] == want, (out["value"], want)
+    # Retry is the only recovery path here and quarantine is off, so a
+    # fault that did NOT recover would have failed the launch (or the
+    # exact-value assert above): completing exactly means every injected
+    # fault was healed.
+    return {"value": out["value"], "faults": faults, "recoveries": faults}
 
 
 def scenario_uts_kill_worker(seed: int, scale: str) -> dict:
@@ -198,12 +224,112 @@ def scenario_procworld_crash(seed: int, scale: str) -> dict:
         b.close()
 
 
+# --------------------------------------------- device-mesh chaos (ISSUE 2)
+
+def _mesh_prereq():
+    from hclib_tpu.jaxcompat import has_mosaic_interpret
+
+    if not has_mosaic_interpret():
+        return "no Mosaic TPU interpret mode (needs jax >= 0.5)"
+    import jax
+
+    if len(jax.devices("cpu")) < 8:
+        return "needs 8 virtual cpu devices"
+    return None
+
+
+def _mesh_rk(ndev, plan, capacity=256):
+    import numpy as _np  # noqa: F401  (jax pulls it anyway)
+
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    mk = Megakernel(
+        kernels=[("bump", bump)], capacity=capacity, num_values=1024,
+        succ_capacity=8, interpret=True,
+    )
+    return ResidentKernel(
+        mk, cpu_mesh(ndev, axis_name="q"), migratable_fns=[0], window=4,
+        fault_plan=plan,
+    )
+
+
+def scenario_mesh_dead_chip(seed: int, scale: str) -> dict:
+    """Seeded dead chip on an 8-device interpret mesh: the survivors must
+    drain the whole workload (queue re-homed, totals conserved)."""
+    skip = _mesh_prereq()
+    if skip:
+        return {"skipped": skip}
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+
+    ndev, per = 8, 4
+    dead = seed % ndev
+    plan = hc.DeviceFaultPlan(
+        seed=seed, dead_device=dead, dead_round=2, heartbeat_timeout=2,
+    )
+    rk = _mesh_rk(ndev, plan)
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    v = 0
+    for d in range(ndev):
+        for _ in range(per):
+            v += 1
+            builders[d].add(0, args=[v])
+    iv, _, info = rk.run(builders, quantum=2, max_rounds=4096)
+    assert info["pending"] == 0 and info["executed"] == ndev * per
+    assert int(iv[:, 0].sum()) == v * (v + 1) // 2
+    fs = info["fault_stats"]
+    assert fs[dead]["rehomed_rows"] > 0
+    quarantiners = sum(
+        1 for d, f in enumerate(fs) if d != dead and dead in f["quarantined"]
+    )
+    assert quarantiners > 0
+    return {"faults": 1, "recoveries": 1, "dead": dead,
+            "rehomed": fs[dead]["rehomed_rows"],
+            "quarantiners": quarantiners, "rounds": info["rounds"]}
+
+
+def scenario_mesh_dropped_credit(seed: int, scale: str) -> dict:
+    """Seeded dropped ICI steal credit: timeout + regeneration heal the
+    channel; totals stay exact."""
+    skip = _mesh_prereq()
+    if skip:
+        return {"skipped": skip}
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+
+    ntasks = 40
+    plan = hc.DeviceFaultPlan(
+        seed=seed, drop_credit_at=[(1, 0, 1)], credit_timeout=2,
+    )
+    rk = _mesh_rk(2, plan, capacity=128)
+    builders = [TaskGraphBuilder(), TaskGraphBuilder()]
+    for i in range(ntasks):
+        builders[0].add(0, args=[i + 1])
+    iv, _, info = rk.run(builders, quantum=2, max_rounds=4096)
+    assert info["pending"] == 0 and info["executed"] == ntasks
+    assert int(iv[:, 0].sum()) == ntasks * (ntasks + 1) // 2
+    fs = info["fault_stats"]
+    dropped = sum(f["credits_dropped"] for f in fs)
+    regen = sum(f["credits_regenerated"] for f in fs)
+    assert dropped == 1 and regen == 1, fs
+    return {"faults": dropped, "recoveries": regen,
+            "rounds": info["rounds"]}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
     ("deadline", scenario_deadline),
     ("quarantine", scenario_quarantine),
     ("procworld_crash", scenario_procworld_crash),
+]
+
+MESH_SCENARIOS = [
+    ("mesh_dead_chip", scenario_mesh_dead_chip),
+    ("mesh_dropped_credit", scenario_mesh_dropped_credit),
 ]
 
 
@@ -213,22 +339,41 @@ def main(argv=None) -> int:
                     help="number of seeds (starting at --seed-base)")
     ap.add_argument("--seed-base", type=int, default=0)
     ap.add_argument("--scale", choices=("smoke", "soak"), default="smoke")
+    ap.add_argument("--mesh", action="store_true",
+                    help="add the seeded device-mesh chaos scenarios "
+                         "(dead chip, dropped steal credit)")
+    ap.add_argument("--mesh-only", action="store_true",
+                    help="run ONLY the device-mesh chaos scenarios")
+    ap.add_argument("--no-skip", action="store_true",
+                    help="treat skipped scenarios as failures (CI gating "
+                         "jobs must fail CLOSED: an environment that "
+                         "cannot run the fault paths is not a pass)")
     ap.add_argument("--timeout-s", type=float, default=300.0,
                     help="hard whole-sweep ceiling; overrun = exit 1 "
                          "with all-thread stack dumps")
     args = ap.parse_args(argv)
 
+    scenarios = list(SCENARIOS)
+    if args.mesh_only:
+        scenarios = list(MESH_SCENARIOS)
+    elif args.mesh:
+        scenarios += MESH_SCENARIOS
+
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)
-    failures = 0
+    failures = skipped = faults = recoveries = 0
     t0 = time.monotonic()
     for seed in range(args.seed_base, args.seed_base + args.seeds):
-        for name, fn in SCENARIOS:
+        for name, fn in scenarios:
             row = {"scenario": name, "seed": seed, "scale": args.scale}
             ts = time.monotonic()
             try:
                 row.update(fn(seed, args.scale))
                 row["ok"] = True
+                if "skipped" in row:
+                    skipped += 1
+                faults += int(row.get("faults", 0))
+                recoveries += int(row.get("recoveries", 0))
             except Exception as e:  # scenario failed; keep sweeping
                 failures += 1
                 row["ok"] = False
@@ -236,12 +381,15 @@ def main(argv=None) -> int:
             row["seconds"] = round(time.monotonic() - ts, 3)
             print(json.dumps(row), flush=True)
     faulthandler.cancel_dump_traceback_later()
+    # The one-line machine-readable summary CI/BENCH tooling diffs.
     print(json.dumps({
-        "summary": True, "failures": failures,
-        "scenarios": len(SCENARIOS) * args.seeds,
+        "summary": True, "failures": failures, "skipped": skipped,
+        "seed_base": args.seed_base, "seeds": args.seeds,
+        "scenarios": len(scenarios) * args.seeds,
+        "faults_injected": faults, "recoveries": recoveries,
         "seconds": round(time.monotonic() - t0, 3),
     }), flush=True)
-    return 1 if failures else 0
+    return 1 if failures or (args.no_skip and skipped) else 0
 
 
 if __name__ == "__main__":
