@@ -22,6 +22,7 @@ distinguishable from weather by reading the probe columns.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -30,6 +31,47 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------- wall budget
+# BENCH_r05 died at the driver's timeout (rc=124) inside a SECONDARY
+# section, after the headline had already been measured - and the whole
+# round parsed as null because the JSON line only printed at the end.
+# Two rules now: (1) the headline runs FIRST and its JSON line flushes
+# the moment it exists; (2) every section start is gated on the time
+# remaining, so the bench self-truncates instead of being killed mid-
+# number. HCLIB_TPU_BENCH_BUDGET_S overrides the default wall budget.
+
+# Armed by main(): other consumers of these bench functions (notably
+# tools/perf_regression.py --device, whose whole-suite wall time easily
+# exceeds one bench budget) must not have their trials truncated by a
+# clock that started at module import.
+_T0 = None
+
+
+def _budget_s() -> float:
+    return float(os.environ.get("HCLIB_TPU_BENCH_BUDGET_S", "780"))
+
+
+def _remaining() -> float:
+    if _T0 is None:
+        return float("inf")
+    return _budget_s() - (time.monotonic() - _T0)
+
+
+def section(name: str, est_s: float, fn):
+    """Run one bench section if ~est_s seconds fit in the remaining wall
+    budget; a failure or a skip never breaks the stdout contract (all
+    section output goes to stderr)."""
+    left = _remaining()
+    if left < est_s:
+        log(f"SKIP {name}: {left:.0f}s of budget left, ~{est_s:.0f}s needed")
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        log(f"{name} failed: {e}")
+        return None
 
 
 _PROBE = None
@@ -43,6 +85,14 @@ def _probe():
 
         _PROBE = ClockProbe()
     return _PROBE
+
+
+def _chol_ceiling_pct(gflops: float) -> float:
+    """Achieved f32-effective GFLOP/s as a percentage of the 3-pass f32
+    ceiling (probe/3): every f32-accurate GEMM costs 3 bf16 MXU passes, so
+    this is the one ceiling formula both the section log and the end-of-run
+    summary must agree on."""
+    return 100.0 * gflops / (_probe().best * 1000.0 / 3.0)
 
 
 def windowed(
@@ -73,6 +123,9 @@ def windowed(
 
     t = 0
     while t < trials or (n_fast() < min_fast and t < max_trials):
+        if t and _remaining() < 0:
+            log(f"  {name}: wall budget exhausted after {t} trials")
+            break
         if t:
             time.sleep(spread_seconds)
         rec = wt.run(fn)
@@ -367,6 +420,7 @@ def bench_device_sw_wave(trials: int = 3, spread_seconds: float = 8.0):
     reps_pair = (2, 12)
     jits = {r: mk._build(1 << 22, reps=r) for r in reps_pair}
     score = None
+    outs = None
     for r in reps_pair:
         outs = jits[r](*fresh())  # compile + warm
         score = int(np.asarray(outs[3])[0])  # best alignment score
@@ -378,6 +432,18 @@ def bench_device_sw_wave(trials: int = 3, spread_seconds: float = 8.0):
     ref = sw_score_one(np.asarray(a), np.asarray(b_))
     assert score == ref, (score, ref)
     log(f"device SW [wave-DAG]: score {score} matches the scan engine")
+    # Batched-dispatch tier counters (guarded by tools/perf_regression.py
+    # so the occupancy the speedup rests on never floats free).
+    global LAST_SW_WAVE_TIERS
+    LAST_SW_WAVE_TIERS = tiers = mk.decode_tier_stats(
+        np.asarray(outs[4 + len(mk.data_specs)])
+    )
+    log(
+        f"device SW [wave-DAG]: batch occupancy "
+        f"{tiers['batch_occupancy']:.2f} ({tiers['batch_rounds']} rounds x "
+        f"width {tiers['batch_width']}, {tiers['prefetch_hits']} prefetch "
+        f"hits, {tiers['full_rounds']} full rounds)"
+    )
 
     one_trial = _graph_slope_trial(jits, fresh, reps_pair, n * m / 1e9)
     s = windowed("SW wave-DAG GCUPS", one_trial, trials, spread_seconds)
@@ -482,10 +548,9 @@ def bench_device_cholesky(
     # utilization against THAT, plus the bf16-equivalent MXU rate, so
     # "fraction of the probed clock" is judged against the right bound.
     probe_tf = _probe().best
-    ceil_gf = probe_tf * 1000.0 / 3.0
     log(
         f"device cholesky: {s['median']/1e3:.1f} TF f32-effective = "
-        f"{100.0 * s['median'] / ceil_gf:.0f}% of the 3-pass f32 ceiling "
+        f"{_chol_ceiling_pct(s['median']):.0f}% of the 3-pass f32 ceiling "
         f"(probe {probe_tf:.0f} TF / 3 passes); bf16-equivalent MXU rate "
         f"{3.0 * s['median']/1e3:.1f} TF = "
         f"{100.0 * 3.0 * s['median'] / (probe_tf * 1000.0):.0f}% of probe"
@@ -495,6 +560,10 @@ def bench_device_cholesky(
 
 T1_NODES = 4130071
 T1L_NODES = 102181082
+
+# Last bench_device_sw_wave run's batched-tier counters (occupancy,
+# prefetch hits), for tools/perf_regression.py.
+LAST_SW_WAVE_TIERS: dict = {}
 
 
 def bench_native_uts():
@@ -593,18 +662,85 @@ def bench_device_uts():
 
 
 def main() -> None:
-    host_rate = bench_host_fib()
-    native_fib_rate = bench_native_fib()
-    device_fib_rate = bench_device_fib()
-    line = (
-        f"fib megakernel (scalar tier) vs python host: "
-        f"{device_fib_rate / host_rate:.1f}x"
-    )
-    if native_fib_rate:
-        line += f"; vs native C++: {device_fib_rate / native_fib_rate:.2f}x"
-    log(line)
+    global _T0
+    _T0 = time.monotonic()  # arm the wall budget for THIS driver run
+    # ---- headline FIRST: the stdout JSON line exists (and is flushed)
+    # before any secondary section can eat the driver budget. Every
+    # fallback rung is itself guarded: stdout MUST end up with one
+    # JSON-parsable line no matter what fails (BENCH_r05 parsed null).
+    host_rate = device_fib_rate = None
     try:
-        vfib_rate = bench_device_vfib()
+        native_uts_rate = bench_native_uts()
+        device_uts_rate, tree, uts_stat = bench_device_uts()
+        print(
+            json.dumps(
+                {
+                    "metric": f"UTS {tree} tree-search throughput "
+                    f"(vectorized DFS, "
+                    f"{'1 TPU core' if tree == 'T1L' else 'cpu backend'})",
+                    "value": round(device_uts_rate),
+                    "unit": "nodes/sec",
+                    "vs_baseline": round(
+                        device_uts_rate / native_uts_rate, 2
+                    ),
+                    "statistic": uts_stat,
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:
+        log(f"uts bench failed: {e}; falling back to fib headline")
+        try:
+            host_rate = bench_host_fib()
+            device_fib_rate = bench_device_fib()
+            print(
+                json.dumps(
+                    {
+                        "metric": "megakernel dynamic-task throughput (fib)",
+                        "value": round(device_fib_rate),
+                        "unit": "tasks/sec",
+                        "vs_baseline": round(device_fib_rate / host_rate, 2),
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as e2:
+            log(f"fib fallback failed too: {e2}")
+            print(
+                json.dumps(
+                    {
+                        "metric": "bench headline unavailable "
+                        f"(uts: {str(e)[:120]}; fib: {str(e2)[:120]})",
+                        "value": 0,
+                        "unit": "none",
+                    }
+                ),
+                flush=True,
+            )
+
+    # ---- secondaries (stderr only), budget-gated, priority order: the
+    # dispatch-tier numbers under acceptance tracking come first.
+    sw_wave = section("sw wave-DAG", 90, bench_device_sw_wave)
+    chol8k = section("cholesky n=8192", 150, bench_device_cholesky)
+    if host_rate is None:  # not already measured by the fallback headline
+        host_rate = section("host fib", 30, bench_host_fib)
+    native_fib_rate = section("native fib", 45, bench_native_fib)
+    if device_fib_rate is None:
+        device_fib_rate = section(
+            "device fib scalar tier", 60, bench_device_fib
+        )
+    if host_rate and device_fib_rate:
+        line = (
+            f"fib megakernel (scalar tier) vs python host: "
+            f"{device_fib_rate / host_rate:.1f}x"
+        )
+        if native_fib_rate:
+            line += (
+                f"; vs native C++: {device_fib_rate / native_fib_rate:.2f}x"
+            )
+        log(line)
+    vfib_rate = section("device fib batch tier", 90, bench_device_vfib)
+    if host_rate and vfib_rate:
         line = (
             f"fib megakernel (batch-dispatch tier) vs python host: "
             f"{vfib_rate / host_rate:.0f}x"
@@ -612,55 +748,20 @@ def main() -> None:
         if native_fib_rate:
             line += f"; vs native C++: {vfib_rate / native_fib_rate:.1f}x"
         log(line)
-    except Exception as e:  # secondary metric must not break the contract
-        log(f"vfib bench failed: {e}")
-    try:
-        bench_device_sw()
-    except Exception as e:  # secondary metric must not break the contract
-        log(f"sw bench failed: {e}")
-    try:
-        bench_device_sw_wave()
-    except Exception as e:  # secondary metric must not break the contract
-        log(f"sw wave bench failed: {e}")
-    try:
-        bench_device_cholesky()
-    except Exception as e:  # secondary metric must not break the contract
-        log(f"cholesky bench failed: {e}")
-    try:
-        # The peak-utilization size (POTRF/TRSM amortized over 8x the
-        # GEMM work); its residual bound reflects f32 accumulation over
-        # twice the update steps - reported, not hidden.
-        bench_device_cholesky(trials=3, n=16384, residual_bound=2e-6)
-    except Exception as e:  # secondary metric must not break the contract
-        log(f"cholesky-16k bench failed: {e}")
-    try:
-        native_uts_rate = bench_native_uts()
-        device_uts_rate, tree, uts_stat = bench_device_uts()
-    except Exception as e:
-        log(f"uts bench failed: {e}; falling back to fib headline")
-        print(
-            json.dumps(
-                {
-                    "metric": "megakernel dynamic-task throughput (fib)",
-                    "value": round(device_fib_rate),
-                    "unit": "tasks/sec",
-                    "vs_baseline": round(device_fib_rate / host_rate, 2),
-                }
-            )
-        )
-        return
-    print(
-        json.dumps(
-            {
-                "metric": f"UTS {tree} tree-search throughput (vectorized DFS, "
-                f"{'1 TPU core' if tree == 'T1L' else 'cpu backend'})",
-                "value": round(device_uts_rate),
-                "unit": "nodes/sec",
-                "vs_baseline": round(device_uts_rate / native_uts_rate, 2),
-                "statistic": uts_stat,
-            }
-        )
+    section("sw pallas (fused ceiling)", 90, bench_device_sw)
+    # The peak-utilization size (POTRF/TRSM amortized over 8x the GEMM
+    # work); its residual bound reflects f32 accumulation over twice the
+    # update steps - reported, not hidden.
+    section(
+        "cholesky n=16384", 200,
+        lambda: bench_device_cholesky(trials=3, n=16384, residual_bound=2e-6),
     )
+    if sw_wave:
+        log(f"wave-DAG SW final: {sw_wave:.1f} GCUPS median (r05 baseline "
+            f"1.2; acceptance floor 12)")
+    if chol8k is not None:
+        log(f"cholesky n=8192 final: {_chol_ceiling_pct(chol8k):.0f}% "
+            f"of the 3-pass ceiling (r05 baseline 80%)")
 
 
 if __name__ == "__main__":

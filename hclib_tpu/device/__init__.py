@@ -22,11 +22,13 @@ from .descriptor import (
     NO_TASK,
     TaskGraphBuilder,
 )
-from .megakernel import KernelContext, Megakernel
+from .megakernel import BatchContext, BatchSpec, KernelContext, Megakernel
 from .resident import ResidentKernel
 
 __all__ = [
     "ResidentKernel",
+    "BatchContext",
+    "BatchSpec",
     "DESC_WORDS",
     "NO_TASK",
     "TaskGraphBuilder",

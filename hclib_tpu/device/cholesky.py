@@ -202,26 +202,25 @@ def _trsmcol_kernel(ctx: KernelContext, ts: int = T, nt: int = 0) -> None:
     wait_stores(last, k + nj)
 
 
-def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
-    """Row-fused trailing update: A_ij -= L_ik L_jk^T for j in (k, i].
+def _updrow_stream(ctx, i, k, lh, ll) -> None:
+    """The row-fused trailing-update stream for row ``i`` at step ``k``
+    with the resident L_ik split already loaded (``lh``/``ll`` values):
+    A_ij -= L_ik L_jk^T for j in (k, i].
 
-    L_ik's split stays resident in VMEM for the whole row; the
-    (A_ij, L_jk-split) streams double-buffer through two slots -
+    The (A_ij, L_jk-split) streams double-buffer through two slots -
     iteration t starts the DMAs for t+1 before computing t, and
     store-backs ride their own semaphores so a slot is only reused once
     its previous store completed. The SYRK j = i case needs no special
     path: lsp[j, k] at j = i IS the resident L_ik (same bits). Every
     started DMA is waited exactly once (the epilogue drains the last two
-    stores)."""
-    i, k = ctx.arg(0), ctx.arg(1)
+    stores), so the scalar kernel and the batched body can both run this
+    back to back. ``ctx`` may be a KernelContext or a BatchContext (only
+    ``data``/``scratch`` are touched)."""
     tiles, lsp = ctx.data["tiles"], ctx.data["lsp"]
     f32a = ctx.scratch["f32a"]
     bfh, bfl = ctx.scratch["bfh"], ctx.scratch["bfl"]
-    rvh, rvl = ctx.scratch["rvh"], ctx.scratch["rvl"]
-    sem = ctx.scratch["sems"]
     sl = ctx.scratch["sload"]  # (2, 3): per-slot {A, L-hi, L-lo}
     ss = ctx.scratch["sstore"]  # (2, 3): [slot, 0] = A store-back
-    _load_all([(lsp.at[i, k, 0], rvh), (lsp.at[i, k, 1], rvl)], sem)
     nj = i - k  # j walks k+1 .. i
 
     def start_loads(slot, j) -> None:
@@ -251,9 +250,7 @@ def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
         pltpu.make_async_copy(tiles.at[i, j], f32a.at[cur], sl.at[cur, 0]).wait()
         pltpu.make_async_copy(lsp.at[j, k, 0], bfh.at[cur], sl.at[cur, 1]).wait()
         pltpu.make_async_copy(lsp.at[j, k, 1], bfl.at[cur], sl.at[cur, 2]).wait()
-        f32a[cur] = f32a[cur] - _mm_nt_split(
-            rvh[:], rvl[:], bfh[cur], bfl[cur]
-        )
+        f32a[cur] = f32a[cur] - _mm_nt_split(lh, ll, bfh[cur], bfl[cur])
         pltpu.make_async_copy(f32a.at[cur], tiles.at[i, j], ss.at[cur, 0]).start()
         return 0
 
@@ -269,6 +266,59 @@ def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
         ).wait()
 
     pltpu.make_async_copy(f32a.at[last], tiles.at[i, i], ss.at[last, 0]).wait()
+
+
+def _updrow_kernel(ctx: KernelContext, ts: int = T) -> None:
+    """Scalar-dispatch trailing update: load L_ik's split resident, then
+    run the shared row stream."""
+    i, k = ctx.arg(0), ctx.arg(1)
+    lsp = ctx.data["lsp"]
+    rvh, rvl = ctx.scratch["rvh"], ctx.scratch["rvl"]
+    sem = ctx.scratch["sems"]
+    _load_all([(lsp.at[i, k, 0], rvh), (lsp.at[i, k, 1], rvl)], sem)
+    _updrow_stream(ctx, i, k, rvh[:], rvl[:])
+
+
+UPD_B = 4  # row tasks per batched trailing-update round
+
+
+def _updrow_batch_kernel(ctx, ts: int = T) -> None:
+    """Batched trailing updates: up to ``ctx.width`` ready row tasks (all
+    rows of one step k, in practice - a TRSMCOL completion readies them
+    together) through one body. The per-row GEMM stream is byte-identical
+    to the scalar kernel's; what the batch buys is the resident-operand
+    pipeline: slot b+1's L_ik split streams into the other half of a
+    double-buffered pair DURING slot b's row stream, so the MXU never
+    stalls on the per-task resident load, and the per-task ``lax.switch``
+    dispatch disappears."""
+    lsp = ctx.data["lsp"]
+    brvh, brvl = ctx.scratch["brvh"], ctx.scratch["brvl"]  # (2, ts, ts)
+    bsem = ctx.scratch["bsem"]  # (2, 2): per-half {hi, lo}
+
+    def res_copies(half, b):
+        i, k = ctx.arg(b, 0), ctx.arg(b, 1)
+        return (
+            pltpu.make_async_copy(lsp.at[i, k, 0], brvh.at[half], bsem.at[half, 0]),
+            pltpu.make_async_copy(lsp.at[i, k, 1], brvl.at[half], bsem.at[half, 1]),
+        )
+
+    for cp in res_copies(0, 0):  # slot 0 is always live (take >= 1)
+        cp.start()
+    for b in range(ctx.width):
+        half = b % 2
+
+        @pl.when(ctx.live(b))
+        def _(b=b, half=half):
+            if b + 1 < ctx.width:
+                @pl.when(ctx.live(b + 1))
+                def _():
+                    for cp in res_copies(1 - half, b + 1):
+                        cp.start()
+
+            for cp in res_copies(half, b):
+                cp.wait()
+            i, k = ctx.arg(b, 0), ctx.arg(b, 1)
+            _updrow_stream(ctx, i, k, brvh[half], brvl[half])
 
 
 def build_cholesky_graph(nt: int, fused_trsm: bool = True) -> TaskGraphBuilder:
@@ -326,7 +376,12 @@ def make_cholesky_megakernel(
     tile: int = T,
     factor_base: Optional[int] = None,
     fused_only: bool = False,
+    batch_updrow: bool = True,
 ) -> Megakernel:
+    """``batch_updrow`` routes the trailing-update row tasks through the
+    megakernel's batched same-kind dispatch tier (UPD_B rows per round,
+    resident L-split pipelined across slots); results are bit-identical
+    to the scalar dispatch, which ``batch_updrow=False`` restores."""
     if factor_base is None:
         # In-kernel A/B at n=8192 (fast windows, interleaved): base 128
         # = 7.36 ms vs base 256 = 7.92-8.02 ms, every trial - the deeper
@@ -347,6 +402,28 @@ def make_cholesky_megakernel(
     else:
         ntasks = nt + 2 * (nt * (nt - 1) // 2)
     capacity = max(64, ntasks)
+    scratch = {
+        "va": pltpu.VMEM((tile, tile), jnp.float32),
+        "f32a": pltpu.VMEM((2, tile, tile), jnp.float32),
+        "f32b": pltpu.VMEM((2, tile, tile), jnp.float32),
+        "bfh": pltpu.VMEM((2, tile, tile), jnp.bfloat16),
+        "bfl": pltpu.VMEM((2, tile, tile), jnp.bfloat16),
+        "rvh": pltpu.VMEM((tile, tile), jnp.bfloat16),
+        "rvl": pltpu.VMEM((tile, tile), jnp.bfloat16),
+        "sems": pltpu.SemaphoreType.DMA((3,)),
+        "sload": pltpu.SemaphoreType.DMA((2, 3)),
+        "sstore": pltpu.SemaphoreType.DMA((2, 3)),
+    }
+    route = {}
+    if batch_updrow:
+        from .megakernel import BatchSpec
+
+        scratch["brvh"] = pltpu.VMEM((2, tile, tile), jnp.bfloat16)
+        scratch["brvl"] = pltpu.VMEM((2, tile, tile), jnp.bfloat16)
+        scratch["bsem"] = pltpu.SemaphoreType.DMA((2, 2))
+        route["updrow"] = BatchSpec(
+            _ft.partial(_updrow_batch_kernel, ts=tile), width=UPD_B
+        )
     return Megakernel(
         kernels=[
             ("potrf", _ft.partial(_potrf_kernel, ts=tile, fbase=factor_base)),
@@ -354,21 +431,11 @@ def make_cholesky_megakernel(
             ("updrow", _ft.partial(_updrow_kernel, ts=tile)),
             ("trsmcol", _ft.partial(_trsmcol_kernel, ts=tile, nt=nt)),
         ],
+        route=route,
         data_specs={
             "tiles": tile_spec, "linvsp": linvsp_spec, "lsp": lsp_spec,
         },
-        scratch_specs={
-            "va": pltpu.VMEM((tile, tile), jnp.float32),
-            "f32a": pltpu.VMEM((2, tile, tile), jnp.float32),
-            "f32b": pltpu.VMEM((2, tile, tile), jnp.float32),
-            "bfh": pltpu.VMEM((2, tile, tile), jnp.bfloat16),
-            "bfl": pltpu.VMEM((2, tile, tile), jnp.bfloat16),
-            "rvh": pltpu.VMEM((tile, tile), jnp.bfloat16),
-            "rvl": pltpu.VMEM((tile, tile), jnp.bfloat16),
-            "sems": pltpu.SemaphoreType.DMA((3,)),
-            "sload": pltpu.SemaphoreType.DMA((2, 3)),
-            "sstore": pltpu.SemaphoreType.DMA((2, 3)),
-        },
+        scratch_specs=scratch,
         capacity=capacity,
         num_values=8,
         succ_capacity=max(
@@ -377,9 +444,13 @@ def make_cholesky_megakernel(
         ),
         interpret=interpret,
         # 8 f32-equivalent tile buffers + compiler stack temporaries
-        # (factor_and_inv block values, bf16 split operands): past the
-        # 16 MiB scoped default once tile >= 512.
-        vmem_limit_bytes=max(24 * tile * tile * 4, 16 * 1024 * 1024),
+        # (factor_and_inv block values, bf16 split operands) + the batched
+        # tier's resident double-buffer pair: past the 16 MiB scoped
+        # default once tile >= 512.
+        vmem_limit_bytes=max(
+            (26 if batch_updrow else 24) * tile * tile * 4,
+            16 * 1024 * 1024,
+        ),
     )
 
 
@@ -409,6 +480,7 @@ def device_cholesky(
     mk: Optional[Megakernel] = None,
     tile: int = T,
     fused_trsm: bool = True,
+    batch_updrow: bool = True,
 ) -> Tuple[np.ndarray, dict]:
     """Factor SPD ``a`` ((nt*tile)^2) on-device; returns (L, info)."""
     n = a.shape[0]
@@ -416,7 +488,9 @@ def device_cholesky(
         raise ValueError(f"matrix size must be a multiple of {tile}")
     nt = n // tile
     if mk is None:
-        mk = make_cholesky_megakernel(nt, interpret, tile=tile)
+        mk = make_cholesky_megakernel(
+            nt, interpret, tile=tile, batch_updrow=batch_updrow
+        )
     b = build_cholesky_graph(nt, fused_trsm=fused_trsm)
     t0 = time.perf_counter()
     _, data, info = mk.run(b, data=cholesky_buffers(a, nt, tile))

@@ -48,8 +48,8 @@ from .descriptor import (
 )
 
 __all__ = [
-    "KernelContext", "Megakernel", "VBLOCK", "decode_overflow",
-    "interpret_mode", "fault_mix",
+    "KernelContext", "BatchContext", "BatchSpec", "Megakernel", "VBLOCK",
+    "decode_overflow", "interpret_mode", "fault_mix",
 ]
 
 
@@ -117,6 +117,30 @@ OVF_ENGINE = 4   # vector-tier per-lane stacks / step budget
 OVF_OUTBOX = 8   # resident AM outbox
 OVF_WAITS = 16   # resident wait table
 OVF_LOCKQ = 32   # resident lock FIFO
+
+# Batched-dispatch tier statistics (the 8-word tstats output a batch-routed
+# megakernel appends after its data outputs; surfaced as info['tiers'] /
+# Megakernel.stats_dict()). All counters reset at every kernel entry, so with
+# reps > 1 they describe the LAST rep - per-graph numbers, which is what
+# occupancy tracking wants.
+TS_BATCH_ROUNDS = 0   # batch rounds fired
+TS_BATCH_TASKS = 1    # descriptors dispatched through batch bodies
+TS_SCALAR_ROUNDS = 2  # descriptors dispatched through lax.switch
+TS_ROUTED = 3         # ring pops diverted into a per-kind lane
+TS_PREFETCH = 4       # descriptors whose operands came from a prefetch
+TS_FULL_ROUNDS = 5    # batch rounds at full width
+TS_SPILLED = 6        # lane entries spilled back to the ring at sched exit
+TS_OFFERED = 7        # batch slots offered (sum of widths over fired rounds)
+TS_WORDS = 8
+
+# Per-lane scheduler state words (SMEM (nbatch, LS_WORDS) scratch): the
+# lane's FIFO cursors plus the cross-round prefetch handshake.
+LS_HEAD = 0     # pop cursor (monotonic; ring-indexed mod capacity)
+LS_TAIL = 1     # push cursor
+LS_PF_BASE = 2  # head-at-issue + 1 of the outstanding prefetch (0 = none)
+LS_PF_N = 3     # descriptors the outstanding prefetch covers
+LS_PF_BUF = 4   # operand-buffer half the prefetch was written into
+LS_WORDS = 8
 
 # counts[] slots
 C_HEAD = 0
@@ -408,10 +432,158 @@ class KernelContext:
         return a_clamped
 
 
+class BatchSpec:
+    """Describes the batched-dispatch form of one kernel-table entry.
+
+    A kind routed through a BatchSpec is never dispatched through the
+    ``lax.switch`` table: the scheduler diverts its ready descriptors into a
+    per-kind SMEM lane and, each batch round, pops up to ``width`` of them
+    and invokes ``body(ctx: BatchContext)`` ONCE for the whole group - one
+    tiled kernel body instead of ``width`` sequential switch dispatches.
+    Ready descriptors of one kind are mutually independent by construction
+    (neither's completion has run, so neither can be the other's
+    predecessor), which is what makes same-kind group execution safe for
+    arbitrary DAGs; the body remains responsible for its slots writing
+    disjoint data.
+
+    ``prefetch=True`` opts into the cross-round double-buffer protocol:
+    the tier tells the body how many descriptors of the NEXT prospective
+    batch to prefetch (``ctx.prefetch_count``) and, the round after, how
+    many of its own slots were already prefetched (``ctx.prefetched``, into
+    operand-buffer half ``ctx.buf``). A lane entry's inputs are fully
+    written before it is pushed (its predecessors completed in earlier
+    rounds and batch bodies drain their stores before completion runs), so
+    prefetching a queued descriptor's operands during the current batch's
+    compute is always safe. A body that opts in MUST issue exactly the
+    starts the tier announces and MUST provide ``drain(ctx)`` to wait the
+    in-flight prefetch of ``ctx.prefetched`` descriptors - the scheduler
+    calls it before spilling unrun lane entries at exit so no DMA outlives
+    its consumer.
+    """
+
+    def __init__(self, body, width: int = 8, prefetch: bool = False,
+                 drain=None) -> None:
+        if width < 1:
+            raise ValueError(f"batch width must be >= 1, got {width}")
+        if prefetch and drain is None:
+            raise ValueError(
+                "prefetch=True requires a drain(ctx) callback: the "
+                "scheduler must be able to retire in-flight prefetch DMAs "
+                "when it exits with lane entries unrun"
+            )
+        self.body = body
+        self.width = int(width)
+        self.prefetch = bool(prefetch)
+        self.drain = drain
+
+
+class BatchContext:
+    """Facilities exposed to batched-dispatch bodies: per-slot descriptor
+    access for the current (and prospective next) batch, plus the underlying
+    KernelContext facilities (``data``/``scratch``/value slots/overflow).
+
+    Slot liveness is a prefix: slots ``[0, count)`` are live, and a live
+    slot's descriptor row is ``idx(s)``. ``count`` is traced (1..width);
+    ``width`` is static - bodies unroll ``range(width)`` under
+    ``pl.when(s < count)``.
+    """
+
+    def __init__(self, kctx, lanes, li, head, count, width,
+                 prefetched, buf, prefetch_count, capacity):
+        self.k = kctx
+        self._lanes = lanes
+        self._li = li
+        self._head = head
+        self.count = count
+        self.width = width
+        # Prefetch protocol (zeros unless the spec opted in):
+        self.prefetched = prefetched      # slots already loaded last round
+        self.buf = buf                    # 0/1 operand half holding them
+        self.prefetch_count = prefetch_count  # next-batch slots to issue
+        self._capacity = capacity
+
+    # -- current batch --
+
+    def _row(self, pos):
+        """Lane entry at FIFO position ``pos``, clamped into the descriptor
+        table: dead-slot reads (callers guard semantics with ``live``) must
+        still be IN-BOUNDS SMEM accesses, and uninitialized lane words must
+        never index past the task table."""
+        row = self._lanes[
+            self._li, jnp.maximum(pos, 0) % self._capacity
+        ]
+        return jnp.clip(row, 0, self._capacity - 1)
+
+    def idx(self, s):
+        """Descriptor row of slot ``s`` (meaningful for s < count; clamped
+        but arbitrary otherwise)."""
+        return self._row(self._head + jnp.minimum(s, self.count - 1))
+
+    def live(self, s):
+        return jnp.int32(s) < self.count
+
+    def arg(self, s, i: int):
+        return self.k._tasks[self.idx(s), F_A0 + i]
+
+    def out_slot(self, s):
+        return self.k._tasks[self.idx(s), F_OUT]
+
+    def set_out(self, s, v) -> None:
+        """Write slot ``s``'s output value (callers guard liveness)."""
+        self.k.ivalues[self.out_slot(s)] = v
+
+    # -- prospective next batch (prefetch targets) --
+
+    def next_idx(self, s):
+        """Descriptor row of slot ``s`` of the NEXT batch (meaningful for
+        s < prefetch_count): lane pops are FIFO, so the entries behind the
+        current batch are exactly what the next batch round will pop."""
+        return self._row(
+            self._head + self.count
+            + jnp.minimum(s, self.prefetch_count - 1)
+        )
+
+    def next_arg(self, s, i: int):
+        return self.k._tasks[self.next_idx(s), F_A0 + i]
+
+    # -- KernelContext delegation --
+
+    @property
+    def data(self):
+        return self.k.data
+
+    @property
+    def scratch(self):
+        return self.k.scratch
+
+    def value(self, slot):
+        return self.k.value(slot)
+
+    def set_value(self, slot, v) -> None:
+        self.k.set_value(slot, v)
+
+    def add_executed(self, n) -> None:
+        self.k.add_executed(n)
+
+    def flag_overflow(self, cond) -> None:
+        self.k.flag_overflow(cond)
+
+
 def _is_vector_spec(fn) -> bool:
     from .vector_engine import VectorTaskSpec
 
     return isinstance(fn, VectorTaskSpec)
+
+
+def _is_batch_spec(fn) -> bool:
+    return isinstance(fn, BatchSpec)
+
+
+def _batch_stub(ctx: "KernelContext") -> None:
+    """Switch-table placeholder for a batch-routed kind. Unreachable by
+    construction: the scalar pop path diverts these F_FNs into their lane
+    before dispatch, so the branch only exists to keep the table dense."""
+    return None
 
 
 def _wrap_vector_spec(spec, interpret: bool):
@@ -468,46 +640,71 @@ class Megakernel:
         interpret: Optional[bool] = None,
         uses_row_values: bool = False,
         vmem_limit_bytes: Optional[int] = None,
+        route: Optional[Dict[str, Any]] = None,
         auto_route: Optional[Dict[str, Any]] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
-        # Auto-routing to the batch-dispatch tier: ``auto_route`` maps a
-        # kernel NAME to the VectorTaskSpec describing that task family
-        # (recursive + reduction-shaped; see device/vector_engine.py).
-        # Tasks with that F_FN are then dispatched as whole subtrees
-        # across the VPU lanes instead of one descriptor at a time - a
-        # user keeps the scalar kernel as the semantic definition and
-        # never pays the scalar tier's ~30 SMEM ops/task (~100 ns) for a
-        # family shape the vector tier handles. The routed entry is a
-        # drop-in at the DAG level: its subtree's reduction lands in the
-        # task's F_OUT value slot and its successors fire on completion,
-        # so irregular DAGs mix routed and scalar tasks freely. The spec
-        # must compute the same out value as the scalar kernel's subtree
-        # would; ``info['executed']`` counts expanded subtree nodes.
-        self.auto_route = dict(auto_route or {})
-        unknown = set(self.auto_route) - {name for name, _ in kernels}
+        # Dispatch-tier routing: ``route`` maps a kernel NAME to the spec
+        # of a non-scalar dispatch tier for that task family. Two tiers:
+        #
+        # - VectorTaskSpec (the subtree tier, device/vector_engine.py): a
+        #   recursive + reduction-shaped family whose tasks are dispatched
+        #   as whole subtrees across the VPU lanes - one descriptor pop
+        #   expands thousands of frame-tasks.
+        # - BatchSpec (the batched same-kind tier): ready descriptors of
+        #   this kind are diverted into a per-kind SMEM lane; each batch
+        #   round pops up to ``width`` of them and runs ONE tiled body over
+        #   the group (with optional cross-round operand prefetch) instead
+        #   of ``width`` sequential ``lax.switch`` dispatches.
+        #
+        # Either way the routed entry is a drop-in at the DAG level: its
+        # result lands where the scalar kernel's would and its successors
+        # fire on completion, so irregular DAGs mix routed and scalar
+        # tasks freely. A spec must compute the same values as the scalar
+        # kernel it replaces. ``auto_route`` is the legacy vector-tier-only
+        # spelling, kept as an alias.
+        self.route = dict(route or {})
+        if auto_route:
+            self.route.update(auto_route)
+        self.auto_route = self.route  # legacy alias
+        unknown = set(self.route) - {name for name, _ in kernels}
         if unknown:
             raise ValueError(
-                f"auto_route names unknown kernels: {sorted(unknown)}"
+                f"route/auto_route names unknown kernels: {sorted(unknown)}"
             )
         not_specs = [
-            n for n, s in self.auto_route.items() if not _is_vector_spec(s)
+            n for n, s in self.route.items()
+            if not (_is_vector_spec(s) or _is_batch_spec(s))
         ]
         if not_specs:
             raise ValueError(
-                f"auto_route values must be VectorTaskSpecs; "
+                f"route values must be VectorTaskSpecs or BatchSpecs; "
                 f"{sorted(not_specs)} are not"
             )
         self.kernel_names = [name for name, _ in kernels]
+        self.fn_id = {name: i for i, name in enumerate(self.kernel_names)}
+        # Batch-routed kinds never reach the switch table (the scheduler
+        # pops them into lanes): their branch is a no-op stub, so their
+        # batched body is the only trace (a scalar twin would force both
+        # bodies' scratch into every build).
+        self.batch_specs = sorted(
+            (
+                (self.fn_id[name], spec)
+                for name, spec in self.route.items()
+                if _is_batch_spec(spec)
+            ),
+            key=lambda kv: kv[0],
+        )
+        batched_ids = {fid for fid, _ in self.batch_specs}
         routed = [
-            (name, self.auto_route.get(name, fn)) for name, fn in kernels
+            (name, self.route.get(name, fn)) for name, fn in kernels
         ]
         self.kernel_fns = [
-            _wrap_vector_spec(fn, interpret) if _is_vector_spec(fn) else fn
-            for _, fn in routed
+            _wrap_vector_spec(fn, interpret) if _is_vector_spec(fn)
+            else (_batch_stub if i in batched_ids else fn)
+            for i, (_, fn) in enumerate(routed)
         ]
-        self.fn_id = {name: i for i, name in enumerate(self.kernel_names)}
         self.data_specs = dict(data_specs or {})
         self.scratch_specs = dict(scratch_specs or {})
         self.capacity = capacity
@@ -527,10 +724,14 @@ class Megakernel:
         # continuation transfer (dead writes otherwise - skipped).
         self.tracks_home = False
         self._jitted: Dict[int, Any] = {}  # fuel -> compiled call
-        # Packs counts + ivalues into one array so the host needs a single
-        # device->host fetch (transfers are ~67ms each through the axon
-        # tunnel; on a directly-attached TPU VM this matters far less).
-        self._packer = jax.jit(lambda c, v: jnp.concatenate([c, v]))
+        # Last run()'s info dict (incl. the batched-tier counters), for
+        # stats_dict() consumers that don't thread the return value.
+        self._last_info: Optional[Dict[str, Any]] = None
+        # Packs counts + ivalues (+ tier stats) into one array so the host
+        # needs a single device->host fetch (transfers are ~67ms each
+        # through the axon tunnel; on a directly-attached TPU VM this
+        # matters far less).
+        self._packer = jax.jit(lambda *a: jnp.concatenate(a))
 
     # -- the kernel body --
 
@@ -553,6 +754,9 @@ class Megakernel:
         ctx_hook: Optional[Callable[["KernelContext"], None]] = None,
         complete_hook=None,
         value_limit: Optional[int] = None,
+        lanes=None,
+        lstate=None,
+        tstats=None,
     ):
         """Builds the scheduler core closures over a concrete set of refs:
         ``stage()`` (copy host state into the mutable windows), and
@@ -570,6 +774,25 @@ class Megakernel:
         """
         capacity = self.capacity
         num_values = value_limit if value_limit is not None else self.num_values
+        # Batched same-kind dispatch tier: requires the per-kind lanes only
+        # Megakernel's own build allocates. The multi-device runners embed
+        # the scheduler without them - a batch-routed kind there would
+        # dispatch into its no-op switch stub and silently drop work, so
+        # refuse at trace time instead.
+        if self.batch_specs and lanes is None:
+            routed = sorted(
+                self.kernel_names[fid] for fid, _ in self.batch_specs
+            )
+            raise ValueError(
+                f"batch-routed kernels ({routed}) "
+                "need the batched dispatch tier's lane scratch, which only "
+                "Megakernel.run/_build provide - the embedding runners "
+                "(resident/ici/pgas/inject) run every kind scalar, and the "
+                "sharded runner's steal/export side cannot see lane "
+                "entries; drop the BatchSpec routes for those"
+            )
+        use_batch = lanes is not None and len(self.batch_specs) > 0
+        nbatch = len(self.batch_specs) if use_batch else 0
 
         # On TPU, SMEM output windows do NOT start with the aliased input's
         # contents (unlike interpret mode) - stage the initial scheduler
@@ -581,6 +804,17 @@ class Megakernel:
         def stage() -> None:
             free[0] = 0
             vfree[0] = 0
+            if use_batch:
+                # Lanes/prefetch state are per-entry scratch (sched() spills
+                # unrun entries back to the ready ring before returning, so
+                # nothing lives in a lane across entries); tstats is the
+                # tier's output window - zeroed here so reps report the
+                # last rep's per-graph counters.
+                for li in range(nbatch):
+                    for w in range(LS_WORDS):
+                        lstate[li, w] = 0
+                for w in range(TS_WORDS):
+                    tstats[w] = 0
             for i in range(8):
                 counts[i] = counts_in[i]
             # Row-owned value blocks sit directly above the host range.
@@ -693,12 +927,82 @@ class Megakernel:
             jax.lax.switch(tasks[idx, F_FN], branches)
             complete(idx)
 
+        def _lane_push(li, t) -> None:
+            tail = lstate[li, LS_TAIL]
+            lanes[li, tail % capacity] = t
+            lstate[li, LS_TAIL] = tail + 1
+
+        def _make_bctx(li, spec, head, take, pre, buf, nxt):
+            kctx = KernelContext(
+                lanes[li, head % capacity], tasks, succ, ready, counts,
+                ivalues, data, scratch, capacity, free, num_values, vfree,
+                self.uses_row_values, self.tracks_home,
+            )
+            if ctx_hook is not None:
+                ctx_hook(kctx)
+            return BatchContext(
+                kctx, lanes, li, head, take, spec.width, pre, buf, nxt,
+                capacity,
+            )
+
         def sched(fuel) -> None:
-            """Pop/dispatch/complete until the ready ring drains, `fuel`
-            tasks have run since this call, or the ring empties with work
-            still pending (a dependency cycle, a lost wakeup, or - sharded -
-            tasks parked on another device's queue; the caller rebalances
-            or inspects)."""
+            """Pop/dispatch/complete until the ready ring (and the per-kind
+            lanes, when the batched tier is on) drain, `fuel` tasks have run
+            since this call, or everything empties with work still pending
+            (a dependency cycle, a lost wakeup, or - sharded - tasks parked
+            on another device's queue; the caller rebalances or inspects).
+
+            With batch-routed kinds, each round dispatches EITHER one batch
+            (up to ``width`` same-kind descriptors through one tiled body)
+            or one scalar descriptor; a batch round may overshoot ``fuel``
+            by width-1 tasks."""
+
+            def batch_round(li, spec, e0) -> None:
+                B = spec.width
+                head = lstate[li, LS_HEAD]
+                avail = lstate[li, LS_TAIL] - head
+                take = jnp.minimum(avail, B)
+                # Cross-round prefetch handshake: an outstanding prefetch
+                # is ours iff it was issued for exactly this head (a spill
+                # or lane restage invalidates by clearing LS_PF_BASE).
+                pf_ok = lstate[li, LS_PF_BASE] == head + 1
+                pre = jnp.where(
+                    pf_ok, jnp.minimum(lstate[li, LS_PF_N], take), 0
+                )
+                buf = lstate[li, LS_PF_BUF]
+                if spec.prefetch:
+                    # Announce next-batch prefetch only when the lane keeps
+                    # entries AND fuel admits another round - the round
+                    # that consumes (or drains) the prefetch is then
+                    # guaranteed to run before sched() exits.
+                    may = ((avail - take) > 0) & (
+                        counts[C_EXECUTED] - e0 + take < fuel
+                    )
+                    nxt = jnp.where(may, jnp.minimum(avail - take, B), 0)
+                else:
+                    nxt = jnp.int32(0)
+                bctx = _make_bctx(li, spec, head, take, pre, buf, nxt)
+                spec.body(bctx)
+                for s in range(B):
+                    @pl.when(jnp.int32(s) < take)
+                    def _(s=s):
+                        complete(lanes[li, (head + s) % capacity])
+                lstate[li, LS_HEAD] = head + take
+                lstate[li, LS_PF_BASE] = jnp.where(
+                    nxt > 0, head + take + 1, 0
+                )
+                lstate[li, LS_PF_N] = nxt
+                # The half a prefetch targets is always 1 - buf; the next
+                # round consumes (or on-demand-fills) that half, so the
+                # parity alternates every round.
+                lstate[li, LS_PF_BUF] = 1 - buf
+                tstats[TS_BATCH_ROUNDS] = tstats[TS_BATCH_ROUNDS] + 1
+                tstats[TS_BATCH_TASKS] = tstats[TS_BATCH_TASKS] + take
+                tstats[TS_OFFERED] = tstats[TS_OFFERED] + B
+                tstats[TS_PREFETCH] = tstats[TS_PREFETCH] + pre
+                tstats[TS_FULL_ROUNDS] = tstats[TS_FULL_ROUNDS] + (
+                    take == B
+                ).astype(jnp.int32)
 
             def cond(carry):
                 # `fuel` budgets *this call*: compare against tasks executed
@@ -715,24 +1019,88 @@ class Megakernel:
                 _, _, e0, _ = carry
                 head = counts[C_HEAD]
                 tail = counts[C_TAIL]
-                has_work = head < tail
+                ring_work = head < tail
+                if not use_batch:
+                    @pl.when(ring_work)
+                    def _():
+                        # LIFO on the owner side (newest first, depth-first,
+                        # small live sets); the head side is the
+                        # steal/export side (device/sharded.py,
+                        # device/ici_steal.py) - the Chase-Lev split of the
+                        # reference deque (src/hclib-deque.c).
+                        idx = ready[(tail - 1) % capacity]
+                        counts[C_TAIL] = tail - 1
+                        step(idx)
 
-                @pl.when(has_work)
+                    return (
+                        counts[C_PENDING],
+                        counts[C_EXECUTED],
+                        e0,
+                        jnp.logical_not(ring_work),
+                    )
+                avails = [
+                    lstate[li, LS_TAIL] - lstate[li, LS_HEAD]
+                    for li in range(nbatch)
+                ]
+                lane_work = functools.reduce(
+                    jnp.logical_or, [a > 0 for a in avails]
+                )
+                # Lane firing policy: lanes fire only once the ring drains.
+                # Ring pops cost ~10 SMEM ops each and keep routing more
+                # same-kind descriptors into the lanes, so waiting them out
+                # maximizes batch occupancy AND leaves entries queued behind
+                # each batch - which is what engages the cross-round
+                # prefetch. Ready kinds that are all batch-routed reach
+                # their lane within a handful of rounds, so the added
+                # latency is noise against one kernel body. One dispatch
+                # per round; among eligible lanes the lowest F_FN wins.
+                fired = jnp.bool_(False)
+                for li, (fid, spec) in enumerate(self.batch_specs):
+                    eligible = (avails[li] > 0) & jnp.logical_not(ring_work)
+
+                    @pl.when(eligible & jnp.logical_not(fired))
+                    def _(li=li, spec=spec, e0=e0):
+                        batch_round(li, spec, e0)
+
+                    fired = fired | eligible
+
+                @pl.when(jnp.logical_not(fired) & ring_work)
                 def _():
-                    # LIFO on the owner side (newest first, depth-first,
-                    # small live sets); the head side is the steal/export
-                    # side (device/sharded.py, device/ici_steal.py) - the
-                    # Chase-Lev split of the reference deque
-                    # (src/hclib-deque.c).
                     idx = ready[(tail - 1) % capacity]
                     counts[C_TAIL] = tail - 1
-                    step(idx)
+                    # Pop-time partitioning: batch-routed kinds divert into
+                    # their lane (one compare per routed kind) no matter
+                    # who pushed them - stage, spawn, install_descriptor,
+                    # and completion all funnel through the ring, so the
+                    # ring stays the single persistent structure and the
+                    # lanes never survive a kernel exit.
+                    fn = tasks[idx, F_FN]
+                    routed = jnp.bool_(False)
+                    for li, (fid, _) in enumerate(self.batch_specs):
+                        hit = fn == jnp.int32(fid)
+
+                        @pl.when(hit)
+                        def _(li=li, idx=idx):
+                            _lane_push(li, idx)
+
+                        routed = routed | hit
+
+                    @pl.when(jnp.logical_not(routed))
+                    def _():
+                        step(idx)
+                        tstats[TS_SCALAR_ROUNDS] = (
+                            tstats[TS_SCALAR_ROUNDS] + 1
+                        )
+
+                    @pl.when(routed)
+                    def _():
+                        tstats[TS_ROUTED] = tstats[TS_ROUTED] + 1
 
                 return (
                     counts[C_PENDING],
                     counts[C_EXECUTED],
                     e0,
-                    jnp.logical_not(has_work),
+                    jnp.logical_not(ring_work | lane_work),
                 )
 
             e0 = counts[C_EXECUTED]
@@ -741,6 +1109,34 @@ class Megakernel:
                 body,
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
+            if use_batch:
+                # Exit with unrun lane entries (fuel exhaustion): retire
+                # any in-flight prefetch, then spill the entries back to
+                # the ready ring - the ring is the only structure whose
+                # contents survive this call (outputs/readback, restage,
+                # host stall diagnosis).
+                for li, (fid, spec) in enumerate(self.batch_specs):
+                    h = lstate[li, LS_HEAD]
+                    t = lstate[li, LS_TAIL]
+                    if spec.prefetch:
+                        pf_ok = lstate[li, LS_PF_BASE] == h + 1
+                        pre = jnp.where(pf_ok, lstate[li, LS_PF_N], 0)
+
+                        @pl.when(pre > 0)
+                        def _(li=li, spec=spec, h=h, pre=pre):
+                            spec.drain(_make_bctx(
+                                li, spec, h, pre, pre,
+                                lstate[li, LS_PF_BUF], jnp.int32(0),
+                            ))
+
+                    def spill(s, _, li=li, h=h):
+                        push_ready(lanes[li, (h + s) % capacity])
+                        return 0
+
+                    jax.lax.fori_loop(0, t - h, spill, 0)
+                    lstate[li, LS_HEAD] = t
+                    lstate[li, LS_PF_BASE] = 0
+                    tstats[TS_SPILLED] = tstats[TS_SPILLED] + (t - h)
 
         def install_descriptor(read_word):
             """Adopt one externally-produced descriptor row (a stolen row
@@ -792,19 +1188,26 @@ class Megakernel:
         self, fuel: int, reps: int, stage_all_values: bool, *refs
     ) -> None:
         ndata = len(self.data_specs)
+        nbatch = len(self.batch_specs)
         n_in = 5 + ndata
+        n_out = 4 + ndata + (1 if nbatch else 0)
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata]
-        scratch_refs = refs[n_in + 4 + ndata : -2]
-        free = refs[-2]  # internal free-stack: [0]=count, [1..]=rows
-        vfree = refs[-1]  # value-block free-stack, same layout
+        out_refs = refs[n_in : n_in + n_out]
+        n_tail = 4 if nbatch else 2  # free, vfree [, lanes, lstate]
+        scratch_refs = refs[n_in + n_out : -n_tail]
+        free = refs[-n_tail]  # internal free-stack: [0]=count, [1..]=rows
+        vfree = refs[-n_tail + 1]  # value-block free-stack, same layout
+        lanes = refs[-2] if nbatch else None  # per-kind ready lanes
+        lstate = refs[-1] if nbatch else None  # lane cursors + prefetch
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         tasks, ready, counts, ivalues = out_refs[:4]
-        data = dict(zip(self.data_specs.keys(), out_refs[4:]))
+        data = dict(zip(self.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        tstats = out_refs[4 + ndata] if nbatch else None
         scratch = dict(zip(self.scratch_specs.keys(), scratch_refs))
         core = self._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, stage_all_values,
+            lanes=lanes, lstate=lstate, tstats=tstats,
         )
 
         def one_rep(r, total_executed) -> jnp.int32:
@@ -856,12 +1259,20 @@ class Megakernel:
         callers must pass stage_all_values=True so value slots above
         value_alloc survive between entries)."""
         ndata = len(self.data_specs)
+        nbatch = len(self.batch_specs)
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
         in_specs = [smem(), smem(), smem(), smem(), smem()] + [
             anyspace() for _ in range(ndata)
         ]
-        out_specs = tuple([smem(), smem(), smem(), smem()] + [anyspace() for _ in range(ndata)])
+        out_specs = tuple(
+            [smem(), smem(), smem(), smem()]
+            + [anyspace() for _ in range(ndata)]
+            # Batched-tier counters ride out as one extra SMEM word row
+            # APPENDED after the data outputs, so every existing consumer's
+            # positional indexing is untouched.
+            + ([smem()] if nbatch else [])
+        )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype) for s in self.data_specs.values()
         ]
@@ -873,9 +1284,10 @@ class Megakernel:
                 jax.ShapeDtypeStruct((self.num_values,), jnp.int32),
             ]
             + data_shapes
+            + ([jax.ShapeDtypeStruct((TS_WORDS,), jnp.int32)] if nbatch else [])
         )
         # inputs: tasks(0) succ(1) ready(2) counts(3) ivalues(4) data(5..)
-        # outputs: tasks(0) ready(1) counts(2) ivalues(3) data(4..)
+        # outputs: tasks(0) ready(1) counts(2) ivalues(3) data(4..) [tstats]
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
             aliases[5 + i] = 4 + i
@@ -888,7 +1300,15 @@ class Megakernel:
             + [
                 pltpu.SMEM((self.capacity + 1,), jnp.int32),
                 pltpu.SMEM((self.num_values // VBLOCK + 1,), jnp.int32),
-            ],
+            ]
+            + (
+                [
+                    pltpu.SMEM((nbatch, self.capacity), jnp.int32),
+                    pltpu.SMEM((nbatch, LS_WORDS), jnp.int32),
+                ]
+                if nbatch
+                else []
+            ),
             input_output_aliases=aliases,
             # Plain bool on purpose: True selects the fast XLA-backed
             # pallas interpreter. interpret_mode()'s InterpretParams
@@ -907,6 +1327,38 @@ class Megakernel:
 
     def _build(self, fuel: int, reps: int = 1):
         return jax.jit(self._build_raw(fuel, reps))
+
+    def decode_tier_stats(self, tstats) -> Dict[str, Any]:
+        """Decode the raw TS_WORDS counter row into the per-tier stats dict
+        (``info['tiers']``). Occupancy is batch tasks over the slots the
+        fired rounds offered (TS_OFFERED accumulates each firing lane's own
+        width, so the ratio stays exact with mixed-width routes) - the
+        number perf tracking watches: low occupancy means the DAG isn't
+        exposing same-kind parallelism (or the firing policy is
+        dispatching partial batches too eagerly)."""
+        t = np.asarray(tstats)
+        rounds = int(t[TS_BATCH_ROUNDS])
+        tasks = int(t[TS_BATCH_TASKS])
+        offered = int(t[TS_OFFERED])
+        width = max(spec.width for _, spec in self.batch_specs)
+        return {
+            "batch_rounds": rounds,
+            "batch_tasks": tasks,
+            "batch_occupancy": tasks / offered if offered else 0.0,
+            "batch_width": width,
+            "full_rounds": int(t[TS_FULL_ROUNDS]),
+            "scalar_tasks": int(t[TS_SCALAR_ROUNDS]),
+            "routed": int(t[TS_ROUTED]),
+            "prefetch_hits": int(t[TS_PREFETCH]),
+            "spilled": int(t[TS_SPILLED]),
+        }
+
+    def stats_dict(self) -> Dict[str, Any]:
+        """Stats snapshot of the most recent ``run()`` (per-tier dispatch
+        counters included when batch-routed); {} before any run. The
+        benches and tools/perf_regression.py read this so tier occupancy
+        never floats free of a harness."""
+        return dict(self._last_info or {})
 
     def run(
         self,
@@ -962,10 +1414,15 @@ class Megakernel:
                 jnp.asarray(ivalues),
                 *[jnp.asarray(data[k]) for k in self.data_specs.keys()],
             )
+        ndata = len(self.data_specs)
         tasks_out, ready_out, counts_out, ivalues_out = outs[:4]
-        data_out = dict(zip(self.data_specs.keys(), outs[4:]))
-        packed = np.asarray(self._packer(counts_out, ivalues_out))
-        counts_np, ivalues_np = packed[:8], packed[8:]
+        data_out = dict(zip(self.data_specs.keys(), outs[4 : 4 + ndata]))
+        packs = [counts_out, ivalues_out]
+        if self.batch_specs:
+            packs.append(outs[4 + ndata])
+        packed = np.asarray(self._packer(*packs))
+        counts_np = packed[:8]
+        ivalues_np = packed[8 : 8 + self.num_values]
         info = {
             "executed": int(counts_np[C_EXECUTED]),
             "pending": int(counts_np[C_PENDING]),
@@ -973,6 +1430,11 @@ class Megakernel:
             "value_alloc": int(counts_np[C_VALLOC]),
             "overflow": bool(counts_np[C_OVERFLOW]),
         }
+        if self.batch_specs:
+            info["tiers"] = self.decode_tier_stats(
+                packed[8 + self.num_values :]
+            )
+        self._last_info = info
         if info["overflow"]:
             raise RuntimeError(
                 f"megakernel overflow: "

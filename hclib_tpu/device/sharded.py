@@ -183,6 +183,17 @@ class ShardedMegakernel:
     ) -> None:
         if len(mesh.axis_names) != 1:
             raise ValueError("ShardedMegakernel wants a 1D mesh (queue axis)")
+        if mk.batch_specs:
+            # _build_raw WOULD supply the lanes, but the steal/export side
+            # scans only the ready ring (lane entries would be invisible to
+            # thieves) and the appended tstats output breaks this runner's
+            # positional out_specs - refuse clearly instead of failing with
+            # an opaque shard_map pytree mismatch at trace time.
+            raise ValueError(
+                "ShardedMegakernel does not support batch-routed kernels "
+                f"({sorted(mk.kernel_names[fid] for fid, _ in mk.batch_specs)}); "
+                "drop the BatchSpec routes for the sharded runner"
+            )
         self.mk = mk
         self.mesh = mesh
         self.axis = mesh.axis_names[0]

@@ -37,6 +37,7 @@ from .megakernel import KernelContext, Megakernel
 __all__ = [
     "device_sw", "make_sw_megakernel", "device_sw_wave",
     "make_sw_wave_megakernel", "build_sw_wave_graph", "sw_wave_buffers",
+    "device_sw_batched", "make_sw_batched_megakernel", "build_sw_tile_graph",
 ]
 
 T = 128
@@ -147,98 +148,170 @@ def _sw_tile_kernel(ctx: KernelContext, with_h: bool = True) -> None:
     ctx.set_value(0, jnp.maximum(best, tile_max))
 
 
-WAVE_R = 8  # tiles batched per wave task (VPU sublanes)
+WAVE_R = 8  # tile slots per wave-chunk descriptor (VPU sublanes)
 WAVE_FN = 0
+WAVE_B = 2  # chunk descriptors per batch round (16 stacked tile planes)
 
 
-def _sw_wave_kernel(ctx: KernelContext, with_h: bool = True) -> None:
-    """A *wave task*: up to WAVE_R tiles of one anti-diagonal processed as
-    stacked (R, T) VPU planes - the dep-bearing wavefront riding the
-    megakernel's batch-dispatch idea (VERDICT r3 #4's alternative
-    criterion). Where the tile kernel sweeps one (1, T) row per VPU step,
-    this sweeps the SAME row index of R tiles at once: sub/diag/cummax all
-    become (R, T) plane ops, so the vector unit runs ~R tiles for one
-    tile's instruction count. Dependencies stay REAL: wave chunks are
-    descriptor tasks whose dep counters encode the anti-diagonal order
-    (chunk of wave w waits on every chunk of wave w-1), exactly the
-    reference's wavefront DAG (test/smithwaterman/smith_waterman.cpp:
-    77-180) regrouped for the hardware.
+def _zero_slot(ctx, buf, slot) -> None:
+    """Uniform zero planes for a dead tile slot (scores can't leak: vb of
+    -1 never matches a real character)."""
+    zrow = jnp.zeros((1, T), jnp.int32)
+    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    ctx.scratch["vtop"][buf, pl.ds(slot, 1)] = zrow
+    ctx.scratch["vleft"][buf, pl.ds(slot, 1)] = zrow
+    ctx.scratch["vcorn"][buf, pl.ds(slot, 1)] = zrow
+    va[buf, pl.ds(slot, 1)] = zrow
+    vb[buf, pl.ds(slot, 1)] = zrow - 1
 
-    args: [w, lo, count] - tiles (ti, w - ti) for ti in [lo, lo+count).
-    """
-    w, lo, count = ctx.arg(0), ctx.arg(1), ctx.arg(2)
+
+def _chunk_dma(ctx, buf, b, chunk: int, w, lo, cnt, wait: bool) -> None:
+    """Start (``wait=False``) or retire (``wait=True``) the operand copies
+    of chunk descriptor ``b`` - tiles (lo+s, w-lo-s) for s < cnt - into
+    operand half ``buf``. Starts and waits are split so a round can put
+    EVERY copy of every slot in flight before the first wait (the old
+    wave kernel's serial start/wait per tile paid ~40 DMA latencies per
+    chunk - the single biggest term in BENCH_r05's 1.2 GCUPS), and so the
+    prefetch path can issue the identical starts one round early. One
+    DMA semaphore per (half, slot) counts all five streams; every start
+    is matched by exactly one wait under the same predicate."""
     aseq, bseq = ctx.data["aseq"], ctx.data["bseq"]
     bot, right = ctx.data["bot"], ctx.data["right"]
-    htiles = ctx.data["htiles"] if with_h else None
-    R = WAVE_R
-    va = ctx.scratch["va"]  # (R, T) a chars per slot
-    vb = ctx.scratch["vb"]  # (R, T) b chars per slot
-    vtop = ctx.scratch["vtop"]  # (R, T) incoming top boundaries
-    vleft = ctx.scratch["vleft"]  # (R, T) incoming left boundaries
-    vcorn = ctx.scratch["vcorn"]  # (R, T) incoming corner rows
-    vh = ctx.scratch["vwh"] if with_h else None  # (R, T, T) the R tiles' H
-    sems = ctx.scratch["sems"]
-
-    def dma(src, dst, s):
-        cp = pltpu.make_async_copy(src, dst, s)
-        cp.start()
-        cp.wait()
-
+    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    vtop, vleft = ctx.scratch["vtop"], ctx.scratch["vleft"]
+    vcorn = ctx.scratch["vcorn"]
+    lsem = ctx.scratch["lsem"]
     zrow = jnp.zeros((1, T), jnp.int32)
-    for s in range(R):  # static slots
+    for s in range(chunk):
+        slot = b * chunk + s
         ti = lo + s
         tj = w - ti
-        live = s < count
+        sem = lsem.at[buf, slot]
 
-        @pl.when(live)
-        def _(s=s, ti=ti, tj=tj):
-            dma(aseq.at[ti], va.at[pl.ds(s, 1)], sems.at[0])
-            dma(bseq.at[tj], vb.at[pl.ds(s, 1)], sems.at[1])
+        def go(src, dst):
+            cp = pltpu.make_async_copy(src, dst, sem)
+            (cp.wait if wait else cp.start)()
+
+        @pl.when(jnp.int32(s) < cnt)
+        def _(slot=slot, ti=ti, tj=tj, go=go):
+            go(aseq.at[ti], va.at[buf, pl.ds(slot, 1)])
+            go(bseq.at[tj], vb.at[buf, pl.ds(slot, 1)])
 
             @pl.when(ti > 0)
             def _():
-                dma(bot.at[ti - 1, tj], vtop.at[pl.ds(s, 1)], sems.at[2])
-
-            @pl.when(ti == 0)
-            def _():
-                vtop[pl.ds(s, 1), :] = zrow
+                go(bot.at[ti - 1, tj], vtop.at[buf, pl.ds(slot, 1)])
 
             @pl.when(tj > 0)
             def _():
-                dma(right.at[ti, tj - 1], vleft.at[pl.ds(s, 1)], sems.at[3])
-
-            @pl.when(tj == 0)
-            def _():
-                vleft[pl.ds(s, 1), :] = zrow
+                go(right.at[ti, tj - 1], vleft.at[buf, pl.ds(slot, 1)])
 
             @pl.when((ti > 0) & (tj > 0))
             def _():
-                dma(
-                    right.at[ti - 1, tj - 1], vcorn.at[pl.ds(s, 1)],
-                    sems.at[0],
+                go(
+                    right.at[ti - 1, tj - 1],
+                    vcorn.at[buf, pl.ds(slot, 1)],
                 )
 
-            @pl.when((ti == 0) | (tj == 0))
-            def _():
-                vcorn[pl.ds(s, 1), :] = zrow
+            if not wait:
+                @pl.when(ti == 0)
+                def _():
+                    vtop[buf, pl.ds(slot, 1)] = zrow
 
-        @pl.when(jnp.logical_not(live))
-        def _(s=s):
-            # Dead slots sweep zeros (harmless, keeps the planes uniform).
-            vtop[pl.ds(s, 1), :] = zrow
-            vleft[pl.ds(s, 1), :] = zrow
-            vcorn[pl.ds(s, 1), :] = zrow
-            va[pl.ds(s, 1), :] = zrow
-            vb[pl.ds(s, 1), :] = zrow - 1  # never matches a real char
+                @pl.when(tj == 0)
+                def _():
+                    vleft[buf, pl.ds(slot, 1)] = zrow
 
-    lane = jax.lax.broadcasted_iota(jnp.int32, (R, T), 1)
-    bplane = vb[:]
-    aplane = va[:]
-    leftp = vleft[:]
-    corner = vcorn[:][:, T - 1 :]  # (R, 1)
+                @pl.when((ti == 0) | (tj == 0))
+                def _():
+                    vcorn[buf, pl.ds(slot, 1)] = zrow
+
+        if not wait:
+            @pl.when(jnp.int32(s) >= cnt)
+            def _(slot=slot):
+                _zero_slot(ctx, buf, slot)
+
+
+def _sw_wave_batch_kernel(ctx, chunk: int, with_h: bool = True) -> None:
+    """Batched-tier SW wavefront body: up to ``ctx.width`` same-kind wave
+    descriptors per round, each carrying up to ``chunk`` anti-diagonal
+    tiles, swept together as (width*chunk, T) VPU planes - the tile
+    kernel's (1, T) row recurrence runs width*chunk tiles per VPU step.
+    Dependencies stay REAL: descriptors are DAG tasks whose dep counters
+    encode the wavefront order (the reference's wavefront DAG,
+    test/smithwaterman/smith_waterman.cpp:77-180, regrouped for the
+    hardware); the scheduler's per-F_FN lane is what groups the
+    simultaneously-ready ones.
+
+    Operand motion is double-buffered across rounds via the tier's
+    prefetch protocol: ``ctx.prefetched`` descriptors already have their
+    boundaries in half ``ctx.buf`` (issued during the PREVIOUS round's
+    compute), the rest start now; the next prospective batch's copies are
+    put in flight into the other half before this round's waits, so they
+    ride under this round's 128-row sweep. A lane entry's inputs are
+    final before it enters the lane (its predecessors' stores drained
+    before their completion), which is what makes the early issue safe.
+
+    descriptor args: [w, lo, count] - tiles (ti, w - ti), ti in
+    [lo, lo+count). A per-tile graph is the chunk=1 special case.
+    """
+    width = ctx.width
+    S = width * chunk
+    buf = ctx.buf
+    vtop, vleft = ctx.scratch["vtop"], ctx.scratch["vleft"]
+    vcorn = ctx.scratch["vcorn"]
+    va, vb = ctx.scratch["va"], ctx.scratch["vb"]
+    vh = ctx.scratch["vwh"] if with_h else None
+    htiles = ctx.data["htiles"] if with_h else None
+    bot, right = ctx.data["bot"], ctx.data["right"]
+    ssem = ctx.scratch["ssem"]
+
+    # Phase 1: start operand copies for live descriptors the prefetch
+    # didn't cover; zero the dead ones.
+    for b in range(width):
+        @pl.when(ctx.live(b) & (jnp.int32(b) >= ctx.prefetched))
+        def _(b=b):
+            _chunk_dma(
+                ctx, buf, b, chunk,
+                ctx.arg(b, 0), ctx.arg(b, 1), ctx.arg(b, 2), wait=False,
+            )
+
+        @pl.when(jnp.logical_not(ctx.live(b)))
+        def _(b=b):
+            for s in range(chunk):
+                _zero_slot(ctx, buf, b * chunk + s)
+
+    # Phase 2: put the NEXT batch's copies in flight into the other half -
+    # they land while this round computes, so the next round starts its
+    # sweep without a single boundary-DMA stall.
+    obuf = 1 - buf
+    for b in range(width):
+        @pl.when(jnp.int32(b) < ctx.prefetch_count)
+        def _(b=b):
+            _chunk_dma(
+                ctx, obuf, b, chunk,
+                ctx.next_arg(b, 0), ctx.next_arg(b, 1), ctx.next_arg(b, 2),
+                wait=False,
+            )
+
+    # Phase 3: retire this round's loads (prefetched and fresh alike wait
+    # the same (src, dst, sem) triples their starts used).
+    for b in range(width):
+        @pl.when(ctx.live(b))
+        def _(b=b):
+            _chunk_dma(
+                ctx, buf, b, chunk,
+                ctx.arg(b, 0), ctx.arg(b, 1), ctx.arg(b, 2), wait=True,
+            )
+
+    # Phase 4: the (S, T) wavefront sweep.
+    lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    aplane = va[buf]
+    bplane = vb[buf]
+    leftp = vleft[buf]
+    corner = vcorn[buf][:, T - 1 :]  # (S, 1)
 
     def col(plane, i):
-        """Column i of an (R, T) plane as (R, 1): mask + lane-reduce
+        """Column i of an (S, T) plane as (S, 1): mask + lane-reduce
         (Mosaic has no dynamic_slice on values; this is 2 plane ops)."""
         return jnp.sum(
             jnp.where(lane == i, plane, 0), axis=1, keepdims=True
@@ -264,45 +337,135 @@ def _sw_wave_kernel(ctx: KernelContext, with_h: bool = True) -> None:
         # of rout - pure plane ops, no scalar extracts in the hot loop.
         rcol = hrow[:, T - 1 :]
         rout = jnp.where(lane == i, rcol, rout)
-        mplane = jnp.maximum(carry[2], hrow)
+        mplane = jnp.maximum(_mpl, hrow)
         return hrow, rout, mplane
 
-    zero_rt = jnp.zeros((R, T), jnp.int32)
+    zero_st = jnp.zeros((S, T), jnp.int32)
     hlast, rout, mplane = jax.lax.fori_loop(
-        0, T, row, (vtop[:], zero_rt, zero_rt)
+        0, T, row, (vtop[buf], zero_st, zero_st)
     )
-    vtop[:] = hlast  # reuse as staging for the bottom-row stores
-    vleft[:] = rout  # staging for the right-column stores
-    vcorn[:] = mplane  # staging: per-slot running max planes
+    # Reuse this half as store staging (the prefetch lives in the other
+    # half, and these stores drain before this body returns).
+    vtop[buf] = hlast
+    vleft[buf] = rout
+    vcorn[buf] = mplane
+    mall = vcorn[buf]
 
-    for s in range(R):
-        ti = lo + s
-        tj = w - ti
+    # Phase 5: publish boundaries (+ tiles), fold the running best score;
+    # all stores start together, then all are waited - successors may be
+    # dispatched the moment this body returns, so nothing may still be in
+    # flight toward the boundary buffers they read.
+    def stores(wait: bool):
+        for b in range(width):
+            @pl.when(ctx.live(b))
+            def _(b=b):
+                w, lo, cnt = ctx.arg(b, 0), ctx.arg(b, 1), ctx.arg(b, 2)
+                for s in range(chunk):
+                    slot = b * chunk + s
+                    ti = lo + s
+                    tj = w - ti
 
-        @pl.when(s < count)
-        def _(s=s, ti=ti, tj=tj):
-            dma(vtop.at[pl.ds(s, 1)], bot.at[ti, tj], sems.at[0])
-            dma(vleft.at[pl.ds(s, 1)], right.at[ti, tj], sems.at[1])
-            if with_h:
-                dma(vh.at[s], htiles.at[ti, tj], sems.at[2])
-            m = jnp.max(vcorn[s])
-            ctx.set_value(0, jnp.maximum(ctx.value(0), m))
+                    @pl.when(jnp.int32(s) < cnt)
+                    def _(slot=slot, ti=ti, tj=tj):
+                        def go(src, dst):
+                            cp = pltpu.make_async_copy(
+                                src, dst, ssem.at[slot]
+                            )
+                            (cp.wait if wait else cp.start)()
 
-    # Each wave task accounts for `count` tiles (itself + count-1 extra),
-    # so 'executed' counts tiles across tiers, as the vector tier does.
-    ctx.add_executed(count - 1)
+                        go(vtop.at[buf, pl.ds(slot, 1)], bot.at[ti, tj])
+                        go(vleft.at[buf, pl.ds(slot, 1)], right.at[ti, tj])
+                        if with_h:
+                            go(vh.at[slot], htiles.at[ti, tj])
+
+                if not wait:
+                    # Each descriptor accounts for `cnt` tiles (itself +
+                    # cnt-1 extra) so 'executed' counts tiles across
+                    # tiers, as the vector tier does.
+                    ctx.add_executed(cnt - 1)
+                    for s in range(chunk):
+                        @pl.when(jnp.int32(s) < cnt)
+                        def _(s=s, b=b):
+                            m = jnp.max(mall[b * chunk + s])
+                            ctx.set_value(
+                                0, jnp.maximum(ctx.value(0), m)
+                            )
+
+    stores(wait=False)
+    stores(wait=True)
+
+
+def _sw_wave_drain(ctx, chunk: int) -> None:
+    """Retire an in-flight prefetch whose targets will be spilled instead
+    of batched (scheduler exit with lane entries unrun): wait the same
+    copies Phase 2 started, so no DMA outlives the kernel's round loop."""
+    for b in range(ctx.width):
+        @pl.when(jnp.int32(b) < ctx.prefetched)
+        def _(b=b):
+            _chunk_dma(
+                ctx, ctx.buf, b, chunk,
+                ctx.arg(b, 0), ctx.arg(b, 1), ctx.arg(b, 2), wait=True,
+            )
+
+
+def _sw_batch_megakernel(
+    nt_i: int, nt_j: int, interpret: Optional[bool], with_h: bool,
+    chunk: int, width: int, capacity: int, succ_capacity: int,
+) -> Megakernel:
+    import functools as _ft
+
+    from .megakernel import BatchSpec, _batch_stub
+
+    i32 = jnp.int32
+    S = width * chunk
+    data_specs = {
+        "aseq": jax.ShapeDtypeStruct((nt_i, 1, T), i32),
+        "bseq": jax.ShapeDtypeStruct((nt_j, 1, T), i32),
+        "bot": jax.ShapeDtypeStruct((nt_i, nt_j, 1, T), i32),
+        "right": jax.ShapeDtypeStruct((nt_i, nt_j, 1, T), i32),
+    }
+    scratch = {
+        # Operand planes are double-buffered (leading 2): one half computes
+        # while the tier's prefetch fills the other.
+        "va": pltpu.VMEM((2, S, T), i32),
+        "vb": pltpu.VMEM((2, S, T), i32),
+        "vtop": pltpu.VMEM((2, S, T), i32),
+        "vleft": pltpu.VMEM((2, S, T), i32),
+        "vcorn": pltpu.VMEM((2, S, T), i32),
+        "lsem": pltpu.SemaphoreType.DMA((2, S)),
+        "ssem": pltpu.SemaphoreType.DMA((S,)),
+    }
+    if with_h:
+        data_specs["htiles"] = jax.ShapeDtypeStruct((nt_i, nt_j, T, T), i32)
+        scratch["vwh"] = pltpu.VMEM((S, T, T), i32)
+    return Megakernel(
+        kernels=[("sw_wave", _batch_stub)],
+        route={
+            "sw_wave": BatchSpec(
+                _ft.partial(
+                    _sw_wave_batch_kernel, chunk=chunk, with_h=with_h
+                ),
+                width=width,
+                prefetch=True,
+                drain=_ft.partial(_sw_wave_drain, chunk=chunk),
+            )
+        },
+        data_specs=data_specs,
+        scratch_specs=scratch,
+        capacity=capacity,
+        num_values=8,
+        succ_capacity=succ_capacity,
+        interpret=interpret,
+    )
 
 
 def make_sw_wave_megakernel(
     nt_i: int, nt_j: int, interpret: Optional[bool] = None,
-    with_h: bool = True,
+    with_h: bool = True, chunk: int = WAVE_R, width: int = WAVE_B,
 ) -> Megakernel:
-    import functools as _ft
-
-    i32 = jnp.int32
     nwaves = nt_i + nt_j - 1
     chunks = [
-        -(-min(w + 1, nt_i, nt_j, nt_i + nt_j - 1 - w) // WAVE_R)
+        -(-min(w + 1, nt_i, nt_j, nt_i + nt_j - 1 - w) // chunk)
         for w in range(nwaves)
     ]
     ntasks = sum(chunks)
@@ -313,36 +476,16 @@ def make_sw_wave_megakernel(
     csr_words = sum(
         chunks[w] * max(0, chunks[w + 1] - 2) for w in range(nwaves - 1)
     )
-    data_specs = {
-        "aseq": jax.ShapeDtypeStruct((nt_i, 1, T), i32),
-        "bseq": jax.ShapeDtypeStruct((nt_j, 1, T), i32),
-        "bot": jax.ShapeDtypeStruct((nt_i, nt_j, 1, T), i32),
-        "right": jax.ShapeDtypeStruct((nt_i, nt_j, 1, T), i32),
-    }
-    scratch = {
-        "va": pltpu.VMEM((WAVE_R, T), i32),
-        "vb": pltpu.VMEM((WAVE_R, T), i32),
-        "vtop": pltpu.VMEM((WAVE_R, T), i32),
-        "vleft": pltpu.VMEM((WAVE_R, T), i32),
-        "vcorn": pltpu.VMEM((WAVE_R, T), i32),
-        "sems": pltpu.SemaphoreType.DMA((4,)),
-    }
-    if with_h:
-        data_specs["htiles"] = jax.ShapeDtypeStruct((nt_i, nt_j, T, T), i32)
-        scratch["vwh"] = pltpu.VMEM((WAVE_R, T, T), i32)
-    return Megakernel(
-        kernels=[("sw_wave", _ft.partial(_sw_wave_kernel, with_h=with_h))],
-        data_specs=data_specs,
-        scratch_specs=scratch,
-        capacity=max(64, ntasks),
-        num_values=8,
-        succ_capacity=max(64, csr_words),
-        interpret=interpret,
+    return _sw_batch_megakernel(
+        nt_i, nt_j, interpret, with_h, chunk, width,
+        capacity=max(64, ntasks), succ_capacity=max(64, csr_words),
     )
 
 
-def build_sw_wave_graph(nt_i: int, nt_j: int) -> TaskGraphBuilder:
-    """Wave-chunk task DAG: up to WAVE_R tiles of one anti-diagonal per
+def build_sw_wave_graph(
+    nt_i: int, nt_j: int, chunk: int = WAVE_R
+) -> TaskGraphBuilder:
+    """Wave-chunk task DAG: up to ``chunk`` tiles of one anti-diagonal per
     task, consecutive anti-diagonals chained by dependencies (shared by
     device_sw_wave and the bench so both stage the SAME graph)."""
     builder = TaskGraphBuilder()
@@ -351,13 +494,50 @@ def build_sw_wave_graph(nt_i: int, nt_j: int) -> TaskGraphBuilder:
         lo = max(0, w - (nt_j - 1))
         hi = min(nt_i - 1, w)
         this_wave = []
-        for base in range(lo, hi + 1, WAVE_R):
-            cnt = min(WAVE_R, hi + 1 - base)
+        for base in range(lo, hi + 1, chunk):
+            cnt = min(chunk, hi + 1 - base)
             this_wave.append(
                 builder.add(WAVE_FN, args=[w, base, cnt], deps=prev_wave)
             )
         prev_wave = this_wave
     return builder
+
+
+def build_sw_tile_graph(nt_i: int, nt_j: int) -> TaskGraphBuilder:
+    """Per-TILE task DAG with the precise 3-neighbor dependencies (the
+    reference's granularity): descriptors carry [w, lo, 1] so the batched
+    wave body runs them as its chunk=1 special case. Which tiles execute
+    together is decided by the SCHEDULER's same-kind lane, round by round
+    - the dynamic-grouping shape the batched dispatch tier exists for."""
+    builder = TaskGraphBuilder()
+    ids: dict = {}
+    for ti in range(nt_i):
+        for tj in range(nt_j):
+            deps = [
+                ids[key]
+                for key in ((ti - 1, tj), (ti, tj - 1), (ti - 1, tj - 1))
+                if key in ids
+            ]
+            ids[(ti, tj)] = builder.add(
+                WAVE_FN, args=[ti + tj, ti, 1], deps=deps
+            )
+    return builder
+
+
+def make_sw_batched_megakernel(
+    nt_i: int, nt_j: int, interpret: Optional[bool] = None,
+    with_h: bool = True, width: int = WAVE_R,
+) -> Megakernel:
+    """Megakernel for the per-tile graph: ``width`` tile descriptors per
+    batch round (the scheduler groups whatever subset of the wavefront is
+    ready). SMEM note: the per-tile table is nt_i*nt_j rows - grids past
+    ~32x32 tiles want the chunked graph (make_sw_wave_megakernel), whose
+    descriptor count divides by the chunk size."""
+    ntasks = nt_i * nt_j
+    return _sw_batch_megakernel(
+        nt_i, nt_j, interpret, with_h, chunk=1, width=width,
+        capacity=max(64, ntasks), succ_capacity=max(64, 3 * ntasks),
+    )
 
 
 def sw_wave_buffers(a: np.ndarray, b: np.ndarray) -> dict:
@@ -396,6 +576,46 @@ def device_sw_wave(
     data = sw_wave_buffers(a, b)
     if "htiles" in mk.data_specs:
         data["htiles"] = np.zeros((nt_i, nt_j, T, T), i32)
+    t0 = time.perf_counter()
+    ivalues, out, info = mk.run(builder, data=data)
+    dt = time.perf_counter() - t0
+    h = (
+        np.asarray(out["htiles"]).swapaxes(1, 2).reshape(n, m)
+        if "htiles" in out
+        else None
+    )
+    info = dict(info)
+    info["seconds"] = dt
+    info["cells_per_sec"] = n * m / dt
+    return int(ivalues[0]), h, info
+
+
+def device_sw_batched(
+    a: np.ndarray,
+    b: np.ndarray,
+    interpret: Optional[bool] = None,
+    mk: Optional[Megakernel] = None,
+    with_h: bool = True,
+    width: int = WAVE_R,
+) -> Tuple[int, Optional[np.ndarray], dict]:
+    """Tiled SW where each task is ONE tile on the precise 3-neighbor DAG
+    and the megakernel's batched same-kind dispatch tier groups whatever
+    subset of the wavefront is ready - up to ``width`` tiles per round
+    through one (width, T)-plane body. Same results as device_sw, with the
+    grouping decided at run time by the scheduler instead of at graph
+    build time; ``info['tiers']`` carries the lane/occupancy counters."""
+    n, m = len(a), len(b)
+    if n % T or m % T:
+        raise ValueError(f"sequence lengths must be multiples of {T}")
+    nt_i, nt_j = n // T, m // T
+    if mk is None:
+        mk = make_sw_batched_megakernel(
+            nt_i, nt_j, interpret, with_h=with_h, width=width
+        )
+    builder = build_sw_tile_graph(nt_i, nt_j)
+    data = sw_wave_buffers(a, b)
+    if "htiles" in mk.data_specs:
+        data["htiles"] = np.zeros((nt_i, nt_j, T, T), np.int32)
     t0 = time.perf_counter()
     ivalues, out, info = mk.run(builder, data=data)
     dt = time.perf_counter() - t0

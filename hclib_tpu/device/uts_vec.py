@@ -481,8 +481,9 @@ def padded_threshold_table(
 
     ``max_rows`` (uts_pallas passes its lane-column limit) caps the row
     round-up when the quantized height would cross a consumer's bound but
-    the real cap still fits - so a cap of, say, 120 rides in 121 rows
-    instead of failing at the quantized 128. ``min_cols`` widens the
+    the real cap still fits - so a cap of, say, 120 under a 127-row bound
+    rides at the bound (rows = max_rows, here 127) instead of failing at
+    the quantized 128. ``min_cols`` widens the
     ordinal padding (capped at MAX_CHILDREN) so callers can opt INTO a
     shared width class across trees whose natural widths differ - the
     test suite pads every depth-varying tree to one (rows, cols) class

@@ -11,7 +11,7 @@ and reaching zero fires the completion promise / parked-context event
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import List, Optional
 
 from .promise import Promise
 from .resilience import CancelScope
@@ -20,7 +20,7 @@ __all__ = ["Finish"]
 
 
 class Finish:
-    __slots__ = ("parent", "_lock", "counter", "on_zero", "_zero_event",
+    __slots__ = ("parent", "_lock", "counter", "on_zero", "_zero_events",
                  "scope")
 
     def __init__(self, parent: Optional["Finish"] = None) -> None:
@@ -30,7 +30,16 @@ class Finish:
         # Promise satisfied when the scope drains (nonblocking finish /
         # escaping continuation), cf. finish_dep.
         self.on_zero: Optional[Promise] = None
-        self._zero_event: Optional[threading.Event] = None
+        # Parked-context waiters, one CALLER-OWNED event each (the
+        # Promise._ctx_waiters shape). A shared cached event was a trap:
+        # run_on_main wakes a parked main thread by setting its park
+        # event, and setting a SHARED finish event both woke every other
+        # waiter on that scope and (before arm_event grew its is-set
+        # check) left the cached event permanently set while counter > 0,
+        # degrading every later park on the scope into a busy spin
+        # (ADVICE r5 medium). Per-caller events make a targeted set()
+        # reach exactly one park, with nothing cached to poison.
+        self._zero_events: List[threading.Event] = []
         # Cancellation chains along the finish tree (resilience.py):
         # cancelling a scope cancels every descendant by inheritance.
         self.scope = CancelScope(
@@ -48,29 +57,35 @@ class Finish:
             self.counter -= 1
             if self.counter != 0:
                 return
-            on_zero, event = self.on_zero, self._zero_event
-            self.on_zero, self._zero_event = None, None
+            on_zero, events = self.on_zero, self._zero_events
+            self.on_zero, self._zero_events = None, []
         if on_zero is not None:
             on_zero.put(None)
-        if event is not None:
+        for event in events:
             event.set()
 
     def quiesced(self) -> bool:
         return self.counter == 0
 
-    def arm_event(self) -> Optional[threading.Event]:
-        """Arm a parked-context event; returns None if already quiescent.
-
-        A cached event that is already set (a cancel-wake sets parked
-        events spuriously; waiters re-check and re-park) is replaced with
-        a fresh one, so a spurious set can never turn later parks into a
-        busy spin."""
+    def register_event(self, event: threading.Event) -> bool:
+        """Register a caller-owned parked-context event, set once at
+        quiescence. Returns False when already quiescent (caller should
+        not park). Callers that abandon the park (timeout, cancellation,
+        spurious wake) must ``unregister_event`` so repeated parks on a
+        long-lived scope don't accumulate dead waiters."""
         with self._lock:
             if self.counter == 0:
-                return None
-            if self._zero_event is None or self._zero_event.is_set():
-                self._zero_event = threading.Event()
-            return self._zero_event
+                return False
+            self._zero_events.append(event)
+            return True
+
+    def unregister_event(self, event: threading.Event) -> None:
+        """Withdraw a parked-context waiter that gave up."""
+        with self._lock:
+            try:
+                self._zero_events.remove(event)
+            except ValueError:
+                pass
 
     def arm_promise(self) -> Optional[Promise]:
         """Attach a completion promise; returns None if already quiescent

@@ -896,10 +896,17 @@ class Runtime:
             self._service_main_ctx()
             task = self._find_task(wid) if wid is not None else None
             if task is None:
+                # The park event is CALLER-OWNED (registered on the finish
+                # like Promise._register_ctx): a run_on_main wake targets
+                # exactly this park instead of poisoning a shared scope
+                # event, and the unregister hook withdraws the waiter on
+                # spurious/timed exits so long scopes don't accumulate
+                # dead events.
                 self._park(
-                    lambda ev, f=fin: f.arm_event() if not f.quiesced() else None,
+                    lambda ev, f=fin: ev if f.register_event(ev) else None,
                     check=scope.cancelled,
                     deadline=deadline,
+                    unregister=fin.unregister_event,
                 )
                 wid = _tls.identity
                 continue
@@ -912,8 +919,10 @@ class Runtime:
                 # The reference swaps to a fresh fiber seeded with this task;
                 # we re-enqueue it and park - another thread runs it.
                 self._requeue_and_park(
-                    task, lambda ev, f=fin: _arm_finish(f, ev),
+                    task,
+                    lambda ev, f=fin: ev if f.register_event(ev) else None,
                     check=scope.cancelled, deadline=deadline,
+                    unregister=fin.unregister_event,
                 )
                 wid = _tls.identity
 
@@ -1259,11 +1268,6 @@ class Runtime:
                 f"quarantined={self.quarantined} stalls={self.stall_reports}"
             )
         return "\n".join(lines)
-
-
-def _arm_finish(fin: Finish, ev: threading.Event) -> Optional[threading.Event]:
-    armed = fin.arm_event()
-    return armed
 
 
 # ---------------------------------------------------------------- public API

@@ -1,0 +1,215 @@
+"""Batched same-kind dispatch tier: per-F_FN lane partitioning, batch
+bodies, cross-round prefetch, tier counters, and the SW / Cholesky wirings
+(batch-vs-scalar results must be bit-identical)."""
+
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+
+from hclib_tpu.device.descriptor import TaskGraphBuilder
+from hclib_tpu.device.megakernel import BatchSpec, Megakernel
+from hclib_tpu.runtime.resilience import StallError
+
+DOUBLE, NEG = 0, 1
+
+
+def _scalar_double(ctx):
+    ctx.set_out(ctx.arg(0) * 2)
+
+
+def _scalar_neg(ctx):
+    ctx.set_out(-ctx.arg(0))
+
+
+def _batch_double(ctx):
+    for s in range(ctx.width):
+        @pl.when(ctx.live(s))
+        def _(s=s):
+            ctx.set_out(s, ctx.arg(s, 0) * 2)
+
+
+def _toy_mk(width=4, capacity=64):
+    return Megakernel(
+        kernels=[("double", _scalar_double), ("neg", _scalar_neg)],
+        route={"double": BatchSpec(_batch_double, width=width)},
+        capacity=capacity,
+        num_values=64,
+        interpret=True,
+    )
+
+
+def _toy_graph():
+    """6 independent doubles; 3 negs each gated on one double; a second
+    wave of 5 doubles gated on all negs - same-kind groups separated by a
+    foreign kind, so routing, batching, and scalar dispatch all engage."""
+    b = TaskGraphBuilder()
+    first = [b.add(DOUBLE, args=[i], out=i) for i in range(6)]
+    negs = [
+        b.add(NEG, args=[10 + i], out=6 + i, deps=[first[i]])
+        for i in range(3)
+    ]
+    b2 = [b.add(DOUBLE, args=[100 + i], out=9 + i, deps=negs) for i in range(5)]
+    del b2
+    return b
+
+
+def test_lane_partitioning_results_and_counters():
+    mk = _toy_mk()
+    iv, _, info = mk.run(_toy_graph())
+    assert list(iv[:6]) == [0, 2, 4, 6, 8, 10]
+    assert list(iv[6:9]) == [-10, -11, -12]
+    assert list(iv[9:14]) == [200, 202, 204, 206, 208]
+    t = info["tiers"]
+    # Every 'double' went through the batch tier, every 'neg' scalar.
+    assert t["batch_tasks"] == 11
+    assert t["routed"] == 11
+    assert t["scalar_tasks"] == 3
+    assert t["spilled"] == 0
+    assert 0 < t["batch_occupancy"] <= 1.0
+    assert t["batch_rounds"] * t["batch_width"] >= t["batch_tasks"]
+    assert info["executed"] == 14
+    # stats_dict() mirrors the last run's info for harness consumers.
+    assert mk.stats_dict()["tiers"]["batch_tasks"] == 11
+
+
+def test_batch_width_one_still_batches():
+    mk = _toy_mk(width=1)
+    iv, _, info = mk.run(_toy_graph())
+    assert list(iv[:6]) == [0, 2, 4, 6, 8, 10]
+    t = info["tiers"]
+    assert t["batch_tasks"] == 11
+    assert t["batch_rounds"] == 11
+    assert t["full_rounds"] == 11
+
+
+def test_fuel_exhaustion_spills_lanes_and_stalls_cleanly():
+    """Fuel running out mid-lane must spill unrun entries back to the ring
+    and surface as a StallError with the right pending count - tasks are
+    never silently lost in a lane."""
+    mk = _toy_mk(width=2)
+    b = TaskGraphBuilder()
+    for i in range(10):
+        b.add(DOUBLE, args=[i], out=i)
+    with pytest.raises(StallError) as ei:
+        mk.run(b, fuel=3)
+    # 2 batch rounds of 2 ran (the second crosses the fuel bound); the
+    # other 6 stay pending.
+    assert ei.value.stats["pending"] == 6
+    assert ei.value.stats["executed"] == 4
+
+
+def test_batchspec_validation():
+    with pytest.raises(ValueError, match="drain"):
+        BatchSpec(_batch_double, width=2, prefetch=True)
+    with pytest.raises(ValueError, match="width"):
+        BatchSpec(_batch_double, width=0)
+    with pytest.raises(ValueError, match="route"):
+        Megakernel(
+            kernels=[("a", _scalar_double)],
+            route={"b": BatchSpec(_batch_double)},
+            interpret=True,
+        )
+
+
+def test_sw_batched_tier_matches_scalar_tile_engine():
+    """Per-tile SW on the 3-neighbor DAG, grouped by the scheduler's lane:
+    H and score bit-identical to the scalar tile engine; executed counts
+    tiles; tier counters see every tile."""
+    from hclib_tpu.device.smithwaterman import device_sw, device_sw_batched
+    from hclib_tpu.models.smithwaterman import random_seq
+
+    a, b = random_seq(256, 3), random_seq(384, 4)
+    score_s, h_s, info_s = device_sw(a, b, interpret=True)
+    score_b, h_b, info_b = device_sw_batched(a, b, interpret=True)
+    assert np.array_equal(h_b, h_s)
+    assert score_b == score_s
+    assert info_b["executed"] == info_s["executed"] == 6
+    t = info_b["tiers"]
+    assert t["batch_tasks"] == 6
+    assert t["scalar_tasks"] == 0
+
+
+def test_sw_wave_chunked_prefetch_engages_and_stays_exact():
+    """Anti-diagonals wider than one batch (chunk=1, width=2 on a 4x4 tile
+    grid: mid-waves queue 3-4 descriptors): the cross-round double-
+    buffered prefetch must engage (hits > 0) and the full H matrix must
+    stay bit-identical to the scalar tile engine - prefetched operands are
+    the same bytes the on-demand path loads."""
+    from hclib_tpu.device.smithwaterman import (
+        build_sw_wave_graph,
+        device_sw,
+        make_sw_wave_megakernel,
+        sw_wave_buffers,
+    )
+    from hclib_tpu.models.smithwaterman import random_seq
+
+    a, b = random_seq(512, 5), random_seq(512, 6)
+    _, h_s, _ = device_sw(a, b, interpret=True)
+    mk = make_sw_wave_megakernel(4, 4, interpret=True, chunk=1, width=2)
+    data = sw_wave_buffers(a, b)
+    data["htiles"] = np.zeros((4, 4, 128, 128), np.int32)
+    iv, out, info = mk.run(build_sw_wave_graph(4, 4, chunk=1), data=data)
+    h_w = np.asarray(out["htiles"]).swapaxes(1, 2).reshape(512, 512)
+    assert np.array_equal(h_w, h_s)
+    assert int(iv[0]) == int(h_s.max())
+    t = info["tiers"]
+    assert t["prefetch_hits"] > 0
+    assert t["batch_tasks"] == mk.stats_dict()["tiers"]["batch_tasks"]
+
+
+def test_cholesky_batched_updrow_bit_identical():
+    """The batched trailing-update tier (resident L-split pipelined across
+    slots) must produce the bit-identical factor of the scalar dispatch."""
+    from hclib_tpu.device.cholesky import (
+        device_cholesky,
+        make_cholesky_megakernel,
+    )
+    from hclib_tpu.models.cholesky import make_spd
+
+    a = make_spd(512).astype(np.float32)  # nt=4: 6 updrow tasks
+    L_b, info_b = device_cholesky(a, interpret=True)
+    mk_s = make_cholesky_megakernel(4, interpret=True, batch_updrow=False)
+    L_s, info_s = device_cholesky(a, interpret=True, mk=mk_s)
+    assert np.array_equal(L_b, L_s)
+    assert info_b["executed"] == info_s["executed"]
+    rel = np.max(np.abs(L_b @ L_b.T - a)) / np.max(np.abs(a))
+    assert rel < 1e-5
+    t = info_b["tiers"]
+    assert t["batch_tasks"] == 6  # every updrow batched
+    assert t["scalar_tasks"] == 4 + 3  # potrf + trsmcol stay scalar
+    assert "tiers" not in info_s
+
+
+def test_vector_and_batch_tiers_coexist():
+    """One megakernel can route different kinds to different tiers: a
+    vector-tier fib family next to a batch-tier kind, both feeding scalar
+    join tasks."""
+    from hclib_tpu.device.vector_engine import fib_spec
+
+    def scalar_fib_stub(ctx):  # semantic definition, replaced by routing
+        ctx.set_out(0)
+
+    def scalar_sum(ctx):
+        ctx.set_out(ctx.value(ctx.arg(0)) + ctx.value(ctx.arg(1)))
+
+    mk = Megakernel(
+        kernels=[
+            ("fib", scalar_fib_stub),
+            ("double", _scalar_double),
+            ("sum", scalar_sum),
+        ],
+        route={
+            "fib": fib_spec(max_n=12, lanes=(1, 8)),
+            "double": BatchSpec(_batch_double, width=2),
+        },
+        capacity=64,
+        num_values=64,
+        interpret=True,
+    )
+    b = TaskGraphBuilder()
+    f = b.add(0, args=[10], out=0)  # fib(10) = 55 via the vector tier
+    d = b.add(1, args=[21], out=1, deps=[])  # 42 via the batch tier
+    b.add(2, args=[0, 1], out=2, deps=[f, d])  # 97 via the scalar tier
+    iv, _, info = mk.run(b)
+    assert iv[0] == 55 and iv[1] == 42 and iv[2] == 97
+    assert info["tiers"]["batch_tasks"] == 1

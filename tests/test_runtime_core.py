@@ -337,6 +337,90 @@ def test_run_on_main_executes_on_launch_thread():
     assert seen[2] == "main-ctx boom"
 
 
+def test_run_on_main_wakes_do_not_poison_finish_parks():
+    """ADVICE r5 medium regression: run_on_main wakes a main thread parked
+    in help_finish through a CALLER-OWNED event registered on the finish
+    (Promise._register_ctx shape), never a shared cached scope event. A
+    string of wakes mid-scope must (a) each reach the main thread, (b)
+    leave no set/abandoned event registered on the still-open finish, and
+    (c) not degrade the pool into a busy spin (park -> instant wake)."""
+    import time as _time
+
+    main_ident = threading.get_ident()
+    got = []
+    waiters_seen = []
+
+    def body():
+        rt = hc.current_runtime()
+        release = threading.Event()
+
+        def blocker():
+            release.wait(10.0)  # holds the root scope open
+
+        def pesterer():
+            for _ in range(5):
+                got.append(rt.run_on_main(threading.get_ident))
+                _time.sleep(0.02)
+            fin = rt.root_finish
+            with fin._lock:
+                evs = list(fin._zero_events)
+            waiters_seen.append([ev.is_set() for ev in evs])
+            release.set()
+
+        hc.async_(blocker)
+        hc.async_(pesterer)
+
+    rt_holder = {}
+
+    def wrapped():
+        rt_holder["rt"] = hc.current_runtime()
+        return body()
+
+    hc.launch(wrapped, nworkers=2)
+    assert got == [main_ident] * 5
+    # Nothing set stayed registered on the open scope (a set shared event
+    # was the old busy-spin poison); at most the main park + a worker.
+    (flags,) = waiters_seen
+    assert len(flags) <= 2 and not any(flags)
+    # Busy-spin detector: five wakes cost ~a dozen parks, not thousands.
+    parks = sum(st.parks for st in rt_holder["rt"].worker_stats)
+    assert parks < 100, parks
+
+
+def test_run_on_main_wakes_leave_no_stale_promise_waiters():
+    """ADVICE r5 low regression: a spurious run_on_main wake on the
+    wait_on park path unregisters its event from Promise._ctx_waiters
+    before re-parking, so repeated wakes against a long-lived promise
+    never accumulate dead waiter events."""
+    import time as _time
+
+    from hclib_tpu.runtime.promise import Promise
+
+    sizes = []
+
+    def body():
+        rt = hc.current_runtime()
+        prom = Promise()
+
+        def pesterer():
+            for _ in range(6):
+                rt.run_on_main(lambda: None)
+                _time.sleep(0.02)
+                with prom._lock:
+                    sizes.append(len(prom._ctx_waiters))
+            prom.put(7)
+
+        with hc.finish():
+            hc.async_(pesterer)
+            prom.future.wait()  # parked main thread, pestered awake
+        assert prom.get() == 7
+
+    hc.launch(body, nworkers=2)
+    # At most the main thread's one live registration at any sample point
+    # (0 while it is between unregister and re-register).
+    assert max(sizes) <= 1, sizes
+
+
 def test_run_on_main_from_escaping_task_at_finalize():
     """An escaping task still blocked in run_on_main when the root finish
     drains is serviced by the finalize join loop (the reference's

@@ -20,10 +20,11 @@ Usage:
 Exit code 1 if any app regressed beyond tolerance vs the previous log.
 
 ``--device`` adds the TPU engines (megakernel fib scalar + batch tiers,
-Cholesky GFLOP/s, Smith-Waterman GCUPS, UTS nodes/s) - the numbers of
-record bench.py reports, guarded here so no TPU claim floats free of a
-harness. Device entries record a RATE (higher is better); host entries
-record wall time.
+Cholesky GFLOP/s, Smith-Waterman GCUPS - fused sweep AND the wave-DAG
+batched-dispatch engine with its batch-occupancy counter, UTS nodes/s) -
+the numbers of record bench.py reports, guarded here so no TPU claim
+floats free of a harness. Device entries record a RATE (higher is
+better); host entries record wall time.
 
 ``--multichip`` runs the benchmark-scale multi-device acceptance
 workloads (hclib_tpu/device/stress.py) on a virtual 8-device CPU mesh:
@@ -94,6 +95,26 @@ def _device_suite(trials: int) -> List[Tuple[str, Callable[[], float], str]]:
             "FLOP/s",
         ),
         ("device-sw", lambda: b.bench_device_sw() * 1e9, "CUPS"),
+        (
+            # The batched same-kind dispatch tier's flagship workload: the
+            # wave-DAG SW chunks grouped + prefetched by the scheduler.
+            "device-sw-wave",
+            lambda: b.bench_device_sw_wave(
+                trials=max(1, trials), spread_seconds=spread
+            ) * 1e9,
+            "CUPS",
+        ),
+        (
+            # Occupancy of the batch rounds behind that number (fraction
+            # of offered batch slots filled, higher is better; populated
+            # by device-sw-wave, so it reads None - recorded as a SKIP,
+            # not a failure - when that entry didn't run or failed). A
+            # collapse here means the DAG stopped exposing same-kind
+            # parallelism to the tier even if GCUPS weather hides it.
+            "device-sw-wave-occupancy",
+            lambda: b.LAST_SW_WAVE_TIERS.get("batch_occupancy"),
+            "fraction",
+        ),
         ("device-uts", lambda: b.bench_device_uts()[0], "nodes/s"),
     ]
 
@@ -191,7 +212,12 @@ def main(argv=None) -> int:
                 if wanted and name not in wanted:
                     continue
                 try:
-                    rate = float(fn())
+                    val = fn()
+                    if val is None:  # dependent entry whose producer
+                        print(f"{name:20s} SKIPPED (no data)",  # didn't run
+                              file=sys.stderr)
+                        continue
+                    rate = float(val)
                 except Exception as e:  # one engine must not sink the log
                     print(f"{name:20s} FAILED: {e}", file=sys.stderr)
                     failures.append(f"{name}: failed ({e})")
