@@ -558,6 +558,70 @@ def bench_device_cholesky(
     return s["median"]
 
 
+def emit_trace_artifacts(log_dir: str = "perf-logs"):
+    """--trace artifact emission: one traced megakernel run + one
+    instrumented host run, folded into a MetricsRegistry snapshot
+    (JSON + Prometheus text) and a merged Perfetto file under
+    ``log_dir`` - the machine-readable observability bundle of a bench
+    round (budget-gated like every other section)."""
+    import hclib_tpu as hc
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.tracebuf import trace_to_jsonable
+    from hclib_tpu.device.workloads import FIB, make_fib_megakernel
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        import timeline
+    finally:
+        sys.path.pop(0)
+
+    os.makedirs(log_dir, exist_ok=True)
+    ts = int(time.time())
+
+    # Device: the fib megakernel with the flight recorder on (interpret
+    # off-TPU; the recorder rides inside the kernel either way).
+    mk = make_fib_megakernel(768, trace=1024)
+    b = TaskGraphBuilder()
+    b.add(FIB, args=[12], out=0)
+    iv, _, dev_info = mk.run(b)
+    assert int(iv[0]) == 144
+
+    # Host: an instrumented + metrics-enabled runtime.
+    rt = hc.Runtime(nworkers=2, instrument=True, metrics=True)
+
+    def body():
+        with hc.finish():
+            for _ in range(200):
+                hc.async_(lambda: None)
+
+    rt.run(body)
+    dump = rt.event_log.dump(log_dir)
+
+    reg = rt.metrics or MetricsRegistry()
+    reg.add_run_info("device_fib", dev_info)
+    snap = reg.snapshot()
+    mpath = os.path.join(log_dir, f"trace_{ts}.metrics.json")
+    with open(mpath, "w") as f:
+        f.write(reg.to_json(snap))
+    with open(os.path.join(log_dir, f"trace_{ts}.prom"), "w") as f:
+        f.write(reg.to_prometheus(snap))
+    tpath = os.path.join(log_dir, f"trace_{ts}.trace.json")
+    with open(tpath, "w") as f:
+        json.dump(trace_to_jsonable(dev_info["trace"]), f)
+    ppath = os.path.join(log_dir, f"trace_{ts}.perfetto.json")
+    doc = timeline.export_perfetto(
+        ppath, dump_path=dump, traces=[dev_info["trace"]]
+    )
+    log(
+        f"trace artifacts: {len(doc['traceEvents'])} perfetto events -> "
+        f"{ppath}; metrics -> {mpath}; device trace -> {tpath}; "
+        f"host dump -> {dump}"
+    )
+    return ppath
+
+
 T1_NODES = 4130071
 T1L_NODES = 102181082
 
@@ -661,7 +725,16 @@ def bench_device_uts():
     raise RuntimeError("no UTS engine ran")
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="hclib_tpu benchmark driver")
+    ap.add_argument(
+        "--trace", action="store_true",
+        help="also emit per-section metrics JSON + a Perfetto trace "
+        "under perf-logs/ (budget-gated like the other sections)",
+    )
+    args = ap.parse_args(argv)
     global _T0
     _T0 = time.monotonic()  # arm the wall budget for THIS driver run
     # ---- headline FIRST: the stdout JSON line exists (and is flushed)
@@ -756,6 +829,8 @@ def main() -> None:
         "cholesky n=16384", 200,
         lambda: bench_device_cholesky(trials=3, n=16384, residual_bound=2e-6),
     )
+    if args.trace:
+        section("trace artifacts", 60, emit_trace_artifacts)
     if sw_wave:
         log(f"wave-DAG SW final: {sw_wave:.1f} GCUPS median (r05 baseline "
             f"1.2; acceptance floor 12)")

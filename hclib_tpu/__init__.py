@@ -28,6 +28,7 @@ from .runtime import (  # noqa: F401
     Locale,
     LocalityGraph,
     MaxReducer,
+    MetricsRegistry,
     Module,
     OrReducer,
     Promise,

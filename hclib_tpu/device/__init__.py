@@ -24,9 +24,13 @@ from .descriptor import (
 )
 from .megakernel import BatchContext, BatchSpec, KernelContext, Megakernel
 from .resident import ResidentKernel
+from .tracebuf import TraceRing, decode_ring, trace_to_jsonable
 
 __all__ = [
     "ResidentKernel",
+    "TraceRing",
+    "decode_ring",
+    "trace_to_jsonable",
     "BatchContext",
     "BatchSpec",
     "DESC_WORDS",

@@ -44,6 +44,7 @@ semaphores on CPU) and compiled/run on real TPU hardware on a 1-device mesh
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Iterable, Optional, Sequence
 
 import jax
@@ -70,6 +71,14 @@ from .megakernel import (
     C_ROUNDS,
     C_TAIL,
     Megakernel,
+)
+from .tracebuf import (
+    HDR as _TR_HDR,
+    NullTracer,
+    TR_ABORT,
+    TR_XFER,
+    Tracer,
+    trace_info,
 )
 
 __all__ = ["ICIStealMegakernel"]
@@ -265,13 +274,16 @@ class ICIStealMegakernel:
 
     # -- the kernel --
 
-    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+    def _kernel(self, quantum: int, max_rounds: int, trace, *refs) -> None:
+        # ``trace`` captured at _build time (pallas traces lazily; see
+        # Megakernel._kernel).
         mk = self.mk
         ndata = len(mk.data_specs)
+        ntrace = 1 if trace is not None else 0
         n_in = 6 + ndata  # + abort word (last input)
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata]
-        rest = refs[n_in + 4 + ndata :]
+        out_refs = refs[n_in : n_in + 4 + ndata + ntrace]
+        rest = refs[n_in + 4 + ndata + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         (
@@ -281,7 +293,12 @@ class ICIStealMegakernel:
         abort_in = in_refs[n_in - 1]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         tasks, ready, counts, ivalues = out_refs[:4]
-        data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
+        data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        tr = (
+            Tracer(out_refs[4 + ndata], trace.capacity)
+            if ntrace
+            else NullTracer()
+        )
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
         # stage_all_values=True: imported tasks may read/accumulate value
         # slots the local partition never declared (an empty partition has
@@ -289,6 +306,7 @@ class ICIStealMegakernel:
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True,
+            tracer=tr if tr.enabled else None,
         )
 
         ndev = self.ndev
@@ -356,6 +374,10 @@ class ICIStealMegakernel:
             quota = jnp.clip(backlog - gavg, 0, W)
             nsend = export(quota)
             sendbuf[W, 0] = nsend
+
+            @pl.when(nsend > 0)
+            def _():
+                tr.emit(TR_XFER, tr.now(), target, nsend)
             # Credit: our *target's* inbox is free once it signalled us at
             # the end of its previous round (it signals its next-round
             # source, which is exactly us because the hop schedule is
@@ -392,6 +414,10 @@ class ICIStealMegakernel:
             tot_p, tot_b, tot_a = allreduce(r, abuf[0] != 0)
             done = (tot_p == 0) | (tot_a > 0)
 
+            @pl.when(tot_a > 0)
+            def _():
+                tr.emit(TR_ABORT, tr.now(), r)
+
             @pl.when(jnp.logical_not(done))
             def _():
                 exchange(r, tot_b)
@@ -418,7 +444,8 @@ class ICIStealMegakernel:
             def _():
                 pltpu.semaphore_wait(csems.at[0], 1)
 
-    def _kernel_hc(self, quantum: int, max_rounds: int, *refs) -> None:
+    def _kernel_hc(self, quantum: int, max_rounds: int, trace,
+                   *refs) -> None:
         """Paired hypercube dimension-exchange body (pof2 device counts).
 
         Each round: drain the local ring for a quantum, then for every XOR
@@ -433,10 +460,11 @@ class ICIStealMegakernel:
         """
         mk = self.mk
         ndata = len(mk.data_specs)
+        ntrace = 1 if trace is not None else 0
         n_in = 5 + ndata
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata]
-        rest = refs[n_in + 4 + ndata :]
+        out_refs = refs[n_in : n_in + 4 + ndata + ntrace]
+        rest = refs[n_in + 4 + ndata + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         nh = self._nh
@@ -447,7 +475,13 @@ class ICIStealMegakernel:
         ssems, rsems, csems = tail[5 + 2 * nh :]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         tasks, ready, counts, ivalues = out_refs[:4]
-        data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
+        data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        if ntrace:
+            # This body is only reachable on pof2 meshes, which run()
+            # routes to ResidentKernel (the traced path) - but keep the
+            # appended output deterministic if built directly.
+            for w in range(_TR_HDR):
+                out_refs[4 + ndata][w] = 0
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
@@ -621,9 +655,12 @@ class ICIStealMegakernel:
         ndata = len(mk.data_specs)
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
+        ntrace = 1 if mk.trace is not None else 0
         # Trailing abort-word input (HBM: the kernel re-reads it per round).
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [anyspace()]
-        out_specs = tuple([smem()] * 4 + [anyspace()] * ndata)
+        out_specs = tuple(
+            [smem()] * 4 + [anyspace()] * ndata + [smem()] * ntrace
+        )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
             for s in mk.data_specs.values()
@@ -636,6 +673,7 @@ class ICIStealMegakernel:
                 jax.ShapeDtypeStruct((mk.num_values,), jnp.int32),
             ]
             + data_shapes
+            + ([mk.trace.out_shape()] if ntrace else [])
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
@@ -677,7 +715,7 @@ class ICIStealMegakernel:
                 pltpu.SemaphoreType.DMA((1,)),  # asem
             ]
         kern = pl.pallas_call(
-            functools.partial(body, quantum, max_rounds),
+            functools.partial(body, quantum, max_rounds, mk.trace),
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
@@ -694,13 +732,15 @@ class ICIStealMegakernel:
                 *[d[0] for d in data], abort[0]
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
-            data_o = outs[4:]
+            data_o = outs[4 : 4 + ndata]
+            trace_o = outs[4 + ndata :]
             gcounts = jax.lax.psum(counts_o, self.axes)
             return (
                 counts_o[None],
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
+                *[t[None] for t in trace_o],
             )
 
         nin = 6 + ndata
@@ -708,7 +748,7 @@ class ICIStealMegakernel:
             step,
             mesh=self.mesh,
             in_specs=(P(self.axes),) * nin,
-            out_specs=(P(self.axes),) * (3 + ndata),
+            out_specs=(P(self.axes),) * (3 + ndata + ntrace),
             check_vma=False,
         )
         return jax.jit(f)
@@ -742,11 +782,18 @@ class ICIStealMegakernel:
         from .sharded import abort_words
 
         abort_arr = abort_words(abort, self.ndev)
+        t0_ns = time.monotonic_ns()
         iv_o, data_o, info = execute_partitions(
             self.mk, self.mesh, self.ndev, self._jitted[key], builders,
             data, ivalues, with_rounds=True, extra_inputs=[abort_arr],
         )
-        info.pop("extra_outputs", None)
+        t1_ns = time.monotonic_ns()
+        tail = info.pop("extra_outputs", None)
+        if self.mk.trace is not None and tail:
+            info["trace"] = trace_info(
+                [tail[-1][d] for d in range(self.ndev)], t0_ns, t1_ns,
+                self.mk.trace.capacity,
+            )
         info["aborted"] = bool(abort_arr[:, 0].any()) and info["pending"] != 0
         if info["overflow"]:
             raise RuntimeError("ici steal: task-table overflow")

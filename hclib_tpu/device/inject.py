@@ -57,6 +57,13 @@ from ..runtime import resilience
 from ..runtime.resilience import CancelledError, StallError
 from .descriptor import DESC_WORDS, NO_TASK, TaskGraphBuilder
 from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
+from .tracebuf import (
+    NullTracer,
+    TR_ABORT,
+    TR_INJECT,
+    Tracer,
+    trace_info,
+)
 
 __all__ = ["StreamingMegakernel", "RING_ROW"]
 
@@ -185,13 +192,16 @@ class StreamingMegakernel:
 
     # ---- kernel ----
 
-    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+    def _kernel(self, quantum: int, max_rounds: int, trace, *refs) -> None:
+        # ``trace`` captured at _build time (pallas traces lazily; see
+        # Megakernel._kernel).
         mk = self.mk
         ndata = len(mk.data_specs)
+        ntrace = 1 if trace is not None else 0
         n_in = 7 + ndata  # + ring, ctl
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 5 + ndata]  # + ctl out
-        rest = refs[n_in + 5 + ndata :]
+        out_refs = refs[n_in : n_in + 5 + ndata + ntrace]  # + ctl out
+        rest = refs[n_in + 5 + ndata + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         free, vfree, ctlbuf, rowbuf, isem = rest[nscratch:]
@@ -199,11 +209,17 @@ class StreamingMegakernel:
         ring, ctl_in = in_refs[5], in_refs[6]
         tasks, ready, counts, ivalues = out_refs[:4]
         ctl_out = out_refs[4]
-        data = dict(zip(mk.data_specs.keys(), out_refs[5:]))
+        data = dict(zip(mk.data_specs.keys(), out_refs[5 : 5 + ndata]))
+        tr = (
+            Tracer(out_refs[5 + ndata], trace.capacity)
+            if ntrace
+            else NullTracer()
+        )
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True,
+            tracer=tr if tr.enabled else None,
         )
         cap = mk.capacity
 
@@ -251,12 +267,23 @@ class StreamingMegakernel:
         def body(carry):
             r, consumed, _, abr = carry
             core.sched(quantum)
+            c0 = consumed
             consumed, close = poll(consumed)
+
+            @pl.when(consumed > c0)
+            def _():
+                tr.emit(TR_INJECT, tr.now(), consumed - c0)
+
             # Host abort word (ctl[3]): re-read by the same acquire DMA as
             # the ring tail, so the abort lands INSIDE the round loop - a
             # running stream stops within one quantum + poll of the write,
             # pending work and unconsumed rows abandoned where they stand.
             aborted = ctlbuf[3] != 0
+
+            @pl.when(aborted & (abr < 0))
+            def _():
+                tr.emit(TR_ABORT, tr.now(), r)
+
             abr = jnp.where(aborted & (abr < 0), r, abr)
             # Nothing runnable and nothing new: exit. The host re-enters
             # while the stream is open; a closed, drained stream is final.
@@ -299,6 +326,7 @@ class StreamingMegakernel:
             jax.ShapeDtypeStruct(s.shape, s.dtype)
             for s in mk.data_specs.values()
         ]
+        ntrace = 1 if mk.trace is not None else 0
         out_shape = tuple(
             [
                 jax.ShapeDtypeStruct((mk.capacity, DESC_WORDS), jnp.int32),
@@ -308,9 +336,11 @@ class StreamingMegakernel:
                 jax.ShapeDtypeStruct((8,), jnp.int32),  # ctl out
             ]
             + data_shapes
+            + ([mk.trace.out_shape()] if ntrace else [])
         )
         out_specs = tuple(
             [smem()] * 4 + [smem()] + [anyspace()] * ndata
+            + [smem()] * ntrace
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
@@ -318,7 +348,7 @@ class StreamingMegakernel:
         from .megakernel import VBLOCK
 
         return jax.jit(pl.pallas_call(
-            functools.partial(self._kernel, quantum, max_rounds),
+            functools.partial(self._kernel, quantum, max_rounds, mk.trace),
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
@@ -413,7 +443,14 @@ class StreamingMegakernel:
         ctl = np.zeros(8, np.int32)  # [tail, close, consumed]
         state = [tasks, ring0, counts, ivalues]
         data_np = [np.asarray(data[k]) for k in mk.data_specs.keys()]
+        ndata = len(mk.data_specs)
         injected = 0
+        # Flight recorder: each entry resets the ring, so the LAST entry's
+        # records surface in info - bracketed by THAT entry's own epoch
+        # (a whole-stream bracket would stretch the final entry's rounds
+        # across every earlier entry's wall time in the Perfetto view).
+        trace_row = None
+        entry_t0_ns = entry_t1_ns = time.monotonic_ns()
         while True:
             # Publish queued rows: rows first, then tail (release order;
             # over the tunnel both land before the next entry launches).
@@ -469,6 +506,7 @@ class StreamingMegakernel:
                 injected += 1
             ctl[0] = injected
             ctl[1] = 1 if closed else 0
+            entry_t0_ns = time.monotonic_ns()
             outs = jitted(
                 jnp.asarray(state[0]), jnp.asarray(succ),
                 jnp.asarray(state[1]), jnp.asarray(state[2]),
@@ -477,7 +515,10 @@ class StreamingMegakernel:
             )
             state = [np.asarray(o) for o in outs[:4]]
             ctl_o = np.asarray(outs[4])
-            data_np = [np.asarray(o) for o in outs[5:]]
+            data_np = [np.asarray(o) for o in outs[5 : 5 + ndata]]
+            if mk.trace is not None:
+                trace_row = np.asarray(outs[5 + ndata])
+                entry_t1_ns = time.monotonic_ns()
             counts_np = state[2]
             ctl[2] = ctl_o[2]  # device-consumed cursor persists
             if bool(counts_np[C_OVERFLOW]):
@@ -493,5 +534,10 @@ class StreamingMegakernel:
                     "pending": int(counts_np[C_PENDING]),
                     "injected": injected,
                 }
+                if mk.trace is not None and trace_row is not None:
+                    info["trace"] = trace_info(
+                        [trace_row], entry_t0_ns, entry_t1_ns,
+                        mk.trace.capacity,
+                    )
                 return state[3], info
             time.sleep(poll_interval_s)

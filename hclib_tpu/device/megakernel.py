@@ -22,6 +22,7 @@ spawn new tasks dynamically (fib/UTS-style recursion) through
 from __future__ import annotations
 
 import functools
+import os
 import types
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -45,6 +46,19 @@ from .descriptor import (
     F_SUCC1,
     NO_TASK,
     TaskGraphBuilder,
+)
+from .tracebuf import (
+    NullTracer,
+    TR_FIRE_BATCH,
+    TR_FIRE_SCALAR,
+    TR_PREFETCH_DRAIN,
+    TR_PREFETCH_ISSUE,
+    TR_ROUND_BEGIN,
+    TR_ROUND_END,
+    TR_SPILL,
+    TraceRing,
+    Tracer,
+    trace_info,
 )
 
 __all__ = [
@@ -642,9 +656,33 @@ class Megakernel:
         vmem_limit_bytes: Optional[int] = None,
         route: Optional[Dict[str, Any]] = None,
         auto_route: Optional[Dict[str, Any]] = None,
+        trace: Optional[Any] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
+        # Device flight recorder (device/tracebuf.py): ``trace`` is None
+        # (off: zero compiled cost, no extra outputs - bit-identical to a
+        # build that predates tracing), a record capacity, or a TraceRing.
+        # When on, run() appends one SMEM ring output the scheduler writes
+        # round/dispatch/prefetch records into, decoded as info['trace'].
+        # HCLIB_TPU_TRACE=1 (default capacity) or =N turns it on
+        # process-wide without touching call sites. Env-derived tracing is
+        # marked so runners that cannot trace (ShardedMegakernel) degrade
+        # to untraced instead of failing a run the env owner never wrote.
+        self.trace_from_env = False
+        if trace is None:
+            env = os.environ.get("HCLIB_TPU_TRACE", "")
+            if env and env != "0":
+                try:
+                    n = int(env)
+                except ValueError:
+                    n = 1
+                # n <= 0 stays off (a negative typo in a process-wide env
+                # must not abort runs that never asked for tracing).
+                if n > 0:
+                    trace = True if n == 1 else n
+                    self.trace_from_env = True
+        self.trace = TraceRing.of(trace)
         # Dispatch-tier routing: ``route`` maps a kernel NAME to the spec
         # of a non-scalar dispatch tier for that task family. Two tiers:
         #
@@ -757,6 +795,7 @@ class Megakernel:
         lanes=None,
         lstate=None,
         tstats=None,
+        tracer=None,
     ):
         """Builds the scheduler core closures over a concrete set of refs:
         ``stage()`` (copy host state into the mutable windows), and
@@ -793,6 +832,10 @@ class Megakernel:
             )
         use_batch = lanes is not None and len(self.batch_specs) > 0
         nbatch = len(self.batch_specs) if use_batch else 0
+        # Flight recorder: a NullTracer's methods are no-ops, so every
+        # emit site below compiles to nothing when tracing is off (the
+        # DeviceFaultPlan zero-cost-when-disabled pattern).
+        tr = tracer if tracer is not None else NullTracer()
 
         # On TPU, SMEM output windows do NOT start with the aliased input's
         # contents (unlike interpret mode) - stage the initial scheduler
@@ -804,6 +847,9 @@ class Megakernel:
         def stage() -> None:
             free[0] = 0
             vfree[0] = 0
+            # Trace header resets per entry/rep, so reps > 1 leaves the
+            # LAST rep's records - the same per-graph semantics tstats has.
+            tr.reset()
             if use_batch:
                 # Lanes/prefetch state are per-entry scratch (sched() spills
                 # unrun entries back to the ready ring before returning, so
@@ -957,8 +1003,9 @@ class Megakernel:
             or one scalar descriptor; a batch round may overshoot ``fuel``
             by width-1 tasks."""
 
-            def batch_round(li, spec, e0) -> None:
+            def batch_round(li, spec, e0, rt) -> None:
                 B = spec.width
+                fid = self.batch_specs[li][0]
                 head = lstate[li, LS_HEAD]
                 avail = lstate[li, LS_TAIL] - head
                 take = jnp.minimum(avail, B)
@@ -981,6 +1028,17 @@ class Megakernel:
                     nxt = jnp.where(may, jnp.minimum(avail - take, B), 0)
                 else:
                     nxt = jnp.int32(0)
+                # Flight-recorder: one record per batch round, lane id and
+                # occupancy packed ((fid << 16) | take), prefetched count
+                # in b - the triple tests/test_tracebuf.py reconciles
+                # against tstats (rounds / tasks / prefetch hits) exactly.
+                tr.emit(
+                    TR_FIRE_BATCH, rt, (jnp.int32(fid) << 16) | take, pre
+                )
+                if spec.prefetch:
+                    @pl.when(nxt > 0)
+                    def _():
+                        tr.emit(TR_PREFETCH_ISSUE, rt, fid, nxt)
                 bctx = _make_bctx(li, spec, head, take, pre, buf, nxt)
                 spec.body(bctx)
                 for s in range(B):
@@ -1020,6 +1078,11 @@ class Megakernel:
                 head = counts[C_HEAD]
                 tail = counts[C_TAIL]
                 ring_work = head < tail
+                # Entry-relative round index: the trace timebase of every
+                # record this iteration emits (no device wall clock; the
+                # host epoch brackets the launch and timeline.py
+                # interpolates).
+                rt = tr.tick()
                 if not use_batch:
                     @pl.when(ring_work)
                     def _():
@@ -1030,6 +1093,7 @@ class Megakernel:
                         # reference deque (src/hclib-deque.c).
                         idx = ready[(tail - 1) % capacity]
                         counts[C_TAIL] = tail - 1
+                        tr.emit(TR_FIRE_SCALAR, rt, tasks[idx, F_FN], idx)
                         step(idx)
 
                     return (
@@ -1060,7 +1124,7 @@ class Megakernel:
 
                     @pl.when(eligible & jnp.logical_not(fired))
                     def _(li=li, spec=spec, e0=e0):
-                        batch_round(li, spec, e0)
+                        batch_round(li, spec, e0, rt)
 
                     fired = fired | eligible
 
@@ -1087,6 +1151,7 @@ class Megakernel:
 
                     @pl.when(jnp.logical_not(routed))
                     def _():
+                        tr.emit(TR_FIRE_SCALAR, rt, fn, idx)
                         step(idx)
                         tstats[TS_SCALAR_ROUNDS] = (
                             tstats[TS_SCALAR_ROUNDS] + 1
@@ -1104,6 +1169,10 @@ class Megakernel:
                 )
 
             e0 = counts[C_EXECUTED]
+            tr.emit(
+                TR_ROUND_BEGIN, tr.tick(),
+                counts[C_TAIL] - counts[C_HEAD], counts[C_PENDING],
+            )
             jax.lax.while_loop(
                 cond,
                 body,
@@ -1115,6 +1184,7 @@ class Megakernel:
                 # the ready ring - the ring is the only structure whose
                 # contents survive this call (outputs/readback, restage,
                 # host stall diagnosis).
+                rt_x = tr.now()
                 for li, (fid, spec) in enumerate(self.batch_specs):
                     h = lstate[li, LS_HEAD]
                     t = lstate[li, LS_TAIL]
@@ -1123,7 +1193,8 @@ class Megakernel:
                         pre = jnp.where(pf_ok, lstate[li, LS_PF_N], 0)
 
                         @pl.when(pre > 0)
-                        def _(li=li, spec=spec, h=h, pre=pre):
+                        def _(li=li, spec=spec, h=h, pre=pre, fid=fid):
+                            tr.emit(TR_PREFETCH_DRAIN, rt_x, fid, pre)
                             spec.drain(_make_bctx(
                                 li, spec, h, pre, pre,
                                 lstate[li, LS_PF_BUF], jnp.int32(0),
@@ -1134,9 +1205,18 @@ class Megakernel:
                         return 0
 
                     jax.lax.fori_loop(0, t - h, spill, 0)
+
+                    @pl.when(t > h)
+                    def _(fid=fid, h=h, t=t):
+                        tr.emit(TR_SPILL, rt_x, fid, t - h)
+
                     lstate[li, LS_HEAD] = t
                     lstate[li, LS_PF_BASE] = 0
                     tstats[TS_SPILLED] = tstats[TS_SPILLED] + (t - h)
+            tr.emit(
+                TR_ROUND_END, tr.tick(),
+                counts[C_EXECUTED] - e0, counts[C_PENDING],
+            )
 
         def install_descriptor(read_word):
             """Adopt one externally-produced descriptor row (a stolen row
@@ -1185,12 +1265,17 @@ class Megakernel:
         )
 
     def _kernel(
-        self, fuel: int, reps: int, stage_all_values: bool, *refs
+        self, fuel: int, reps: int, stage_all_values: bool, trace, *refs
     ) -> None:
+        # ``trace`` is the TraceRing captured when _build_raw fixed the
+        # output tree - NOT self.trace: pallas kernels trace lazily (first
+        # call), so reading mutable instance state here could disagree
+        # with the already-built out_shape and shift every ref slice.
         ndata = len(self.data_specs)
         nbatch = len(self.batch_specs)
+        ntrace = 1 if trace is not None else 0
         n_in = 5 + ndata
-        n_out = 4 + ndata + (1 if nbatch else 0)
+        n_out = 4 + ndata + (1 if nbatch else 0) + ntrace
         in_refs = refs[:n_in]
         out_refs = refs[n_in : n_in + n_out]
         n_tail = 4 if nbatch else 2  # free, vfree [, lanes, lstate]
@@ -1203,11 +1288,17 @@ class Megakernel:
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(self.data_specs.keys(), out_refs[4 : 4 + ndata]))
         tstats = out_refs[4 + ndata] if nbatch else None
+        tracer = (
+            Tracer(out_refs[4 + ndata + (1 if nbatch else 0)],
+                   trace.capacity)
+            if ntrace
+            else None
+        )
         scratch = dict(zip(self.scratch_specs.keys(), scratch_refs))
         core = self._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, stage_all_values,
-            lanes=lanes, lstate=lstate, tstats=tstats,
+            lanes=lanes, lstate=lstate, tstats=tstats, tracer=tracer,
         )
 
         def one_rep(r, total_executed) -> jnp.int32:
@@ -1272,6 +1363,9 @@ class Megakernel:
             # APPENDED after the data outputs, so every existing consumer's
             # positional indexing is untouched.
             + ([smem()] if nbatch else [])
+            # The flight-recorder ring rides last, same appended-output
+            # discipline (absent entirely when tracing is off).
+            + ([smem()] if self.trace is not None else [])
         )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype) for s in self.data_specs.values()
@@ -1285,6 +1379,7 @@ class Megakernel:
             ]
             + data_shapes
             + ([jax.ShapeDtypeStruct((TS_WORDS,), jnp.int32)] if nbatch else [])
+            + ([self.trace.out_shape()] if self.trace is not None else [])
         )
         # inputs: tasks(0) succ(1) ready(2) counts(3) ivalues(4) data(5..)
         # outputs: tasks(0) ready(1) counts(2) ivalues(3) data(4..) [tstats]
@@ -1292,7 +1387,9 @@ class Megakernel:
         for i in range(ndata):
             aliases[5 + i] = 4 + i
         return pl.pallas_call(
-            functools.partial(self._kernel, fuel, reps, stage_all_values),
+            functools.partial(
+                self._kernel, fuel, reps, stage_all_values, self.trace
+            ),
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
@@ -1405,6 +1502,14 @@ class Megakernel:
             if self.interpret
             else contextlib.nullcontext()
         )
+        import time as _time
+
+        # Epoch bracket for the flight recorder (the clockprobe trick):
+        # monotonic_ns before launch and after readback are the host wall
+        # clock the trace's round-indexed records interpolate into - the
+        # same clock runtime/instrument.py stamps host events with, so
+        # device rounds and host spans share one Perfetto timeline.
+        t0_ns = _time.monotonic_ns()
         with cm:
             outs = jitted(
                 jnp.asarray(tasks),
@@ -1420,7 +1525,10 @@ class Megakernel:
         packs = [counts_out, ivalues_out]
         if self.batch_specs:
             packs.append(outs[4 + ndata])
+        if self.trace is not None:
+            packs.append(outs[4 + ndata + (1 if self.batch_specs else 0)])
         packed = np.asarray(self._packer(*packs))
+        t1_ns = _time.monotonic_ns()
         counts_np = packed[:8]
         ivalues_np = packed[8 : 8 + self.num_values]
         info = {
@@ -1430,9 +1538,16 @@ class Megakernel:
             "value_alloc": int(counts_np[C_VALLOC]),
             "overflow": bool(counts_np[C_OVERFLOW]),
         }
+        off = 8 + self.num_values
         if self.batch_specs:
             info["tiers"] = self.decode_tier_stats(
-                packed[8 + self.num_values :]
+                packed[off : off + TS_WORDS]
+            )
+            off += TS_WORDS
+        if self.trace is not None:
+            info["trace"] = trace_info(
+                [packed[off : off + self.trace.words]], t0_ns, t1_ns,
+                self.trace.capacity,
             )
         self._last_info = info
         if info["overflow"]:

@@ -95,6 +95,13 @@ from .megakernel import (
     Megakernel,
     VBLOCK,
 )
+from .tracebuf import (
+    NullTracer,
+    TR_ABORT,
+    TR_XFER,
+    Tracer,
+    trace_info,
+)
 
 __all__ = ["PGASMegakernel"]
 
@@ -170,13 +177,16 @@ class PGASMegakernel:
 
     # -- the kernel --
 
-    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+    def _kernel(self, quantum: int, max_rounds: int, trace, *refs) -> None:
+        # ``trace`` captured at _build time (pallas traces lazily; see
+        # Megakernel._kernel).
         mk = self.mk
         ndata = len(mk.data_specs)
+        ntrace = 1 if trace is not None else 0
         n_in = 7 + ndata  # + waits_in + abort word (last)
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata]
-        rest = refs[n_in + 4 + ndata :]
+        out_refs = refs[n_in : n_in + 4 + ndata + ntrace]
+        rest = refs[n_in + 4 + ndata + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         (
@@ -190,7 +200,12 @@ class PGASMegakernel:
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         waits_in = in_refs[5 + ndata]  # waits ride after the data inputs
         tasks, ready, counts, ivalues = out_refs[:4]
-        data = dict(zip(mk.data_specs.keys(), out_refs[4:]))
+        data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        tr = (
+            Tracer(out_refs[4 + ndata], trace.capacity)
+            if ntrace
+            else NullTracer()
+        )
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
 
         ndev = self.ndev
@@ -298,6 +313,7 @@ class PGASMegakernel:
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True, ctx_hook,
+            tracer=tr if tr.enabled else None,
         )
 
         # -- round-loop phases --
@@ -365,8 +381,14 @@ class PGASMegakernel:
                 sent_round[t] = sent_round[t] + 1
                 return h + 1
 
-            h = jax.lax.while_loop(cond, body, obctl[0])
+            h0 = obctl[0]
+            h = jax.lax.while_loop(cond, body, h0)
             obctl[0] = h
+
+            @pl.when(h > h0)
+            def _():
+                # AM launches this round (wire traffic, all targets).
+                tr.emit(TR_XFER, tr.now(), me, h - h0)
 
         def stat_allreduce(r):
             """Ring-allreduce of the S-word stat vector (pending, received,
@@ -562,6 +584,10 @@ class PGASMegakernel:
                 & (statacc[2] == 0)
                 & (tot_sent == statacc[1])
             ) | (statacc[3] > 0)
+
+            @pl.when(statacc[3] > 0)
+            def _():
+                tr.emit(TR_ABORT, tr.now(), r)
             # Unconditional: on the done round every delta is zero, and on
             # a max_rounds cutoff this leaves no arrival semaphore
             # unconsumed for announced messages.
@@ -590,9 +616,12 @@ class PGASMegakernel:
         ndev, nchan = self.ndev, self.nchan
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
+        ntrace = 1 if mk.trace is not None else 0
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [smem()]
         in_specs += [anyspace()]  # abort word (HBM: re-read per round)
-        out_specs = tuple([smem()] * 4 + [anyspace()] * ndata)
+        out_specs = tuple(
+            [smem()] * 4 + [anyspace()] * ndata + [smem()] * ntrace
+        )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
             for s in mk.data_specs.values()
@@ -605,12 +634,13 @@ class PGASMegakernel:
                 jax.ShapeDtypeStruct((mk.num_values,), jnp.int32),
             ]
             + data_shapes
+            + ([mk.trace.out_shape()] if ntrace else [])
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
             aliases[5 + i] = 4 + i
         kern = pl.pallas_call(
-            functools.partial(self._kernel, quantum, max_rounds),
+            functools.partial(self._kernel, quantum, max_rounds, mk.trace),
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
@@ -653,13 +683,15 @@ class PGASMegakernel:
                 *[d[0] for d in data_in], waits[0], abort[0],
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
-            data_o = outs[4:]
+            data_o = outs[4 : 4 + ndata]
+            trace_o = outs[4 + ndata :]
             gcounts = jax.lax.psum(counts_o, self.axis)
             return (
                 counts_o[None],
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
+                *[t[None] for t in trace_o],
             )
 
         nin = 7 + ndata
@@ -667,7 +699,7 @@ class PGASMegakernel:
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
-            out_specs=(P(self.axis),) * (3 + ndata),
+            out_specs=(P(self.axis),) * (3 + ndata + ntrace),
             check_vma=False,
         )
         return jax.jit(f)
@@ -741,13 +773,22 @@ class PGASMegakernel:
         from .sharded import abort_words
 
         abort_arr = abort_words(abort, ndev)
+        import time as _time
+
+        t0_ns = _time.monotonic_ns()
         iv_o, data_o, info = execute_partitions(
             mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,
             with_rounds=True, mutate=bump_waits,
             extra_inputs=[waits_arr, abort_arr],
         )
+        t1_ns = _time.monotonic_ns()
         info["rounds"] = info.pop("steal_rounds")
-        info.pop("extra_outputs", None)
+        tail = info.pop("extra_outputs", None)
+        if mk.trace is not None and tail:
+            info["trace"] = trace_info(
+                [tail[-1][d] for d in range(ndev)], t0_ns, t1_ns,
+                mk.trace.capacity,
+            )
         info["aborted"] = bool(abort_arr[:, 0].any()) and info["pending"] != 0
         if info["overflow"]:
             raise RuntimeError(

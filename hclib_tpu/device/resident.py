@@ -90,6 +90,7 @@ the Mosaic race detector) and compiled/run on the real 1-device TPU
 from __future__ import annotations
 
 import functools
+import time
 import types
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -134,6 +135,21 @@ from .megakernel import (
     C_VBASE,
     Megakernel,
     VBLOCK,
+)
+from .tracebuf import (
+    CR_DROPPED,
+    CR_DUPED,
+    CR_REGENERATED,
+    FLT_DEAD_QUARANTINE,
+    FLT_DELAY,
+    NullTracer,
+    TR_ABORT,
+    TR_CREDIT,
+    TR_FAULT,
+    TR_INJECT,
+    TR_XFER,
+    Tracer,
+    trace_info,
 )
 
 __all__ = [
@@ -429,12 +445,16 @@ class ResidentKernel:
 
     # -- the kernel --
 
-    def _kernel(self, quantum: int, max_rounds: int, *refs) -> None:
+    def _kernel(self, quantum: int, max_rounds: int, trace, *refs) -> None:
+        # ``trace`` is captured at _build time (pallas traces lazily;
+        # reading mk.trace here could disagree with the built out tree).
         mk = self.mk
         ndata = len(mk.data_specs)
+        ntrace = 1 if trace is not None else 0
         n_in = 7 + ndata + (2 if self.inject else 0)  # + abort word (last)
         in_refs = refs[:n_in]
-        n_out = 5 + ndata + (1 if self.inject else 0)  # + fstats (last)
+        # + fstats, then the optional flight-recorder ring (always last).
+        n_out = 5 + ndata + (1 if self.inject else 0) + ntrace
         out_refs = refs[n_in : n_in + n_out]
         rest = refs[n_in + n_out :]
         nscratch = len(mk.scratch_specs)
@@ -479,7 +499,12 @@ class ResidentKernel:
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
         if self.inject:
             ctl_out = out_refs[4 + ndata]
-        fstats = out_refs[n_out - 1]
+        fstats = out_refs[n_out - 1 - ntrace]
+        tr = (
+            Tracer(out_refs[n_out - 1], trace.capacity)
+            if ntrace
+            else NullTracer()
+        )
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
 
         ndev = self.ndev
@@ -684,6 +709,7 @@ class ResidentKernel:
             tasks_in, ready_in, counts_in, ivalues_in, True, ctx_hook,
             complete_hook if (self.migratable and self.homed) else None,
             value_limit=RBASE,
+            tracer=tr if tr.enabled else None,
         )
 
         def dep_dec(row) -> None:
@@ -1273,6 +1299,12 @@ class ResidentKernel:
                         def _():
                             sendbuf[W, 0] = export(quota)
 
+                        @pl.when(sendbuf[W, 0] > 0)
+                        def _(partner=partner):
+                            tr.emit(
+                                TR_XFER, tr.now(), partner, sendbuf[W, 0]
+                            )
+
                         @pl.when(r > 0)
                         def _(k=k):
                             pltpu.semaphore_wait(csems.at[2 * k + 1], 1)
@@ -1332,11 +1364,25 @@ class ResidentKernel:
                             fstats[FS_DELAYED] = fstats[
                                 FS_DELAYED
                             ] + delay_me.astype(jnp.int32)
+
+                            @pl.when(delay_me)
+                            def _(k=k):
+                                tr.emit(
+                                    TR_FAULT, tr.now(), FLT_DELAY, k
+                                )
+
                             sendbuf[W, 0] = 0
 
                             @pl.when(quota > 0)
                             def _():
                                 sendbuf[W, 0] = export(quota)
+
+                            @pl.when(sendbuf[W, 0] > 0)
+                            def _(partner=partner):
+                                tr.emit(
+                                    TR_XFER, tr.now(), partner,
+                                    sendbuf[W, 0],
+                                )
 
                             if plan.dead_device is not None:
                                 fstats[FS_REHOMED] = fstats[
@@ -1355,9 +1401,14 @@ class ResidentKernel:
                                 cbal[k] = cbal[k] - 1
 
                             @pl.when((r > 0) & skip)
-                            def _(k=k):
+                            def _(k=k, partner=partner):
                                 owed[k] = owed[k] - 1
                                 fstats[FS_REGEN] = fstats[FS_REGEN] + 1
+                                tr.emit(
+                                    TR_CREDIT, tr.now(),
+                                    (jnp.int32(k) << 8) | partner,
+                                    CR_REGENERATED,
+                                )
 
                             rdma2 = pltpu.make_async_remote_copy(
                                 src_ref=sendbuf, dst_ref=inboxes[k],
@@ -1379,16 +1430,25 @@ class ResidentKernel:
                                 )
 
                             @pl.when(dup_theirs)
-                            def _(k=k):
+                            def _(k=k, partner=partner):
                                 pltpu.semaphore_signal(
                                     csems.at[2 * k + 1], inc=1,
                                     device_id=pdev, device_id_type=did_type,
                                 )
                                 fstats[FS_DUPED] = fstats[FS_DUPED] + 1
+                                tr.emit(
+                                    TR_CREDIT, tr.now(),
+                                    (jnp.int32(k) << 8) | partner, CR_DUPED,
+                                )
 
                             @pl.when(drop_theirs)
-                            def _():
+                            def _(k=k, partner=partner):
                                 fstats[FS_DROPPED] = fstats[FS_DROPPED] + 1
+                                tr.emit(
+                                    TR_CREDIT, tr.now(),
+                                    (jnp.int32(k) << 8) | partner,
+                                    CR_DROPPED,
+                                )
 
                             # Deterministic mirror of the partner's signal
                             # decisions: the live balance the exit drain
@@ -1443,6 +1503,9 @@ class ResidentKernel:
                             fstats[FS_DEAD_ROUND] < 0, r,
                             fstats[FS_DEAD_ROUND],
                         )
+                        tr.emit(
+                            TR_FAULT, tr.now(), FLT_DEAD_QUARANTINE, src
+                        )
 
                     return 0
 
@@ -1474,7 +1537,13 @@ class ResidentKernel:
             core.sched(jnp.where(am_dead, 0, quantum))
             pstate[PS_HB] = pstate[PS_HB] + jnp.where(am_dead, 0, 1)
             if self.inject:
-                consumed = poll(consumed)
+                c_new = poll(consumed)
+
+                @pl.when(c_new > consumed)
+                def _():
+                    tr.emit(TR_INJECT, tr.now(), c_new - consumed)
+
+                consumed = c_new
                 inj_backlog = ctlbuf[0] - consumed
             else:
                 inj_backlog = jnp.int32(0)
@@ -1488,6 +1557,11 @@ class ResidentKernel:
             drain_outbox()
             fold_and_steal(r, inj_backlog, am_dead, local_abort)
             aborted = statacc[SF_ABORT] > 0
+
+            @pl.when(aborted & (fstats[FS_ABORT_ROUND] < 0))
+            def _():
+                tr.emit(TR_ABORT, tr.now(), r)
+
             fstats[FS_ABORT_ROUND] = jnp.where(
                 aborted & (fstats[FS_ABORT_ROUND] < 0), r,
                 fstats[FS_ABORT_ROUND],
@@ -1576,9 +1650,13 @@ class ResidentKernel:
         if self.inject:
             out_specs.append(smem())
             out_shape.append(jax.ShapeDtypeStruct((8,), jnp.int32))
-        # Per-device fault/abort stats (FS_* words), always last.
+        # Per-device fault/abort stats (FS_* words), then the optional
+        # flight-recorder ring - appended outputs, existing indices intact.
         out_specs.append(smem())
         out_shape.append(jax.ShapeDtypeStruct((FS_WORDS,), jnp.int32))
+        if mk.trace is not None:
+            out_specs.append(smem())
+            out_shape.append(mk.trace.out_shape())
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
             aliases[5 + i] = 4 + i
@@ -1640,7 +1718,7 @@ class ResidentKernel:
                 pltpu.SMEM((ndev,), jnp.int32),  # deadmask
             ]
         kern = pl.pallas_call(
-            functools.partial(self._kernel, quantum, max_rounds),
+            functools.partial(self._kernel, quantum, max_rounds, mk.trace),
             out_shape=tuple(out_shape),
             in_specs=in_specs,
             out_specs=tuple(out_specs),
@@ -1661,7 +1739,9 @@ class ResidentKernel:
             )
             counts_o, iv_o = outs[2], outs[3]
             data_o = outs[4 : 4 + ndata]
-            fstats_o = outs[-1]
+            ntrace = 1 if self.mk.trace is not None else 0
+            fstats_o = outs[-1 - ntrace]
+            tail_o = ([outs[-1]] if ntrace else [])
             gcounts = jax.lax.psum(counts_o, axes)
             return (
                 counts_o[None],
@@ -1669,6 +1749,7 @@ class ResidentKernel:
                 gcounts[None],
                 *[d[None] for d in data_o],
                 fstats_o[None],
+                *[t[None] for t in tail_o],
             )
 
         nin = 7 + ndata + (2 if self.inject else 0)
@@ -1799,12 +1880,22 @@ class ResidentKernel:
         key = (quantum, max_rounds)
         if key not in self._jitted:
             self._jitted[key] = self._build(quantum, max_rounds)
+        t0_ns = time.monotonic_ns()
         iv_o, data_o, info = execute_partitions(
             mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,
             with_rounds=True, mutate=bump_waits, extra_inputs=extra,
         )
+        t1_ns = time.monotonic_ns()
         info["rounds"] = info.pop("steal_rounds")
-        frows = info.pop("extra_outputs")[-1]
+        tail = info.pop("extra_outputs")
+        if mk.trace is not None:
+            trows = tail[-1]
+            info["trace"] = trace_info(
+                [trows[d] for d in range(ndev)], t0_ns, t1_ns,
+                mk.trace.capacity,
+            )
+            tail = tail[:-1]
+        frows = tail[-1]
         fs = [decode_fault_stats(frows[d]) for d in range(ndev)]
         info["fault_stats"] = fs
         info["aborted"] = any(f["abort_round"] >= 0 for f in fs)

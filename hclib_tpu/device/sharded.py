@@ -23,6 +23,7 @@ termination becomes a collective.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import jax
@@ -194,6 +195,31 @@ class ShardedMegakernel:
                 f"({sorted(mk.kernel_names[fid] for fid, _ in mk.batch_specs)}); "
                 "drop the BatchSpec routes for the sharded runner"
             )
+        # The trace ring cannot ride this runner: same appended-output
+        # problem as tstats (positional out_specs), and the bulk-
+        # synchronous steal loop re-enters the kernel per round (each
+        # entry resets the ring). The fully-resident runners trace.
+        self._suppress_trace = False
+        if mk.trace is not None:
+            if getattr(mk, "trace_from_env", False):
+                # HCLIB_TPU_TRACE is a process-wide opt-in; building this
+                # runner untraced beats failing a run the env owner never
+                # wrote trace= into. Suppression is LOCAL to this runner's
+                # builds - the shared Megakernel keeps its ring for
+                # mk.run() / the resident runners.
+                import logging
+
+                logging.getLogger("hclib_tpu.device").warning(
+                    "ShardedMegakernel cannot trace; ignoring "
+                    "HCLIB_TPU_TRACE for this runner's builds"
+                )
+                self._suppress_trace = True
+            else:
+                raise ValueError(
+                    "ShardedMegakernel does not support the trace ring; "
+                    "use ResidentKernel/ICIStealMegakernel tracing or "
+                    "build the Megakernel with trace=None"
+                )
         self.mk = mk
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
@@ -205,10 +231,25 @@ class ShardedMegakernel:
         self.migratable_fns = frozenset(int(f) for f in migratable_fns)
         self._jitted: Dict[Any, Any] = {}
 
+    @contextlib.contextmanager
+    def _maybe_untraced(self):
+        """Build-time trace suppression for env-derived tracing: restores
+        mk.trace afterwards so other runners sharing the kernel keep it."""
+        if not self._suppress_trace:
+            yield
+            return
+        saved = self.mk.trace
+        self.mk.trace = None
+        try:
+            yield
+        finally:
+            self.mk.trace = saved
+
     def _build(self, fuel: int):
         # Single kernel entry per launch: lean value staging suffices (run()
         # widens value_alloc over presets before the call).
-        inner = self.mk._build_raw(fuel)
+        with self._maybe_untraced():
+            inner = self.mk._build_raw(fuel)
         ndata = len(self.mk.data_specs)
         axis = self.axis
 
@@ -248,7 +289,8 @@ class ShardedMegakernel:
         # rounds ARE reusable (stage() rebuilds the row free stack from
         # completion tombstones), so capacity tracks the live set; only
         # bump-side alloc_values blocks ratchet across rounds.
-        inner = self.mk._build_raw(quantum, stage_all_values=True)
+        with self._maybe_untraced():
+            inner = self.mk._build_raw(quantum, stage_all_values=True)
         ndata = len(self.mk.data_specs)
         axis = self.axis
         ndev = self.ndev

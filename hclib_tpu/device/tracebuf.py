@@ -1,0 +1,322 @@
+"""Device-side flight recorder: a fixed-width trace ring in device memory.
+
+The persistent megakernel is the north-star component of this repo, and
+until now it was a black box: when a round stalls, a lane starves, or a
+perf number collapses (the r05 1.2-vs-64 GCUPS gap), the only evidence
+was end-of-run aggregate counters (``info['tiers']``, ``fault_stats``).
+This module gives every round loop an **opt-in trace ring**: an SMEM
+int32 output row the kernel appends fixed-width records to from *inside*
+its scheduling rounds - round entry/exit, dispatch-tier fires (with lane
+occupancy), prefetch issue/drain, steal-credit traffic, abort/fault
+observation.
+
+Design rules (the ``DeviceFaultPlan`` pattern):
+
+- **Compiled in only when enabled.** A ``None`` ring emits nothing: the
+  ``NullTracer``'s methods are no-ops, so call sites stay unconditional
+  and a disabled build is bit-identical to one that predates tracing
+  (asserted in tests/test_tracebuf.py). There is no "check a flag at
+  runtime" cost - the flag is resolved at trace time.
+- **Overflow counted, not crashed.** The write cursor is monotonic and
+  records land at ``cursor % capacity``: a full ring keeps the *last*
+  ``capacity`` records (the rounds before a stall are what debugging
+  wants) and the decoder reports ``dropped = max(0, written - capacity)``.
+- **No device clock.** TPU scalar cores expose no useful wall clock to
+  kernels; records carry the ROUND index as their timebase. The host
+  brackets the kernel launch with ``time.monotonic_ns()`` (the same
+  clock ``runtime/instrument.py`` stamps host events with - the
+  clockprobe bracketing trick) and tools/timeline.py interpolates round
+  -> wall time inside that epoch, which is what lets device rounds and
+  host spans land on ONE Perfetto timeline.
+
+Record layout: 4 int32 words ``[tag, t, a, b]`` where ``t`` is the round
+index and ``a``/``b`` are per-tag payloads (see the TR_* table). The ring
+row is ``HDR`` header words followed by ``capacity * TR_WORDS`` record
+words; header word 0 is the monotonic write cursor and word 1 a
+scheduler-entry-relative round cursor (the single-core megakernel has no
+exchange round of its own, so its tracer mints one per scheduling
+iteration).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "TraceRing",
+    "Tracer",
+    "NullTracer",
+    "decode_ring",
+    "trace_info",
+    "trace_to_jsonable",
+    "records_of",
+    "TR_WORDS",
+    "HDR",
+    "TR_ROUND_BEGIN",
+    "TR_ROUND_END",
+    "TR_FIRE_SCALAR",
+    "TR_FIRE_BATCH",
+    "TR_PREFETCH_ISSUE",
+    "TR_PREFETCH_DRAIN",
+    "TR_SPILL",
+    "TR_CREDIT",
+    "TR_XFER",
+    "TR_ABORT",
+    "TR_FAULT",
+    "TR_INJECT",
+    "TAG_NAMES",
+]
+
+# Header words (HDR total; the rest reserved/zero).
+TH_COUNT = 0  # records ever written (monotonic; slot = count % capacity)
+TH_ROUND = 1  # entry-relative round cursor (single-core megakernel only)
+HDR = 8
+
+TR_WORDS = 4  # [tag, t, a, b]
+
+# Record tags. Payload conventions (a, b):
+TR_ROUND_BEGIN = 1     # a = ready backlog, b = pending
+TR_ROUND_END = 2       # a = executed since entry, b = pending
+TR_FIRE_SCALAR = 3     # a = kernel-table F_FN, b = descriptor row
+TR_FIRE_BATCH = 4      # a = (lane_fn << 16) | take, b = prefetched count
+TR_PREFETCH_ISSUE = 5  # a = lane F_FN, b = descriptors announced
+TR_PREFETCH_DRAIN = 6  # a = lane F_FN, b = in-flight descriptors retired
+TR_SPILL = 7           # a = lane F_FN, b = entries spilled to the ring
+TR_CREDIT = 8          # a = (hop << 8) | peer, b = delta code (CR_*)
+TR_XFER = 9            # a = partner/hop, b = rows sent
+TR_ABORT = 10          # a = round the folded abort word was observed
+TR_FAULT = 11          # a = fault code (FLT_*), b = detail (peer/mask)
+TR_INJECT = 12         # a = rows installed from the injection ring
+
+TAG_NAMES: Dict[int, str] = {
+    TR_ROUND_BEGIN: "round_begin",
+    TR_ROUND_END: "round_end",
+    TR_FIRE_SCALAR: "fire_scalar",
+    TR_FIRE_BATCH: "fire_batch",
+    TR_PREFETCH_ISSUE: "prefetch_issue",
+    TR_PREFETCH_DRAIN: "prefetch_drain",
+    TR_SPILL: "spill",
+    TR_CREDIT: "credit",
+    TR_XFER: "xfer",
+    TR_ABORT: "abort",
+    TR_FAULT: "fault",
+    TR_INJECT: "inject",
+}
+
+# TR_CREDIT delta codes (b word).
+CR_DROPPED = 1      # granter dropped the credit it owed
+CR_DUPED = 2        # granter signalled twice
+CR_REGENERATED = 3  # starved waiter skipped an owed wait (regeneration)
+
+# TR_FAULT codes (a word).
+FLT_DEAD_QUARANTINE = 1  # b = peer quarantined by heartbeat timeout
+FLT_WEDGE = 2            # b = starved-channel encoding ((hop<<8)|granter)+1
+FLT_DELAY = 3            # b = hop whose export quota was zeroed
+
+
+class TraceRing:
+    """Host-side spec of a device trace ring (capacity in RECORDS).
+
+    Capacity budgets SMEM: the ring is an SMEM output of ``HDR +
+    capacity * TR_WORDS`` int32 words, and SMEM windows pad scalars
+    ~32 B/word (the same accounting that caps task tables near ~800
+    rows, device/workloads.py) - the 2048-record default costs about as
+    much as a 512-row task table, so size DOWN next to SMEM-heavy
+    kernels."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"trace capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+
+    @property
+    def words(self) -> int:
+        return HDR + self.capacity * TR_WORDS
+
+    def out_shape(self):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.ShapeDtypeStruct((self.words,), jnp.int32)
+
+    @staticmethod
+    def of(trace: Union[None, int, "TraceRing"]) -> Optional["TraceRing"]:
+        """Normalize a ``trace=`` argument (None / record count / ring)."""
+        if trace is None:
+            return None
+        if isinstance(trace, TraceRing):
+            return trace
+        if isinstance(trace, bool):
+            return TraceRing() if trace else None
+        return TraceRing(int(trace))
+
+
+def _i32(x):
+    import jax.numpy as jnp
+
+    return jnp.int32(x) if isinstance(x, (int, np.integer)) else x
+
+
+class Tracer:
+    """Device-side writer over one ring ref (an SMEM int32 output row).
+
+    Every method is a handful of scalar SMEM ops; none branch. Emission
+    under a fault/abort condition belongs inside the caller's ``pl.when``
+    like any other conditional SMEM write.
+    """
+
+    enabled = True
+
+    def __init__(self, ref, capacity: int) -> None:
+        self._ref = ref
+        self._cap = int(capacity)
+
+    def reset(self) -> None:
+        """Zero the header (per kernel entry / rep, from stage())."""
+        for w in range(HDR):
+            self._ref[w] = 0
+
+    def emit(self, tag: int, t, a=0, b=0) -> None:
+        n = self._ref[TH_COUNT]
+        base = HDR + (n % self._cap) * TR_WORDS
+        import jax.numpy as jnp
+
+        self._ref[base + 0] = jnp.int32(tag)
+        self._ref[base + 1] = _i32(t)
+        self._ref[base + 2] = _i32(a)
+        self._ref[base + 3] = _i32(b)
+        self._ref[TH_COUNT] = n + 1
+
+    def tick(self):
+        """Mint the next entry-relative round index (single-core sched)."""
+        r = self._ref[TH_ROUND]
+        self._ref[TH_ROUND] = r + 1
+        return r
+
+    def now(self):
+        """The current round cursor, without advancing it."""
+        return self._ref[TH_ROUND]
+
+
+class NullTracer:
+    """The disabled recorder: no refs, no writes, no compiled code."""
+
+    enabled = False
+
+    def reset(self) -> None:
+        return None
+
+    def emit(self, tag: int, t, a=0, b=0) -> None:
+        return None
+
+    def tick(self):
+        return 0
+
+    def now(self):
+        return 0
+
+
+# ------------------------------------------------------------------ decode
+
+def decode_ring(row, capacity: Optional[int] = None) -> Dict[str, Any]:
+    """Decode one ring row into ``{written, dropped, records}``.
+
+    ``records`` is an (n, 4) int64 array of [tag, t, a, b] in emission
+    order; when the ring wrapped it holds the LAST ``capacity`` records
+    and ``dropped`` counts the overwritten prefix."""
+    row = np.asarray(row).astype(np.int64).ravel()
+    if capacity is None:
+        capacity = (len(row) - HDR) // TR_WORDS
+    written = int(row[TH_COUNT])
+    body = row[HDR : HDR + capacity * TR_WORDS].reshape(capacity, TR_WORDS)
+    if written <= capacity:
+        records = body[:written].copy()
+    else:
+        start = written % capacity
+        records = np.roll(body, -start, axis=0).copy()
+    return {
+        "written": written,
+        "dropped": max(0, written - capacity),
+        "capacity": int(capacity),
+        "records": records,
+    }
+
+
+def trace_info(
+    rows: Sequence, t0_ns: int, t1_ns: int,
+    capacity: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The uniform ``info['trace']`` shape every traced runner returns:
+    one decoded ring per device plus the host-wall-clock epoch that
+    bracketed the kernel launch (``time.monotonic_ns()``, the clock host
+    EventLog records share - what lets tools/timeline.py place device
+    rounds on the host timeline)."""
+    return {
+        "epoch": {"t0_ns": int(t0_ns), "t1_ns": int(t1_ns)},
+        "rings": [decode_ring(r, capacity) for r in rows],
+    }
+
+
+def records_of(trace: Dict[str, Any], tag: int, ring: int = 0) -> np.ndarray:
+    """Records of one tag from ``info['trace']`` (rows: [tag, t, a, b])."""
+    recs = np.asarray(trace["rings"][ring]["records"])
+    if recs.size == 0:
+        return recs.reshape(0, TR_WORDS)
+    return recs[recs[:, 0] == tag]
+
+
+def trace_to_jsonable(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe copy of a trace_info dict (record arrays -> lists), so
+    run infos can be saved next to perf logs and fed back to
+    ``tools/timeline.py --trace``."""
+    return {
+        "epoch": dict(trace["epoch"]),
+        "rings": [
+            {
+                "written": r["written"],
+                "dropped": r["dropped"],
+                "capacity": r["capacity"],
+                "records": np.asarray(r["records"]).tolist(),
+            }
+            for r in trace["rings"]
+        ],
+    }
+
+
+def trace_from_jsonable(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of trace_to_jsonable (tools/timeline.py --trace loader)."""
+    return {
+        "epoch": dict(obj["epoch"]),
+        "rings": [
+            {
+                "written": int(r["written"]),
+                "dropped": int(r["dropped"]),
+                "capacity": int(r["capacity"]),
+                "records": np.asarray(
+                    r["records"], dtype=np.int64
+                ).reshape(-1, TR_WORDS),
+            }
+            for r in obj["rings"]
+        ],
+    }
+
+
+def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Flat numeric summary of a trace (MetricsRegistry food): per-tag
+    record counts plus written/dropped totals across rings."""
+    out: Dict[str, Any] = {
+        "rings": len(trace["rings"]),
+        "written": sum(r["written"] for r in trace["rings"]),
+        "dropped": sum(r["dropped"] for r in trace["rings"]),
+    }
+    for tag, name in TAG_NAMES.items():
+        n = 0
+        for r in trace["rings"]:
+            recs = np.asarray(r["records"])
+            if recs.size:
+                n += int((recs[:, 0] == tag).sum())
+        out[name] = n
+    return out

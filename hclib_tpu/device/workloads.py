@@ -67,6 +67,7 @@ def make_fib_megakernel(
     capacity: int = 768,  # SMEM windows pad scalars ~32B/word: ~800-row max
     interpret: Optional[bool] = None,
     num_values: Optional[int] = None,
+    trace=None,
 ) -> Megakernel:
     # Descriptor rows recycle, and value blocks are row-owned (SUM reads
     # its children's results out of its own row's block), so both live
@@ -88,6 +89,7 @@ def make_fib_megakernel(
         succ_capacity=64,
         interpret=interpret,
         uses_row_values=True,
+        trace=trace,
     )
 
 

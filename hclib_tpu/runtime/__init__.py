@@ -15,6 +15,7 @@ from .locality import (
 )
 from .instrument import EventLog, load_dump, register_event_type
 from .mem import allocate_at, async_copy, free_at, memset_at
+from .metrics import MetricsRegistry
 from .module import Module, register_module, unregister_all_modules
 from .promise import Future, Promise, PromiseError
 from .reducers import MaxReducer, OrReducer, Reducer, SumReducer

@@ -36,6 +36,7 @@ __all__ = [
     "END",
     "SINGLE",
     "load_dump",
+    "load_manifest",
 ]
 
 START = 0
@@ -93,19 +94,46 @@ class _WorkerBuffer:
 
 
 class EventLog:
-    """Per-worker event recording + binary dump."""
+    """Per-worker event recording + binary dump.
+
+    Worker buffers are lock-free by construction (each is written by
+    exactly one worker thread). Records from NON-worker threads - module
+    init, procworld progress engines, the watchdog, the main launch
+    context before identity binding - used to be silently dropped
+    (worker_id outside ``[0, nworkers)``); they now land in a shared
+    **external lane** (lane index ``nworkers`` in the dump, guarded by a
+    lock since any thread may write it) and are counted in
+    ``external_records``. Dumps name the lane in the manifest so readers
+    can label it."""
 
     def __init__(self, nworkers: int, capacity: int = 1 << 16) -> None:
         self.nworkers = nworkers
-        self._buffers = [_WorkerBuffer(capacity) for _ in range(nworkers)]
+        # +1: the external overflow lane for non-worker threads.
+        self._buffers = [
+            _WorkerBuffer(capacity) for _ in range(nworkers + 1)
+        ]
         self._id_lock = threading.Lock()
         self._next_id = 0
+        self._ext_lock = threading.Lock()
+        self.external_records = 0
+        # Per-worker id counters (worker w mints w+1 + k*(nworkers+1)):
+        # striping keeps ids process-unique WITHOUT the global lock that
+        # used to sit on every task execution - a measured hot-path tax
+        # guarded by tools/perf_regression.py's instrument-overhead entry.
+        self._wid_next = [0] * nworkers
 
-    def new_id(self) -> int:
-        """Fresh correlation id for a START/END pair."""
+    def new_id(self, worker_id: Optional[int] = None) -> int:
+        """Fresh correlation id for a START/END pair. With ``worker_id``
+        (the recording worker) the id is minted lock-free from that
+        worker's stripe; without, from the locked shared stripe 0."""
+        if worker_id is not None and 0 <= worker_id < self.nworkers:
+            self._wid_next[worker_id] += 1
+            return worker_id + 1 + self._wid_next[worker_id] * (
+                self.nworkers + 1
+            )
         with self._id_lock:
             self._next_id += 1
-            return self._next_id
+            return self._next_id * (self.nworkers + 1)
 
     def record(self, worker_id: int, type_: int, transition: int = SINGLE,
                eid: int = 0) -> None:
@@ -113,24 +141,53 @@ class EventLog:
             self._buffers[worker_id].record(
                 time.monotonic_ns(), type_, transition, eid
             )
+        else:
+            # Any out-of-range id (None-identity threads pass -1) routes
+            # to the shared lane; counted so the dump's completeness is
+            # checkable.
+            with self._ext_lock:
+                self._buffers[self.nworkers].record(
+                    time.monotonic_ns(), type_, transition, eid
+                )
+                self.external_records += 1
 
     def dump(self, directory: Optional[str] = None) -> str:
         """Write ``hclib.<ts>.dump/<worker>`` binary files + manifest
-        (layout parity: src/hclib-instrument.c:50-83)."""
+        (layout parity: src/hclib-instrument.c:50-83). Lane ``nworkers``
+        is the external lane (named in the manifest)."""
         base = directory or os.environ.get("HCLIB_TPU_DUMP_DIR", ".")
         path = os.path.join(base, f"hclib.{int(time.time() * 1000)}.dump")
         os.makedirs(path, exist_ok=True)
         with _type_lock:
             names = list(_type_names)
+        # Drain the external lane atomically with its counter so the
+        # manifest count matches exactly what THIS dump's lane file holds
+        # (dumps drain; a stale counter would advertise phantom records).
+        with self._ext_lock:
+            ext_events = self._buffers[self.nworkers].drain()
+            ext_count = self.external_records
+            self.external_records = 0
         with open(os.path.join(path, "event_types.json"), "w") as f:
-            json.dump({"event_types": names, "dtype": _EVENT_DTYPE.descr}, f)
-        for w, b in enumerate(self._buffers):
+            json.dump(
+                {
+                    "event_types": names,
+                    "dtype": _EVENT_DTYPE.descr,
+                    "nworkers": self.nworkers,
+                    "external_lane": self.nworkers,
+                    "external_records": ext_count,
+                },
+                f,
+            )
+        for w, b in enumerate(self._buffers[: self.nworkers]):
             b.drain().tofile(os.path.join(path, str(w)))
+        ext_events.tofile(os.path.join(path, str(self.nworkers)))
         return path
 
 
 def load_dump(path: str) -> Tuple[List[str], Dict[int, np.ndarray]]:
-    """Read a dump directory back: (event type names, worker -> events)."""
+    """Read a dump directory back: (event type names, worker -> events).
+    Lane ``manifest['external_lane']`` (when present) holds non-worker
+    threads' records; ``load_manifest`` exposes the full manifest."""
     with open(os.path.join(path, "event_types.json")) as f:
         manifest = json.load(f)
     out: Dict[int, np.ndarray] = {}
@@ -140,3 +197,10 @@ def load_dump(path: str) -> Tuple[List[str], Dict[int, np.ndarray]]:
                 os.path.join(path, entry), dtype=_EVENT_DTYPE
             )
     return manifest["event_types"], out
+
+
+def load_manifest(path: str) -> Dict:
+    """The dump's full manifest (event types, dtype, external-lane info;
+    old dumps lack the lane keys - callers get {} defaults via .get)."""
+    with open(os.path.join(path, "event_types.json")) as f:
+        return json.load(f)

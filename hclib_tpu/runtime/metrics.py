@@ -1,0 +1,188 @@
+"""Unified telemetry: one registry over every observability source.
+
+The runtime grew five independent sources - ``Runtime.stats_dict()``
+(worker counters + resilience retry/quarantine), the megakernel's
+``info['tiers']`` dispatch counters, the resident mesh's
+``info['fault_stats']``, the device flight recorder
+(``info['trace']``, device/tracebuf.py), and ad-hoc run infos - each with
+its own shape and no common export. ``MetricsRegistry`` folds them into
+ONE snapshot/delta API with JSON and Prometheus-text export, so a
+dashboard, the watchdog's stats-dump rung, or a bench artifact all read
+the same numbers the same way.
+
+Model:
+
+- ``register(name, source)`` attaches a LIVE source (a zero-arg callable
+  returning a mapping, e.g. ``rt.stats_dict``) polled at snapshot time.
+- ``record(name, mapping)`` stores a STATIC snapshot (e.g. a device
+  run's ``info``); the latest record under a name wins.
+- ``add_run_info(name, info)`` is the device-run convenience: it keeps
+  the numeric core of an info dict and summarizes ``fault_stats`` and
+  the trace ring (per-tag record counts) instead of carrying raw rows.
+- ``snapshot()`` flattens everything to ``{dotted.key: number}`` plus a
+  timestamp; ``delta(a, b)`` subtracts two snapshots key-wise (counters
+  become rates when divided by the timestamp delta).
+- ``to_json()`` / ``to_prometheus()`` render a snapshot; the Prometheus
+  form sanitizes keys into ``<namespace>_<key>`` gauges.
+
+Enable runtime-side via ``Runtime(metrics=True)`` or
+``HCLIB_TPU_METRICS=1``: the runtime registers its own ``stats_dict``
+and the watchdog's stats-dump rung (strike 2) logs the registry snapshot
+alongside ``format_stats()``, so a stalled run's post-mortem carries
+device counters too when the program recorded them.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import threading
+import time
+from typing import Any, Callable, Dict, Mapping, Optional
+
+__all__ = ["MetricsRegistry"]
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def _flatten(prefix: str, obj: Any, out: Dict[str, float]) -> None:
+    """Numeric leaves only: strings/None are dropped (Prometheus carries
+    numbers; string context belongs in the JSON info files next to it),
+    bools coerce to 0/1, lists index as ``.<i>``."""
+    if isinstance(obj, Mapping):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            _flatten(key, v, out)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _flatten(f"{prefix}.{i}", v, out)
+    elif isinstance(obj, bool):
+        out[prefix] = 1.0 if obj else 0.0
+    elif _is_num(obj):
+        out[prefix] = float(obj)
+    # numpy scalars quack like numbers.Real; arrays do not - summarize
+    # them before recording (add_run_info does for the known shapes).
+
+
+class MetricsRegistry:
+    """Aggregates live sources and recorded run infos into flat numeric
+    snapshots with JSON / Prometheus export. Thread-safe: the watchdog
+    thread snapshots while workers record."""
+
+    def __init__(self, namespace: str = "hclib_tpu") -> None:
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Callable[[], Mapping]] = {}
+        self._records: Dict[str, Mapping] = {}
+
+    # -- wiring --
+
+    def register(self, name: str, source: Callable[[], Mapping]) -> None:
+        """Attach a live source polled at every snapshot."""
+        if not callable(source):
+            raise TypeError(f"source {name!r} must be callable")
+        with self._lock:
+            self._sources[name] = source
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def record(self, name: str, mapping: Mapping) -> None:
+        """Store a static snapshot under ``name`` (latest wins)."""
+        with self._lock:
+            self._records[name] = dict(mapping)
+
+    def add_run_info(self, name: str, info: Mapping) -> None:
+        """Record a device run's ``info`` dict: numeric scalars plus
+        ``tiers``/``fault_stats`` pass through; the flight-recorder trace
+        is summarized (per-tag counts, written/dropped) rather than
+        carried raw; array-valued entries (per_device_counts) reduce to
+        per-device executed/rounds."""
+        keep: Dict[str, Any] = {}
+        for k, v in info.items():
+            if k == "trace":
+                from ..device.tracebuf import summarize
+
+                keep["trace"] = summarize(v)
+            elif k == "per_device_counts":
+                import numpy as np
+
+                from ..device.megakernel import C_EXECUTED
+
+                c = np.asarray(v)
+                keep["per_device_executed"] = c[:, C_EXECUTED].tolist()
+            elif k == "extra_outputs":
+                continue
+            else:
+                keep[k] = v
+        self.record(name, keep)
+
+    # -- snapshots --
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``{'t': epoch_seconds, 'metrics': {dotted.key: float}}``. A
+        live source that raises is reported as ``<name>.error = 1``
+        instead of sinking the snapshot (the watchdog must be able to
+        snapshot a half-dead runtime)."""
+        with self._lock:
+            sources = dict(self._sources)
+            records = dict(self._records)
+        metrics: Dict[str, float] = {}
+        for name, fn in sources.items():
+            try:
+                _flatten(name, fn(), metrics)
+            except Exception:
+                metrics[f"{name}.error"] = 1.0
+        for name, rec in records.items():
+            _flatten(name, rec, metrics)
+        return {"t": time.time(), "metrics": metrics}
+
+    @staticmethod
+    def delta(
+        a: Mapping[str, Any], b: Mapping[str, Any]
+    ) -> Dict[str, Any]:
+        """Key-wise ``b - a`` over two snapshots (missing keys read 0, so
+        a source that appeared mid-interval deltas from zero); ``t`` is
+        the interval seconds."""
+        am: Mapping[str, float] = a.get("metrics", a)
+        bm: Mapping[str, float] = b.get("metrics", b)
+        keys = set(am) | set(bm)
+        return {
+            "t": float(b.get("t", 0.0)) - float(a.get("t", 0.0)),
+            "metrics": {
+                k: float(bm.get(k, 0.0)) - float(am.get(k, 0.0))
+                for k in sorted(keys)
+            },
+        }
+
+    # -- export --
+
+    def to_json(self, snapshot: Optional[Mapping] = None) -> str:
+        return json.dumps(snapshot or self.snapshot(), sort_keys=True)
+
+    @staticmethod
+    def _sanitize(key: str) -> str:
+        out = []
+        for ch in key:
+            out.append(ch if (ch.isalnum() or ch == "_") else "_")
+        name = "".join(out)
+        if name and name[0].isdigit():
+            name = "_" + name
+        return name
+
+    def to_prometheus(self, snapshot: Optional[Mapping] = None) -> str:
+        """Prometheus text exposition: one gauge per flattened key,
+        ``<namespace>_<sanitized key>``. Values render via repr(float)
+        (Prometheus accepts scientific notation)."""
+        snap = snapshot or self.snapshot()
+        lines = []
+        for k in sorted(snap["metrics"]):
+            name = f"{self.namespace}_{self._sanitize(k)}"
+            v = snap["metrics"][k]
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(v)!r}")
+        lines.append("")
+        return "\n".join(lines)

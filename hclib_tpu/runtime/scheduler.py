@@ -192,6 +192,7 @@ class Runtime:
         watchdog_escalate: Optional[bool] = None,
         fault_plan: Optional[FaultPlan] = None,
         default_retry: Optional[RetryPolicy] = None,
+        metrics: Optional[bool] = None,
     ) -> None:
         if nworkers is None:
             env = os.environ.get("HCLIB_TPU_WORKERS") or os.environ.get("HCLIB_WORKERS")
@@ -263,6 +264,20 @@ class Runtime:
             from .timer import StateTimer
 
             self.state_timer = StateTimer(nworkers)
+        # Unified telemetry (runtime/metrics.py): a MetricsRegistry with
+        # this runtime's stats_dict pre-registered; device runs record
+        # their infos into it (rt.metrics.add_run_info) and the watchdog's
+        # stats-dump rung logs its snapshot.
+        if metrics is None:
+            # Same convention as HCLIB_TPU_TRACE: "0" (and empty) is OFF.
+            env = os.environ.get("HCLIB_TPU_METRICS", "")
+            metrics = env not in ("", "0")
+        self.metrics = None
+        if metrics:
+            from .metrics import MetricsRegistry
+
+            self.metrics = MetricsRegistry()
+            self.metrics.register("runtime", self.stats_dict)
         self._watchdog_s = watchdog_s
         self._watchdog_escalate = watchdog_escalate
         self._watchdog_thread: Optional[threading.Thread] = None
@@ -415,7 +430,7 @@ class Runtime:
         if ev is not None and wid is not None:
             from .instrument import START
 
-            eid = ev.new_id()
+            eid = ev.new_id(wid)
             ev.record(wid, self._ev_task, START, eid)
         if st is not None and wid is not None:
             from .timer import WORK
@@ -1053,7 +1068,10 @@ class Runtime:
                 if self.event_log is not None:
                     from .instrument import SINGLE
 
-                    self.event_log.record(0, ev_stall, SINGLE, strikes)
+                    # -1 routes to the external lane: the watchdog thread
+                    # must not write worker 0's lock-free buffer (a real
+                    # cross-thread race before the lane existed).
+                    self.event_log.record(-1, ev_stall, SINGLE, strikes)
                 head = (
                     f"hclib_tpu watchdog: no task executed in "
                     f"{self._watchdog_s:.1f}s with work outstanding "
@@ -1063,7 +1081,13 @@ class Runtime:
                 if strikes == 1:
                     log.warning("%s", head)
                 elif strikes == 2:
-                    log.error("%s\n%s", head, self.format_stats())
+                    dump = self.format_stats()
+                    if self.metrics is not None:
+                        # The stats-dump rung carries the unified snapshot
+                        # too: device counters a program recorded into the
+                        # registry survive in the stall post-mortem.
+                        dump += "\nmetrics: " + self.metrics.to_json()
+                    log.error("%s\n%s", head, dump)
                 if strikes >= 3 and self._watchdog_escalate:
                     err = StallError(
                         f"watchdog: stalled for "
@@ -1286,6 +1310,7 @@ def launch(
     deadline_s: Optional[float] = None,
     fault_plan: Optional[FaultPlan] = None,
     default_retry: Optional[RetryPolicy] = None,
+    metrics: Optional[bool] = None,
 ) -> Any:
     """Run ``fn`` inside a fresh runtime; returns its result."""
     return Runtime(
@@ -1298,6 +1323,7 @@ def launch(
         watchdog_escalate=watchdog_escalate,
         fault_plan=fault_plan,
         default_retry=default_retry,
+        metrics=metrics,
     ).run(fn, *args, deadline_s=deadline_s)
 
 

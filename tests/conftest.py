@@ -74,3 +74,19 @@ def _clean_modules():
     module._mem_fns.clear()
     module._mem_fns.update(saved_mem)
     module._per_worker_factories[:] = saved_factories
+
+
+def timeline_mod():
+    """Import tools/timeline.py (shared by the observability tests so the
+    sys.path dance lives in ONE place)."""
+    import sys
+
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    sys.path.insert(0, tools)
+    try:
+        import timeline
+    finally:
+        sys.path.remove(tools)
+    return timeline

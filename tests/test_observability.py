@@ -4,7 +4,6 @@ The reference's instrumentation recorder is stubbed (src/hclib-instrument.c:
 211-252); here it must actually record and round-trip.
 """
 
-import sys
 import time
 
 import numpy as np
@@ -161,20 +160,199 @@ def test_windowed_trials_stats_survive_sheared_trials():
     assert s2["n_trials"] == 3 and s2["n_used"] == 2 and s2["n_fast"] == 2
 
 
+def test_event_log_external_lane_counts_non_worker_records(tmp_path):
+    """Records from non-worker threads (module init, watchdog, procworld
+    engines) used to vanish; they now land in the external lane and are
+    counted (the satellite fix)."""
+    from hclib_tpu.runtime.instrument import EventLog, load_manifest
+
+    log = EventLog(2, capacity=16)
+    t = register_event_type("ext_evt")
+    log.record(0, t, 2, 1)      # worker lane
+    log.record(-1, t, 2, 2)     # main/module context (no identity)
+    log.record(99, t, 2, 3)     # out-of-range id
+    assert log.external_records == 2
+    path = log.dump(str(tmp_path))
+    names, per_worker = load_dump(path)
+    man = load_manifest(path)
+    assert man["external_lane"] == 2 and man["external_records"] == 2
+    assert len(per_worker[2]) == 2
+    assert sorted(per_worker[2]["id"]) == [2, 3]
+
+
+def test_watchdog_stall_event_lands_in_external_lane(tmp_path, caplog):
+    import logging
+
+    rt = hc.Runtime(nworkers=1, watchdog_s=0.15, watchdog_escalate=False,
+                    instrument=True)
+
+    def body():
+        time.sleep(0.5)
+
+    with caplog.at_level(logging.WARNING, logger="hclib_tpu.resilience"):
+        rt.run(body)
+    assert rt.stall_reports >= 1
+    # The watchdog thread's 'stall' records route to the external lane
+    # (writing worker 0's lock-free buffer from another thread was a
+    # race).
+    assert rt.event_log.external_records >= 1
+
+
+def _timeline():
+    from conftest import timeline_mod
+
+    return timeline_mod()
+
+
+def test_spans_from_events_empty_and_open_paths(tmp_path):
+    timeline = _timeline()
+    from hclib_tpu.runtime.instrument import _EVENT_DTYPE, EventLog
+
+    # Empty input: no spans, no crash.
+    assert timeline.spans_from_events(np.zeros(0, _EVENT_DTYPE)) == []
+    # Open span (START without END): kept, flagged, closed at last ts.
+    ev = np.zeros(3, _EVENT_DTYPE)
+    ev[0] = (100, 0, START, 1)   # never ends
+    ev[1] = (200, 0, START, 2)
+    ev[2] = (300, 0, END, 2)
+    spans = timeline.spans_from_events(ev)
+    open_ = [s for s in spans if s.get("open")]
+    assert len(spans) == 2 and len(open_) == 1
+    assert open_[0]["t0"] == 100 and open_[0]["t1"] == 300
+    # Empty-dump render path.
+    log = EventLog(1, capacity=4)
+    path = log.dump(str(tmp_path))
+    text = timeline.render_dump(path)
+    assert "(no events recorded)" in text
+    # render_stats / render_device_report degrade on empty inputs.
+    assert "0 tasks executed" in timeline.render_stats({"workers": []})
+    assert "(no per_device_counts in info)" in (
+        timeline.render_device_report({"executed": 1})
+    )
+
+
+def test_render_dump_density_vectorization_matches_bruteforce():
+    """The np.add.at density must equal the old O(spans*width) loop."""
+    timeline = _timeline()
+    rng = np.random.default_rng(3)
+    width, t_lo, total = 37, 1000, 50000
+    bucket = total / width
+    spans = []
+    for _ in range(200):
+        a = int(rng.integers(t_lo, t_lo + total))
+        b = int(rng.integers(a, t_lo + total + 1))
+        spans.append({"type": 0, "id": 0, "t0": a, "t1": b})
+    got = timeline._density(spans, t_lo, bucket, width)
+    want = np.zeros(width)
+    for s in spans:
+        b0 = (s["t0"] - t_lo) / bucket
+        b1 = max((s["t1"] - t_lo) / bucket, b0 + 1e-9)
+        for bk in range(int(b0), min(int(np.ceil(b1)), width)):
+            want[bk] += max(0.0, min(b1, bk + 1) - max(b0, bk))
+    assert np.allclose(got, want, atol=1e-6)
+
+
+def test_render_dump_labels_unknown_types_and_top(tmp_path):
+    timeline = _timeline()
+    from hclib_tpu.runtime.instrument import EventLog
+
+    log = EventLog(1, capacity=16)
+    # A type id past the manifest (simulates a foreign/stale dump).
+    log.record(0, 999, START, 1)
+    log.record(0, 999, END, 1)
+    path = log.dump(str(tmp_path))
+    text = timeline.render_dump(path, top=2)
+    assert "type<999>" in text
+    assert "top 1 spans by duration" in text
+
+
+def test_metrics_registry_snapshot_delta_and_exports():
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    live = {"executed": 10, "nested": {"a": 1.5, "flag": True}}
+    reg.register("rt", lambda: live)
+    reg.record("run", {"tasks": 100, "skip_me": "string", "arr": [1, 2]})
+    s1 = reg.snapshot()
+    m = s1["metrics"]
+    assert m["rt.executed"] == 10.0
+    assert m["rt.nested.a"] == 1.5
+    assert m["rt.nested.flag"] == 1.0
+    assert m["run.tasks"] == 100.0
+    assert m["run.arr.0"] == 1.0 and m["run.arr.1"] == 2.0
+    assert "run.skip_me" not in m  # strings are not metrics
+    live["executed"] = 25
+    s2 = reg.snapshot()
+    d = MetricsRegistry.delta(s1, s2)
+    assert d["metrics"]["rt.executed"] == 15.0
+    assert d["metrics"]["run.tasks"] == 0.0
+    assert d["t"] >= 0.0
+    # JSON export round-trips; Prometheus text is well-formed gauges.
+    import json as _json
+
+    assert _json.loads(reg.to_json(s2))["metrics"]["rt.executed"] == 25.0
+    prom = reg.to_prometheus(s2)
+    assert "# TYPE hclib_tpu_rt_executed gauge" in prom
+    assert "hclib_tpu_rt_executed 25.0" in prom
+    # A raising live source degrades to an error flag, not a crash.
+    reg.register("bad", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert reg.snapshot()["metrics"]["bad.error"] == 1.0
+
+
+def test_metrics_registry_add_run_info_summarizes_device_shapes():
+    from hclib_tpu.device import tracebuf as tb
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    import numpy as _np
+
+    trace = {
+        "epoch": {"t0_ns": 0, "t1_ns": 10},
+        "rings": [{
+            "written": 3, "dropped": 1, "capacity": 2,
+            "records": _np.array(
+                [[tb.TR_FIRE_SCALAR, 0, 0, 0],
+                 [tb.TR_ROUND_END, 1, 1, 0]], dtype=_np.int64),
+        }],
+    }
+    info = {
+        "executed": 7,
+        "tiers": {"batch_tasks": 5},
+        "per_device_counts": _np.zeros((2, 8), _np.int32),
+        "extra_outputs": [object()],  # must be dropped, not flattened
+        "trace": trace,
+    }
+    reg = MetricsRegistry()
+    reg.add_run_info("dev", info)
+    m = reg.snapshot()["metrics"]
+    assert m["dev.executed"] == 7.0
+    assert m["dev.tiers.batch_tasks"] == 5.0
+    assert m["dev.trace.fire_scalar"] == 1.0
+    assert m["dev.trace.dropped"] == 1.0
+    assert m["dev.per_device_executed.0"] == 0.0
+    assert not any(k.startswith("dev.extra_outputs") for k in m)
+
+
+def test_runtime_metrics_wiring():
+    rt = hc.Runtime(nworkers=2, metrics=True)
+
+    def body():
+        with hc.finish():
+            for _ in range(10):
+                hc.async_(lambda: None)
+
+    rt.run(body)
+    m = rt.metrics.snapshot()["metrics"]
+    assert sum(
+        v for k, v in m.items()
+        if k.startswith("runtime.workers.") and k.endswith(".executed")
+    ) >= 11
+
+
 def test_timeline_renders_dump_and_reports(tmp_path):
     """tools/timeline.py turns a dump + info/stats dicts into readable
     reports (the reference's tools/timeline.py + instrument parser
     station)."""
-    import os
-
-    tools = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
-    )
-    sys.path.insert(0, tools)
-    try:
-        import timeline
-    finally:
-        sys.path.remove(tools)
+    timeline = _timeline()
 
     rt = hc.Runtime(nworkers=2, instrument=True)
 

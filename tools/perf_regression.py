@@ -10,6 +10,12 @@ This harness runs the suite (fib, fib-ddt, nqueens, qsort, cilksort, FFT,
 UTS, Cholesky, Smith-Waterman - the BASELINE.md apps plus the BASELINE.json
 configs), writes ``perf-logs/<unix_ts>.json`` with per-app mean/min/std
 nanoseconds, and flags regressions against the most recent prior log.
+Every run also executes the **instrument-overhead guard**: the same
+spawn-storm workload with the EventLog recorder off vs on, failing when
+the ratio exceeds ``--instrument-tolerance`` (default 3x; the
+recorder measures ~1.2-1.8x on no-op spawn storms, but a loaded CI box
+swings the denominator) - the
+observability layer must never silently tax the hot path.
 
 Usage:
   python tools/perf_regression.py               # full sizes, 3 trials
@@ -119,6 +125,41 @@ def _device_suite(trials: int) -> List[Tuple[str, Callable[[], float], str]]:
     ]
 
 
+def _instrument_overhead(quick: bool, trials: int) -> dict:
+    """Observability-tax guard: the same spawn-storm workload with the
+    EventLog recorder off vs on (min-of-N each, interleaved start so a
+    machine-load drift taxes both arms). The recorder (and by policy the
+    whole flight-recorder layer) must never silently tax the hot path -
+    the ratio is bounded by --instrument-tolerance."""
+    import hclib_tpu as hc
+
+    ntasks = 2000 if quick else 6000
+
+    def run_once(instr: bool) -> int:
+        rt = hc.Runtime(nworkers=2, instrument=instr)
+
+        def body():
+            with hc.finish():
+                for _ in range(ntasks):
+                    hc.async_(lambda: None)
+
+        t0 = time.perf_counter_ns()
+        rt.run(body)
+        return time.perf_counter_ns() - t0
+
+    n = max(2, trials)
+    base, instr = [], []
+    for _ in range(n):
+        base.append(run_once(False))
+        instr.append(run_once(True))
+    return {
+        "base_ns": min(base),
+        "instrumented_ns": min(instr),
+        "ratio": min(instr) / min(base),
+        "tasks": ntasks,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -153,6 +194,9 @@ def main(argv=None) -> int:
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--tolerance", type=float, default=0.25,
                     help="allowed fractional slowdown vs previous log")
+    ap.add_argument("--instrument-tolerance", type=float, default=3.0,
+                    help="max instrument=True slowdown ratio (the "
+                    "flight-recorder/EventLog overhead guard)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -200,6 +244,29 @@ def main(argv=None) -> int:
                 failures.append(f"{name}: {ratio:.2f}x slower than previous log")
                 line += "  REGRESSED"
         print(line, flush=True)
+
+    if not wanted or "instrument-overhead" in wanted:
+        try:
+            ov = _instrument_overhead(args.quick, args.trials)
+        except Exception as e:
+            print(f"instrument-overhead FAILED: {e}", file=sys.stderr)
+            failures.append(f"instrument-overhead: failed ({e})")
+        else:
+            results["instrument-overhead"] = ov
+            line = (
+                f"{'instrument-overhead':15s} ratio {ov['ratio']:5.2f}x "
+                f"({ov['instrumented_ns'] / 1e6:.1f} ms vs "
+                f"{ov['base_ns'] / 1e6:.1f} ms, {ov['tasks']} tasks)"
+            )
+            if ov["ratio"] > args.instrument_tolerance:
+                failures.append(
+                    f"instrument-overhead: instrument=True is "
+                    f"{ov['ratio']:.2f}x slower (bound "
+                    f"{args.instrument_tolerance:.2f}x) - the recorder is "
+                    "taxing the hot path"
+                )
+                line += "  REGRESSED"
+            print(line, flush=True)
 
     if args.device:
         import jax

@@ -1,25 +1,33 @@
 """Render instrumentation dumps and device counters into human-readable
-per-worker timelines and reports.
+per-worker timelines, reports, and Chrome Trace / Perfetto JSON.
 
 The analogue of the reference's trace station (tools/timeline.py renders
 worker timelines from binary logs; tools/hclib_instrument_parser.c decodes
-the per-thread dumps) for this runtime's two observability sources:
+the per-thread dumps) for this runtime's observability sources:
 
 1. **Host event dumps** (`runtime/instrument.py`, live - the reference's
    recorder is stubbed): ``python tools/timeline.py hclib.<ts>.dump/``
    pairs START/END records per worker, draws a density timeline (one row
    per worker, one column per time bucket, shade = busy fraction), and
-   tabulates per-event-type counts/durations.
+   tabulates per-event-type counts/durations. ``--top N`` lists the N
+   longest spans.
 
 2. **Device per-round counters** (megakernel/resident ``info`` dicts with
    ``per_device_counts``): ``python tools/timeline.py --device info.json``
    renders a per-device report (executed / rounds / backlog bars) so a
-   multi-chip run's load balance is readable at a glance. JSON files are
-   produced by ``tools/perf_regression.py --multichip`` and by any caller
-   that saves a run's ``info``.
+   multi-chip run's load balance is readable at a glance.
 
-Both modes print plain text (no plotting deps); the module's render
-functions return the string so tests can assert on content.
+3. **Perfetto export** (``--perfetto out.json``): merges host EventLog
+   dumps and device flight-recorder rings (``--trace trace.json``, the
+   JSON form of ``info['trace']`` - see device/tracebuf.py) into ONE
+   Chrome Trace Event file: a process per device, a thread per
+   worker/lane, with device round-relative time aligned to the host wall
+   clock through the per-run epoch bracket (the clockprobe bracketing
+   trick: both EventLog and the epoch use ``time.monotonic_ns``). Open at
+   https://ui.perfetto.dev.
+
+Text modes print plain text (no plotting deps); render functions return
+strings so tests can assert on content.
 """
 
 from __future__ import annotations
@@ -27,7 +35,7 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -48,12 +56,21 @@ def _bar(value: float, vmax: float, width: int = 40) -> str:
     return "#" * n + "." * (width - n)
 
 
+def _type_name(names: Sequence[str], tid: int) -> str:
+    """ONE labeling rule for event-type ids everywhere: manifest name when
+    the id is in range, ``type<N>`` otherwise (ids past the manifest come
+    from types registered after the dump, or foreign dumps)."""
+    if 0 <= tid < len(names):
+        return names[tid]
+    return f"type<{tid}>"
+
+
 def spans_from_events(events: np.ndarray) -> List[Dict]:
     """Pair START/END records (by event type + correlation id) into spans.
 
-    Unmatched STARTs are kept open-ended (end = last timestamp seen);
-    SINGLE records become zero-length marks. Returns a list of dicts
-    {type, id, t0, t1} with nanosecond timestamps."""
+    Unmatched STARTs are kept open-ended (end = last timestamp seen,
+    flagged ``open``); SINGLE records become zero-length marks. Returns a
+    list of dicts {type, id, t0, t1} with nanosecond timestamps."""
     from hclib_tpu.runtime.instrument import END, SINGLE, START
 
     open_: Dict[tuple, int] = {}
@@ -77,11 +94,46 @@ def spans_from_events(events: np.ndarray) -> List[Dict]:
     return spans
 
 
-def render_dump(path: str, width: int = 72) -> str:
-    """Per-worker density timeline + per-event-type table for one dump dir."""
-    from hclib_tpu.runtime.instrument import load_dump
+def _density(spans: List[Dict], t_lo: int, bucket: float,
+             width: int) -> np.ndarray:
+    """Busy fraction per time bucket, vectorized: exact fractional overlap
+    of every span with every bucket via two edge scatters (np.add.at) plus
+    a diff-array cumsum for whole interior buckets - O(spans + width)
+    instead of the old O(spans * width) python loop."""
+    busy = np.zeros(width)
+    if not spans:
+        return busy
+    x0 = (np.array([s["t0"] for s in spans], dtype=float) - t_lo) / bucket
+    x1 = (np.array([s["t1"] for s in spans], dtype=float) - t_lo) / bucket
+    x1 = np.maximum(x1, x0 + 1e-9)
+    x0 = np.clip(x0, 0.0, width)
+    x1 = np.clip(x1, 0.0, width)
+    a0 = np.minimum(np.floor(x0).astype(int), width - 1)
+    a1 = np.minimum(np.floor(x1).astype(int), width - 1)
+    same = a0 == a1
+    np.add.at(busy, a0[same], (x1 - x0)[same])
+    multi = ~same
+    np.add.at(busy, a0[multi], a0[multi] + 1.0 - x0[multi])
+    np.add.at(busy, a1[multi], x1[multi] - a1[multi])
+    diff = np.zeros(width + 1)
+    np.add.at(diff, a0[multi] + 1, 1.0)
+    np.add.at(diff, a1[multi], -1.0)
+    busy += np.cumsum(diff)[:width]
+    return busy
+
+
+def render_dump(path: str, width: int = 72, top: int = 0) -> str:
+    """Per-worker density timeline + per-event-type table for one dump
+    dir; ``top`` > 0 appends the N longest spans. The external lane (non-
+    worker threads, manifest ``external_lane``) renders as ``ext``."""
+    from hclib_tpu.runtime.instrument import load_dump, load_manifest
 
     names, by_worker = load_dump(path)
+    try:
+        manifest = load_manifest(path)
+    except Exception:
+        manifest = {}
+    ext_lane = manifest.get("external_lane")
     all_spans = {w: spans_from_events(ev) for w, ev in by_worker.items()}
     ts = [s["t0"] for sp in all_spans.values() for s in sp] + [
         s["t1"] for sp in all_spans.values() for s in sp
@@ -92,30 +144,27 @@ def render_dump(path: str, width: int = 72) -> str:
         return "\n".join(out)
     t_lo, t_hi = min(ts), max(ts)
     total = max(t_hi - t_lo, 1)
+    nworkers = len(by_worker) - (1 if ext_lane in by_worker else 0)
     out.append(
         f"{sum(len(v) for v in by_worker.values())} events, "
-        f"{len(by_worker)} workers, span {total / 1e6:.3f} ms"
+        f"{nworkers} workers, span {total / 1e6:.3f} ms"
     )
+    if manifest.get("external_records"):
+        out[-1] += f" ({manifest['external_records']} external-lane records)"
     out.append("")
     out.append("per-worker timeline (shade = busy fraction per bucket):")
     bucket = total / width
     for w in sorted(all_spans):
-        busy = np.zeros(width)
-        nspans = 0
-        for s in all_spans[w]:
-            nspans += 1
-            b0 = (s["t0"] - t_lo) / bucket
-            b1 = max((s["t1"] - t_lo) / bucket, b0 + 1e-9)
-            for b in range(int(b0), min(int(np.ceil(b1)), width)):
-                # overlap of [b0, b1) with bucket b
-                busy[b] += max(
-                    0.0, min(b1, b + 1) - max(b0, b)
-                )
+        spans = all_spans[w]
+        if w == ext_lane and not spans:
+            continue  # an idle external lane adds noise, not signal
+        busy = _density(spans, t_lo, bucket, width)
         row = "".join(_shade(f) for f in busy)
-        frac = sum(
-            s["t1"] - s["t0"] for s in all_spans[w]
-        ) / total
-        out.append(f"  w{w:<3d}|{row}| {100 * frac:5.1f}% busy, {nspans} spans")
+        frac = sum(s["t1"] - s["t0"] for s in spans) / total
+        label = "ext " if w == ext_lane else f"w{w:<3d}"
+        out.append(
+            f"  {label}|{row}| {100 * frac:5.1f}% busy, {len(spans)} spans"
+        )
     out.append(
         f"      +{'-' * width}+  0 = {0.0:.3f} ms .. {total / 1e6:.3f} ms"
     )
@@ -134,11 +183,29 @@ def render_dump(path: str, width: int = 72) -> str:
                 if s["type"] == tid
             ]
         )
-        name = names[tid] if tid < len(names) else f"type{tid}"
         out.append(
-            f"  {name:<20} {len(durs):>8} {durs.sum() / 1e3:>10.3f} "
+            f"  {_type_name(names, tid):<20} {len(durs):>8} "
+            f"{durs.sum() / 1e3:>10.3f} "
             f"{durs.mean():>10.2f} {durs.max():>10.2f}"
         )
+    if top > 0:
+        ranked = sorted(
+            (
+                (s["t1"] - s["t0"], w, s)
+                for w, sp in all_spans.items()
+                for s in sp
+            ),
+            key=lambda x: -x[0],
+        )[:top]
+        out.append("")
+        out.append(f"top {len(ranked)} spans by duration:")
+        for dur, w, s in ranked:
+            who = "ext" if w == ext_lane else f"w{w}"
+            flag = " OPEN" if s.get("open") else ""
+            out.append(
+                f"  {dur / 1e3:>10.1f} us  {who:<4} "
+                f"{_type_name(names, s['type']):<20} id={s['id']}{flag}"
+            )
     return "\n".join(out)
 
 
@@ -215,11 +282,155 @@ def render_stats(stats: Dict, width: int = 40) -> str:
     return "\n".join(out)
 
 
+# ------------------------------------------------------------- perfetto
+
+def _meta(pid: int, tid: Optional[int], name_key: str, name: str) -> Dict:
+    ev = {"ph": "M", "pid": pid, "name": name_key,
+          "args": {"name": name}}
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def _host_events(dump_path: str) -> List[Dict]:
+    from hclib_tpu.runtime.instrument import load_dump, load_manifest
+
+    names, by_worker = load_dump(dump_path)
+    try:
+        ext_lane = load_manifest(dump_path).get("external_lane")
+    except Exception:
+        ext_lane = None
+    events: List[Dict] = [_meta(0, None, "process_name", "host runtime")]
+    for w in sorted(by_worker):
+        spans = spans_from_events(by_worker[w])
+        if w == ext_lane and not spans:
+            continue
+        tname = "external" if w == ext_lane else f"worker {w}"
+        events.append(_meta(0, w, "thread_name", tname))
+        for s in spans:
+            events.append({
+                "ph": "X",
+                "pid": 0,
+                "tid": w,
+                "ts": s["t0"] / 1e3,  # Chrome trace ts/dur are in us
+                "dur": max((s["t1"] - s["t0"]) / 1e3, 0.001),
+                "name": _type_name(names, s["type"]),
+                "cat": "host",
+                "args": {"id": s["id"], "open": bool(s.get("open"))},
+            })
+    return events
+
+
+# Lane-thread base tid inside a device process: tids [0, _TID_LANES) are
+# the fixed tracks (rounds / scalar / events), lane fid f maps to
+# _TID_LANES + f.
+_TID_ROUNDS, _TID_SCALAR, _TID_EVENTS, _TID_LANES = 0, 1, 2, 16
+
+
+def _device_events(trace: Dict, pid0: int) -> List[Dict]:
+    """Chrome-trace events for one trace_info dict: a process per ring
+    (device), a thread per worker/lane track, round-relative record time
+    interpolated into the host epoch bracket."""
+    from hclib_tpu.device import tracebuf as tb
+
+    ep = trace["epoch"]
+    t0, t1 = float(ep["t0_ns"]), float(ep["t1_ns"])
+    events: List[Dict] = []
+    for d, ring in enumerate(trace["rings"]):
+        pid = pid0 + d
+        recs = np.asarray(ring["records"])
+        events.append(_meta(pid, None, "process_name", f"device {d}"))
+        if recs.size == 0:
+            continue
+        rmax = float(max(int(recs[:, 1].max()) + 1, 1))
+        slot_us = max((t1 - t0) / rmax / 1e3, 0.001)
+
+        def ts_us(r):
+            return (t0 + (t1 - t0) * (float(r) / rmax)) / 1e3
+
+        used_tids: Dict[int, str] = {}
+
+        def span(tid, tname, r0, dur_slots, name, args):
+            used_tids.setdefault(tid, tname)
+            events.append({
+                "ph": "X", "pid": pid, "tid": tid,
+                "ts": ts_us(r0),
+                "dur": max(dur_slots, 0.25) * slot_us,
+                "name": name, "cat": "device", "args": args,
+            })
+
+        open_rounds: List[Tuple[int, Dict]] = []
+        for tag, t, a, b in recs.tolist():
+            if tag == tb.TR_ROUND_BEGIN:
+                open_rounds.append((t, {"backlog": a, "pending": b}))
+            elif tag == tb.TR_ROUND_END:
+                rb, args = open_rounds.pop() if open_rounds else (t, {})
+                args = dict(args)
+                args.update({"executed": a, "pending": b})
+                span(_TID_ROUNDS, "rounds", rb, t + 1 - rb, "round", args)
+            elif tag == tb.TR_FIRE_SCALAR:
+                span(_TID_SCALAR, "scalar dispatch", t, 0.5,
+                     f"fn{a}", {"row": b})
+            elif tag == tb.TR_FIRE_BATCH:
+                fid, take = a >> 16, a & 0xFFFF
+                span(_TID_LANES + fid, f"lane fn{fid}", t, 0.5,
+                     f"batch x{take}", {"take": take, "prefetched": b})
+            elif tag == tb.TR_PREFETCH_ISSUE:
+                span(_TID_LANES + a, f"lane fn{a}", t, 0.25,
+                     "prefetch", {"count": b})
+            elif tag == tb.TR_PREFETCH_DRAIN:
+                span(_TID_LANES + a, f"lane fn{a}", t, 0.25,
+                     "prefetch drain", {"count": b})
+            elif tag == tb.TR_SPILL:
+                span(_TID_LANES + a, f"lane fn{a}", t, 0.25,
+                     "spill", {"count": b})
+            else:
+                name = tb.TAG_NAMES.get(tag, f"tag{tag}")
+                span(_TID_EVENTS, "events", t, 0.25, name,
+                     {"a": a, "b": b})
+        # Close dangling round_begins (fuel exit mid-record is possible).
+        for rb, args in open_rounds:
+            span(_TID_ROUNDS, "rounds", rb, 1, "round (open)", args)
+        for tid, tname in sorted(used_tids.items()):
+            events.append(_meta(pid, tid, "thread_name", tname))
+    return events
+
+
+def export_perfetto(
+    out_path: str,
+    dump_path: Optional[str] = None,
+    traces: Sequence[Dict] = (),
+) -> Dict:
+    """Merge a host EventLog dump and device flight-recorder traces into
+    one Chrome Trace Event JSON (open at https://ui.perfetto.dev).
+    ``traces`` are ``info['trace']`` dicts (or their JSON-loaded form).
+    Returns the trace dict; writes it to ``out_path`` when non-empty."""
+    from hclib_tpu.device.tracebuf import trace_from_jsonable
+
+    events: List[Dict] = []
+    if dump_path:
+        events.extend(_host_events(dump_path))
+    pid0 = 1
+    for tr in traces:
+        if tr.get("rings") and isinstance(
+            tr["rings"][0].get("records"), list
+        ):
+            tr = trace_from_jsonable(tr)
+        events.extend(_device_events(tr, pid0))
+        pid0 += len(tr["rings"])
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(
-        description="render hclib_tpu traces/counters as text timelines"
+        description="render hclib_tpu traces/counters as text timelines "
+        "or Perfetto JSON"
     )
     ap.add_argument("path", nargs="?", help="instrument dump directory")
     ap.add_argument(
@@ -230,11 +441,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--stats", action="append", default=[],
         help="JSON file holding Runtime.stats_dict() output",
     )
+    ap.add_argument(
+        "--trace", action="append", default=[],
+        help="JSON file holding a device trace (info['trace'] via "
+        "tracebuf.trace_to_jsonable)",
+    )
+    ap.add_argument(
+        "--perfetto", metavar="OUT",
+        help="write a merged Chrome-trace/Perfetto JSON from the dump "
+        "(positional path) and --trace files",
+    )
+    ap.add_argument(
+        "--top", type=int, default=0,
+        help="also list the N longest spans of the dump",
+    )
     ap.add_argument("--width", type=int, default=72)
     args = ap.parse_args(argv)
     shown = False
-    if args.path:
-        print(render_dump(args.path, width=args.width))
+    if args.perfetto:
+        traces = []
+        for f in args.trace:
+            with open(f) as fh:
+                traces.append(json.load(fh))
+        doc = export_perfetto(
+            args.perfetto, dump_path=args.path, traces=traces
+        )
+        print(
+            f"perfetto: {len(doc['traceEvents'])} events -> "
+            f"{args.perfetto}"
+        )
+        shown = True
+    elif args.path:
+        print(render_dump(args.path, width=args.width, top=args.top))
         shown = True
     bar_width = min(args.width, 60)
     for f in args.device:

@@ -1,6 +1,6 @@
 """Lesson 10: observability and the auto-routed fast path.
 
-Two production-facing features close the tour:
+Three production-facing features close the tour:
 
 1. **Tracing and reports.** The runtime records per-worker START/END task
    events into binary double-buffered logs (the reference's instrument
@@ -17,6 +17,12 @@ Two production-facing features close the tour:
    whole subtrees across the VPU lanes instead of one ~100 ns descriptor
    at a time, while the rest of the DAG stays on the scalar tier -
    dependencies, value slots, and counts all behave identically.
+
+3. **The device flight recorder.** ``Megakernel(trace=N)`` compiles a
+   fixed-width trace ring into the scheduler's round loop
+   (device/tracebuf.py): every dispatch is a record, the host brackets
+   the launch with its wall clock, and ``tools/timeline.py --perfetto``
+   merges host events + device rounds into one zoomable timeline.
 """
 
 import os
@@ -94,11 +100,44 @@ def part_two_auto_route() -> None:
     )
 
 
+def part_three_flight_recorder(tmpdir: str) -> None:
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.tracebuf import TR_FIRE_SCALAR, records_of
+    from hclib_tpu.device.workloads import FIB, make_fib_megakernel
+
+    # trace=256: a 256-record ring rides out of the kernel; every
+    # scheduler round appends records from INSIDE the device loop.
+    mk = make_fib_megakernel(256, interpret=True, trace=256)
+    b = TaskGraphBuilder()
+    b.add(FIB, args=[10], out=0)
+    iv, _, info = mk.run(b)
+    assert int(iv[0]) == 55
+    ring = info["trace"]["rings"][0]
+    fires = records_of(info["trace"], TR_FIRE_SCALAR)
+    # Overflow is counted, never fatal: the ring keeps the LAST records.
+    print(
+        f"flight recorder: {ring['written']} records written "
+        f"({ring['dropped']} dropped past the {ring['capacity']}-record "
+        f"ring), {len(fires)} scalar dispatch fires kept"
+    )
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import timeline
+
+    out = os.path.join(tmpdir, "lesson10.perfetto.json")
+    doc = timeline.export_perfetto(out, traces=[info["trace"]])
+    assert len(doc["traceEvents"]) > 0
+    print(
+        f"perfetto: {len(doc['traceEvents'])} events -> {out} "
+        "(open at https://ui.perfetto.dev)\n"
+    )
+
+
 def main() -> None:
     import tempfile
 
     with tempfile.TemporaryDirectory() as d:
         part_one_tracing(d)
+        part_three_flight_recorder(d)
     part_two_auto_route()
     print("lesson 10 OK")
 
