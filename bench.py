@@ -725,6 +725,91 @@ def bench_device_uts():
     raise RuntimeError("no UTS engine ran")
 
 
+def bench_checkpoint():
+    """Checkpoint/restore cost of record (ISSUE 5): quiesce latency,
+    bundle size, and save/restore wall time for the seeded UTS traversal
+    and the Cholesky factor, written to perf-logs/<ts>.checkpoint.json.
+    Runs on the current backend (interpret on CPU-only hosts) - the
+    numbers that matter operationally are the QUIESCE latency (how long a
+    preemption notice stalls before the state is exportable) and the
+    BUNDLE size (what a preemption window must flush to disk)."""
+    import tempfile
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import make_uts_megakernel
+    from hclib_tpu.runtime.checkpoint import (
+        restore_megakernel, snapshot_megakernel,
+    )
+
+    out = {}
+
+    def uts_builder():
+        b = TaskGraphBuilder()
+        b.add(0, args=[1, 0])  # UTS_NODE root
+        return b
+
+    def one(name, make_mk, builder, data_of):
+        mk_plain = make_mk(False)
+        full = mk_plain.run(builder(), data=data_of())[2]
+        mk = make_mk(True)
+        mk.run(builder(), data=data_of())  # warm the checkpoint build
+        at = max(1, full["executed"] // 2)
+        t0 = time.perf_counter()
+        _, _, info_q = mk.run(builder(), data=data_of(), quiesce=at)
+        quiesce_s = time.perf_counter() - t0
+        bundle = snapshot_megakernel(mk, info_q)
+        d = tempfile.mkdtemp(prefix=f"hclib-bench-ckpt-{name}-")
+        stats = bundle.save(d)
+        t0 = time.perf_counter()
+        _, _, info_r = restore_megakernel(d, make_mk(True))
+        restore_s = time.perf_counter() - t0
+        assert info_r["executed"] == full["executed"], (name, info_r)
+        row = {
+            "executed": full["executed"],
+            "checkpoint_at": info_q["quiesce"]["executed_at"],
+            "quiesce_entry_s": round(quiesce_s, 4),
+            "bundle_bytes": stats["bundle_bytes"],
+            "save_s": stats["save_s"],
+            "restore_s": round(restore_s, 4),
+        }
+        out[name] = row
+        log(f"checkpoint [{name}]: quiesced at "
+            f"{row['checkpoint_at']}/{row['executed']} tasks in "
+            f"{row['quiesce_entry_s'] * 1e3:.1f} ms, bundle "
+            f"{row['bundle_bytes'] / 1024:.0f} KiB "
+            f"(save {row['save_s'] * 1e3:.1f} ms, restore+drain "
+            f"{row['restore_s'] * 1e3:.1f} ms)")
+
+    one(
+        "uts",
+        lambda ck: make_uts_megakernel(checkpoint=ck),
+        uts_builder,
+        lambda: None,
+    )
+
+    from hclib_tpu.device.cholesky import (
+        build_cholesky_graph, cholesky_buffers, make_cholesky_megakernel,
+    )
+    from hclib_tpu.models.cholesky import make_spd
+
+    nt = 4
+    a = make_spd(nt * 128).astype(np.float32)
+    one(
+        "cholesky",
+        lambda ck: make_cholesky_megakernel(nt, checkpoint=ck),
+        lambda: build_cholesky_graph(nt),
+        lambda: cholesky_buffers(a, nt),
+    )
+
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.checkpoint.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"checkpoint bench written: {path}")
+    return out
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -733,6 +818,12 @@ def main(argv=None) -> None:
         "--trace", action="store_true",
         help="also emit per-section metrics JSON + a Perfetto trace "
         "under perf-logs/ (budget-gated like the other sections)",
+    )
+    ap.add_argument(
+        "--checkpoint", action="store_true",
+        help="also measure checkpoint/restore cost (quiesce latency + "
+        "bundle size for UTS and Cholesky) into perf-logs/ "
+        "(budget-gated like the other sections)",
     )
     args = ap.parse_args(argv)
     global _T0
@@ -831,6 +922,8 @@ def main(argv=None) -> None:
     )
     if args.trace:
         section("trace artifacts", 60, emit_trace_artifacts)
+    if args.checkpoint:
+        section("checkpoint/restore", 120, bench_checkpoint)
     if sw_wave:
         log(f"wave-DAG SW final: {sw_wave:.1f} GCUPS median (r05 baseline "
             f"1.2; acceptance floor 12)")

@@ -20,6 +20,8 @@ from .runtime import (  # noqa: F401
     RECURSIVE,
     CancelScope,
     CancelledError,
+    CheckpointBundle,
+    CheckpointError,
     DeviceFaultPlan,
     FaultPlan,
     Finish,
@@ -44,6 +46,7 @@ from .runtime import (  # noqa: F401
     async_,
     async_copy,
     async_future,
+    checkpoint_on_preempt,
     current_finish,
     current_runtime,
     current_worker,
@@ -54,6 +57,12 @@ from .runtime import (  # noqa: F401
     forasync_future,
     free_at,
     generate_default_graph,
+    restore_megakernel,
+    restore_resident,
+    restore_stream,
+    snapshot_megakernel,
+    snapshot_resident,
+    snapshot_stream,
     launch,
     load_locality_file,
     memset_at,

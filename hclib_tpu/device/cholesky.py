@@ -377,6 +377,7 @@ def make_cholesky_megakernel(
     factor_base: Optional[int] = None,
     fused_only: bool = False,
     batch_updrow: bool = True,
+    checkpoint: Optional[bool] = None,
 ) -> Megakernel:
     """``batch_updrow`` routes the trailing-update row tasks through the
     megakernel's batched same-kind dispatch tier (UPD_B rows per round,
@@ -443,6 +444,7 @@ def make_cholesky_megakernel(
             4 * ntasks + (nt * nt if fused_only else nt * nt * nt // 2),
         ),
         interpret=interpret,
+        checkpoint=checkpoint,
         # 8 f32-equivalent tile buffers + compiler stack temporaries
         # (factor_and_inv block values, bf16 split operands) + the batched
         # tier's resident double-buffer pair: past the 16 MiB scoped

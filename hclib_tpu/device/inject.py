@@ -12,8 +12,11 @@ ring** in HBM that the scheduler polls *from inside the kernel*:
   0..15 are the standard descriptor ABI (device/descriptor.py).
 - ctl[8] int32: [0]=tail (total rows ever appended), [1]=close flag,
   [2]=device-consumed cursor (echoed back), [3]=host abort word - polled
-  by the kernel INSIDE its round loop, [4] echoes the round the abort was
-  observed. This driver uploads a fresh ctl copy per entry, so an abort
+  by the kernel INSIDE its round loop, [4] echoes the round the abort
+  was observed, [5]=host quiesce word + [6]=its executed-count threshold
+  (checkpoint builds only, see ``quiesce()``; the output's [5] echoes
+  the round the quiesce was observed, -1 = never). This driver uploads
+  a fresh ctl copy per entry, so an abort
   lands at the next ENTRY boundary and the in-kernel poll then bounds the
   final entry to about one round; the per-round ctl re-read is the device
   half a zero-copy pinned-host producer would need for true mid-quantum
@@ -60,7 +63,9 @@ from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
 from .tracebuf import (
     NullTracer,
     TR_ABORT,
+    TR_CKPT,
     TR_INJECT,
+    TR_QUIESCE,
     Tracer,
     trace_info,
 )
@@ -97,8 +102,16 @@ class StreamingMegakernel:
         self._lock = threading.Lock()
         self._pending_rows: List[np.ndarray] = []
         self._closed = False
+        # Distinguishes a quiesce-induced close (undone by a same-object
+        # resume) from an explicit close()/abort() (sticky).
+        self._closed_by_quiesce = False
         self._abort_reason: Optional[str] = None
         self._abort_t: Optional[float] = None
+        # Checkpoint quiesce (mk must be built with checkpoint=True):
+        # requested threshold + the wall clock of the request, for the
+        # quiesce-latency stat.
+        self._quiesce_after: Optional[int] = None
+        self._quiesce_t: Optional[float] = None
         # Abort-latency accounting (surfaced by stats_dict): filled by the
         # run_stream driver when the abort entry returns.
         self._stats: Dict[str, Any] = {
@@ -137,6 +150,29 @@ class StreamingMegakernel:
                 self._abort_reason = str(reason)
                 self._abort_t = time.monotonic()
             self._closed = True
+            self._closed_by_quiesce = False
+
+    def quiesce(self, after_executed: int = 0) -> None:
+        """Host-side checkpoint request (``mk`` must be built with
+        ``checkpoint=True``): at its next entry boundary the driving
+        run_stream publishes the ctl quiesce word; the kernel observes it
+        inside its round loop - once at least ``after_executed`` tasks
+        have run (0: immediately; a positive k is the deterministic
+        checkpoint-at-k spelling) - stops popping at that round boundary,
+        and exits with its live scheduler state. run_stream then returns
+        with ``info['quiesced']=True`` and ``info['state']`` (feed it to
+        ``runtime.checkpoint.snapshot_stream`` / ``run_stream(
+        resume_state=...)``), the ring closed so producers fail fast -
+        preemption semantics: checkpoint, then stop."""
+        if not self.mk.checkpoint:
+            raise ValueError(
+                "quiesce() needs Megakernel(checkpoint=True): the quiesce "
+                "word is compiled into the round loop only then"
+            )
+        with self._lock:
+            if self._quiesce_after is None:
+                self._quiesce_after = max(0, int(after_executed))
+                self._quiesce_t = time.monotonic()
 
     def stats_dict(self) -> dict:
         """Resilience counters for this stream (abort latency included)."""
@@ -189,6 +225,7 @@ class StreamingMegakernel:
         """No more injections: the stream drains and run_stream returns."""
         with self._lock:
             self._closed = True
+            self._closed_by_quiesce = False
 
     # ---- kernel ----
 
@@ -260,12 +297,14 @@ class StreamingMegakernel:
             )
             return consumed, close
 
+        ckpt = mk.checkpoint
+
         def cond(carry):
-            r, consumed, done, abr = carry
+            r, consumed, done, abr, qr = carry
             return jnp.logical_not(done) & (r < max_rounds)
 
         def body(carry):
-            r, consumed, _, abr = carry
+            r, consumed, _, abr, qr = carry
             core.sched(quantum)
             c0 = consumed
             consumed, close = poll(consumed)
@@ -285,31 +324,56 @@ class StreamingMegakernel:
                 tr.emit(TR_ABORT, tr.now(), r)
 
             abr = jnp.where(aborted & (abr < 0), r, abr)
+            # Host quiesce word (ctl[5], checkpoint builds only; same
+            # acquire DMA): observed once the cumulative executed count
+            # passes ctl[6], the round loop stops popping at this round
+            # boundary and exits WITH its state - unlike abort, nothing
+            # is abandoned (pending rows, unconsumed ring rows, and the
+            # consumed cursor all survive into the exported snapshot).
+            if ckpt:
+                qz = (ctlbuf[5] != 0) & (counts[C_EXECUTED] >= ctlbuf[6])
+
+                @pl.when(qz & (qr < 0))
+                def _():
+                    tr.emit(TR_QUIESCE, tr.now(), r)
+
+                qr = jnp.where(qz & (qr < 0), r, qr)
+            else:
+                qz = jnp.bool_(False)
             # Nothing runnable and nothing new: exit. The host re-enters
             # while the stream is open; a closed, drained stream is final.
             idle = counts[C_PENDING] == 0
-            done = (idle & (consumed == ctlbuf[0])) | aborted
-            return r + 1, consumed, done, abr
+            done = (idle & (consumed == ctlbuf[0])) | aborted | qz
+            return r + 1, consumed, done, abr, qr
 
         # Initial ctl fetch: the consumed cursor (slot 2) persists across
         # entries through the host-echoed ctl.
         cp0 = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
         cp0.start()
         cp0.wait()
-        _, consumed, _, abr = jax.lax.while_loop(
+        _, consumed, _, abr, qr = jax.lax.while_loop(
             cond, body, (jnp.int32(0), ctlbuf[2], jnp.bool_(False),
-                         jnp.int32(-1))
+                         jnp.int32(-1), jnp.int32(-1))
         )
         # Report progress: consumed count rides the aliased ctl output
         # (slot 2); tail/close/abort echo through; slot 4 reports the round
-        # the abort word was first observed (-1: never).
+        # the abort word was first observed, slot 5 the round the quiesce
+        # word was (-1: never).
         ctl_out[0] = ctlbuf[0]
         ctl_out[1] = ctlbuf[1]
         ctl_out[2] = consumed
         ctl_out[3] = ctlbuf[3]
         ctl_out[4] = abr
-        for i in range(5, 8):
+        ctl_out[5] = qr if ckpt else 0
+        for i in range(6, 8):
             ctl_out[i] = 0
+        if ckpt:
+            @pl.when(qr >= 0)
+            def _():
+                tr.emit(
+                    TR_CKPT, tr.now(), counts[C_PENDING],
+                    ctlbuf[0] - consumed,
+                )
 
     def _build(self, quantum: int, max_rounds: int):
         mk = self.mk
@@ -368,7 +432,7 @@ class StreamingMegakernel:
 
     def run_stream(
         self,
-        builder: TaskGraphBuilder,
+        builder: Optional[TaskGraphBuilder] = None,
         ivalues: Optional[np.ndarray] = None,
         data: Optional[Dict[str, Any]] = None,
         quantum: int = 1 << 10,
@@ -376,6 +440,7 @@ class StreamingMegakernel:
         poll_interval_s: float = 0.001,
         deadline_s: Optional[float] = None,
         cancel_scope=None,
+        resume_state: Optional[Dict[str, Any]] = None,
     ) -> Tuple[np.ndarray, dict]:
         """Run the stream to completion: entries re-enter the resident
         scheduler while the host (any thread) injects; returns after
@@ -392,7 +457,29 @@ class StreamingMegakernel:
         abort hook, so a device stream never outlives its cancelled scope.
         ANY exception escaping this driver closes the ring, so concurrent
         producers fail fast on their next inject() instead of queueing
-        rows nobody will ever drain."""
+        rows nobody will ever drain.
+
+        Checkpoint/restore (``mk`` built with ``checkpoint=True``):
+        ``quiesce()`` from any thread stops the stream at its next round
+        boundary WITH its state - run_stream returns (ivalues, info) where
+        ``info['quiesced']=True`` and ``info['state']`` is the resumable
+        snapshot (tables, values, unconsumed ring rows). A later
+        ``run_stream(resume_state=...)`` - on this object or a freshly
+        built equivalent one - re-publishes the residue and continues the
+        stream mid-graph (``builder`` and ``resume_state`` are mutually
+        exclusive)."""
+        if (builder is None) == (resume_state is None):
+            raise ValueError(
+                "run_stream wants exactly one of builder= (a fresh "
+                "stream) or resume_state= (a checkpointed one)"
+            )
+        if resume_state is not None and (
+            data is not None or ivalues is not None
+        ):
+            raise ValueError(
+                "resume_state= carries its own data/ivalues; passing "
+                "them too would be silently ignored"
+            )
         unregister = None
         if cancel_scope is not None:
             # Register-then-replay (the one implementation, in
@@ -404,7 +491,7 @@ class StreamingMegakernel:
         try:
             return self._run_stream(
                 builder, ivalues, data, quantum, max_rounds,
-                poll_interval_s, deadline_s,
+                poll_interval_s, deadline_s, resume_state,
             )
         except BaseException:
             with self._lock:
@@ -416,22 +503,61 @@ class StreamingMegakernel:
 
     def _run_stream(
         self, builder, ivalues, data, quantum, max_rounds,
-        poll_interval_s, deadline_s,
+        poll_interval_s, deadline_s, resume_state=None,
     ) -> Tuple[np.ndarray, dict]:
         deadline = (
             None if deadline_s is None else time.monotonic() + deadline_s
         )
         mk = self.mk
-        tasks, succ, ring0, counts = builder.finalize(
-            capacity=mk.capacity, succ_capacity=mk.succ_capacity
-        )
-        if ivalues is None:
-            ivalues = np.zeros(mk.num_values, np.int32)
+        ring = np.zeros((self.ring_capacity, RING_ROW), np.int32)
+        ctl = np.zeros(8, np.int32)  # [tail, close, consumed, abort, ...]
+        injected = 0
+        if resume_state is not None:
+            # Same-object resume must behave like a fresh stream: clear
+            # the quiesce request and undo the QUIESCE-induced close (the
+            # snapshot already captured everything producers queued). An
+            # explicit close()/abort() stays sticky - drain-and-exit
+            # semantics survive the resume.
+            with self._lock:
+                self._quiesce_after = None
+                self._quiesce_t = None
+                if self._closed_by_quiesce:
+                    self._closed = False
+                    self._closed_by_quiesce = False
+            st = resume_state
+            succ = np.asarray(st["succ"])
+            state = [
+                np.asarray(st["tasks"]), np.asarray(st["ready"]),
+                np.asarray(st["counts"]), np.asarray(st["ivalues"]),
+            ]
+            data = dict(st.get("data") or {})
+            # Residue: rows published-but-unconsumed at quiesce (plus any
+            # host-queued rows the snapshot captured) re-publish from ring
+            # slot 0 with a reset consumed cursor - installed rows already
+            # live in the task table.
+            residue = np.asarray(
+                st.get("ring_rows",
+                       np.zeros((0, RING_ROW), np.int32))
+            ).reshape(-1, RING_ROW)
+            if len(residue) > self.ring_capacity:
+                raise ValueError(
+                    f"resume residue ({len(residue)} rows) exceeds this "
+                    f"stream's ring_capacity {self.ring_capacity}"
+                )
+            ring[: len(residue)] = residue
+            injected = len(residue)
         else:
-            counts = counts.copy()
-            mk.widen_value_alloc(counts, ivalues)
-        mk.check_row_values(int(counts[C_VALLOC]))
-        data = dict(data or {})
+            tasks, succ, ring0, counts = builder.finalize(
+                capacity=mk.capacity, succ_capacity=mk.succ_capacity
+            )
+            if ivalues is None:
+                ivalues = np.zeros(mk.num_values, np.int32)
+            else:
+                counts = counts.copy()
+                mk.widen_value_alloc(counts, ivalues)
+            mk.check_row_values(int(counts[C_VALLOC]))
+            data = dict(data or {})
+            state = [tasks, ring0, counts, ivalues]
         if set(data.keys()) != set(mk.data_specs.keys()):
             raise ValueError("data buffers != declared data_specs")
         key = (quantum, max_rounds)
@@ -439,12 +565,8 @@ class StreamingMegakernel:
             self._jitted[key] = self._build(quantum, max_rounds)
         jitted = self._jitted[key]
 
-        ring = np.zeros((self.ring_capacity, RING_ROW), np.int32)
-        ctl = np.zeros(8, np.int32)  # [tail, close, consumed]
-        state = [tasks, ring0, counts, ivalues]
         data_np = [np.asarray(data[k]) for k in mk.data_specs.keys()]
         ndata = len(mk.data_specs)
-        injected = 0
         # Flight recorder: each entry resets the ring, so the LAST entry's
         # records surface in info - bracketed by THAT entry's own epoch
         # (a whole-stream bracket would stretch the final entry's rounds
@@ -458,6 +580,7 @@ class StreamingMegakernel:
                 rows, self._pending_rows = self._pending_rows, []
                 closed = self._closed
                 abort_reason = self._abort_reason
+                quiesce_after = self._quiesce_after
             if abort_reason is not None:
                 # Publish the ctl abort word and run ONE final entry: the
                 # kernel polls the word inside its round loop and exits
@@ -506,6 +629,12 @@ class StreamingMegakernel:
                 injected += 1
             ctl[0] = injected
             ctl[1] = 1 if closed else 0
+            if quiesce_after is not None:
+                # Publish the quiesce word + threshold: the kernel
+                # observes it inside its round loop once the executed
+                # count passes the threshold and exits with its state.
+                ctl[5] = 1
+                ctl[6] = quiesce_after
             entry_t0_ns = time.monotonic_ns()
             outs = jitted(
                 jnp.asarray(state[0]), jnp.asarray(succ),
@@ -523,6 +652,59 @@ class StreamingMegakernel:
             ctl[2] = ctl_o[2]  # device-consumed cursor persists
             if bool(counts_np[C_OVERFLOW]):
                 raise RuntimeError("streaming megakernel overflow")
+            observed_round = int(ctl_o[5]) if quiesce_after is not None else -1
+            # A threshold the workload never reaches must not spin this
+            # loop forever: once the stream is fully drained, the entry
+            # boundary IS a round boundary - export host-side (observed
+            # round -1) instead of waiting on a quiesce the kernel can
+            # never observe.
+            drained_cut = (
+                quiesce_after is not None
+                and int(counts_np[C_PENDING]) == 0
+                and int(ctl_o[2]) == injected
+            )
+            if observed_round >= 0 or drained_cut:
+                # The quiesce point: export the live stream state and
+                # stop. The ring closes (preemption semantics:
+                # checkpoint, then stop) so concurrent producers fail
+                # fast; rows they queued before the close ride along as
+                # unpublished residue.
+                consumed = int(ctl_o[2])
+                with self._lock:
+                    late, self._pending_rows = self._pending_rows, []
+                    if not self._closed:
+                        self._closed = True
+                        self._closed_by_quiesce = True
+                    t0 = self._quiesce_t
+                residue = list(ring[consumed:injected]) + list(late)
+                info = {
+                    "executed": int(counts_np[C_EXECUTED]),
+                    "pending": int(counts_np[C_PENDING]),
+                    "injected": injected,
+                    "quiesced": True,
+                    "quiesce_observed_round": observed_round,
+                    "quiesce_latency_s": (
+                        None if t0 is None
+                        else round(time.monotonic() - t0, 6)
+                    ),
+                    "state": {
+                        "tasks": state[0],
+                        "succ": np.asarray(succ),
+                        "ready": state[1],
+                        "counts": state[2],
+                        "ivalues": state[3],
+                        "data": dict(zip(mk.data_specs.keys(), data_np)),
+                        "ring_rows": np.asarray(residue, np.int32).reshape(
+                            -1, RING_ROW
+                        ),
+                    },
+                }
+                if mk.trace is not None and trace_row is not None:
+                    info["trace"] = trace_info(
+                        [trace_row], entry_t0_ns, entry_t1_ns,
+                        mk.trace.capacity,
+                    )
+                return state[3], info
             if (
                 closed
                 and int(counts_np[C_PENDING]) == 0

@@ -49,10 +49,12 @@ from .descriptor import (
 )
 from .tracebuf import (
     NullTracer,
+    TR_CKPT,
     TR_FIRE_BATCH,
     TR_FIRE_SCALAR,
     TR_PREFETCH_DRAIN,
     TR_PREFETCH_ISSUE,
+    TR_QUIESCE,
     TR_ROUND_BEGIN,
     TR_ROUND_END,
     TR_SPILL,
@@ -155,6 +157,25 @@ LS_PF_BASE = 2  # head-at-issue + 1 of the outstanding prefetch (0 = none)
 LS_PF_N = 3     # descriptors the outstanding prefetch covers
 LS_PF_BUF = 4   # operand-buffer half the prefetch was written into
 LS_WORDS = 8
+
+# Quiesce control words (the checkpoint/restore subsystem,
+# runtime/checkpoint.py). ``qctl`` is an 8-word int32 row in HBM that the
+# scheduler RE-READS by DMA inside its round loop when the megakernel was
+# built with ``checkpoint=True`` - the checkpoint twin of the abort word
+# (device/inject.py ctl[3], device/resident.py's abort input): a host with
+# in-place device-buffer write access stops a resident kernel mid-run by
+# writing the word; through this driver the word is uploaded at entry.
+# On observing (flag set AND at least ``after`` tasks executed since
+# entry), workers stop popping at the next round boundary, per-kind lanes
+# spill back to the ready ring (the fuel-exit path), and the kernel
+# returns with its live scheduler state in the aliased outputs instead of
+# discarding it.
+QC_FLAG = 0    # nonzero = quiesce requested
+QC_AFTER = 1   # honor the flag only once this many tasks ran this entry
+# ``qstat`` (8-word SMEM output, appended; present only when
+# checkpoint=True) reports the observation back to the host:
+QS_QUIESCED = 0  # 1 = the round loop observed the quiesce word
+QS_AT = 1        # tasks executed since entry at observation
 
 # counts[] slots
 C_HEAD = 0
@@ -657,6 +678,7 @@ class Megakernel:
         route: Optional[Dict[str, Any]] = None,
         auto_route: Optional[Dict[str, Any]] = None,
         trace: Optional[Any] = None,
+        checkpoint: Optional[bool] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
@@ -683,6 +705,21 @@ class Megakernel:
                     trace = True if n == 1 else n
                     self.trace_from_env = True
         self.trace = TraceRing.of(trace)
+        # Checkpoint/restore (runtime/checkpoint.py): ``checkpoint=True``
+        # compiles the quiesce protocol into the scheduler - a qctl HBM
+        # input re-read inside the round loop plus a qstat output (QC_*/
+        # QS_* above). DeviceFaultPlan discipline: False compiles none of
+        # it (no extra refs, no per-round DMA - bit-identical to a build
+        # that predates checkpointing). HCLIB_TPU_CHECKPOINT=1 turns it on
+        # process-wide; env-derived enablement is marked so runners that
+        # cannot export state (ShardedMegakernel) degrade instead of
+        # failing a run the env owner never wrote.
+        self.checkpoint_from_env = False
+        if checkpoint is None:
+            env = os.environ.get("HCLIB_TPU_CHECKPOINT", "")
+            checkpoint = bool(env) and env != "0"
+            self.checkpoint_from_env = checkpoint
+        self.checkpoint = bool(checkpoint)
         # Dispatch-tier routing: ``route`` maps a kernel NAME to the spec
         # of a non-scalar dispatch tier for that task family. Two tiers:
         #
@@ -796,6 +833,7 @@ class Megakernel:
         lstate=None,
         tstats=None,
         tracer=None,
+        quiesce_hook=None,
     ):
         """Builds the scheduler core closures over a concrete set of refs:
         ``stage()`` (copy host state into the mutable windows), and
@@ -810,6 +848,14 @@ class Megakernel:
         of every completion to forward migrated tasks' results home, and
         whose ``value_limit`` caps dynamic value allocation below the
         region it reserves for migration result slots).
+
+        ``quiesce_hook(executed_since_entry)`` - when given - is evaluated
+        once per scheduling round and returns a traced bool; a True makes
+        sched() stop popping at that round boundary and exit through the
+        normal fuel-exhaustion path (lanes spill to the ring, prefetches
+        drain), leaving the live scheduler state in the output windows.
+        The hook owns observation bookkeeping (qstat, TR_QUIESCE). None
+        compiles nothing - the checkpoint-off path is byte-identical.
         """
         capacity = self.capacity
         num_values = value_limit if value_limit is not None else self.num_values
@@ -1083,8 +1129,15 @@ class Megakernel:
                 # host epoch brackets the launch and timeline.py
                 # interpolates).
                 rt = tr.tick()
+                # Quiesce poll (checkpoint builds only): a True stops this
+                # round's pop - the round boundary the export contract
+                # promises - and exits the loop below.
+                if quiesce_hook is not None:
+                    qz = quiesce_hook(counts[C_EXECUTED] - e0)
+                else:
+                    qz = jnp.bool_(False)
                 if not use_batch:
-                    @pl.when(ring_work)
+                    @pl.when(ring_work & jnp.logical_not(qz))
                     def _():
                         # LIFO on the owner side (newest first, depth-first,
                         # small live sets); the head side is the
@@ -1100,7 +1153,7 @@ class Megakernel:
                         counts[C_PENDING],
                         counts[C_EXECUTED],
                         e0,
-                        jnp.logical_not(ring_work),
+                        jnp.logical_not(ring_work) | qz,
                     )
                 avails = [
                     lstate[li, LS_TAIL] - lstate[li, LS_HEAD]
@@ -1118,7 +1171,10 @@ class Megakernel:
                 # their lane within a handful of rounds, so the added
                 # latency is noise against one kernel body. One dispatch
                 # per round; among eligible lanes the lowest F_FN wins.
-                fired = jnp.bool_(False)
+                # (``fired`` starts at the quiesce flag: an observed
+                # quiesce suppresses both the batch fire and the scalar
+                # pop, so the exit below sees an untouched round.)
+                fired = qz
                 for li, (fid, spec) in enumerate(self.batch_specs):
                     eligible = (avails[li] > 0) & jnp.logical_not(ring_work)
 
@@ -1165,7 +1221,7 @@ class Megakernel:
                     counts[C_PENDING],
                     counts[C_EXECUTED],
                     e0,
-                    jnp.logical_not(ring_work | lane_work),
+                    jnp.logical_not(ring_work | lane_work) | qz,
                 )
 
             e0 = counts[C_EXECUTED]
@@ -1265,40 +1321,77 @@ class Megakernel:
         )
 
     def _kernel(
-        self, fuel: int, reps: int, stage_all_values: bool, trace, *refs
+        self, fuel: int, reps: int, stage_all_values: bool, trace, ckpt,
+        *refs
     ) -> None:
-        # ``trace`` is the TraceRing captured when _build_raw fixed the
-        # output tree - NOT self.trace: pallas kernels trace lazily (first
-        # call), so reading mutable instance state here could disagree
-        # with the already-built out_shape and shift every ref slice.
+        # ``trace``/``ckpt`` are the TraceRing / checkpoint flag captured
+        # when _build_raw fixed the output tree - NOT self.trace: pallas
+        # kernels trace lazily (first call), so reading mutable instance
+        # state here could disagree with the already-built out_shape and
+        # shift every ref slice.
         ndata = len(self.data_specs)
         nbatch = len(self.batch_specs)
         ntrace = 1 if trace is not None else 0
-        n_in = 5 + ndata
-        n_out = 4 + ndata + (1 if nbatch else 0) + ntrace
+        n_in = 5 + ndata + (1 if ckpt else 0)  # qctl rides last
+        n_out = 4 + ndata + (1 if nbatch else 0) + (1 if ckpt else 0) + ntrace
         in_refs = refs[:n_in]
         out_refs = refs[n_in : n_in + n_out]
-        n_tail = 4 if nbatch else 2  # free, vfree [, lanes, lstate]
-        scratch_refs = refs[n_in + n_out : -n_tail]
-        free = refs[-n_tail]  # internal free-stack: [0]=count, [1..]=rows
-        vfree = refs[-n_tail + 1]  # value-block free-stack, same layout
-        lanes = refs[-2] if nbatch else None  # per-kind ready lanes
-        lstate = refs[-1] if nbatch else None  # lane cursors + prefetch
+        tail = list(refs[n_in + n_out :])
+        scratch_refs = tail[: len(self.scratch_specs)]
+        tail = tail[len(self.scratch_specs) :]
+        free = tail.pop(0)  # internal free-stack: [0]=count, [1..]=rows
+        vfree = tail.pop(0)  # value-block free-stack, same layout
+        lanes = tail.pop(0) if nbatch else None  # per-kind ready lanes
+        lstate = tail.pop(0) if nbatch else None  # lane cursors + prefetch
+        qbuf = tail.pop(0) if ckpt else None  # quiesce-word staging
+        qsem = tail.pop(0) if ckpt else None  # its DMA semaphore
+        assert not tail, f"{len(tail)} unconsumed scratch refs"
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
+        qctl = in_refs[5 + ndata] if ckpt else None
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(self.data_specs.keys(), out_refs[4 : 4 + ndata]))
         tstats = out_refs[4 + ndata] if nbatch else None
+        qstat = (
+            out_refs[4 + ndata + (1 if nbatch else 0)] if ckpt else None
+        )
         tracer = (
-            Tracer(out_refs[4 + ndata + (1 if nbatch else 0)],
-                   trace.capacity)
+            Tracer(out_refs[n_out - 1], trace.capacity)
             if ntrace
             else None
         )
         scratch = dict(zip(self.scratch_specs.keys(), scratch_refs))
+        tr = tracer if tracer is not None else NullTracer()
+
+        quiesce_hook = None
+        if ckpt:
+            for w in range(8):
+                qstat[w] = 0
+
+            def quiesce_hook(executed_since):
+                # Acquire-read the quiesce word from HBM - the same
+                # re-read-every-round discipline as the abort words, so a
+                # host with in-place buffer write access (pinned-host
+                # production) lands a quiesce mid-entry; this driver
+                # uploads qctl at entry, which bounds latency at one
+                # round past the QC_AFTER threshold.
+                cp = pltpu.make_async_copy(qctl, qbuf, qsem.at[0])
+                cp.start()
+                cp.wait()
+                q = (qbuf[QC_FLAG] != 0) & (executed_since >= qbuf[QC_AFTER])
+
+                @pl.when(q & (qstat[QS_QUIESCED] == 0))
+                def _():
+                    qstat[QS_QUIESCED] = 1
+                    qstat[QS_AT] = executed_since
+                    tr.emit(TR_QUIESCE, tr.now(), executed_since)
+
+                return q
+
         core = self._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, stage_all_values,
             lanes=lanes, lstate=lstate, tstats=tstats, tracer=tracer,
+            quiesce_hook=quiesce_hook,
         )
 
         def one_rep(r, total_executed) -> jnp.int32:
@@ -1312,6 +1405,16 @@ class Megakernel:
         # across reps.
         total = jax.lax.fori_loop(0, reps, one_rep, jnp.int32(0))
         counts[C_EXECUTED] = total
+        if ckpt:
+            # State-export record: one TR_CKPT at exit when this entry
+            # quiesced (pending rows exported, ready backlog) - the device
+            # half of the checkpoint bracket tools/timeline.py renders.
+            @pl.when(qstat[QS_QUIESCED] != 0)
+            def _():
+                tr.emit(
+                    TR_CKPT, tr.now(), counts[C_PENDING],
+                    counts[C_TAIL] - counts[C_HEAD],
+                )
 
     # -- host entry --
 
@@ -1351,11 +1454,16 @@ class Megakernel:
         value_alloc survive between entries)."""
         ndata = len(self.data_specs)
         nbatch = len(self.batch_specs)
+        ckpt = self.checkpoint
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
-        in_specs = [smem(), smem(), smem(), smem(), smem()] + [
-            anyspace() for _ in range(ndata)
-        ]
+        in_specs = (
+            [smem(), smem(), smem(), smem(), smem()]
+            + [anyspace() for _ in range(ndata)]
+            # The quiesce ctl rides last in ANY (HBM): the scheduler
+            # re-reads it by DMA every round (checkpoint builds only).
+            + ([anyspace()] if ckpt else [])
+        )
         out_specs = tuple(
             [smem(), smem(), smem(), smem()]
             + [anyspace() for _ in range(ndata)]
@@ -1363,6 +1471,8 @@ class Megakernel:
             # APPENDED after the data outputs, so every existing consumer's
             # positional indexing is untouched.
             + ([smem()] if nbatch else [])
+            # Quiesce status (QS_* words), same appended discipline.
+            + ([smem()] if ckpt else [])
             # The flight-recorder ring rides last, same appended-output
             # discipline (absent entirely when tracing is off).
             + ([smem()] if self.trace is not None else [])
@@ -1379,6 +1489,7 @@ class Megakernel:
             ]
             + data_shapes
             + ([jax.ShapeDtypeStruct((TS_WORDS,), jnp.int32)] if nbatch else [])
+            + ([jax.ShapeDtypeStruct((8,), jnp.int32)] if ckpt else [])
             + ([self.trace.out_shape()] if self.trace is not None else [])
         )
         # inputs: tasks(0) succ(1) ready(2) counts(3) ivalues(4) data(5..)
@@ -1388,7 +1499,8 @@ class Megakernel:
             aliases[5 + i] = 4 + i
         return pl.pallas_call(
             functools.partial(
-                self._kernel, fuel, reps, stage_all_values, self.trace
+                self._kernel, fuel, reps, stage_all_values, self.trace,
+                ckpt,
             ),
             out_shape=out_shape,
             in_specs=in_specs,
@@ -1404,6 +1516,14 @@ class Megakernel:
                     pltpu.SMEM((nbatch, LS_WORDS), jnp.int32),
                 ]
                 if nbatch
+                else []
+            )
+            + (
+                [
+                    pltpu.SMEM((8,), jnp.int32),  # qbuf (quiesce staging)
+                    pltpu.SemaphoreType.DMA((1,)),  # qsem
+                ]
+                if ckpt
                 else []
             ),
             input_output_aliases=aliases,
@@ -1457,12 +1577,29 @@ class Megakernel:
         never floats free of a harness."""
         return dict(self._last_info or {})
 
+    @staticmethod
+    def quiesce_words(quiesce) -> np.ndarray:
+        """Normalize a ``quiesce=`` argument into the 8-word qctl row:
+        None/False = off (zeros - a caller plumbing a boolean flag must
+        get 'no quiesce', not 'quiesce now'), True = quiesce at the first
+        round boundary, an int k = quiesce once >= k tasks have executed
+        this entry (the deterministic checkpoint-at-round-k spelling;
+        batch rounds may overshoot by width-1 like fuel does)."""
+        q = np.zeros(8, np.int32)
+        if quiesce is None or quiesce is False:
+            return q
+        q[QC_FLAG] = 1
+        if quiesce is not True:
+            q[QC_AFTER] = int(quiesce)
+        return q
+
     def run(
         self,
         builder: TaskGraphBuilder,
         data: Optional[Dict[str, Any]] = None,
         ivalues: Optional[np.ndarray] = None,
         fuel: int = 1 << 22,
+        quiesce=None,
     ):
         """Execute the task graph to completion; returns
         (ivalues, data_dict, info_dict).
@@ -1474,7 +1611,14 @@ class Megakernel:
         allocations): their returned contents are whatever the last kernel
         entry left there and must not be relied on. A deliberate ZERO preset
         above the out-slot range is invisible to the widening scan - declare
-        it with ``TaskGraphBuilder.reserve_values`` so staging covers it."""
+        it with ``TaskGraphBuilder.reserve_values`` so staging covers it.
+
+        ``quiesce`` (checkpoint builds only; see ``quiesce_words``) makes
+        the scheduler stop popping at a round boundary and return its live
+        state: the run comes back with ``info['quiesced']=True`` and
+        ``info['state']`` (the resumable scheduler snapshot - feed it to
+        ``resume()`` or ``runtime.checkpoint.snapshot_megakernel``)
+        instead of raising StallError on the pending remainder."""
         tasks, succ, ring, counts = builder.finalize(
             capacity=self.capacity, succ_capacity=self.succ_capacity
         )
@@ -1489,9 +1633,49 @@ class Megakernel:
             raise ValueError(
                 f"data buffers {sorted(data)} != declared {sorted(self.data_specs)}"
             )
-        if fuel not in self._jitted:
-            self._jitted[fuel] = self._build(fuel)
-        jitted = self._jitted[fuel]
+        return self._execute(
+            tasks, succ, ring, counts, ivalues, data, fuel, quiesce,
+            stage_all_values=False,
+        )
+
+    def resume(self, state: Dict[str, Any], fuel: int = 1 << 22,
+               quiesce=None):
+        """Re-enter mid-graph from a quiesced run's exported state (the
+        ``info['state']`` dict of a quiesced ``run()``/``resume()``, or a
+        restored CheckpointBundle's) and continue to completion - the
+        restart half of the checkpoint protocol. Stages ALL value slots
+        (live row-owned blocks / bump allocations survive the re-entry,
+        the sharded steal loop's re-entrant discipline) and rebuilds the
+        row free stack from completion tombstones. Chains: a resumed run
+        may itself be quiesced again."""
+        data = dict(state.get("data") or {})
+        if set(data.keys()) != set(self.data_specs.keys()):
+            raise ValueError(
+                f"state data buffers {sorted(data)} != declared "
+                f"{sorted(self.data_specs)}"
+            )
+        return self._execute(
+            state["tasks"], state["succ"], state["ready"], state["counts"],
+            state["ivalues"], data, fuel, quiesce, stage_all_values=True,
+        )
+
+    def _execute(
+        self, tasks, succ, ring, counts, ivalues, data, fuel, quiesce,
+        stage_all_values: bool,
+    ):
+        if quiesce is False:  # falsy boolean plumbing = off, everywhere
+            quiesce = None
+        if quiesce is not None and not self.checkpoint:
+            raise ValueError(
+                "quiesce= needs Megakernel(checkpoint=True): the quiesce "
+                "word is compiled into the round loop only then"
+            )
+        key = (fuel, bool(stage_all_values))
+        if key not in self._jitted:
+            self._jitted[key] = jax.jit(
+                self._build_raw(fuel, stage_all_values=stage_all_values)
+            )
+        jitted = self._jitted[key]
         import contextlib
 
         # Interpret mode runs as plain JAX ops; pin them to the host CPU
@@ -1504,6 +1688,16 @@ class Megakernel:
         )
         import time as _time
 
+        args = [
+            jnp.asarray(tasks),
+            jnp.asarray(succ),
+            jnp.asarray(ring),
+            jnp.asarray(counts),
+            jnp.asarray(ivalues),
+            *[jnp.asarray(data[k]) for k in self.data_specs.keys()],
+        ]
+        if self.checkpoint:
+            args.append(jnp.asarray(self.quiesce_words(quiesce)))
         # Epoch bracket for the flight recorder (the clockprobe trick):
         # monotonic_ns before launch and after readback are the host wall
         # clock the trace's round-indexed records interpolate into - the
@@ -1511,22 +1705,20 @@ class Megakernel:
         # device rounds and host spans share one Perfetto timeline.
         t0_ns = _time.monotonic_ns()
         with cm:
-            outs = jitted(
-                jnp.asarray(tasks),
-                jnp.asarray(succ),
-                jnp.asarray(ring),
-                jnp.asarray(counts),
-                jnp.asarray(ivalues),
-                *[jnp.asarray(data[k]) for k in self.data_specs.keys()],
-            )
+            outs = jitted(*args)
         ndata = len(self.data_specs)
         tasks_out, ready_out, counts_out, ivalues_out = outs[:4]
         data_out = dict(zip(self.data_specs.keys(), outs[4 : 4 + ndata]))
         packs = [counts_out, ivalues_out]
+        off_out = 4 + ndata
         if self.batch_specs:
-            packs.append(outs[4 + ndata])
+            packs.append(outs[off_out])
+            off_out += 1
+        if self.checkpoint:
+            packs.append(outs[off_out])
+            off_out += 1
         if self.trace is not None:
-            packs.append(outs[4 + ndata + (1 if self.batch_specs else 0)])
+            packs.append(outs[off_out])
         packed = np.asarray(self._packer(*packs))
         t1_ns = _time.monotonic_ns()
         counts_np = packed[:8]
@@ -1544,11 +1736,32 @@ class Megakernel:
                 packed[off : off + TS_WORDS]
             )
             off += TS_WORDS
+        quiesced = False
+        if self.checkpoint:
+            qstat = packed[off : off + 8]
+            off += 8
+            quiesced = bool(qstat[QS_QUIESCED])
+            info["quiesced"] = quiesced
+            if quiesced:
+                info["quiesce"] = {"executed_at": int(qstat[QS_AT])}
         if self.trace is not None:
             info["trace"] = trace_info(
                 [packed[off : off + self.trace.words]], t0_ns, t1_ns,
                 self.trace.capacity,
             )
+        if quiesced:
+            # The exported scheduler snapshot: everything resume() (and
+            # CheckpointBundle) needs to relaunch mid-graph. succ is
+            # input-only (never mutated on device), so the input array IS
+            # its live value.
+            info["state"] = {
+                "tasks": np.asarray(tasks_out),
+                "succ": np.asarray(succ),
+                "ready": np.asarray(ready_out),
+                "counts": counts_np.copy(),
+                "ivalues": ivalues_np.copy(),
+                "data": {k: np.asarray(v) for k, v in data_out.items()},
+            }
         self._last_info = info
         if info["overflow"]:
             raise RuntimeError(
@@ -1557,7 +1770,7 @@ class Megakernel:
                 f"(capacity={self.capacity}, num_values={self.num_values}); "
                 "raise the limits, coarsen tasks, or audit frees"
             )
-        if info["pending"] != 0:
+        if info["pending"] != 0 and not quiesced:
             from ..runtime.resilience import StallError
 
             raise StallError(

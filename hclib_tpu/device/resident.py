@@ -144,9 +144,11 @@ from .tracebuf import (
     FLT_DELAY,
     NullTracer,
     TR_ABORT,
+    TR_CKPT,
     TR_CREDIT,
     TR_FAULT,
     TR_INJECT,
+    TR_QUIESCE,
     TR_XFER,
     Tracer,
     trace_info,
@@ -199,6 +201,7 @@ FS_REHOMED = 6      # rows I exported while dead (queue re-homing)
 FS_ABORT_ROUND = 7  # round the folded abort word was observed (-1: none)
 FS_STARVED = 8      # ((hop << 8) | granter) + 1 of my starved channel
 FS_HB = 9           # my final heartbeat
+FS_QUIESCE_ROUND = 10  # round the folded quiesce word was observed (-1)
 FS_WORDS = 16
 
 
@@ -220,6 +223,7 @@ def decode_fault_stats(row) -> Dict[str, Any]:
             else {"hop": (st - 1) >> 8, "granter": (st - 1) & 0xFF}
         ),
         "heartbeat": row[FS_HB],
+        "quiesce_round": row[FS_QUIESCE_ROUND],
     }
 
 
@@ -392,6 +396,14 @@ class ResidentKernel:
         # starved-channel wedge flag, so a local abort (or an unrecoverable
         # dropped credit) exits the WHOLE mesh in lockstep one fold later -
         # a divergent exit would strand partners in the paired exchanges.
+        # SF_QUIESCE (checkpoint builds only - the word costs an exchanged
+        # stat slot, so a non-checkpoint build compiles none of it) folds
+        # the host quiesce word the same way: on observing it every device
+        # stops popping (sched quantum -> 0) but KEEPS the exchange rounds
+        # - outboxes drain, in-flight AMs land, sent == recv - and the
+        # mesh exits in lockstep with nothing on the wire, every device's
+        # live scheduler state in its aliased outputs (the clean-cut
+        # property a checkpoint needs that an abort does not provide).
         self.SF_PEND = 0
         self.SF_RECV = 1
         self.SF_OUTB = 2
@@ -399,7 +411,13 @@ class ResidentKernel:
         self.SF_INJ = 4
         self.SF_ABORT = 5
         self.SF_WEDGE = 6
-        self.SX_AM = 7
+        self.checkpoint = bool(mk.checkpoint)
+        if self.checkpoint:
+            self.SF_QUIESCE = 7
+            self.SX_AM = 8
+        else:
+            self.SF_QUIESCE = None
+            self.SX_AM = 7
         self.SX_DATA = self.SX_AM + self.ndev
         nxt = self.SX_DATA + self.ndev * self.nchan
         if self.plan is not None:
@@ -521,13 +539,14 @@ class ResidentKernel:
             self.SF_INJ,
         )
         SF_ABORT, SF_WEDGE = self.SF_ABORT, self.SF_WEDGE
+        SF_QUIESCE, ckpt = self.SF_QUIESCE, self.checkpoint
         SX_AM, SX_DATA, S_BL, S = self.SX_AM, self.SX_DATA, self.S_BL, self.S
         did_type = self._did_type
         me = self._flat_me()
 
         # pstate slots
         PS_RECV, PS_NWAIT, PS_SENT, PS_PROXIES = 0, 1, 2, 3
-        PS_HB, PS_WEDGE = 4, 5
+        PS_HB, PS_WEDGE, PS_QUIESCE = 4, 5, 6
 
         # ---- compiled-in fault predicates (None plan emits nothing) ----
 
@@ -741,6 +760,7 @@ class ResidentKernel:
                 fstats[i] = 0
             fstats[FS_DEAD_ROUND] = -1
             fstats[FS_ABORT_ROUND] = -1
+            fstats[FS_QUIESCE_ROUND] = -1
             if plan is not None:
                 for k in range(nh):
                     pair_down[k] = -1
@@ -1210,7 +1230,8 @@ class ResidentKernel:
 
         # ---- the fold + steal hops ----
 
-        def fold_and_steal(r, inj_backlog, am_dead, local_abort):
+        def fold_and_steal(r, inj_backlog, am_dead, local_abort,
+                           local_quiesce):
             statacc[SF_PEND] = counts[C_PENDING]
             statacc[SF_RECV] = pstate[PS_RECV]
             statacc[SF_OUTB] = obctl[1] - obctl[0]
@@ -1218,6 +1239,8 @@ class ResidentKernel:
             statacc[SF_INJ] = inj_backlog
             statacc[SF_ABORT] = local_abort.astype(jnp.int32)
             statacc[SF_WEDGE] = pstate[PS_WEDGE]
+            if ckpt:
+                statacc[SF_QUIESCE] = local_quiesce.astype(jnp.int32)
 
             def f1(p, _):
                 statacc[SX_AM + p] = am_sent[me ^ p]
@@ -1534,7 +1557,15 @@ class ResidentKernel:
             # exports - stays up, like a real chip whose ICI router
             # outlives its core.
             am_dead = is_dead(r) if plan is not None else jnp.bool_(False)
-            core.sched(jnp.where(am_dead, 0, quantum))
+            # Quiesce drain rounds: once the folded quiesce word was
+            # observed, stop popping (fuel 0 - the round boundary the
+            # export contract promises) but keep the exchange machinery
+            # live until the wire is empty; heartbeats keep ticking so
+            # the drain cannot be mistaken for a dead chip.
+            hold = am_dead
+            if ckpt:
+                hold = hold | (pstate[PS_QUIESCE] != 0)
+            core.sched(jnp.where(hold, 0, quantum))
             pstate[PS_HB] = pstate[PS_HB] + jnp.where(am_dead, 0, 1)
             if self.inject:
                 c_new = poll(consumed)
@@ -1554,8 +1585,16 @@ class ResidentKernel:
             cpa.start()
             cpa.wait()
             local_abort = abuf[0] != 0
+            # Quiesce word rides the same per-device HBM row (word [1],
+            # threshold in [2]): every device compares the same r, so the
+            # fold sees a lockstep-consistent flag.
+            if ckpt:
+                local_quiesce = (abuf[1] != 0) & (r >= abuf[2])
+            else:
+                local_quiesce = jnp.bool_(False)
             drain_outbox()
-            fold_and_steal(r, inj_backlog, am_dead, local_abort)
+            fold_and_steal(r, inj_backlog, am_dead, local_abort,
+                           local_quiesce)
             aborted = statacc[SF_ABORT] > 0
 
             @pl.when(aborted & (fstats[FS_ABORT_ROUND] < 0))
@@ -1566,12 +1605,31 @@ class ResidentKernel:
                 aborted & (fstats[FS_ABORT_ROUND] < 0), r,
                 fstats[FS_ABORT_ROUND],
             )
-            done = (
-                (statacc[SF_PEND] == 0)
-                & (statacc[SF_OUTB] == 0)
+            wire_idle = (
+                (statacc[SF_OUTB] == 0)
                 & (statacc[SF_INJ] == 0)
                 & (statacc[SF_SENT] == statacc[SF_RECV])
-            ) | aborted | (statacc[SF_WEDGE] > 0)
+            )
+            settled = jnp.bool_(False)
+            if ckpt:
+                quiescing = statacc[SF_QUIESCE] > 0
+
+                @pl.when(quiescing & (fstats[FS_QUIESCE_ROUND] < 0))
+                def _():
+                    fstats[FS_QUIESCE_ROUND] = r
+                    tr.emit(TR_QUIESCE, tr.now(), r)
+
+                pstate[PS_QUIESCE] = pstate[PS_QUIESCE] | quiescing.astype(
+                    jnp.int32
+                )
+                # Lockstep clean-cut exit: quiesced AND the wire is empty
+                # (pending work intentionally remains - that is the
+                # checkpoint).
+                settled = quiescing & wire_idle
+            done = (
+                ((statacc[SF_PEND] == 0) & wire_idle)
+                | aborted | (statacc[SF_WEDGE] > 0) | settled
+            )
             if plan is not None and (
                 plan.drops_credits() and plan.credit_timeout == 0
             ):
@@ -1592,6 +1650,14 @@ class ResidentKernel:
             cond, body, (jnp.int32(0), jnp.bool_(False), consumed0)
         )
         counts[C_ROUNDS] = r
+        if ckpt:
+            # State-export record (the checkpoint bracket's device half).
+            @pl.when(pstate[PS_QUIESCE] != 0)
+            def _():
+                tr.emit(
+                    TR_CKPT, tr.now(), counts[C_PENDING],
+                    counts[C_TAIL] - counts[C_HEAD],
+                )
         if self.inject:
             ctl_out[0] = ctlbuf[0]
             ctl_out[1] = ctlbuf[1]
@@ -1728,6 +1794,8 @@ class ResidentKernel:
         )
         axes = self.axes
 
+        ckpt = self.checkpoint
+
         def step(tasks, succ, ring, counts, iv, *rest):
             data_in = rest[:ndata]
             waits = rest[ndata]
@@ -1742,6 +1810,11 @@ class ResidentKernel:
             ntrace = 1 if self.mk.trace is not None else 0
             fstats_o = outs[-1 - ntrace]
             tail_o = ([outs[-1]] if ntrace else [])
+            # Checkpoint builds export the mutated task table + ready
+            # ring too - the per-device scheduler snapshot restore()
+            # relaunches from (dropped by non-checkpoint builds, whose
+            # positional consumers predate them).
+            state_o = [outs[0], outs[1]] if ckpt else []
             gcounts = jax.lax.psum(counts_o, axes)
             return (
                 counts_o[None],
@@ -1749,11 +1822,18 @@ class ResidentKernel:
                 gcounts[None],
                 *[d[None] for d in data_o],
                 fstats_o[None],
+                *[s[None] for s in state_o],
                 *[t[None] for t in tail_o],
             )
 
         nin = 7 + ndata + (2 if self.inject else 0)
-        nout = 4 + ndata
+        # fstats (and the trace ring / checkpoint state outputs, when
+        # built in) are per-device outputs too: out_specs must cover them
+        # or shard_map rejects the pytree at trace time.
+        nout = (
+            4 + ndata + (1 if self.mk.trace is not None else 0)
+            + (2 if ckpt else 0)
+        )
         f = shard_map(
             step,
             mesh=self.mesh,
@@ -1765,7 +1845,7 @@ class ResidentKernel:
 
     def run(
         self,
-        builders: Sequence[TaskGraphBuilder],
+        builders: Optional[Sequence[TaskGraphBuilder]] = None,
         data: Optional[Dict[str, np.ndarray]] = None,
         ivalues: Optional[np.ndarray] = None,
         waits: Optional[Sequence[Sequence[Tuple[int, int, int]]]] = None,
@@ -1773,6 +1853,8 @@ class ResidentKernel:
         quantum: int = 64,
         max_rounds: int = 1 << 14,
         abort=None,
+        quiesce=None,
+        resume_state: Optional[Dict[str, Any]] = None,
     ):
         """Execute all partitions fully on-device.
 
@@ -1793,11 +1875,54 @@ class ResidentKernel:
         ``info['fault_stats']`` carries
         each device's FS_* trace (abort round, credits dropped/regenerated/
         duplicated, quarantine mask, re-homed rows, heartbeat).
+
+        Checkpoint (``mk`` built with ``checkpoint=True``): ``quiesce``
+        is the host quiesce word - truthy stops the mesh at its next
+        round boundary, an int k at round >= k (the deterministic
+        checkpoint-at-round-k spelling). Unlike abort, the exit is a
+        clean cut: every device stops popping but the exchange rounds
+        keep draining until outboxes are empty and sent == recv, then the
+        mesh exits in lockstep with ``info['quiesced']=True`` and
+        ``info['state']`` (the stacked per-device snapshot;
+        ``run(resume_state=...)`` relaunches mid-graph, and
+        ``runtime.checkpoint`` serializes / re-homes it onto a different
+        mesh size). Quiesce with pending host-declared ``waits`` is
+        refused: the wait table is kernel scratch and parked wait rows
+        would never re-arm after a restore.
         """
         from .sharded import execute_partitions
 
         mk = self.mk
         ndev = self.ndev
+        if (builders is None) == (resume_state is None):
+            raise ValueError(
+                "run() wants exactly one of builders= or resume_state="
+            )
+        if quiesce is False:  # falsy boolean plumbing = off (see
+            quiesce = None    # Megakernel.quiesce_words)
+        if quiesce is not None and not self.checkpoint:
+            raise ValueError(
+                "quiesce= needs Megakernel(checkpoint=True): the quiesce "
+                "word is compiled into the round loop only then"
+            )
+        if quiesce is not None and any(w for w in (waits or [])):
+            raise ValueError(
+                "checkpoint quiesce with host-declared waits is not "
+                "supported: the wait table is kernel scratch and parked "
+                "wait rows would never re-arm after a restore"
+            )
+        if resume_state is not None:
+            if waits or inject_rows:
+                raise ValueError(
+                    "resume_state= cannot be combined with waits/"
+                    "inject_rows: the snapshot already carries every "
+                    "pending row"
+                )
+            if data is not None or ivalues is not None:
+                raise ValueError(
+                    "resume_state= carries its own data/ivalues"
+                )
+            data = dict(resume_state.get("data") or {})
         waits = list(waits or [])
         if len(waits) < ndev:
             waits = waits + [[] for _ in range(ndev - len(waits))]
@@ -1841,6 +1966,13 @@ class ResidentKernel:
 
         abort_arr = abort_words(abort, ndev)
         abort_requested = bool(abort_arr[:, 0].any())
+        quiesce_requested = quiesce is not None
+        if quiesce_requested:
+            # Quiesce word rides words [1] (flag) and [2] (round
+            # threshold) of the same per-device HBM row the abort word
+            # occupies - one ctl row per device, re-read every round.
+            abort_arr[:, 1] = 1
+            abort_arr[:, 2] = 0 if quiesce is True else int(quiesce)
         extra += [abort_arr]
 
         def bump_waits(tasks, succ, ring, counts):
@@ -1883,10 +2015,14 @@ class ResidentKernel:
         t0_ns = time.monotonic_ns()
         iv_o, data_o, info = execute_partitions(
             mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,
-            with_rounds=True, mutate=bump_waits, extra_inputs=extra,
+            with_rounds=True,
+            mutate=bump_waits if resume_state is None else None,
+            extra_inputs=extra, state=resume_state,
+            keep_inputs=self.checkpoint,
         )
         t1_ns = time.monotonic_ns()
         info["rounds"] = info.pop("steal_rounds")
+        inputs = info.pop("inputs", None)
         tail = info.pop("extra_outputs")
         if mk.trace is not None:
             trows = tail[-1]
@@ -1895,10 +2031,27 @@ class ResidentKernel:
                 mk.trace.capacity,
             )
             tail = tail[:-1]
+        if self.checkpoint:
+            tasks_rows, ready_rows = tail[-2], tail[-1]
+            tail = tail[:-2]
         frows = tail[-1]
         fs = [decode_fault_stats(frows[d]) for d in range(ndev)]
         info["fault_stats"] = fs
         info["aborted"] = any(f["abort_round"] >= 0 for f in fs)
+        if self.checkpoint:
+            info["quiesced"] = any(f["quiesce_round"] >= 0 for f in fs)
+            if info["quiesced"]:
+                # The stacked per-device snapshot run(resume_state=)
+                # relaunches from; runtime/checkpoint.py serializes it
+                # and re-homes it onto a different mesh size.
+                info["state"] = {
+                    "tasks": np.asarray(tasks_rows),
+                    "succ": np.asarray(inputs["succ"]),
+                    "ready": np.asarray(ready_rows),
+                    "counts": np.asarray(info["per_device_counts"]),
+                    "ivalues": np.asarray(iv_o),
+                    "data": {k: np.asarray(v) for k, v in data_o.items()},
+                }
         if info["overflow"]:
             from .megakernel import decode_overflow
 
@@ -1926,7 +2079,9 @@ class ResidentKernel:
                 f"{info['pending']} pending",
                 stats=info,
             )
-        if info["pending"] != 0 and not (abort_requested or info["aborted"]):
+        if info["pending"] != 0 and not (
+            abort_requested or info["aborted"] or info.get("quiesced")
+        ):
             suspects = sorted({
                 p for f in fs for p in f["quarantined"]
             })

@@ -114,6 +114,8 @@ def execute_partitions(
     with_rounds: bool,
     mutate=None,
     extra_inputs: Sequence[np.ndarray] = (),
+    state=None,
+    keep_inputs: bool = False,
 ):
     """Shared host-side driver for the multi-device runners: partition the
     builders, widen per-device value allocs over presets, validate data
@@ -124,14 +126,26 @@ def execute_partitions(
     ``mutate(tasks, succ, ring, counts)`` lets a runner adjust the
     partitioned arrays in place before upload (e.g. the PGAS runner's
     wait-dependency bumps); ``extra_inputs`` are device_put after the data
-    buffers (same leading device axis)."""
-    tasks, succ, ring, counts = partition_builders(mk, ndev, builders)
-    if ivalues is None:
-        ivalues = np.zeros((ndev, mk.num_values), np.int32)
+    buffers (same leading device axis). ``state`` (a checkpoint snapshot:
+    stacked per-device tasks/succ/ready/counts/ivalues) bypasses the
+    builder partitioning and preset widening entirely - the arrays are a
+    quiesced run's exported state, already consistent. ``keep_inputs``
+    surfaces the uploaded input arrays as ``info['inputs']`` (the
+    checkpoint path needs the succ CSR, which is input-only)."""
+    if state is not None:
+        tasks = np.asarray(state["tasks"]).copy()
+        succ = np.asarray(state["succ"]).copy()
+        ring = np.asarray(state["ready"]).copy()
+        counts = np.asarray(state["counts"]).copy()
+        ivalues = np.asarray(state["ivalues"]).copy()
     else:
-        ivalues = np.asarray(ivalues)
-        for d in range(ndev):
-            mk.widen_value_alloc(counts[d], ivalues[d])
+        tasks, succ, ring, counts = partition_builders(mk, ndev, builders)
+        if ivalues is None:
+            ivalues = np.zeros((ndev, mk.num_values), np.int32)
+        else:
+            ivalues = np.asarray(ivalues)
+            for d in range(ndev):
+                mk.widen_value_alloc(counts[d], ivalues[d])
     # Mutate AFTER preset widening: runners that symmetrize or validate
     # the per-device value_alloc (ResidentKernel's symmetric-heap layout
     # and migration result-slot check) must see the final values.
@@ -164,6 +178,8 @@ def execute_partitions(
     # Runner-specific trailing outputs (e.g. the resident kernel's
     # per-device fault/abort stats) ride after the data buffers.
     info["extra_outputs"] = [np.asarray(x) for x in outs[3 + nd :]]
+    if keep_inputs:
+        info["inputs"] = {"succ": succ}
     if with_rounds:
         info["steal_rounds"] = int(np.asarray(counts_o)[0][C_ROUNDS])
     return np.asarray(iv_o), data_o, info
@@ -220,6 +236,27 @@ class ShardedMegakernel:
                     "use ResidentKernel/ICIStealMegakernel tracing or "
                     "build the Megakernel with trace=None"
                 )
+        # Checkpoint quiesce cannot ride this runner either: the appended
+        # qstat output breaks the positional out_specs, and the bulk-
+        # synchronous steal loop re-enters the kernel per round with its
+        # OWN state threading (quiesce mid-round would race the exchange).
+        # Use ResidentKernel(checkpoint) for mesh checkpoints.
+        self._suppress_ckpt = False
+        if mk.checkpoint:
+            if getattr(mk, "checkpoint_from_env", False):
+                import logging
+
+                logging.getLogger("hclib_tpu.device").warning(
+                    "ShardedMegakernel cannot checkpoint; ignoring "
+                    "HCLIB_TPU_CHECKPOINT for this runner's builds"
+                )
+                self._suppress_ckpt = True
+            else:
+                raise ValueError(
+                    "ShardedMegakernel does not support checkpoint "
+                    "quiesce; use ResidentKernel for mesh checkpoint/"
+                    "restore or build the Megakernel with checkpoint=False"
+                )
         self.mk = mk
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
@@ -233,17 +270,23 @@ class ShardedMegakernel:
 
     @contextlib.contextmanager
     def _maybe_untraced(self):
-        """Build-time trace suppression for env-derived tracing: restores
-        mk.trace afterwards so other runners sharing the kernel keep it."""
-        if not self._suppress_trace:
+        """Build-time trace/checkpoint suppression for env-derived
+        enablement: restores mk state afterwards so other runners sharing
+        the kernel keep the capability."""
+        if not (self._suppress_trace or self._suppress_ckpt):
             yield
             return
-        saved = self.mk.trace
-        self.mk.trace = None
+        saved_trace = self.mk.trace
+        saved_ckpt = self.mk.checkpoint
+        if self._suppress_trace:
+            self.mk.trace = None
+        if self._suppress_ckpt:
+            self.mk.checkpoint = False
         try:
             yield
         finally:
-            self.mk.trace = saved
+            self.mk.trace = saved_trace
+            self.mk.checkpoint = saved_ckpt
 
     def _build(self, fuel: int):
         # Single kernel entry per launch: lean value staging suffices (run()

@@ -411,6 +411,7 @@ def _sw_wave_drain(ctx, chunk: int) -> None:
 def _sw_batch_megakernel(
     nt_i: int, nt_j: int, interpret: Optional[bool], with_h: bool,
     chunk: int, width: int, capacity: int, succ_capacity: int,
+    checkpoint: Optional[bool] = None,
 ) -> Megakernel:
     import functools as _ft
 
@@ -456,12 +457,14 @@ def _sw_batch_megakernel(
         num_values=8,
         succ_capacity=succ_capacity,
         interpret=interpret,
+        checkpoint=checkpoint,
     )
 
 
 def make_sw_wave_megakernel(
     nt_i: int, nt_j: int, interpret: Optional[bool] = None,
     with_h: bool = True, chunk: int = WAVE_R, width: int = WAVE_B,
+    checkpoint: Optional[bool] = None,
 ) -> Megakernel:
     nwaves = nt_i + nt_j - 1
     chunks = [
@@ -479,6 +482,7 @@ def make_sw_wave_megakernel(
     return _sw_batch_megakernel(
         nt_i, nt_j, interpret, with_h, chunk, width,
         capacity=max(64, ntasks), succ_capacity=max(64, csr_words),
+        checkpoint=checkpoint,
     )
 
 

@@ -66,6 +66,8 @@ __all__ = [
     "TR_ABORT",
     "TR_FAULT",
     "TR_INJECT",
+    "TR_QUIESCE",
+    "TR_CKPT",
     "TAG_NAMES",
 ]
 
@@ -89,6 +91,8 @@ TR_XFER = 9            # a = partner/hop, b = rows sent
 TR_ABORT = 10          # a = round the folded abort word was observed
 TR_FAULT = 11          # a = fault code (FLT_*), b = detail (peer/mask)
 TR_INJECT = 12         # a = rows installed from the injection ring
+TR_QUIESCE = 13        # a = executed-since-entry (or round) at observation
+TR_CKPT = 14           # a = pending rows exported, b = ready backlog
 
 TAG_NAMES: Dict[int, str] = {
     TR_ROUND_BEGIN: "round_begin",
@@ -103,6 +107,8 @@ TAG_NAMES: Dict[int, str] = {
     TR_ABORT: "abort",
     TR_FAULT: "fault",
     TR_INJECT: "inject",
+    TR_QUIESCE: "quiesce",
+    TR_CKPT: "ckpt_export",
 }
 
 # TR_CREDIT delta codes (b word).

@@ -16,7 +16,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .descriptor import TaskGraphBuilder
-from .megakernel import VBLOCK, KernelContext, Megakernel
+from .megakernel import VBLOCK, KernelContext, Megakernel, fault_mix
 
 __all__ = [
     "device_fib",
@@ -24,6 +24,9 @@ __all__ = [
     "make_fib_megakernel",
     "make_vfib_megakernel",
     "device_vfib",
+    "make_uts_megakernel",
+    "device_uts_mk",
+    "UTS_NODE",
 ]
 
 
@@ -143,6 +146,83 @@ def device_vfib(
     mk = make_vfib_megakernel(max_n=n + 2, lanes=lanes, interpret=interpret)
     b = TaskGraphBuilder()
     b.add(VFIB, args=[n], out=0)
+    ivalues, _, info = mk.run(b)
+    return int(ivalues[0]), info
+
+
+# ------------------------------------------------------ UTS, scalar tier
+
+UTS_NODE = 0
+
+
+def make_uts_megakernel(
+    seed: int = 19,
+    q_millis: int = 440,
+    m_children: int = 4,
+    max_depth: int = 12,
+    capacity: int = 1024,
+    interpret: Optional[bool] = None,
+    trace=None,
+    checkpoint: Optional[bool] = None,
+) -> Megakernel:
+    """Seeded unbalanced-tree search on the scalar megakernel tier: the
+    dynamic-spawn UTS-style workload (the reference's north-star tree,
+    models/uts.py, reduced to the descriptor ABI) used by the checkpoint
+    tests/bench to quiesce a traversal mid-tree.
+
+    Every node task counts itself into value slot 0 and spawns child c
+    (c < ``m_children``) iff ``fault_mix(seed, c, node_id, 0, depth) <
+    q_millis`` - the same in-kernel integer mixer the DeviceFaultPlan
+    decision tables use, so the whole tree is a pure function of the
+    seed (deterministic, reproducible, unbalanced by construction). The
+    root (depth 0) spawns all ``m_children`` (the b0 root factor of UTS);
+    ``max_depth`` bounds the traversal. Spawned rows are link-free
+    (count-accumulate only), so they are migratable on every multi-device
+    runner AND re-homeable across mesh sizes by
+    ``CheckpointBundle.reshard``."""
+
+    def node(ctx: KernelContext) -> None:
+        ctx.set_value(0, ctx.value(0) + 1)
+        node_id = ctx.arg(0)
+        depth = ctx.arg(1)
+
+        @pl.when(depth < max_depth)
+        def _():
+            for c in range(m_children):
+                h = fault_mix(seed, c, node_id, 0, depth)
+                exists = (depth == 0) | (h < q_millis)
+
+                @pl.when(exists)
+                def _(c=c):
+                    ctx.spawn(
+                        UTS_NODE,
+                        [node_id * 31 + jnp.int32(7 * c + 1) + depth,
+                         depth + 1],
+                        nargs=2,
+                    )
+
+    return Megakernel(
+        kernels=[("uts_node", node)],
+        capacity=capacity,
+        num_values=16,
+        succ_capacity=8,
+        interpret=interpret,
+        trace=trace,
+        checkpoint=checkpoint,
+    )
+
+
+def device_uts_mk(
+    seed: int = 19,
+    interpret: Optional[bool] = None,
+    mk: Optional[Megakernel] = None,
+    **mk_kw,
+) -> Tuple[int, dict]:
+    """Run the seeded UTS tree to completion; returns (nodes, info)."""
+    if mk is None:
+        mk = make_uts_megakernel(seed=seed, interpret=interpret, **mk_kw)
+    b = TaskGraphBuilder()
+    b.add(UTS_NODE, args=[1, 0])
     ivalues, _, info = mk.run(b)
     return int(ivalues[0]), info
 

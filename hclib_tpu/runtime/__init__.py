@@ -13,6 +13,17 @@ from .locality import (
     generate_default_graph,
     load_locality_file,
 )
+from .checkpoint import (
+    CheckpointBundle,
+    CheckpointError,
+    checkpoint_on_preempt,
+    restore_megakernel,
+    restore_resident,
+    restore_stream,
+    snapshot_megakernel,
+    snapshot_resident,
+    snapshot_stream,
+)
 from .instrument import EventLog, load_dump, register_event_type
 from .mem import allocate_at, async_copy, free_at, memset_at
 from .metrics import MetricsRegistry

@@ -1,0 +1,547 @@
+"""Checkpoint/restore: preemption-tolerant snapshot and elastic resume of
+the persistent megakernel.
+
+A resident kernel that runs for minutes is exactly what TPU preemption
+kills: a SIGTERM or maintenance event used to lose the whole task graph,
+and the only mitigations were abort-and-rerun (the abort words, README
+"Device faults") and post-mortem traces (the flight recorder). This module
+is the missing robustness layer:
+
+- **Quiesce** (device side, compiled in only with
+  ``Megakernel(checkpoint=True)`` - the DeviceFaultPlan discipline): a
+  host-writable quiesce word rides next to the abort word and is polled
+  inside every round loop (megakernel sched, streaming-inject ctl[5],
+  resident-mesh ctl word [1] folded into the termination collective). On
+  observing it, workers stop popping at the next round boundary - per-kind
+  batch lanes spill to the ready ring, in-flight prefetches drain, the
+  resident mesh keeps its exchange rounds until the wire is empty (sent ==
+  recv, outboxes drained) - and the kernel returns with its LIVE scheduler
+  state through the aliased outputs: task table, ready ring, counters,
+  value heap, tier counters, fault/trace cursors.
+
+- **Bundle** (this module): ``CheckpointBundle`` serializes that exported
+  state plus the host-held descriptor metadata into a versioned on-disk
+  artifact - a directory holding ``state.npz`` (the arrays) and
+  ``manifest.json`` (magic, version, kind, kernel-table names, capacities,
+  mesh dims, sha256 of the npz) - integrity-checked on load.
+
+- **Restore**: ``restore_megakernel`` / ``restore_stream`` /
+  ``restore_resident`` validate the manifest against a freshly-built
+  (same-code) runner and relaunch MID-GRAPH: the re-entry stages all value
+  slots and rebuilds row free stacks from completion tombstones (the
+  sharded steal loop's re-entrant discipline), so for a deterministic
+  workload *checkpoint at round k + restore + run to completion* is
+  bit-identical to the uninterrupted run (asserted in
+  tests/test_checkpoint.py under interpret mode).
+
+- **Elastic resume** (``CheckpointBundle.reshard``): a resident-mesh
+  bundle taken on N chips restores onto M != N chips by re-homing the
+  per-chip queues host-side - the same task-conservation semantics as the
+  PR 2 dead-chip re-homing path (link-free migratable rows move whole;
+  totals conserved), applied at rest instead of over ICI. Rows that cannot
+  re-home (successor links, homed-migration proxies, dynamic out slots)
+  are refused with a diagnostic naming the offending row.
+
+- **Preemption wiring** (``checkpoint_on_preempt``): SIGTERM (via
+  ``resilience.install_preempt_handler``), the ``HCLIB_TPU_PREEMPT`` env,
+  or the watchdog's optional checkpoint rung
+  (``HCLIB_TPU_WATCHDOG_CHECKPOINT``) fire registered preemption hooks;
+  binding a stream quiesces it so the driving ``run_stream`` returns a
+  restorable snapshot instead of losing the graph - checkpoint, then stop.
+
+Caveats (stated, not hidden): host-side tasks and help-first host
+execution are NOT captured - the bundle holds device scheduler state only,
+so checkpoint the device layer and re-enter the host program idempotently
+(the same caveat class as ``help_finish``'s documented timeout limit).
+Resident quiesce with pending host-declared waits is refused (the wait
+table is kernel scratch), as is resharding a bundle whose live rows carry
+successor links or per-device data buffers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import resilience
+
+__all__ = [
+    "BUNDLE_VERSION",
+    "CheckpointBundle",
+    "CheckpointError",
+    "snapshot_megakernel",
+    "snapshot_stream",
+    "snapshot_resident",
+    "restore_megakernel",
+    "restore_stream",
+    "restore_resident",
+    "checkpoint_on_preempt",
+]
+
+MAGIC = "hclib-tpu-checkpoint"
+BUNDLE_VERSION = 1
+
+# state dict keys serialized for every kind (data buffers ride as
+# ``data/<name>`` entries; the stream kind adds ``ring_rows``).
+_STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
+
+# Descriptor-word indices, bound once (descriptor ABI, device/descriptor).
+from ..device.descriptor import (  # noqa: E402
+    DESC_WORDS,
+    F_CSR_N,
+    F_DEP,
+    F_HOME,
+    F_OUT,
+    F_SUCC0,
+    F_SUCC1,
+    NO_TASK,
+)
+
+
+class CheckpointError(RuntimeError):
+    """A bundle failed validation: corrupt artifact, version mismatch, or
+    a restore target whose configuration contradicts the manifest."""
+
+
+def _kernel_meta(mk) -> Dict[str, Any]:
+    return {
+        "kernel_names": list(mk.kernel_names),
+        "capacity": int(mk.capacity),
+        "num_values": int(mk.num_values),
+        "succ_capacity": int(mk.succ_capacity),
+        "data_specs": {
+            k: {"shape": list(s.shape), "dtype": str(np.dtype(s.dtype))}
+            for k, s in mk.data_specs.items()
+        },
+    }
+
+
+def _check_kernel_meta(mk, meta: Dict[str, Any]) -> None:
+    """The restore target must be the SAME program shape the bundle was
+    taken from: descriptor F_FN words index the kernel table by position,
+    so a renamed/reordered table would silently run the wrong kernels."""
+    mine = _kernel_meta(mk)
+    for key in ("kernel_names", "capacity", "num_values", "succ_capacity"):
+        if mine[key] != meta.get(key):
+            raise CheckpointError(
+                f"restore target mismatch: {key} is {mine[key]!r} here but "
+                f"{meta.get(key)!r} in the bundle - rebuild the megakernel "
+                "exactly as checkpointed (names, order, capacities)"
+            )
+    if set(mine["data_specs"]) != set(meta.get("data_specs", {})):
+        raise CheckpointError(
+            f"restore target mismatch: data buffers "
+            f"{sorted(mine['data_specs'])} != bundle "
+            f"{sorted(meta.get('data_specs', {}))}"
+        )
+
+
+class CheckpointBundle:
+    """One checkpoint: ``kind`` ("megakernel" | "stream" | "resident"),
+    ``meta`` (the JSON manifest body) and ``arrays`` (flat name ->
+    np.ndarray; data buffers under ``data/<name>``)."""
+
+    def __init__(self, kind: str, meta: Dict[str, Any],
+                 arrays: Dict[str, np.ndarray]) -> None:
+        self.kind = kind
+        self.meta = meta
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+    # ---- state <-> arrays ----
+
+    @staticmethod
+    def _flatten_state(state: Dict[str, Any],
+                       meta: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Flatten a runner state dict into named arrays. Extension
+        dtypes numpy cannot round-trip through npz (bfloat16 data
+        buffers save as raw ``|V2`` void and reload unusable) are stored
+        as same-width unsigned views with the true dtype recorded in
+        ``meta['dtypes']`` - ``state()`` views them back bit-exactly."""
+        arrays = {k: np.asarray(state[k]) for k in _STATE_KEYS}
+        if "ring_rows" in state:
+            arrays["ring_rows"] = np.asarray(state["ring_rows"])
+        for name, buf in (state.get("data") or {}).items():
+            arrays[f"data/{name}"] = np.asarray(buf)
+        dtypes: Dict[str, str] = {}
+        for k, v in arrays.items():
+            if v.dtype.kind not in "biufc":
+                dtypes[k] = str(v.dtype)
+                arrays[k] = v.view(f"u{v.dtype.itemsize}")
+        if dtypes:
+            meta["dtypes"] = dtypes
+        return arrays
+
+    def _restore_dtype(self, key: str, arr: np.ndarray) -> np.ndarray:
+        name = (self.meta.get("dtypes") or {}).get(key)
+        if name is None:
+            return arr.copy()
+        import ml_dtypes  # noqa: F401  (registers bfloat16 & friends)
+
+        return arr.view(np.dtype(name)).copy()
+
+    def state(self) -> Dict[str, Any]:
+        """The resumable state dict (what ``Megakernel.resume`` /
+        ``run_stream(resume_state=)`` / ``run(resume_state=)`` take)."""
+        st: Dict[str, Any] = {
+            k: self._restore_dtype(k, self.arrays[k]) for k in _STATE_KEYS
+        }
+        if "ring_rows" in self.arrays:
+            st["ring_rows"] = self.arrays["ring_rows"].copy()
+        st["data"] = {
+            k.split("/", 1)[1]: self._restore_dtype(k, v)
+            for k, v in self.arrays.items()
+            if k.startswith("data/")
+        }
+        return st
+
+    # ---- persistence ----
+
+    def save(self, path: str, metrics=None) -> Dict[str, Any]:
+        """Write the bundle as a directory: ``state.npz`` +
+        ``manifest.json`` (magic, version, kind, meta, npz sha256).
+        Returns {bundle_bytes, save_s, sha256}; with ``metrics`` (a
+        MetricsRegistry) the stats are recorded under "checkpoint"."""
+        t0 = time.monotonic()
+        os.makedirs(path, exist_ok=True)
+        buf = io.BytesIO()
+        np.savez(buf, **self.arrays)
+        blob = buf.getvalue()
+        sha = hashlib.sha256(blob).hexdigest()
+        npz_path = os.path.join(path, "state.npz")
+        with open(npz_path, "wb") as f:
+            f.write(blob)
+        manifest = {
+            "magic": MAGIC,
+            "version": BUNDLE_VERSION,
+            "kind": self.kind,
+            "created_unix": time.time(),
+            "sha256": sha,
+            "meta": self.meta,
+        }
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        stats = {
+            "bundle_bytes": len(blob),
+            "save_s": round(time.monotonic() - t0, 6),
+            "sha256": sha,
+        }
+        if metrics is not None:
+            rec = {"bundle_bytes": stats["bundle_bytes"],
+                   "save_s": stats["save_s"]}
+            for k in ("quiesce_latency_s", "quiesce_round", "executed_at"):
+                if k in self.meta and self.meta[k] is not None:
+                    rec[k] = self.meta[k]
+            metrics.record("checkpoint", rec)
+        return stats
+
+    @classmethod
+    def load(cls, path: str) -> "CheckpointBundle":
+        """Load + integrity-check a saved bundle. Raises CheckpointError
+        on a missing/foreign manifest, a version from the future, or an
+        npz whose sha256 disagrees with the manifest (bit rot, truncated
+        copy, tampering)."""
+        man_path = os.path.join(path, "manifest.json")
+        npz_path = os.path.join(path, "state.npz")
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointError(
+                f"unreadable checkpoint manifest {man_path}: {e}"
+            )
+        if manifest.get("magic") != MAGIC:
+            raise CheckpointError(
+                f"{man_path} is not a {MAGIC} bundle "
+                f"(magic={manifest.get('magic')!r})"
+            )
+        try:
+            version = int(manifest.get("version", -1))
+        except (TypeError, ValueError):
+            version = -1  # a mangled field is a corrupt manifest
+        if version != BUNDLE_VERSION:
+            raise CheckpointError(
+                f"bundle version {manifest.get('version')!r} != supported "
+                f"{BUNDLE_VERSION}: re-checkpoint with this build or "
+                "restore with the build that wrote it"
+            )
+        try:
+            with open(npz_path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise CheckpointError(f"unreadable checkpoint state: {e}")
+        sha = hashlib.sha256(blob).hexdigest()
+        if sha != manifest.get("sha256"):
+            raise CheckpointError(
+                f"checkpoint state corrupt: sha256 {sha[:12]}... != "
+                f"manifest {str(manifest.get('sha256'))[:12]}... "
+                f"({npz_path})"
+            )
+        with np.load(io.BytesIO(blob)) as z:
+            arrays = {k: z[k] for k in z.files}
+        return cls(manifest["kind"], manifest.get("meta", {}), arrays)
+
+    # ---- elastic resume (resident mesh only) ----
+
+    def reshard(self, ndev_new: int) -> "CheckpointBundle":
+        """Re-home a resident-mesh bundle's per-chip queues onto
+        ``ndev_new`` devices (N -> M re-sharding) - checkpoint-time
+        elasticity with the PR 2 dead-chip re-homing semantics: only
+        link-free migratable rows move (whole, conserving the pending
+        total), dealt round-robin; per-device accumulator value slots
+        fold by SUM into the new devices' symmetric host regions (the
+        ``ShardedMegakernel.migratable_fns`` contract: migratable kernels
+        write accumulate-style slots the host combines) and executed
+        counters fold the same way, so executed + pending totals are
+        conserved exactly. Refused with a diagnostic when any live row
+        carries successor links / a home-link / a dynamic out slot, or
+        when the kernel has per-device data buffers (no generic fold
+        exists for those)."""
+        from ..device.megakernel import (
+            C_ALLOC, C_EXECUTED, C_PENDING, C_VALLOC,
+        )
+
+        if self.kind != "resident":
+            raise CheckpointError(
+                f"reshard applies to resident-mesh bundles, not {self.kind}"
+            )
+        ndev_new = int(ndev_new)
+        if ndev_new < 1 or (ndev_new & (ndev_new - 1)):
+            raise CheckpointError(
+                f"reshard wants a power-of-two device count, got {ndev_new}"
+            )
+        if any(k.startswith("data/") for k in self.arrays):
+            raise CheckpointError(
+                "reshard cannot re-home per-device data buffers: restore "
+                "onto the original mesh size, or drain and re-partition "
+                "at the application level"
+            )
+        tasks = self.arrays["tasks"]
+        counts = self.arrays["counts"]
+        ivalues = self.arrays["ivalues"]
+        ndev, cap, _ = tasks.shape
+        V = ivalues.shape[1]
+        va = int(counts[:, C_VALLOC].max())
+        live_rows: List[np.ndarray] = []
+        for d in range(ndev):
+            alloc = int(counts[d][C_ALLOC])
+            for i in range(alloc):
+                row = tasks[d, i]
+                if int(row[F_DEP]) == -1:
+                    continue  # tombstone (completed/exported)
+                bad = None
+                if int(row[F_DEP]) != 0:
+                    bad = "a nonzero dependency counter"
+                elif (
+                    int(row[F_SUCC0]) != NO_TASK
+                    or int(row[F_SUCC1]) != NO_TASK
+                    or int(row[F_CSR_N]) != 0
+                ):
+                    bad = "successor links"
+                elif int(row[F_HOME]) >= 0:
+                    bad = "a migration home-link"
+                elif int(row[F_OUT]) >= va:
+                    bad = f"a dynamic out slot ({int(row[F_OUT])} >= {va})"
+                if bad is not None:
+                    raise CheckpointError(
+                        f"reshard: device {d} row {i} carries {bad}; only "
+                        "ready link-free rows re-home across mesh sizes "
+                        "(quiesce drains dependent subgraphs first, or "
+                        "restore onto the original mesh size)"
+                    )
+                live_rows.append(row.copy())
+        pend_total = int(counts[:, C_PENDING].sum())
+        if pend_total != len(live_rows):
+            raise CheckpointError(
+                f"reshard conservation check failed: {pend_total} pending "
+                f"!= {len(live_rows)} live rows - the bundle is not a "
+                "clean quiesce snapshot"
+            )
+        parts: List[List[np.ndarray]] = [[] for _ in range(ndev_new)]
+        for i, row in enumerate(live_rows):
+            parts[i % ndev_new].append(row)
+        for j, p in enumerate(parts):
+            if len(p) > cap:
+                raise CheckpointError(
+                    f"reshard: device {j} would hold {len(p)} rows > "
+                    f"capacity {cap}"
+                )
+        tasks_new = np.zeros((ndev_new, cap, DESC_WORDS), np.int32)
+        ready_new = np.full((ndev_new, cap), NO_TASK, np.int32)
+        counts_new = np.zeros((ndev_new, 8), np.int32)
+        ivalues_new = np.zeros((ndev_new, V), np.int32)
+        for j, p in enumerate(parts):
+            for i, row in enumerate(p):
+                tasks_new[j, i] = row
+                ready_new[j, i] = i
+            n = len(p)
+            counts_new[j][0] = 0  # head
+            counts_new[j][1] = n  # tail
+            counts_new[j][C_ALLOC] = n
+            counts_new[j][C_PENDING] = n
+            counts_new[j][C_VALLOC] = va
+        # Fold the old devices' accumulator host regions and executed
+        # counters mod M: column-wise sums (what the host combines at the
+        # end) are conserved exactly.
+        for d in range(ndev):
+            j = d % ndev_new
+            ivalues_new[j][:va] += ivalues[d][:va]
+            counts_new[j][C_EXECUTED] += int(counts[d][C_EXECUTED])
+        scap = self.arrays["succ"].shape[1]
+        succ_new = np.full((ndev_new, scap), NO_TASK, np.int32)
+        meta = dict(self.meta)
+        meta["ndev"] = ndev_new
+        meta["resharded_from"] = int(ndev)
+        return CheckpointBundle(
+            "resident", meta,
+            {
+                "tasks": tasks_new, "succ": succ_new, "ready": ready_new,
+                "counts": counts_new, "ivalues": ivalues_new,
+            },
+        )
+
+
+# --------------------------------------------------------------- snapshot
+
+def _require_quiesced(info: Dict[str, Any], what: str) -> Dict[str, Any]:
+    if not info.get("quiesced") or "state" not in info:
+        raise CheckpointError(
+            f"{what}: the run info carries no quiesced state - pass "
+            "quiesce= (or call .quiesce()) so the kernel exports its "
+            "scheduler state at a round boundary"
+        )
+    return info["state"]
+
+
+def snapshot_megakernel(mk, info: Dict[str, Any],
+                        meta: Optional[Dict[str, Any]] = None
+                        ) -> CheckpointBundle:
+    """Bundle a quiesced ``Megakernel.run/resume`` info dict."""
+    state = _require_quiesced(info, "snapshot_megakernel")
+    m = _kernel_meta(mk)
+    m.update(info.get("quiesce") or {})
+    m.update(meta or {})
+    return CheckpointBundle(
+        "megakernel", m, CheckpointBundle._flatten_state(state, m)
+    )
+
+
+def snapshot_stream(sm, info: Dict[str, Any],
+                    meta: Optional[Dict[str, Any]] = None
+                    ) -> CheckpointBundle:
+    """Bundle a quiesced ``StreamingMegakernel.run_stream`` return."""
+    state = _require_quiesced(info, "snapshot_stream")
+    m = _kernel_meta(sm.mk)
+    m["ring_capacity"] = int(sm.ring_capacity)
+    m["quiesce_latency_s"] = info.get("quiesce_latency_s")
+    m["quiesce_round"] = info.get("quiesce_observed_round")
+    m.update(meta or {})
+    return CheckpointBundle(
+        "stream", m, CheckpointBundle._flatten_state(state, m)
+    )
+
+
+def snapshot_resident(rk, info: Dict[str, Any],
+                      meta: Optional[Dict[str, Any]] = None
+                      ) -> CheckpointBundle:
+    """Bundle a quiesced ``ResidentKernel.run`` info dict."""
+    state = _require_quiesced(info, "snapshot_resident")
+    m = _kernel_meta(rk.mk)
+    m["ndev"] = int(rk.ndev)
+    m["dims"] = [int(d) for d in rk.dims]
+    m["quiesce_round"] = max(
+        f["quiesce_round"] for f in info["fault_stats"]
+    )
+    m.update(meta or {})
+    return CheckpointBundle(
+        "resident", m, CheckpointBundle._flatten_state(state, m)
+    )
+
+
+# ---------------------------------------------------------------- restore
+
+def _as_bundle(bundle_or_path) -> CheckpointBundle:
+    if isinstance(bundle_or_path, CheckpointBundle):
+        return bundle_or_path
+    return CheckpointBundle.load(bundle_or_path)
+
+
+def restore_megakernel(bundle_or_path, mk, fuel: int = 1 << 22,
+                       quiesce=None):
+    """Validate + relaunch a megakernel bundle mid-graph on ``mk`` (built
+    exactly as checkpointed, ``checkpoint=True`` not required unless you
+    pass ``quiesce=`` to re-checkpoint). Returns (ivalues, data, info) of
+    the continued run."""
+    b = _as_bundle(bundle_or_path)
+    if b.kind != "megakernel":
+        raise CheckpointError(
+            f"restore_megakernel got a {b.kind!r} bundle"
+        )
+    _check_kernel_meta(mk, b.meta)
+    return mk.resume(b.state(), fuel=fuel, quiesce=quiesce)
+
+
+def restore_stream(bundle_or_path, sm, **run_stream_kw):
+    """Validate + resume a stream bundle on ``sm`` (a StreamingMegakernel
+    whose Megakernel matches the manifest). The residue rows re-publish
+    on the fresh ring; the stream starts OPEN - inject()/close() as
+    usual, or close() first for drain-and-exit semantics."""
+    b = _as_bundle(bundle_or_path)
+    if b.kind != "stream":
+        raise CheckpointError(f"restore_stream got a {b.kind!r} bundle")
+    _check_kernel_meta(sm.mk, b.meta)
+    return sm.run_stream(resume_state=b.state(), **run_stream_kw)
+
+
+def restore_resident(bundle_or_path, rk, quantum: int = 64,
+                     max_rounds: int = 1 << 14, quiesce=None):
+    """Validate + relaunch a resident-mesh bundle on ``rk``. A mesh-size
+    mismatch re-homes the queues automatically (``reshard`` - totals
+    conserved; see its docstring for the eligibility rules). Returns
+    (ivalues, data, info) of the continued run."""
+    b = _as_bundle(bundle_or_path)
+    if b.kind != "resident":
+        raise CheckpointError(f"restore_resident got a {b.kind!r} bundle")
+    _check_kernel_meta(rk.mk, b.meta)
+    if int(b.meta.get("ndev", rk.ndev)) != rk.ndev:
+        b = b.reshard(rk.ndev)
+    return rk.run(
+        resume_state=b.state(), quantum=quantum, max_rounds=max_rounds,
+        quiesce=quiesce,
+    )
+
+
+# ------------------------------------------------------------- preemption
+
+@contextlib.contextmanager
+def checkpoint_on_preempt(stream, after_executed: int = 0):
+    """Bind a running stream's checkpoint trigger to process preemption:
+    SIGTERM (after ``resilience.install_preempt_handler()``), the
+    ``HCLIB_TPU_PREEMPT`` env, or the watchdog's checkpoint rung
+    (``HCLIB_TPU_WATCHDOG_CHECKPOINT=1``) quiesce the stream - the
+    driving run_stream returns with ``info['quiesced']=True`` and the
+    caller saves the bundle (checkpoint, then stop). Register-then-replay:
+    a preemption that fired BEFORE this binding still checkpoints.
+
+    ::
+
+        with checkpoint_on_preempt(sm):
+            iv, info = sm.run_stream(b, ...)
+        if info.get("quiesced"):
+            snapshot_stream(sm, info).save(path)
+    """
+
+    def hook() -> None:
+        stream.quiesce(after_executed)
+
+    resilience.register_preempt_hook(hook)
+    try:
+        yield
+    finally:
+        resilience.unregister_preempt_hook(hook)

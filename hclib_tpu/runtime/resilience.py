@@ -61,6 +61,11 @@ __all__ = [
     "register_abort_hook",
     "unregister_abort_hook",
     "bind_abort_to_scope",
+    "register_preempt_hook",
+    "unregister_preempt_hook",
+    "fire_preempt",
+    "preempt_requested",
+    "install_preempt_handler",
 ]
 
 LOG = logging.getLogger("hclib_tpu.resilience")
@@ -156,6 +161,119 @@ def bind_abort_to_scope(abort_fn, scope: Optional["CancelScope"] = None):
         unregister_abort_hook(hook)
 
     return unregister
+
+
+# Preemption hooks (the checkpoint/restore subsystem, runtime/checkpoint
+# .py): register_abort_hook's checkpoint-flavored twin. A TPU preemption
+# notice (SIGTERM from the maintenance controller, or the
+# HCLIB_TPU_PREEMPT env a wrapper script sets) should CHECKPOINT the
+# resident megakernel - quiesce at a round boundary, export its state -
+# rather than abort-and-lose it. Hooks (typically a bound
+# ``StreamingMegakernel.quiesce`` or a host flag a driving loop polls)
+# fire on ``fire_preempt``; the sources are the signal handler installed
+# by ``install_preempt_handler`` and the watchdog's optional checkpoint
+# rung (HCLIB_TPU_WATCHDOG_CHECKPOINT). Hooks must be idempotent/fast.
+_preempt_hooks: List[Any] = []
+_preempt_fired = False
+
+
+def register_preempt_hook(fn) -> None:
+    """Register a checkpoint trigger fired on preemption; if a preemption
+    already fired this process, the hook replays immediately (the same
+    register-then-replay protocol as ``bind_abort_to_scope`` - a SIGTERM
+    that landed before the stream started must still checkpoint it)."""
+    with _waker_lock:
+        _preempt_hooks.append(fn)
+        fired = _preempt_fired
+    if fired or preempt_requested():
+        try:
+            fn()
+        except Exception:
+            LOG.exception("preempt hook failed during replay")
+
+
+def unregister_preempt_hook(fn) -> None:
+    with _waker_lock:
+        try:
+            _preempt_hooks.remove(fn)
+        except ValueError:
+            pass
+
+
+def fire_preempt(reason: str = "preempted") -> int:
+    """Invoke every registered preemption hook; returns the number
+    notified. NOT called directly from signal frames: hooks and this
+    function take ordinary locks, so ``install_preempt_handler`` defers
+    the call to a daemon thread (same-thread lock re-entry from a signal
+    handler would deadlock)."""
+    global _preempt_fired
+    with _waker_lock:
+        _preempt_fired = True
+        hooks = list(_preempt_hooks)
+    LOG.warning("preemption notice (%s): firing %d checkpoint hook(s)",
+                reason, len(hooks))
+    for h in hooks:
+        try:
+            h()
+        except Exception:  # a dying stream must not block the others
+            LOG.exception("preempt hook failed")
+    return len(hooks)
+
+
+def reset_preempt() -> None:
+    """Clear the process-wide preempt flag (tests / fresh launches)."""
+    global _preempt_fired
+    _preempt_fired = False
+
+
+def preempt_requested() -> bool:
+    """True when this process has been asked to preempt: fire_preempt ran
+    (signal/watchdog), or the HCLIB_TPU_PREEMPT env var is set - the
+    spelling for wrapper scripts that cannot deliver a signal."""
+    if _preempt_fired:
+        return True
+    v = os.environ.get("HCLIB_TPU_PREEMPT", "")
+    return bool(v) and v != "0"
+
+
+def install_preempt_handler(signals: Optional[Sequence[int]] = None):
+    """Install a preemption handler for ``signals`` (default: SIGTERM -
+    what TPU maintenance/preemption delivers). The handler itself only
+    sets the process-wide flag and hands ``fire_preempt`` to a daemon
+    thread: Python signal handlers run between bytecodes ON the main
+    thread, so taking ``_waker_lock`` (or a stream's lock, or the
+    logging lock) there could deadlock against the very frame the
+    signal interrupted - the hooks run lock-safe on their own thread.
+    Chains to any previous Python-level handler so an outer framework's
+    shutdown logic still runs. Main thread only (CPython restriction);
+    returns an uninstall callable."""
+    import signal as _signal
+
+    if signals is None:
+        signals = (_signal.SIGTERM,)
+    prev = {}
+
+    def handler(signum, frame):
+        global _preempt_fired
+        _preempt_fired = True  # plain store: safe in a signal frame
+        threading.Thread(
+            target=fire_preempt, args=(f"signal {signum}",), daemon=True,
+        ).start()
+        p = prev.get(signum)
+        if callable(p):
+            p(signum, frame)
+
+    for s in signals:
+        prev[s] = _signal.signal(s, handler)
+
+    def uninstall() -> None:
+        for s, p in prev.items():
+            try:
+                _signal.signal(s, p)
+            except (ValueError, TypeError):
+                pass
+
+    return uninstall
 
 
 def any_cancelled() -> bool:

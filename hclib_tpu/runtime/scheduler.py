@@ -1088,6 +1088,16 @@ class Runtime:
                         # registry survive in the stall post-mortem.
                         dump += "\nmetrics: " + self.metrics.to_json()
                     log.error("%s\n%s", head, dump)
+                    if os.environ.get(
+                        "HCLIB_TPU_WATCHDOG_CHECKPOINT", ""
+                    ) not in ("", "0"):
+                        # Optional checkpoint rung: before escalation can
+                        # cancel (and abort device streams, losing their
+                        # task graphs), fire the preemption hooks so any
+                        # registered resident stream quiesces and exports
+                        # its state - the stall post-mortem then carries
+                        # a restorable snapshot, not just counters.
+                        resilience.fire_preempt("watchdog stall strike 2")
                 if strikes >= 3 and self._watchdog_escalate:
                     err = StallError(
                         f"watchdog: stalled for "

@@ -1,0 +1,637 @@
+"""Checkpoint/restore (ISSUE 5): preemption-tolerant snapshot + elastic
+resume of the persistent megakernel.
+
+Acceptance semantics under test: for a deterministic workload,
+*checkpoint at round k then restore and run to completion* must be
+bit-identical to the uninterrupted run (UTS dynamic tree, Cholesky with
+the batched dispatch tier, wave-DAG SW with cross-round prefetch - all
+under interpret mode); a checkpoint-disabled build must behave exactly as
+before (DeviceFaultPlan discipline); corrupt or version-mismatched
+bundles must be rejected with structured errors. Resident-mesh round
+trips (same mesh and N -> M re-homing) need the Mosaic interpret mode and
+ride the chaos marker like the other mesh tests.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import hclib_tpu as hc
+from hclib_tpu.device.descriptor import TaskGraphBuilder
+from hclib_tpu.device.inject import StreamingMegakernel
+from hclib_tpu.device.megakernel import Megakernel
+from hclib_tpu.device.workloads import (
+    UTS_NODE,
+    device_uts_mk,
+    make_uts_megakernel,
+)
+from hclib_tpu.jaxcompat import has_mosaic_interpret
+from hclib_tpu.runtime import resilience
+from hclib_tpu.runtime.checkpoint import (
+    CheckpointBundle,
+    CheckpointError,
+    checkpoint_on_preempt,
+    restore_megakernel,
+    restore_resident,
+    restore_stream,
+    snapshot_megakernel,
+    snapshot_resident,
+    snapshot_stream,
+)
+
+needs_mosaic = pytest.mark.skipif(
+    not has_mosaic_interpret(),
+    reason="needs the Mosaic TPU interpret mode (pltpu.InterpretParams, "
+           "jax >= 0.5): the ICI mesh kernels simulate remote DMA + "
+           "semaphores on CPU",
+)
+
+UTS_KW = dict(max_depth=8, interpret=True)
+
+
+def _uts_builder():
+    b = TaskGraphBuilder()
+    b.add(UTS_NODE, args=[1, 0])
+    return b
+
+
+# ------------------------------------------------ megakernel round trips
+
+
+def test_uts_checkpoint_then_restore_bit_identical():
+    """ACCEPTANCE (dynamic tree): quiesce the seeded UTS traversal at
+    round k, resume from the exported state, and the final node count +
+    executed totals are bit-identical to the uninterrupted run."""
+    nodes, info_full = device_uts_mk(**UTS_KW)
+    assert nodes > 100  # the tree is a real traversal, not a stub
+    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    iv_q, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 3)
+    assert info_q["quiesced"] is True
+    assert info_q["pending"] > 0  # genuinely mid-tree
+    assert info_q["quiesce"]["executed_at"] >= nodes // 3
+    iv_r, _, info_r = mk.resume(info_q["state"])
+    assert int(iv_r[0]) == nodes
+    assert info_r["executed"] == info_full["executed"] == nodes
+    assert info_r["pending"] == 0
+
+
+def test_checkpoint_chains_and_quiesce_past_end_is_clean():
+    """A resumed run can be quiesced AGAIN (chained checkpoints); a
+    quiesce threshold past the workload size never fires and the run
+    completes normally."""
+    nodes, _ = device_uts_mk(**UTS_KW)
+    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    _, _, q1 = mk.run(_uts_builder(), quiesce=nodes // 4)
+    _, _, q2 = mk.resume(q1["state"], quiesce=nodes // 2)
+    assert q2["quiesced"] and q2["pending"] > 0
+    iv, _, done = mk.resume(q2["state"])
+    assert int(iv[0]) == nodes and done["pending"] == 0
+    # Threshold past the end: completes, not quiesced, no state attached.
+    iv2, _, info2 = mk.run(_uts_builder(), quiesce=10 * nodes)
+    assert int(iv2[0]) == nodes
+    assert info2["quiesced"] is False and "state" not in info2
+
+
+def test_checkpoint_off_path_bit_identical_and_guarded():
+    """DeviceFaultPlan discipline: a checkpoint-enabled build that never
+    quiesces produces bit-identical outputs to a plain build, and a plain
+    build refuses quiesce= with a clear error instead of silently
+    ignoring it."""
+    n0, info0 = device_uts_mk(**UTS_KW)
+    mk_on = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    iv_on, _, info_on = mk_on.run(_uts_builder())
+    assert int(iv_on[0]) == n0
+    assert info_on["executed"] == info0["executed"]
+    assert info_on["quiesced"] is False
+    mk_off = make_uts_megakernel(**UTS_KW)
+    with pytest.raises(ValueError, match="checkpoint=True"):
+        mk_off.run(_uts_builder(), quiesce=5)
+    # quiesce=False is OFF (boolean plumbing), never "quiesce now" - on
+    # both the plain and the checkpoint-enabled build.
+    iv_f, _, info_f = mk_off.run(_uts_builder(), quiesce=False)
+    assert int(iv_f[0]) == n0
+    iv_f2, _, info_f2 = mk_on.run(_uts_builder(), quiesce=False)
+    assert int(iv_f2[0]) == n0 and info_f2["quiesced"] is False
+
+
+def test_cholesky_batch_tier_checkpoint_bit_identical(tmp_path):
+    """ACCEPTANCE (static DAG + batched dispatch tier): quiesce the
+    Cholesky factorization mid-graph - batch lanes spill to the ring at
+    the quiesce boundary - restore THROUGH THE ON-DISK BUNDLE (the bf16
+    split caches exercise the extension-dtype round trip), and L is
+    bit-identical to the uninterrupted factor."""
+    from hclib_tpu.device.cholesky import (
+        _from_tiles,
+        build_cholesky_graph,
+        cholesky_buffers,
+        make_cholesky_megakernel,
+    )
+    from hclib_tpu.models.cholesky import make_spd
+
+    nt = 2
+    a = make_spd(256).astype(np.float32)
+    mk_full = make_cholesky_megakernel(nt, interpret=True)
+    _, data_full, info_full = mk_full.run(
+        build_cholesky_graph(nt), data=cholesky_buffers(a, nt)
+    )
+    L_full = np.asarray(data_full["tiles"])
+
+    mk = make_cholesky_megakernel(nt, interpret=True, checkpoint=True)
+    _, _, info_q = mk.run(
+        build_cholesky_graph(nt), data=cholesky_buffers(a, nt), quiesce=2,
+    )
+    assert info_q["quiesced"] and info_q["pending"] > 0
+    path = str(tmp_path / "chol-ckpt")
+    snapshot_megakernel(mk, info_q).save(path)
+    mk2 = make_cholesky_megakernel(nt, interpret=True, checkpoint=True)
+    _, data_r, info_r = restore_megakernel(path, mk2)
+    assert info_r["pending"] == 0
+    assert info_r["executed"] == info_full["executed"]
+    assert np.array_equal(np.asarray(data_r["tiles"]), L_full)
+    assert np.array_equal(
+        np.tril(_from_tiles(np.asarray(data_r["tiles"]), nt)),
+        np.tril(_from_tiles(L_full, nt)),
+    )
+
+
+def test_sw_wave_prefetch_checkpoint_bit_identical():
+    """ACCEPTANCE (batch tier + cross-round prefetch): quiesce the wave-
+    DAG SW mid-sweep - the in-flight prefetch drains before lane spill
+    (no DMA outlives the scheduler) - restore, and the full H matrix is
+    bit-identical to the uninterrupted run."""
+    from hclib_tpu.device.smithwaterman import (
+        build_sw_wave_graph,
+        make_sw_wave_megakernel,
+        sw_wave_buffers,
+    )
+    from hclib_tpu.models.smithwaterman import random_seq
+
+    a, b = random_seq(512, 5), random_seq(512, 6)
+
+    def fresh_data():
+        d = sw_wave_buffers(a, b)
+        d["htiles"] = np.zeros((4, 4, 128, 128), np.int32)
+        return d
+
+    mk_full = make_sw_wave_megakernel(4, 4, interpret=True, chunk=1,
+                                      width=2)
+    iv_f, out_f, info_f = mk_full.run(
+        build_sw_wave_graph(4, 4, chunk=1), data=fresh_data()
+    )
+    h_full = np.asarray(out_f["htiles"])
+
+    mk = make_sw_wave_megakernel(4, 4, interpret=True, chunk=1, width=2,
+                                 checkpoint=True)
+    _, _, info_q = mk.run(
+        build_sw_wave_graph(4, 4, chunk=1), data=fresh_data(), quiesce=6,
+    )
+    assert info_q["quiesced"] and info_q["pending"] > 0
+    iv_r, out_r, info_r = mk.resume(info_q["state"])
+    assert np.array_equal(np.asarray(out_r["htiles"]), h_full)
+    assert int(iv_r[0]) == int(iv_f[0])  # best score
+    assert info_r["executed"] == info_f["executed"]
+
+
+# -------------------------------------------------------- bundle on disk
+
+
+def test_bundle_save_load_restore_and_metrics(tmp_path):
+    """Versioned on-disk artifact: quiesce -> snapshot -> save (npz +
+    manifest, sha256) -> load -> restore onto a FRESHLY built megakernel;
+    checkpoint size/duration land in the MetricsRegistry."""
+    nodes, _ = device_uts_mk(**UTS_KW)
+    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    _, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 2)
+    bundle = snapshot_megakernel(mk, info_q)
+    reg = hc.MetricsRegistry()
+    path = str(tmp_path / "ckpt")
+    stats = bundle.save(path, metrics=reg)
+    assert stats["bundle_bytes"] > 0 and os.path.exists(
+        os.path.join(path, "manifest.json")
+    )
+    snap = reg.snapshot()["metrics"]
+    assert snap["checkpoint.bundle_bytes"] == stats["bundle_bytes"]
+    assert "checkpoint.save_s" in snap
+    # Restore on a fresh (same-code) kernel, straight from disk.
+    mk2 = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    iv, _, info = restore_megakernel(path, mk2)
+    assert int(iv[0]) == nodes and info["pending"] == 0
+
+
+def test_bundle_corruption_and_version_rejected(tmp_path):
+    import json
+
+    nodes, _ = device_uts_mk(**UTS_KW)
+    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    _, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 2)
+    path = str(tmp_path / "ckpt")
+    snapshot_megakernel(mk, info_q).save(path)
+    npz = os.path.join(path, "state.npz")
+    blob = open(npz, "rb").read()
+    with open(npz, "wb") as f:  # flip bytes: sha256 must catch it
+        f.write(blob[:-8] + b"\x00" * 8)
+    with pytest.raises(CheckpointError, match="corrupt"):
+        CheckpointBundle.load(path)
+    with open(npz, "wb") as f:
+        f.write(blob)
+    man_path = os.path.join(path, "manifest.json")
+    man = json.load(open(man_path))
+    man["version"] = 99
+    json.dump(man, open(man_path, "w"))
+    with pytest.raises(CheckpointError, match="version 99"):
+        CheckpointBundle.load(path)
+    man["version"] = 1
+    man["magic"] = "something-else"
+    json.dump(man, open(man_path, "w"))
+    with pytest.raises(CheckpointError, match="magic"):
+        CheckpointBundle.load(path)
+
+
+def test_restore_rejects_mismatched_program():
+    """A bundle only restores onto the SAME program shape: F_FN words
+    index the kernel table positionally, so a different table must be
+    refused, not silently misdispatched."""
+    nodes, _ = device_uts_mk(**UTS_KW)
+    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    _, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 2)
+    bundle = snapshot_megakernel(mk, info_q)
+    other = Megakernel(
+        kernels=[("bump", lambda ctx: ctx.set_value(0, ctx.value(0) + 1))],
+        capacity=64, num_values=16, succ_capacity=8, interpret=True,
+        checkpoint=True,
+    )
+    with pytest.raises(CheckpointError, match="kernel_names"):
+        restore_megakernel(bundle, other)
+    wrong_cap = make_uts_megakernel(checkpoint=True, capacity=512,
+                                    **UTS_KW)
+    with pytest.raises(CheckpointError, match="capacity"):
+        restore_megakernel(bundle, wrong_cap)
+    with pytest.raises(CheckpointError, match="megakernel"):
+        restore_stream(bundle, StreamingMegakernel(mk))
+    # And non-quiesced info has no exportable state.
+    with pytest.raises(CheckpointError, match="no quiesced state"):
+        snapshot_megakernel(mk, {"executed": 1})
+
+
+# -------------------------------------------------------- streaming-inject
+
+
+def _bump_mk(checkpoint=False):
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    return Megakernel(
+        kernels=[("bump", bump)], capacity=512, num_values=64,
+        succ_capacity=8, interpret=True, checkpoint=checkpoint,
+    )
+
+
+def test_streaming_checkpoint_roundtrip(tmp_path):
+    """Quiesce a live stream mid-drain, bundle it, restore on a FRESH
+    stream object, inject more work there, and the grand total is exact -
+    nothing lost at the cut (unconsumed ring rows ride the bundle)."""
+    sm = StreamingMegakernel(_bump_mk(checkpoint=True), ring_capacity=512)
+    b = TaskGraphBuilder()
+    for i in range(10):
+        b.add(0, args=[i + 1])
+    for i in range(10, 40):
+        sm.inject(0, args=[i + 1])
+    sm.quiesce(after_executed=12)
+    iv, info = sm.run_stream(b, quantum=4, deadline_s=120.0)
+    assert info["quiesced"] and info["executed"] >= 12
+    assert info["quiesce_latency_s"] is not None
+    # The quiesced stream is closed: producers fail fast.
+    with pytest.raises(RuntimeError, match="closed"):
+        sm.inject(0, args=[99])
+    path = str(tmp_path / "stream-ckpt")
+    snapshot_stream(sm, info).save(path)
+    sm2 = StreamingMegakernel(_bump_mk(checkpoint=True), ring_capacity=512)
+    for i in range(40, 45):
+        sm2.inject(0, args=[i + 1])
+    sm2.close()
+    iv2, info2 = restore_stream(
+        CheckpointBundle.load(path), sm2, quantum=64, deadline_s=120.0,
+    )
+    assert int(iv2[0]) == 45 * 46 // 2
+    assert info2["executed"] == 45
+
+
+def test_streaming_same_object_resume_and_drained_cut():
+    """Two review-hardened paths: (1) resuming on the SAME stream object
+    clears the quiesce request and the quiesce-induced close, so the
+    continued run drains instead of instantly re-quiescing (an explicit
+    close() stays sticky across the resume - drain-and-exit works); (2) a
+    quiesce threshold the workload never reaches cuts host-side once the
+    stream drains (observed round -1) instead of spinning run_stream
+    forever."""
+    sm = StreamingMegakernel(_bump_mk(checkpoint=True), ring_capacity=256)
+    b = TaskGraphBuilder()
+    for i in range(30):
+        b.add(0, args=[i + 1])
+    sm.quiesce(after_executed=10)
+    iv, info = sm.run_stream(b, quantum=4, deadline_s=120.0)
+    assert info["quiesced"] and info["pending"] > 0
+    # resume_state carries its own buffers: passing more is refused, not
+    # silently ignored (parity with ResidentKernel.run's guard).
+    with pytest.raises(ValueError, match="carries its own"):
+        sm.run_stream(resume_state=info["state"],
+                      ivalues=np.zeros(64, np.int32))
+    sm.close()  # explicit: must survive the same-object resume
+    iv2, info2 = sm.run_stream(resume_state=info["state"],
+                               deadline_s=120.0)
+    assert int(iv2[0]) == 30 * 31 // 2
+    assert info2["pending"] == 0 and not info2.get("quiesced")
+
+    sm3 = StreamingMegakernel(_bump_mk(checkpoint=True), ring_capacity=64)
+    b3 = TaskGraphBuilder()
+    b3.add(0, args=[5])
+    sm3.quiesce(after_executed=1 << 30)  # unreachable threshold
+    iv3, info3 = sm3.run_stream(b3, quantum=64, deadline_s=120.0)
+    assert info3["quiesced"] is True
+    assert info3["quiesce_observed_round"] == -1  # host-side drained cut
+    assert info3["pending"] == 0 and int(iv3[0]) == 5
+
+
+def test_preempt_hook_quiesces_running_stream():
+    """The preemption path end to end: fire_preempt (what SIGTERM /
+    HCLIB_TPU_PREEMPT / the watchdog checkpoint rung call) lands while
+    the stream runs; the bound hook quiesces it, and run_stream returns a
+    restorable snapshot instead of losing the graph."""
+    resilience.reset_preempt()
+    # Ring sized so the feeder cannot exhaust it before the preemption
+    # lands even on a slow box (~0.1s / 5ms period ≈ 20 rows queued).
+    sm = StreamingMegakernel(_bump_mk(checkpoint=True),
+                             ring_capacity=2048)
+    b = TaskGraphBuilder()
+    b.add(0, args=[1])
+    stop = threading.Event()
+
+    def feeder():
+        while not stop.is_set():
+            try:
+                sm.inject(0, args=[1])
+            except RuntimeError:
+                return  # quiesce closed the ring - expected
+            time.sleep(0.005)
+
+    def preempter():
+        time.sleep(0.1)
+        assert resilience.fire_preempt("test preemption") >= 1
+
+    tf = threading.Thread(target=feeder)
+    tp = threading.Thread(target=preempter)
+    try:
+        with checkpoint_on_preempt(sm):
+            tf.start()
+            tp.start()
+            iv, info = sm.run_stream(b, quantum=16, deadline_s=120.0)
+        assert info["quiesced"] is True
+        assert "state" in info
+        # Restorable: drain the snapshot to completion on a fresh stream.
+        sm2 = StreamingMegakernel(_bump_mk(checkpoint=True),
+                                  ring_capacity=2048)
+        sm2.close()
+        iv2, info2 = sm2.run_stream(
+            resume_state=info["state"], deadline_s=120.0
+        )
+        assert info2["pending"] == 0
+        assert int(iv2[0]) == info2["executed"]  # every bump(1) landed once
+    finally:
+        stop.set()
+        tp.join()
+        tf.join()
+        resilience.reset_preempt()
+    assert not resilience._preempt_hooks  # context manager unregistered
+
+
+def test_preempt_env_replays_into_new_bindings(monkeypatch):
+    """HCLIB_TPU_PREEMPT set before the stream starts (the wrapper-script
+    spelling): register-then-replay quiesces it immediately, so even a
+    notice that predates the run checkpoints instead of racing it."""
+    resilience.reset_preempt()
+    monkeypatch.setenv("HCLIB_TPU_PREEMPT", "1")
+    sm = StreamingMegakernel(_bump_mk(checkpoint=True), ring_capacity=64)
+    b = TaskGraphBuilder()
+    b.add(0, args=[7])
+    try:
+        with checkpoint_on_preempt(sm):
+            iv, info = sm.run_stream(b, quantum=16, deadline_s=120.0)
+        assert info["quiesced"] is True
+    finally:
+        resilience.reset_preempt()
+
+
+def test_install_preempt_handler_fires_hooks():
+    """The SIGTERM handler wiring: install, raise the signal in-process,
+    and the registered hook fires (on the handler's deferred daemon
+    thread - signal frames must not take hook locks); uninstall restores
+    the previous handler."""
+    import signal
+
+    resilience.reset_preempt()
+    fired = threading.Event()
+    hook = fired.set
+    resilience.register_preempt_hook(hook)
+    uninstall = resilience.install_preempt_handler()
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert resilience.preempt_requested()  # flag set in the frame
+        assert fired.wait(10.0), "SIGTERM did not reach the preempt hooks"
+    finally:
+        uninstall()
+        resilience.unregister_preempt_hook(hook)
+        resilience.reset_preempt()
+
+
+# ------------------------------------------------------- resident mesh
+
+
+def _mesh_uts_rk(ndev, checkpoint=True, capacity=256):
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    mk = make_uts_megakernel(
+        max_depth=6, interpret=True, capacity=capacity,
+        checkpoint=checkpoint,
+    )
+    # homed=False: UTS rows are link-free (count-accumulate only), so
+    # round-3 whole-row migration suffices - and it keeps the quiesced
+    # state proxy-free, which is what makes N -> M re-homing legal.
+    return ResidentKernel(
+        mk, cpu_mesh(ndev, axis_name="q"), migratable_fns=[UTS_NODE],
+        window=4, homed=False,
+    )
+
+
+def _mesh_uts_builders(ndev):
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    for d in range(ndev):
+        builders[d].add(UTS_NODE, args=[d + 1, 0])
+    return builders
+
+
+def test_resident_quiesce_validation_needs_no_mesh():
+    """Host-side guards (no Mosaic needed): quiesce on a non-checkpoint
+    build, quiesce with waits, and resume_state conflicts all refuse
+    before any kernel builds."""
+    rk = _mesh_uts_rk(2, checkpoint=False)
+    with pytest.raises(ValueError, match="checkpoint=True"):
+        rk.run(_mesh_uts_builders(2), quiesce=1)
+    rk2 = _mesh_uts_rk(2, checkpoint=True)
+    with pytest.raises(ValueError, match="waits"):
+        rk2.run(_mesh_uts_builders(2), quiesce=1, waits=[[(0, 1, 0)]])
+    with pytest.raises(ValueError, match="exactly one"):
+        rk2.run(_mesh_uts_builders(2), resume_state={})
+    with pytest.raises(ValueError, match="exactly one"):
+        rk2.run()
+
+
+def test_reshard_refuses_unsafe_rows():
+    """N -> M re-homing moves only ready link-free rows (the PR 2
+    dead-chip semantics): dependent rows, successor links, home-links,
+    and dynamic out slots are refused with a diagnostic."""
+    from hclib_tpu.device.descriptor import (
+        DESC_WORDS, F_DEP, F_HOME, F_OUT, F_SUCC0, NO_TASK,
+    )
+
+    def fake_bundle(mutate):
+        ndev, cap, V = 2, 8, 16
+        tasks = np.zeros((ndev, cap, DESC_WORDS), np.int32)
+        tasks[:, :, F_SUCC0] = NO_TASK
+        tasks[:, :, 2:4] = NO_TASK
+        tasks[:, :, F_HOME] = NO_TASK
+        counts = np.zeros((ndev, 8), np.int32)
+        counts[:, 1] = 1  # tail
+        counts[:, 2] = 1  # alloc
+        counts[:, 3] = 1  # pending
+        counts[:, 4] = 2  # value_alloc
+        ready = np.zeros((ndev, cap), np.int32)
+        mutate(tasks)
+        return CheckpointBundle(
+            "resident", {"ndev": ndev},
+            {
+                "tasks": tasks, "succ": np.full((ndev, 8), -1, np.int32),
+                "ready": ready, "counts": counts,
+                "ivalues": np.zeros((ndev, V), np.int32),
+            },
+        )
+
+    ok = fake_bundle(lambda t: None).reshard(1)
+    assert int(ok.arrays["counts"][0][3]) == 2  # both rows re-homed
+
+    def dep(t):
+        t[0, 0, F_DEP] = 1
+
+    with pytest.raises(CheckpointError, match="dependency counter"):
+        fake_bundle(dep).reshard(1)
+
+    def linked(t):
+        t[0, 0, F_SUCC0] = 1
+
+    with pytest.raises(CheckpointError, match="successor links"):
+        fake_bundle(linked).reshard(1)
+
+    def homed(t):
+        t[0, 0, F_HOME] = 1
+
+    with pytest.raises(CheckpointError, match="home-link"):
+        fake_bundle(homed).reshard(1)
+
+    def dyn_out(t):
+        t[0, 0, F_OUT] = 5  # >= value_alloc 2
+
+    with pytest.raises(CheckpointError, match="dynamic out slot"):
+        fake_bundle(dyn_out).reshard(1)
+    with pytest.raises(CheckpointError, match="power-of-two"):
+        fake_bundle(lambda t: None).reshard(3)
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_resident_mesh_checkpoint_roundtrip_same_mesh():
+    """ACCEPTANCE: quiesce a 4-device resident mesh mid-traversal (the
+    fold observes the word, sched stops popping, the wire drains, the
+    mesh exits in lockstep), resume on the same mesh size, and the totals
+    equal the uninterrupted run exactly."""
+    ndev = 4
+    rk_full = _mesh_uts_rk(ndev)
+    iv_f, _, info_f = rk_full.run(
+        _mesh_uts_builders(ndev), quantum=8, max_rounds=4096
+    )
+    total = int(np.asarray(iv_f)[:, 0].sum())
+    assert info_f["pending"] == 0 and total == info_f["executed"]
+
+    rk = _mesh_uts_rk(ndev)
+    iv_q, _, info_q = rk.run(
+        _mesh_uts_builders(ndev), quantum=8, max_rounds=4096, quiesce=2,
+    )
+    assert info_q["quiesced"] is True
+    assert info_q["pending"] > 0
+    fs = info_q["fault_stats"]
+    assert all(f["quiesce_round"] >= 2 for f in fs)  # threshold honored
+    iv_r, _, info_r = rk.run(
+        resume_state=info_q["state"], quantum=8, max_rounds=4096
+    )
+    assert info_r["pending"] == 0
+    assert info_r["executed"] == info_f["executed"]
+    assert int(np.asarray(iv_r)[:, 0].sum()) == total
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_resident_mesh_restore_onto_smaller_and_larger_mesh(tmp_path):
+    """ACCEPTANCE (elastic resume): a 4-chip checkpoint restores onto 2
+    chips (and a 2-chip one onto 4) - per-chip queues re-homed host-side
+    with the dead-chip conservation semantics, the full workload drains,
+    totals conserved exactly."""
+    ndev = 4
+    rk_full = _mesh_uts_rk(ndev)
+    iv_f, _, info_f = rk_full.run(
+        _mesh_uts_builders(ndev), quantum=8, max_rounds=4096
+    )
+    total = int(np.asarray(iv_f)[:, 0].sum())
+
+    rk = _mesh_uts_rk(ndev)
+    _, _, info_q = rk.run(
+        _mesh_uts_builders(ndev), quantum=8, max_rounds=4096, quiesce=2,
+    )
+    bundle = snapshot_resident(rk, info_q)
+    path = str(tmp_path / "mesh-ckpt")
+    bundle.save(path)
+
+    # 4 -> 2: restore_resident reshards automatically off the manifest.
+    rk_small = _mesh_uts_rk(2)
+    iv_s, _, info_s = restore_resident(
+        CheckpointBundle.load(path), rk_small, quantum=8,
+        max_rounds=4096,
+    )
+    assert info_s["pending"] == 0
+    assert info_s["executed"] == info_f["executed"]
+    assert int(np.asarray(iv_s)[:, 0].sum()) == total
+
+    # 2 -> 4: checkpoint the 2-chip run, grow back to 4.
+    rk2 = _mesh_uts_rk(2)
+    _, _, info_q2 = rk2.run(
+        _mesh_uts_builders(2), quantum=8, max_rounds=4096, quiesce=2,
+    )
+    if info_q2["pending"] > 0:
+        rk_big = _mesh_uts_rk(4)
+        iv_b, _, info_b = restore_resident(
+            snapshot_resident(rk2, info_q2), rk_big, quantum=8,
+            max_rounds=4096,
+        )
+        assert info_b["pending"] == 0
+        # 2-chip seeds 1,2 are a subset of the 4-chip run's totals: check
+        # against the 2-chip uninterrupted run instead.
+        rk2_full = _mesh_uts_rk(2)
+        iv2_f, _, info2_f = rk2_full.run(
+            _mesh_uts_builders(2), quantum=8, max_rounds=4096
+        )
+        assert info_b["executed"] == info2_f["executed"]
+        assert (
+            int(np.asarray(iv_b)[:, 0].sum())
+            == int(np.asarray(iv2_f)[:, 0].sum())
+        )

@@ -19,10 +19,18 @@ a dropped ICI steal credit healed by timeout + regeneration. They need
 the Mosaic TPU interpret mode (jax >= 0.5); on older builds they report
 as skipped, not failed.
 
+``--preempt`` adds the seeded PREEMPTION scenarios (ISSUE 5): checkpoint
+a UTS megakernel mid-traversal and restore it bit-exactly from the
+on-disk bundle; fire_preempt (the SIGTERM/watchdog path) quiescing a
+live injection stream whose snapshot then drains exactly; and a
+resident-mesh checkpoint restored onto a SMALLER mesh (N->M re-homing,
+totals conserved - Mosaic-gated like the other mesh scenarios).
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
     python tools/chaos_soak.py --mesh --seeds 1   # device-mesh chaos (CI)
+    python tools/chaos_soak.py --preempt-only --seeds 1  # checkpoint (CI)
 
 One JSON line per scenario; a machine-readable summary line last (seed
 base/count, faults injected, recoveries, failures, wall time) so CI and
@@ -319,6 +327,148 @@ def scenario_mesh_dropped_credit(seed: int, scale: str) -> dict:
             "rounds": info["rounds"]}
 
 
+# --------------------------------------- preemption checkpoint (ISSUE 5)
+
+def scenario_preempt_checkpoint(seed: int, scale: str) -> dict:
+    """Seeded preemption mid-UTS-traversal: quiesce at a round boundary,
+    bundle to disk (npz + checksummed manifest), restore on a FRESH
+    megakernel, and the final totals are bit-identical to the
+    uninterrupted run - the fault is the preemption, the recovery is the
+    checkpoint/restore round trip."""
+    import tempfile
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import (
+        UTS_NODE, device_uts_mk, make_uts_megakernel,
+    )
+    from hclib_tpu.runtime.checkpoint import (
+        restore_megakernel, snapshot_megakernel,
+    )
+
+    kw = dict(seed=19 + seed, interpret=True,
+              max_depth=7 if scale == "smoke" else 9)
+    nodes, _ = device_uts_mk(**kw)
+    mk = make_uts_megakernel(checkpoint=True, **kw)
+    b = TaskGraphBuilder()
+    b.add(UTS_NODE, args=[1, 0])
+    t0 = time.monotonic()
+    _, _, info_q = mk.run(b, quiesce=max(1, nodes // 3))
+    quiesce_s = time.monotonic() - t0
+    assert info_q["quiesced"] and info_q["pending"] > 0, info_q
+    d = tempfile.mkdtemp(prefix="hclib-ckpt-")
+    stats = snapshot_megakernel(mk, info_q).save(d)
+    iv, _, info_r = restore_megakernel(
+        d, make_uts_megakernel(checkpoint=True, **kw)
+    )
+    assert int(iv[0]) == nodes, (int(iv[0]), nodes)
+    assert info_r["executed"] == nodes and info_r["pending"] == 0
+    return {"faults": 1, "recoveries": 1, "nodes": nodes,
+            "checkpoint_at": info_q["quiesce"]["executed_at"],
+            "bundle_bytes": stats["bundle_bytes"],
+            "quiesce_s": round(quiesce_s, 3)}
+
+
+def scenario_preempt_stream(seed: int, scale: str) -> dict:
+    """fire_preempt (the SIGTERM/watchdog path) lands mid-stream: the
+    bound hook quiesces it, the snapshot restores on a fresh stream, and
+    the drain is exact - totals conserved across the preemption."""
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.runtime import resilience
+    from hclib_tpu.runtime.checkpoint import checkpoint_on_preempt
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    def make_sm():
+        return StreamingMegakernel(
+            Megakernel(kernels=[("bump", bump)], capacity=512,
+                       num_values=64, succ_capacity=8, interpret=True,
+                       checkpoint=True),
+            ring_capacity=512,
+        )
+
+    resilience.reset_preempt()
+    n = 60 if scale == "smoke" else 300
+    sm = make_sm()
+    b = TaskGraphBuilder()
+    for i in range(10):
+        b.add(0, args=[i + 1])
+    for i in range(10, n):
+        sm.inject(0, args=[i + 1])
+
+    def preempter():
+        time.sleep(0.05 + 0.01 * (seed % 3))
+        resilience.fire_preempt(f"soak preemption seed {seed}")
+
+    t = threading.Thread(target=preempter)
+    t.start()
+    try:
+        with checkpoint_on_preempt(sm, after_executed=5):
+            iv, info = sm.run_stream(b, quantum=8, deadline_s=120.0)
+    finally:
+        t.join()
+        resilience.reset_preempt()
+    assert info.get("quiesced"), "preemption never quiesced the stream"
+    sm2 = make_sm()
+    sm2.close()
+    iv2, info2 = sm2.run_stream(resume_state=info["state"],
+                                deadline_s=120.0)
+    want = n * (n + 1) // 2
+    assert int(iv2[0]) == want, (int(iv2[0]), want)
+    return {"faults": 1, "recoveries": 1, "injected": n,
+            "executed_at_cut": info["executed"]}
+
+
+def scenario_preempt_mesh_reshard(seed: int, scale: str) -> dict:
+    """Resident-mesh preemption with ELASTIC resume: quiesce a 4-chip
+    interpret mesh mid-traversal, restore the bundle onto 2 chips (queues
+    re-homed host-side, PR 2 conservation semantics), totals exact."""
+    skip = _mesh_prereq()
+    if skip:
+        return {"skipped": skip}
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.workloads import UTS_NODE, make_uts_megakernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+    from hclib_tpu.runtime.checkpoint import (
+        restore_resident, snapshot_resident,
+    )
+    import numpy as np
+
+    def make_rk(ndev):
+        mk = make_uts_megakernel(seed=19 + seed, max_depth=6,
+                                 interpret=True, checkpoint=True)
+        return ResidentKernel(
+            mk, cpu_mesh(ndev, axis_name="q"),
+            migratable_fns=[UTS_NODE], window=4, homed=False,
+        )
+
+    def builders(ndev):
+        bs = [TaskGraphBuilder() for _ in range(ndev)]
+        for d in range(ndev):
+            bs[d].add(UTS_NODE, args=[d + 1, 0])
+        return bs
+
+    iv_f, _, info_f = make_rk(4).run(builders(4), quantum=8,
+                                     max_rounds=4096)
+    total = int(np.asarray(iv_f)[:, 0].sum())
+    rk = make_rk(4)
+    _, _, info_q = rk.run(builders(4), quantum=8, max_rounds=4096,
+                          quiesce=2)
+    assert info_q["quiesced"], info_q
+    iv_r, _, info_r = restore_resident(
+        snapshot_resident(rk, info_q), make_rk(2), quantum=8,
+        max_rounds=4096,
+    )
+    assert info_r["pending"] == 0
+    assert int(np.asarray(iv_r)[:, 0].sum()) == total
+    return {"faults": 1, "recoveries": 1, "total": total,
+            "executed": info_r["executed"],
+            "pending_at_cut": info_q["pending"]}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -330,6 +480,12 @@ SCENARIOS = [
 MESH_SCENARIOS = [
     ("mesh_dead_chip", scenario_mesh_dead_chip),
     ("mesh_dropped_credit", scenario_mesh_dropped_credit),
+]
+
+PREEMPT_SCENARIOS = [
+    ("preempt_checkpoint", scenario_preempt_checkpoint),
+    ("preempt_stream", scenario_preempt_stream),
+    ("preempt_mesh_reshard", scenario_preempt_mesh_reshard),
 ]
 
 
@@ -344,6 +500,12 @@ def main(argv=None) -> int:
                          "(dead chip, dropped steal credit)")
     ap.add_argument("--mesh-only", action="store_true",
                     help="run ONLY the device-mesh chaos scenarios")
+    ap.add_argument("--preempt", action="store_true",
+                    help="add the seeded preemption scenarios "
+                         "(checkpoint mid-run, restore, totals "
+                         "conserved; incl. N->M mesh reshard)")
+    ap.add_argument("--preempt-only", action="store_true",
+                    help="run ONLY the preemption scenarios")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -353,11 +515,16 @@ def main(argv=None) -> int:
                          "with all-thread stack dumps")
     args = ap.parse_args(argv)
 
-    scenarios = list(SCENARIOS)
-    if args.mesh_only:
-        scenarios = list(MESH_SCENARIOS)
-    elif args.mesh:
+    # An -only flag drops the base suite; the group flags are additive
+    # on top of whatever remains, so every combination runs exactly the
+    # groups it names (e.g. --mesh-only --preempt = mesh + preempt).
+    scenarios = (
+        [] if (args.mesh_only or args.preempt_only) else list(SCENARIOS)
+    )
+    if args.mesh or args.mesh_only:
         scenarios += MESH_SCENARIOS
+    if args.preempt or args.preempt_only:
+        scenarios += PREEMPT_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

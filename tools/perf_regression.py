@@ -160,6 +160,59 @@ def _instrument_overhead(quick: bool, trials: int) -> dict:
     }
 
 
+def _checkpoint_overhead(quick: bool, trials: int) -> dict:
+    """Checkpoint-tax guard (ISSUE 5): the same seeded UTS megakernel
+    traversal with checkpoint support off vs compiled-in-but-never-
+    quiesced (min-of-N each, interleaved arms like the instrument guard).
+    The quiesce word must never silently tax a run that doesn't
+    checkpoint; the enabled-but-idle path is bounded by
+    --checkpoint-tolerance (it pays one qctl DMA per scheduling round).
+    Also measures the quiesce LAG - how far past the requested round the
+    boundary landed, in tasks - which must stay within one batch width
+    (the same overshoot contract fuel has)."""
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import (
+        UTS_NODE, make_uts_megakernel,
+    )
+
+    kw = dict(interpret=True, max_depth=6 if quick else 8)
+
+    def builder():
+        b = TaskGraphBuilder()
+        b.add(UTS_NODE, args=[1, 0])
+        return b
+
+    mk_off = make_uts_megakernel(**kw)
+    mk_on = make_uts_megakernel(checkpoint=True, **kw)
+    nodes = mk_off.run(builder())[2]["executed"]  # also warms the jit
+    mk_on.run(builder())  # warm the enabled build too
+    n = max(2, trials)
+    base, on = [], []
+    for _ in range(n):
+        t0 = time.perf_counter_ns()
+        mk_off.run(builder())
+        base.append(time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
+        mk_on.run(builder())
+        on.append(time.perf_counter_ns() - t0)
+    # Quiesce latency: request the cut at half the tree; the observed
+    # boundary must not drift (lag in tasks) and the quiesced entry must
+    # not cost more than an uninterrupted run (it does strictly less).
+    at = nodes // 2
+    t0 = time.perf_counter_ns()
+    _, _, info_q = mk_on.run(builder(), quiesce=at)
+    quiesce_ns = time.perf_counter_ns() - t0
+    lag = info_q["quiesce"]["executed_at"] - at
+    return {
+        "base_ns": min(base),
+        "checkpoint_ns": min(on),
+        "ratio": min(on) / min(base),
+        "nodes": nodes,
+        "quiesce_entry_ns": quiesce_ns,
+        "quiesce_lag_tasks": int(lag),
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -197,6 +250,10 @@ def main(argv=None) -> int:
     ap.add_argument("--instrument-tolerance", type=float, default=3.0,
                     help="max instrument=True slowdown ratio (the "
                     "flight-recorder/EventLog overhead guard)")
+    ap.add_argument("--checkpoint-tolerance", type=float, default=3.0,
+                    help="max checkpoint-enabled-but-idle slowdown ratio "
+                    "(the quiesce-word overhead guard; the off path is "
+                    "compiled out entirely)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -266,6 +323,38 @@ def main(argv=None) -> int:
                     "taxing the hot path"
                 )
                 line += "  REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "checkpoint-overhead" in wanted:
+        try:
+            co = _checkpoint_overhead(args.quick, args.trials)
+        except Exception as e:
+            print(f"checkpoint-overhead FAILED: {e}", file=sys.stderr)
+            failures.append(f"checkpoint-overhead: failed ({e})")
+        else:
+            results["checkpoint-overhead"] = co
+            line = (
+                f"{'checkpoint-overhead':15s} ratio {co['ratio']:5.2f}x "
+                f"({co['checkpoint_ns'] / 1e6:.1f} ms vs "
+                f"{co['base_ns'] / 1e6:.1f} ms, {co['nodes']} nodes; "
+                f"quiesce lag {co['quiesce_lag_tasks']} tasks)"
+            )
+            if co["ratio"] > args.checkpoint_tolerance:
+                failures.append(
+                    f"checkpoint-overhead: checkpoint=True (idle) is "
+                    f"{co['ratio']:.2f}x slower (bound "
+                    f"{args.checkpoint_tolerance:.2f}x) - the quiesce "
+                    "word is taxing the round loop"
+                )
+                line += "  REGRESSED"
+            if co["quiesce_lag_tasks"] > 8:
+                failures.append(
+                    f"checkpoint-overhead: quiesce landed "
+                    f"{co['quiesce_lag_tasks']} tasks past the requested "
+                    "round - the boundary latency contract (<= one batch "
+                    "width) regressed"
+                )
+                line += "  LAG-REGRESSED"
             print(line, flush=True)
 
     if args.device:

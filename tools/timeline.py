@@ -360,6 +360,7 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
             })
 
         open_rounds: List[Tuple[int, Dict]] = []
+        quiesce_at: Optional[int] = None
         for tag, t, a, b in recs.tolist():
             if tag == tb.TR_ROUND_BEGIN:
                 open_rounds.append((t, {"backlog": a, "pending": b}))
@@ -384,6 +385,20 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
             elif tag == tb.TR_SPILL:
                 span(_TID_LANES + a, f"lane fn{a}", t, 0.25,
                      "spill", {"count": b})
+            elif tag == tb.TR_QUIESCE:
+                quiesce_at = t
+                span(_TID_EVENTS, "events", t, 0.25, "quiesce",
+                     {"at": a})
+            elif tag == tb.TR_CKPT:
+                # The checkpoint bracket: quiesce observation -> state
+                # export, rendered as one span so the drain cost (lane
+                # spills, wire settling on the mesh) is readable at a
+                # glance in Perfetto.
+                q0 = quiesce_at if quiesce_at is not None else t
+                span(_TID_EVENTS, "events", q0, max(t - q0, 0) + 0.5,
+                     "checkpoint (quiesce→export)",
+                     {"pending": a, "ready_backlog": b})
+                quiesce_at = None
             else:
                 name = tb.TAG_NAMES.get(tag, f"tag{tag}")
                 span(_TID_EVENTS, "events", t, 0.25, name,
