@@ -810,6 +810,85 @@ def bench_checkpoint():
     return out
 
 
+def bench_autoscale():
+    """Elastic-autoscaling cost of record (ISSUE 6): resize latency
+    (quiesced state -> resumable state across a reshard) and tasks/s
+    sustained THROUGH scale events, for an autoscaled UTS mesh that
+    scales 2 -> 4 under backlog and back in on the idle tail. Written to
+    perf-logs/<ts>.autoscale.json. Needs the Mosaic interpret mode on
+    CPU hosts (the resident mesh simulates remote DMA); logged as a skip
+    otherwise."""
+    import jax
+
+    from hclib_tpu.jaxcompat import has_mosaic_interpret
+
+    if jax.default_backend() != "tpu" and not has_mosaic_interpret():
+        log("autoscale bench: no TPU and no Mosaic interpret mode; skip")
+        return None
+    import hclib_tpu as hc
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.workloads import UTS_NODE, make_uts_megakernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    def make_kernel(ndev):
+        mk = make_uts_megakernel(max_depth=7, interpret=True,
+                                 checkpoint=True)
+        return ResidentKernel(
+            mk, cpu_mesh(ndev, axis_name="q"),
+            migratable_fns=[UTS_NODE], window=4, homed=False,
+        )
+
+    builders = [TaskGraphBuilder() for _ in range(2)]
+    for d in range(2):
+        for r in range(8):
+            builders[d].add(UTS_NODE, args=[d * 8 + r + 1, 0])
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(
+        make_kernel,
+        hc.AutoscalerPolicy(min_devices=1, max_devices=4,
+                            scale_out_backlog=4.0, scale_in_backlog=1.0,
+                            hysteresis=1, cooldown=1),
+        slice_rounds=8, metrics=reg,
+    )
+    t0 = time.perf_counter()
+    iv, _, info = asc.run(builders, quantum=8)
+    wall = time.perf_counter() - t0
+    resizes = [e for e in info["scale_events"]
+               if e["from_ndev"] != e["to_ndev"]]
+    out = {
+        "executed": info["executed"],
+        "wall_s": round(wall, 4),
+        "tasks_per_sec": round(info["executed"] / max(wall, 1e-9)),
+        "slices": len(info["scale_events"]),
+        "resizes": [
+            {
+                "kind": e["kind"], "from": e["from_ndev"],
+                "to": e["to_ndev"],
+                "resize_latency_s": e["resize_latency_s"],
+            }
+            for e in resizes
+        ],
+        "ndev_final": info["ndev_final"],
+    }
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.autoscale.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    lat = [r["resize_latency_s"] for r in out["resizes"]
+           if r["resize_latency_s"] is not None]
+    if lat:
+        log(f"autoscale: {info['executed']} tasks through "
+            f"{len(resizes)} resize(s) at {out['tasks_per_sec']:,} "
+            f"tasks/s; resize latency {max(lat) * 1e3:.1f} ms max")
+    else:
+        log(f"autoscale: {info['executed']} tasks at "
+            f"{out['tasks_per_sec']:,} tasks/s, no resizes fired")
+    log(f"autoscale bench written: {path}")
+    return out
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -823,6 +902,12 @@ def main(argv=None) -> None:
         "--checkpoint", action="store_true",
         help="also measure checkpoint/restore cost (quiesce latency + "
         "bundle size for UTS and Cholesky) into perf-logs/ "
+        "(budget-gated like the other sections)",
+    )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="also measure elastic-autoscaling cost (resize latency + "
+        "tasks/s through a scale event) into perf-logs/ "
         "(budget-gated like the other sections)",
     )
     args = ap.parse_args(argv)
@@ -924,6 +1009,8 @@ def main(argv=None) -> None:
         section("trace artifacts", 60, emit_trace_artifacts)
     if args.checkpoint:
         section("checkpoint/restore", 120, bench_checkpoint)
+    if args.autoscale:
+        section("elastic autoscale", 120, bench_autoscale)
     if sw_wave:
         log(f"wave-DAG SW final: {sw_wave:.1f} GCUPS median (r05 baseline "
             f"1.2; acceptance floor 12)")

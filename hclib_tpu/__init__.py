@@ -18,6 +18,8 @@ Layers:
 from .runtime import (  # noqa: F401
     FLAT,
     RECURSIVE,
+    Autoscaler,
+    AutoscalerPolicy,
     CancelScope,
     CancelledError,
     CheckpointBundle,
@@ -32,12 +34,14 @@ from .runtime import (  # noqa: F401
     MaxReducer,
     MetricsRegistry,
     Module,
+    Observation,
     OrReducer,
     Promise,
     PromiseError,
     Reducer,
     RetryPolicy,
     Runtime,
+    ScaleEvent,
     StallError,
     SumReducer,
     Task,

@@ -120,6 +120,13 @@ class StreamingMegakernel:
             "abort_observed_round": None,
             "abort_latency_s": None,
             "abort_drain_executed": None,
+            # Preempt-storm accounting (ISSUE 6): how many quiesce cuts
+            # this stream object has taken and resumed through, so a
+            # storm soak can assert every injected preemption actually
+            # cut (and the MetricsRegistry can rate() the churn).
+            "quiesces": 0,
+            "resumes": 0,
+            "last_quiesce_latency_s": None,
         }
 
     # ---- lifecycle (resilience: the ring must never stay open) ----
@@ -521,6 +528,7 @@ class StreamingMegakernel:
             with self._lock:
                 self._quiesce_after = None
                 self._quiesce_t = None
+                self._stats["resumes"] += 1
                 if self._closed_by_quiesce:
                     self._closed = False
                     self._closed_by_quiesce = False
@@ -676,6 +684,11 @@ class StreamingMegakernel:
                         self._closed = True
                         self._closed_by_quiesce = True
                     t0 = self._quiesce_t
+                    self._stats["quiesces"] += 1
+                    self._stats["last_quiesce_latency_s"] = (
+                        None if t0 is None
+                        else round(time.monotonic() - t0, 6)
+                    )
                 residue = list(ring[consumed:injected]) + list(late)
                 info = {
                     "executed": int(counts_np[C_EXECUTED]),

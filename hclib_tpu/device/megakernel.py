@@ -176,6 +176,7 @@ QC_AFTER = 1   # honor the flag only once this many tasks ran this entry
 # checkpoint=True) reports the observation back to the host:
 QS_QUIESCED = 0  # 1 = the round loop observed the quiesce word
 QS_AT = 1        # tasks executed since entry at observation
+QS_POLLS = 2     # scheduling rounds ticked (the quiesce_stride counter)
 
 # counts[] slots
 C_HEAD = 0
@@ -679,6 +680,7 @@ class Megakernel:
         auto_route: Optional[Dict[str, Any]] = None,
         trace: Optional[Any] = None,
         checkpoint: Optional[bool] = None,
+        quiesce_stride: Optional[int] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
@@ -720,6 +722,23 @@ class Megakernel:
             checkpoint = bool(env) and env != "0"
             self.checkpoint_from_env = checkpoint
         self.checkpoint = bool(checkpoint)
+        # Quiesce poll stride (checkpoint builds only): the scheduler
+        # re-reads the qctl word from HBM every scheduling round by
+        # default, which is what the checkpoint-overhead guard prices
+        # (~1.2x enabled-idle). ``quiesce_stride=N`` polls every Nth
+        # round instead - the DMA cost amortizes N-fold and a quiesce
+        # request lands at most N-1 rounds later than it would have (the
+        # bounded-latency trade ROADMAP's open item asked to expose).
+        # HCLIB_TPU_QUIESCE_STRIDE sets it process-wide; a malformed or
+        # nonpositive value degrades to 1 (poll every round), never off.
+        if quiesce_stride is None:
+            env = os.environ.get("HCLIB_TPU_QUIESCE_STRIDE", "")
+            if env:
+                try:
+                    quiesce_stride = int(env)
+                except ValueError:
+                    quiesce_stride = 1
+        self.quiesce_stride = max(1, int(quiesce_stride or 1))
         # Dispatch-tier routing: ``route`` maps a kernel NAME to the spec
         # of a non-scalar dispatch tier for that task family. Two tiers:
         #
@@ -1322,13 +1341,14 @@ class Megakernel:
 
     def _kernel(
         self, fuel: int, reps: int, stage_all_values: bool, trace, ckpt,
-        *refs
+        qstride, *refs
     ) -> None:
-        # ``trace``/``ckpt`` are the TraceRing / checkpoint flag captured
-        # when _build_raw fixed the output tree - NOT self.trace: pallas
-        # kernels trace lazily (first call), so reading mutable instance
-        # state here could disagree with the already-built out_shape and
-        # shift every ref slice.
+        # ``trace``/``ckpt``/``qstride`` are the TraceRing / checkpoint
+        # flag / quiesce poll stride captured when _build_raw fixed the
+        # output tree - NOT self.trace: pallas kernels trace lazily
+        # (first call), so reading mutable instance state here could
+        # disagree with the already-built out_shape and shift every ref
+        # slice.
         ndata = len(self.data_specs)
         nbatch = len(self.batch_specs)
         ntrace = 1 if trace is not None else 0
@@ -1373,10 +1393,26 @@ class Megakernel:
                 # host with in-place buffer write access (pinned-host
                 # production) lands a quiesce mid-entry; this driver
                 # uploads qctl at entry, which bounds latency at one
-                # round past the QC_AFTER threshold.
-                cp = pltpu.make_async_copy(qctl, qbuf, qsem.at[0])
-                cp.start()
-                cp.wait()
+                # round past the QC_AFTER threshold. ``quiesce_stride``
+                # > 1 skips the DMA on all but every Nth round (round 0
+                # always polls, so qbuf is never read uninitialized); a
+                # stale qbuf between polls is safe because the host only
+                # ever raises the flag monotonically within an entry -
+                # observation latency grows by at most stride-1 rounds.
+                if qstride > 1:
+                    cnt = qstat[QS_POLLS]
+                    qstat[QS_POLLS] = cnt + 1
+
+                    @pl.when(cnt % qstride == 0)
+                    def _():
+                        cp = pltpu.make_async_copy(qctl, qbuf, qsem.at[0])
+                        cp.start()
+                        cp.wait()
+                else:
+                    qstat[QS_POLLS] = qstat[QS_POLLS] + 1
+                    cp = pltpu.make_async_copy(qctl, qbuf, qsem.at[0])
+                    cp.start()
+                    cp.wait()
                 q = (qbuf[QC_FLAG] != 0) & (executed_since >= qbuf[QC_AFTER])
 
                 @pl.when(q & (qstat[QS_QUIESCED] == 0))
@@ -1500,7 +1536,7 @@ class Megakernel:
         return pl.pallas_call(
             functools.partial(
                 self._kernel, fuel, reps, stage_all_values, self.trace,
-                ckpt,
+                ckpt, self.quiesce_stride,
             ),
             out_shape=out_shape,
             in_specs=in_specs,

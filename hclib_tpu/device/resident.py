@@ -471,8 +471,14 @@ class ResidentKernel:
         ntrace = 1 if trace is not None else 0
         n_in = 7 + ndata + (2 if self.inject else 0)  # + abort word (last)
         in_refs = refs[:n_in]
-        # + fstats, then the optional flight-recorder ring (always last).
-        n_out = 5 + ndata + (1 if self.inject else 0) + ntrace
+        # + fstats, then (checkpoint builds only) the exported wait table
+        # - the lifted scratch limit: quiesce with pending host-declared
+        # waits now exports them instead of refusing - then the optional
+        # flight-recorder ring (always last).
+        n_out = (
+            5 + ndata + (1 if self.inject else 0)
+            + (1 if self.checkpoint else 0) + ntrace
+        )
         out_refs = refs[n_in : n_in + n_out]
         rest = refs[n_in + n_out :]
         nscratch = len(mk.scratch_specs)
@@ -483,6 +489,7 @@ class ResidentKernel:
             head, tail[:n] = tail[:n], []
             return head
 
+        nckpt = 1 if self.checkpoint else 0
         nh = self.nh
         (free, vfree, candbuf, sendbuf, statacc, statsnd) = take(6)
         statrcv = take(nh)
@@ -517,7 +524,8 @@ class ResidentKernel:
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
         if self.inject:
             ctl_out = out_refs[4 + ndata]
-        fstats = out_refs[n_out - 1 - ntrace]
+        fstats = out_refs[n_out - 1 - ntrace - nckpt]
+        waits_out = out_refs[n_out - 1 - ntrace] if self.checkpoint else None
         tr = (
             Tracer(out_refs[n_out - 1], trace.capacity)
             if ntrace
@@ -1204,11 +1212,16 @@ class ResidentKernel:
 
         if self.inject:
 
-            def poll(consumed):
+            def poll(consumed, quiescing=None):
                 cp = pltpu.make_async_copy(ictl, ctlbuf, isem.at[0])
                 cp.start()
                 cp.wait()
                 tl = ctlbuf[0]
+                if quiescing is not None:
+                    # Quiescing round: consume nothing (tl clamps to the
+                    # cursor, the chunk loop is immediately done) - the
+                    # unread rows are the exported ring residue.
+                    tl = jnp.where(quiescing, jnp.minimum(tl, consumed), tl)
 
                 def chunk(c):
                     base = (c // 8) * 8
@@ -1557,18 +1570,44 @@ class ResidentKernel:
             # exports - stays up, like a real chip whose ICI router
             # outlives its core.
             am_dead = is_dead(r) if plan is not None else jnp.bool_(False)
-            # Quiesce drain rounds: once the folded quiesce word was
-            # observed, stop popping (fuel 0 - the round boundary the
-            # export contract promises) but keep the exchange machinery
-            # live until the wire is empty; heartbeats keep ticking so
-            # the drain cannot be mistaken for a dead chip.
+            # Host abort word: re-read from HBM every round (BEFORE the
+            # sched/poll so the quiesce flag can gate both), folded into
+            # the termination collective below so the whole mesh exits in
+            # lockstep within one fold of the write landing.
+            cpa = pltpu.make_async_copy(abort_in, abuf, asem.at[0])
+            cpa.start()
+            cpa.wait()
+            local_abort = abuf[0] != 0
+            # Quiesce word rides the same per-device HBM row (word [1],
+            # threshold in [2]): every device compares the same r, so the
+            # flag is lockstep-consistent without waiting for the fold.
+            if ckpt:
+                local_quiesce = (abuf[1] != 0) & (r >= abuf[2])
+            else:
+                local_quiesce = jnp.bool_(False)
+            # Quiesce drain rounds: from the threshold round on, stop
+            # popping (fuel 0 - the round boundary the export contract
+            # promises) but keep the exchange machinery live until the
+            # wire is empty; heartbeats keep ticking so the drain cannot
+            # be mistaken for a dead chip.
             hold = am_dead
             if ckpt:
-                hold = hold | (pstate[PS_QUIESCE] != 0)
+                hold = hold | local_quiesce | (pstate[PS_QUIESCE] != 0)
             core.sched(jnp.where(hold, 0, quantum))
             pstate[PS_HB] = pstate[PS_HB] + jnp.where(am_dead, 0, 1)
             if self.inject:
-                c_new = poll(consumed)
+                # Quiescing also stops RING consumption: published-but-
+                # unconsumed rows stay put and export as the checkpoint's
+                # ring residue (with the consumed cursor), instead of
+                # being installed into the cut - the poll is the consumer
+                # half of the cursor contract the bundle preserves.
+                if ckpt:
+                    quiescing = (
+                        local_quiesce | (pstate[PS_QUIESCE] != 0)
+                    )
+                else:
+                    quiescing = jnp.bool_(False)
+                c_new = poll(consumed, quiescing)
 
                 @pl.when(c_new > consumed)
                 def _():
@@ -1578,20 +1617,6 @@ class ResidentKernel:
                 inj_backlog = ctlbuf[0] - consumed
             else:
                 inj_backlog = jnp.int32(0)
-            # Host abort word: re-read from HBM every round, folded into
-            # the termination collective below so the whole mesh exits in
-            # lockstep within one fold of the write landing.
-            cpa = pltpu.make_async_copy(abort_in, abuf, asem.at[0])
-            cpa.start()
-            cpa.wait()
-            local_abort = abuf[0] != 0
-            # Quiesce word rides the same per-device HBM row (word [1],
-            # threshold in [2]): every device compares the same r, so the
-            # fold sees a lockstep-consistent flag.
-            if ckpt:
-                local_quiesce = (abuf[1] != 0) & (r >= abuf[2])
-            else:
-                local_quiesce = jnp.bool_(False)
             drain_outbox()
             fold_and_steal(r, inj_backlog, am_dead, local_abort,
                            local_quiesce)
@@ -1624,8 +1649,15 @@ class ResidentKernel:
                 )
                 # Lockstep clean-cut exit: quiesced AND the wire is empty
                 # (pending work intentionally remains - that is the
-                # checkpoint).
-                settled = quiescing & wire_idle
+                # checkpoint). Unconsumed INJECT rows also remain, by
+                # design: the poll stopped consuming at the quiesce, so
+                # the ring residue + cursor export with the state rather
+                # than gating the exit (SF_INJ is a normal-termination
+                # condition only).
+                settled = quiescing & (
+                    (statacc[SF_OUTB] == 0)
+                    & (statacc[SF_SENT] == statacc[SF_RECV])
+                )
             done = (
                 ((statacc[SF_PEND] == 0) & wire_idle)
                 | aborted | (statacc[SF_WEDGE] > 0) | settled
@@ -1658,6 +1690,27 @@ class ResidentKernel:
                     TR_CKPT, tr.now(), counts[C_PENDING],
                     counts[C_TAIL] - counts[C_HEAD],
                 )
+
+            # Export the live wait table (the lifted kernel-scratch
+            # limit): pending waits leave with their needs REBASED to
+            # arrivals-since-entry (need - chan_tot), so a resume that
+            # restages with fresh channel counters fires them at exactly
+            # the same residual arrival count. Rows beyond the count are
+            # zeroed - the exported array must be a pure function of the
+            # run, not of stale SMEM (bundle sha256 determinism).
+            for i in range(MAXW + 1):
+                for w in range(3):
+                    waits_out[i, w] = 0
+            waits_out[0, 0] = pstate[PS_NWAIT]
+
+            def wexp(i, _):
+                ch = wait_tab[i, 0]
+                waits_out[1 + i, 0] = ch
+                waits_out[1 + i, 1] = wait_tab[i, 1] - chan_tot[ch]
+                waits_out[1 + i, 2] = wait_tab[i, 2]
+                return 0
+
+            jax.lax.fori_loop(0, pstate[PS_NWAIT], wexp, 0)
         if self.inject:
             ctl_out[0] = ctlbuf[0]
             ctl_out[1] = ctlbuf[1]
@@ -1716,10 +1769,16 @@ class ResidentKernel:
         if self.inject:
             out_specs.append(smem())
             out_shape.append(jax.ShapeDtypeStruct((8,), jnp.int32))
-        # Per-device fault/abort stats (FS_* words), then the optional
-        # flight-recorder ring - appended outputs, existing indices intact.
+        # Per-device fault/abort stats (FS_* words), then (checkpoint
+        # builds) the exported wait table, then the optional flight-
+        # recorder ring - appended outputs, existing indices intact.
         out_specs.append(smem())
         out_shape.append(jax.ShapeDtypeStruct((FS_WORDS,), jnp.int32))
+        if self.checkpoint:
+            out_specs.append(smem())
+            out_shape.append(
+                jax.ShapeDtypeStruct((self.max_waits + 1, 3), jnp.int32)
+            )
         if mk.trace is not None:
             out_specs.append(smem())
             out_shape.append(mk.trace.out_shape())
@@ -1808,13 +1867,18 @@ class ResidentKernel:
             counts_o, iv_o = outs[2], outs[3]
             data_o = outs[4 : 4 + ndata]
             ntrace = 1 if self.mk.trace is not None else 0
-            fstats_o = outs[-1 - ntrace]
+            nckpt = 1 if ckpt else 0
+            fstats_o = outs[-1 - ntrace - nckpt]
             tail_o = ([outs[-1]] if ntrace else [])
             # Checkpoint builds export the mutated task table + ready
             # ring too - the per-device scheduler snapshot restore()
             # relaunches from (dropped by non-checkpoint builds, whose
-            # positional consumers predate them).
-            state_o = [outs[0], outs[1]] if ckpt else []
+            # positional consumers predate them) - plus the wait table
+            # and (inject runs) the ctl echo carrying the inject-ring
+            # consumed cursor, the two lifted coverage limits.
+            state_o = [outs[0], outs[1], outs[-1 - ntrace]] if ckpt else []
+            if ckpt and self.inject:
+                state_o.append(outs[4 + ndata])
             gcounts = jax.lax.psum(counts_o, axes)
             return (
                 counts_o[None],
@@ -1832,7 +1896,7 @@ class ResidentKernel:
         # or shard_map rejects the pytree at trace time.
         nout = (
             4 + ndata + (1 if self.mk.trace is not None else 0)
-            + (2 if ckpt else 0)
+            + ((3 + (1 if self.inject else 0)) if ckpt else 0)
         )
         f = shard_map(
             step,
@@ -1886,9 +1950,12 @@ class ResidentKernel:
         ``info['state']`` (the stacked per-device snapshot;
         ``run(resume_state=...)`` relaunches mid-graph, and
         ``runtime.checkpoint`` serializes / re-homes it onto a different
-        mesh size). Quiesce with pending host-declared ``waits`` is
-        refused: the wait table is kernel scratch and parked wait rows
-        would never re-arm after a restore.
+        mesh size). Pending host-declared ``waits`` survive the cut: the
+        kernel exports its live wait table at exit (needs rebased to
+        arrivals-since-entry), and ``resume_state`` restages it, so
+        parked wait rows re-arm exactly. An injecting mesh exports its
+        ring residue + consumed cursor the same way (``state['ring_rows']``
+        / ``state['ictl']``), so a mid-stream quiesce loses nothing.
         """
         from .sharded import execute_partitions
 
@@ -1905,18 +1972,13 @@ class ResidentKernel:
                 "quiesce= needs Megakernel(checkpoint=True): the quiesce "
                 "word is compiled into the round loop only then"
             )
-        if quiesce is not None and any(w for w in (waits or [])):
-            raise ValueError(
-                "checkpoint quiesce with host-declared waits is not "
-                "supported: the wait table is kernel scratch and parked "
-                "wait rows would never re-arm after a restore"
-            )
         if resume_state is not None:
             if waits or inject_rows:
                 raise ValueError(
                     "resume_state= cannot be combined with waits/"
                     "inject_rows: the snapshot already carries every "
-                    "pending row"
+                    "pending row (incl. its wait table and inject-ring "
+                    "residue)"
                 )
             if data is not None or ivalues is not None:
                 raise ValueError(
@@ -1926,39 +1988,83 @@ class ResidentKernel:
         waits = list(waits or [])
         if len(waits) < ndev:
             waits = waits + [[] for _ in range(ndev - len(waits))]
-        waits_arr = np.zeros((ndev, self.max_waits + 1, 3), np.int32)
-        for d, wlist in enumerate(waits):
-            if len(wlist) > self.max_waits:
-                raise ValueError(f"device {d}: too many waits")
-            waits_arr[d, 0, 0] = len(wlist)
-            for i, (ch, need, row) in enumerate(wlist):
-                if not (0 <= ch < len(self.channels)):
-                    raise ValueError(f"bad channel id {ch}")
-                if not (0 <= row < builders[d].num_tasks):
-                    raise ValueError(
-                        f"device {d}: wait names task {row} out of range"
-                    )
-                waits_arr[d, 1 + i] = (ch, need, row)
+        if resume_state is not None and "waits" in resume_state:
+            # Restage the exported wait table (needs already rebased to
+            # arrivals-since-entry by the kernel's exit export; the
+            # parked rows keep their dep bump in the snapshot, so no
+            # bump_waits pass runs on resume).
+            waits_arr = np.asarray(
+                resume_state["waits"], np.int32
+            ).reshape(-1, self.max_waits + 1, 3)
+            if waits_arr.shape[0] != ndev:
+                raise ValueError(
+                    f"resume_state wait table covers "
+                    f"{waits_arr.shape[0]} devices, this mesh has {ndev}"
+                )
+        else:
+            waits_arr = np.zeros((ndev, self.max_waits + 1, 3), np.int32)
+            for d, wlist in enumerate(waits):
+                if len(wlist) > self.max_waits:
+                    raise ValueError(f"device {d}: too many waits")
+                waits_arr[d, 0, 0] = len(wlist)
+                for i, (ch, need, row) in enumerate(wlist):
+                    if not (0 <= ch < len(self.channels)):
+                        raise ValueError(f"bad channel id {ch}")
+                    if not (0 <= row < builders[d].num_tasks):
+                        raise ValueError(
+                            f"device {d}: wait names task {row} out of "
+                            "range"
+                        )
+                    waits_arr[d, 1 + i] = (ch, need, row)
         extra: List[np.ndarray] = [waits_arr]
         if self.inject:
             R = self.ring_capacity
             iring = np.zeros((ndev, R, RING_ROW), np.int32)
             ictl = np.zeros((ndev, 8), np.int32)
-            for d, rows in enumerate(inject_rows or []):
-                if len(rows) > R:
-                    raise ValueError(f"device {d}: injection ring overflow")
-                for i, spec in enumerate(rows):
-                    fn, args = spec[0], spec[1]
-                    out = spec[2] if len(spec) > 2 else 0
-                    iring[d, i, F_FN] = fn
-                    iring[d, i, F_SUCC0] = NO_TASK
-                    iring[d, i, F_SUCC1] = NO_TASK
-                    for j, a in enumerate(args):
-                        iring[d, i, F_A0 + j] = int(a)
-                    iring[d, i, F_OUT] = out
-                    iring[d, i, F_HOME] = NO_TASK
-                ictl[d, 0] = len(rows)
-                ictl[d, 1] = 1  # closed: single-entry run drains fully
+            if resume_state is not None:
+                # Re-publish the inject-ring residue (rows that were on
+                # the ring but unconsumed at quiesce): packed from slot
+                # 0 with a reset consumed cursor, so the in-kernel poll
+                # discovers exactly the rows the cut left behind - the
+                # cursor survives the checkpoint (and any reshard).
+                rr = resume_state.get("ring_rows")
+                rc = resume_state.get("ictl")
+                if rr is not None and rc is not None:
+                    rr = np.asarray(rr, np.int32)
+                    rc = np.asarray(rc, np.int32)
+                    if rr.shape[0] != ndev:
+                        raise ValueError(
+                            f"resume_state inject ring covers "
+                            f"{rr.shape[0]} devices, this mesh has {ndev}"
+                        )
+                    for d in range(ndev):
+                        n = int(rc[d, 0])
+                        if n > R:
+                            raise ValueError(
+                                f"device {d}: {n} residue ring rows "
+                                f"exceed ring_capacity {R}"
+                            )
+                        iring[d, :n] = rr[d, :n]
+                        ictl[d, 0] = n
+                        ictl[d, 1] = 1  # single-entry run drains fully
+            else:
+                for d, rows in enumerate(inject_rows or []):
+                    if len(rows) > R:
+                        raise ValueError(
+                            f"device {d}: injection ring overflow"
+                        )
+                    for i, spec in enumerate(rows):
+                        fn, args = spec[0], spec[1]
+                        out = spec[2] if len(spec) > 2 else 0
+                        iring[d, i, F_FN] = fn
+                        iring[d, i, F_SUCC0] = NO_TASK
+                        iring[d, i, F_SUCC1] = NO_TASK
+                        for j, a in enumerate(args):
+                            iring[d, i, F_A0 + j] = int(a)
+                        iring[d, i, F_OUT] = out
+                        iring[d, i, F_HOME] = NO_TASK
+                    ictl[d, 0] = len(rows)
+                    ictl[d, 1] = 1  # closed: single-entry run drains fully
             extra += [iring, ictl]
         elif inject_rows:
             raise ValueError("inject_rows requires inject=True")
@@ -2032,18 +2138,28 @@ class ResidentKernel:
             )
             tail = tail[:-1]
         if self.checkpoint:
-            tasks_rows, ready_rows = tail[-2], tail[-1]
-            tail = tail[:-2]
+            if self.inject:
+                ictl_rows = tail[-1]
+                tail = tail[:-1]
+            waits_rows = tail[-1]
+            tasks_rows, ready_rows = tail[-3], tail[-2]
+            tail = tail[:-3]
         frows = tail[-1]
         fs = [decode_fault_stats(frows[d]) for d in range(ndev)]
         info["fault_stats"] = fs
         info["aborted"] = any(f["abort_round"] >= 0 for f in fs)
         if self.checkpoint:
             info["quiesced"] = any(f["quiesce_round"] >= 0 for f in fs)
+            if self.inject:
+                info["inject_ctl"] = np.asarray(ictl_rows)
             if info["quiesced"]:
                 # The stacked per-device snapshot run(resume_state=)
                 # relaunches from; runtime/checkpoint.py serializes it
-                # and re-homes it onto a different mesh size.
+                # and re-homes it onto a different mesh size. The wait
+                # table (needs rebased at export) and the inject-ring
+                # residue + cursor ride along - the two coverage limits
+                # PR 6 lifted - so a mid-stream, waits-pending mesh
+                # quiesces, migrates, and resumes without loss.
                 info["state"] = {
                     "tasks": np.asarray(tasks_rows),
                     "succ": np.asarray(inputs["succ"]),
@@ -2051,7 +2167,24 @@ class ResidentKernel:
                     "counts": np.asarray(info["per_device_counts"]),
                     "ivalues": np.asarray(iv_o),
                     "data": {k: np.asarray(v) for k, v in data_o.items()},
+                    "waits": np.asarray(waits_rows),
                 }
+                if self.inject:
+                    ic = np.asarray(ictl_rows)
+                    rr = np.zeros(
+                        (ndev, self.ring_capacity, RING_ROW), np.int32
+                    )
+                    nictl = np.zeros((ndev, 8), np.int32)
+                    for d in range(ndev):
+                        tl, cl, cons = (
+                            int(ic[d, 0]), int(ic[d, 1]), int(ic[d, 2])
+                        )
+                        res = iring[d, cons:tl]
+                        rr[d, : len(res)] = res
+                        nictl[d, 0] = len(res)
+                        nictl[d, 1] = cl
+                    info["state"]["ring_rows"] = rr
+                    info["state"]["ictl"] = nictl
         if info["overflow"]:
             from .megakernel import decode_overflow
 

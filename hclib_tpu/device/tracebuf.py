@@ -68,6 +68,15 @@ __all__ = [
     "TR_INJECT",
     "TR_QUIESCE",
     "TR_CKPT",
+    "TR_SCALE",
+    "SC_HOLD",
+    "SC_OUT",
+    "SC_IN",
+    "SC_EVACUATE",
+    "SC_CHECKPOINT",
+    "SC_FINISH",
+    "SC_NAMES",
+    "host_trace_info",
     "TAG_NAMES",
 ]
 
@@ -93,6 +102,30 @@ TR_FAULT = 11          # a = fault code (FLT_*), b = detail (peer/mask)
 TR_INJECT = 12         # a = rows installed from the injection ring
 TR_QUIESCE = 13        # a = executed-since-entry (or round) at observation
 TR_CKPT = 14           # a = pending rows exported, b = ready backlog
+TR_SCALE = 15          # a = (from_ndev << 8) | to_ndev, b = SC_* kind
+                       # (host-emitted by runtime/autoscaler.py; rides
+                       # the same record ABI so timeline.py renders
+                       # scale events beside device rounds)
+
+# TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
+SC_HOLD = 0
+SC_OUT = 1
+SC_IN = 2
+SC_EVACUATE = 3
+SC_CHECKPOINT = 4
+SC_FINISH = 5
+
+# The ONE name table for SC_* codes: runtime/autoscaler.py derives its
+# kind->code map from it and tools/timeline.py labels TR_SCALE spans
+# with it, so a new kind is one edit here, not three drifting copies.
+SC_NAMES: Dict[int, str] = {
+    SC_HOLD: "hold",
+    SC_OUT: "scale out",
+    SC_IN: "scale in",
+    SC_EVACUATE: "evacuate",
+    SC_CHECKPOINT: "checkpoint",
+    SC_FINISH: "finish",
+}
 
 TAG_NAMES: Dict[int, str] = {
     TR_ROUND_BEGIN: "round_begin",
@@ -109,6 +142,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_INJECT: "inject",
     TR_QUIESCE: "quiesce",
     TR_CKPT: "ckpt_export",
+    TR_SCALE: "scale",
 }
 
 # TR_CREDIT delta codes (b word).
@@ -263,6 +297,26 @@ def trace_info(
     return {
         "epoch": {"t0_ns": int(t0_ns), "t1_ns": int(t1_ns)},
         "rings": [decode_ring(r, capacity) for r in rows],
+    }
+
+
+def host_trace_info(
+    records: Sequence[Sequence[int]], t0_ns: int, t1_ns: int,
+) -> Dict[str, Any]:
+    """A trace_info-shaped dict built from HOST-emitted records (rows of
+    [tag, t, a, b] - e.g. the autoscaler's TR_SCALE events, with ``t``
+    the control-slice index). It rides the same epoch-bracket contract
+    as a device ring, so ``tools/timeline.py --perfetto`` merges host
+    control-loop events onto the same timeline as device rounds."""
+    arr = np.asarray(list(records), dtype=np.int64).reshape(-1, TR_WORDS)
+    return {
+        "epoch": {"t0_ns": int(t0_ns), "t1_ns": int(t1_ns)},
+        "rings": [{
+            "written": int(arr.shape[0]),
+            "dropped": 0,
+            "capacity": max(1, int(arr.shape[0])),
+            "records": arr,
+        }],
     }
 
 

@@ -164,6 +164,7 @@ def make_uts_megakernel(
     interpret: Optional[bool] = None,
     trace=None,
     checkpoint: Optional[bool] = None,
+    quiesce_stride: Optional[int] = None,
 ) -> Megakernel:
     """Seeded unbalanced-tree search on the scalar megakernel tier: the
     dynamic-spawn UTS-style workload (the reference's north-star tree,
@@ -209,6 +210,7 @@ def make_uts_megakernel(
         interpret=interpret,
         trace=trace,
         checkpoint=checkpoint,
+        quiesce_stride=quiesce_stride,
     )
 
 

@@ -13,6 +13,12 @@ from .locality import (
     generate_default_graph,
     load_locality_file,
 )
+from .autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+    Observation,
+    ScaleEvent,
+)
 from .checkpoint import (
     CheckpointBundle,
     CheckpointError,

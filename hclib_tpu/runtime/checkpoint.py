@@ -89,8 +89,11 @@ MAGIC = "hclib-tpu-checkpoint"
 BUNDLE_VERSION = 1
 
 # state dict keys serialized for every kind (data buffers ride as
-# ``data/<name>`` entries; the stream kind adds ``ring_rows``).
+# ``data/<name>`` entries; the stream kind adds ``ring_rows``, the
+# resident kind adds its exported wait table and - when injecting - the
+# per-device ring residue + cursor words).
 _STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
+_OPT_KEYS = ("ring_rows", "waits", "ictl")
 
 # Descriptor-word indices, bound once (descriptor ABI, device/descriptor).
 from ..device.descriptor import (  # noqa: E402
@@ -165,8 +168,9 @@ class CheckpointBundle:
         as same-width unsigned views with the true dtype recorded in
         ``meta['dtypes']`` - ``state()`` views them back bit-exactly."""
         arrays = {k: np.asarray(state[k]) for k in _STATE_KEYS}
-        if "ring_rows" in state:
-            arrays["ring_rows"] = np.asarray(state["ring_rows"])
+        for k in _OPT_KEYS:
+            if k in state:
+                arrays[k] = np.asarray(state[k])
         for name, buf in (state.get("data") or {}).items():
             arrays[f"data/{name}"] = np.asarray(buf)
         dtypes: Dict[str, str] = {}
@@ -192,8 +196,9 @@ class CheckpointBundle:
         st: Dict[str, Any] = {
             k: self._restore_dtype(k, self.arrays[k]) for k in _STATE_KEYS
         }
-        if "ring_rows" in self.arrays:
-            st["ring_rows"] = self.arrays["ring_rows"].copy()
+        for k in _OPT_KEYS:
+            if k in self.arrays:
+                st[k] = self.arrays[k].copy()
         st["data"] = {
             k.split("/", 1)[1]: self._restore_dtype(k, v)
             for k, v in self.arrays.items()
@@ -311,10 +316,22 @@ class CheckpointBundle:
             raise CheckpointError(
                 f"reshard applies to resident-mesh bundles, not {self.kind}"
             )
-        ndev_new = int(ndev_new)
+        try:
+            ndev_new = int(ndev_new)
+        except (TypeError, ValueError):
+            raise CheckpointError(
+                f"reshard wants an integer device count, got {ndev_new!r}"
+            )
+        tasks = self.arrays["tasks"]
+        counts = self.arrays["counts"]
+        ivalues = self.arrays["ivalues"]
+        ndev, cap, _ = tasks.shape
         if ndev_new < 1 or (ndev_new & (ndev_new - 1)):
             raise CheckpointError(
-                f"reshard wants a power-of-two device count, got {ndev_new}"
+                f"reshard wants a power-of-two device count >= 1, got "
+                f"{ndev_new} (the resident mesh's hypercube hop schedule "
+                "is pof2-only; an evacuation drops to the next pof2 "
+                "below the survivor count)"
             )
         if any(k.startswith("data/") for k in self.arrays):
             raise CheckpointError(
@@ -322,10 +339,19 @@ class CheckpointBundle:
                 "onto the original mesh size, or drain and re-partition "
                 "at the application level"
             )
-        tasks = self.arrays["tasks"]
-        counts = self.arrays["counts"]
-        ivalues = self.arrays["ivalues"]
-        ndev, cap, _ = tasks.shape
+        waits = self.arrays.get("waits")
+        if waits is not None and int(np.asarray(waits)[:, 0, 0].sum()) > 0:
+            # A pending wait pins its parked row to the device whose
+            # channel counters it watches (needs are rebased per-device
+            # arrival counts); its row also carries a dep bump, so the
+            # row scan below would refuse it anyway - but name the real
+            # reason first.
+            raise CheckpointError(
+                "reshard: the bundle carries pending host-declared waits "
+                "(per-device channel arrival counts do not re-home); "
+                "resume on the original mesh size and let the waits fire "
+                "before resizing"
+            )
         V = ivalues.shape[1]
         va = int(counts[:, C_VALLOC].max())
         live_rows: List[np.ndarray] = []
@@ -368,9 +394,15 @@ class CheckpointBundle:
             parts[i % ndev_new].append(row)
         for j, p in enumerate(parts):
             if len(p) > cap:
+                # The M=1 (and any aggressive scale-in) failure mode:
+                # the folded backlog must still fit each survivor's
+                # task table. Diagnose with the numbers that fix it.
                 raise CheckpointError(
-                    f"reshard: device {j} would hold {len(p)} rows > "
-                    f"capacity {cap}"
+                    f"reshard {ndev} -> {ndev_new}: device {j} would "
+                    f"hold {len(p)} rows > capacity {cap} "
+                    f"({len(live_rows)} live rows total); scale in less "
+                    f"aggressively (>= {-(-len(live_rows) // cap)} "
+                    "devices) or rebuild with a larger capacity"
                 )
         tasks_new = np.zeros((ndev_new, cap, DESC_WORDS), np.int32)
         ready_new = np.full((ndev_new, cap), NO_TASK, np.int32)
@@ -395,16 +427,89 @@ class CheckpointBundle:
             counts_new[j][C_EXECUTED] += int(counts[d][C_EXECUTED])
         scap = self.arrays["succ"].shape[1]
         succ_new = np.full((ndev_new, scap), NO_TASK, np.int32)
+        arrays = {
+            "tasks": tasks_new, "succ": succ_new, "ready": ready_new,
+            "counts": counts_new, "ivalues": ivalues_new,
+        }
+        if waits is not None:
+            # Verified empty above: a fresh all-zero table for M devices.
+            arrays["waits"] = np.zeros(
+                (ndev_new,) + np.asarray(waits).shape[1:], np.int32
+            )
+        if "ring_rows" in self.arrays:
+            # Inject-ring residue re-homes like the task rows: injected
+            # descriptors are link-free by construction (inject refuses
+            # dep_count != 0), so the rows are location-free and deal
+            # round-robin; the consumed cursor was already folded into
+            # the packed-from-zero representation at export.
+            rr = np.asarray(self.arrays["ring_rows"])
+            ic = np.asarray(self.arrays["ictl"])
+            R = rr.shape[1]
+            residue = [
+                rr[d, i]
+                for d in range(rr.shape[0])
+                for i in range(int(ic[d, 0]))
+            ]
+            rr_new = np.zeros((ndev_new, R) + rr.shape[2:], np.int32)
+            ic_new = np.zeros((ndev_new, 8), np.int32)
+            ic_new[:, 1] = ic[:, 1].max() if ic.size else 0  # close flag
+            for i, row in enumerate(residue):
+                j = i % ndev_new
+                slot = ic_new[j, 0]
+                if slot >= R:
+                    raise CheckpointError(
+                        f"reshard {ndev} -> {ndev_new}: device {j} would "
+                        f"hold > {R} inject-ring residue rows "
+                        f"({len(residue)} total); scale in less "
+                        "aggressively or raise ring_capacity"
+                    )
+                rr_new[j, slot] = row
+                ic_new[j, 0] = slot + 1
+            if int(ic_new[:, 0].sum()) != len(residue):
+                raise CheckpointError(
+                    "reshard ring conservation check failed"
+                )
+            arrays["ring_rows"] = rr_new
+            arrays["ictl"] = ic_new
         meta = dict(self.meta)
         meta["ndev"] = ndev_new
         meta["resharded_from"] = int(ndev)
-        return CheckpointBundle(
-            "resident", meta,
-            {
-                "tasks": tasks_new, "succ": succ_new, "ready": ready_new,
-                "counts": counts_new, "ivalues": ivalues_new,
-            },
-        )
+        return CheckpointBundle("resident", meta, arrays)
+
+    def diff(self, other: "CheckpointBundle") -> Dict[str, Any]:
+        """Structural comparison of two bundles, for the bit-identity
+        assertions the storm tests make: returns ``{'equal': bool,
+        'kind': ..., 'only_self': [...], 'only_other': [...],
+        'mismatched': {key: {n, max_abs}}}``. Arrays compare bit-exactly
+        (shape + values); dtype views are compared raw (two bundles of
+        the same build store identically)."""
+        only_self = sorted(set(self.arrays) - set(other.arrays))
+        only_other = sorted(set(other.arrays) - set(self.arrays))
+        mismatched: Dict[str, Any] = {}
+        for k in sorted(set(self.arrays) & set(other.arrays)):
+            a, b = self.arrays[k], other.arrays[k]
+            if a.shape != b.shape or a.dtype != b.dtype:
+                mismatched[k] = {
+                    "shape": [list(a.shape), list(b.shape)],
+                    "dtype": [str(a.dtype), str(b.dtype)],
+                }
+                continue
+            if not np.array_equal(a, b):
+                av = a.astype(np.int64) if a.dtype.kind in "biu" else a
+                bv = b.astype(np.int64) if b.dtype.kind in "biu" else b
+                d = np.abs(av - bv)
+                mismatched[k] = {
+                    "n": int((av != bv).sum()),
+                    "max_abs": float(d.max()),
+                }
+        return {
+            "equal": not (only_self or only_other or mismatched)
+            and self.kind == other.kind,
+            "kind": [self.kind, other.kind],
+            "only_self": only_self,
+            "only_other": only_other,
+            "mismatched": mismatched,
+        }
 
 
 # --------------------------------------------------------------- snapshot

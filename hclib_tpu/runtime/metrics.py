@@ -95,6 +95,20 @@ class MetricsRegistry:
         with self._lock:
             self._records[name] = dict(mapping)
 
+    def record_event(self, name: str, mapping: Mapping) -> None:
+        """Record one occurrence of a recurring event (an autoscaler
+        decision, a checkpoint cut): keeps ``<name>.count`` (monotonic)
+        plus ``<name>.last.*`` (the latest event's numeric fields) - the
+        counter/last-value pair a dashboard rate()s and inspects, without
+        the registry ever holding an unbounded event list."""
+        with self._lock:
+            prev = self._records.get(name)
+            count = (
+                int(prev.get("count", 0)) + 1
+                if isinstance(prev, dict) else 1
+            )
+            self._records[name] = {"count": count, "last": dict(mapping)}
+
     def add_run_info(self, name: str, info: Mapping) -> None:
         """Record a device run's ``info`` dict: numeric scalars plus
         ``tiers``/``fault_stats`` pass through; the flight-recorder trace

@@ -1,0 +1,345 @@
+"""Elastic autoscaling (ISSUE 6): the metrics-driven quiesce -> reshard
+-> resume control loop.
+
+The policy half is PURE (observation in, decision out) and is tested
+headless - hysteresis, cooldown, the no-flap guarantee, and the
+evacuation fast path need no mesh and no Mosaic. The control loop's
+telemetry (typed ScaleEvents -> MetricsRegistry + TR_SCALE host ring ->
+Perfetto) is host-only too. The end-to-end mesh runs (scale out under
+backlog, dead-chip evacuation mid-stream, preemption checkpoint of an
+autoscaled deployment, totals bit-identical to an uninterrupted run)
+need the Mosaic interpret mode and ride the chaos marker like the other
+mesh tests.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import hclib_tpu as hc
+from hclib_tpu.device.tracebuf import TR_SCALE, records_of
+from hclib_tpu.jaxcompat import has_mosaic_interpret
+from hclib_tpu.runtime import resilience
+
+needs_mosaic = pytest.mark.skipif(
+    not has_mosaic_interpret(),
+    reason="needs the Mosaic TPU interpret mode (pltpu.InterpretParams, "
+           "jax >= 0.5): the ICI mesh kernels simulate remote DMA + "
+           "semaphores on CPU",
+)
+
+
+# ---------------------------------------------------------- policy, pure
+
+
+def _policy(**kw):
+    base = dict(min_devices=1, max_devices=8, scale_out_backlog=16.0,
+                scale_in_backlog=2.0, hysteresis=2, cooldown=2)
+    base.update(kw)
+    return hc.AutoscalerPolicy(**base)
+
+
+def test_policy_hysteresis_gates_scale_out():
+    p = _policy()
+    hot = hc.Observation(2, [40, 40])
+    assert p.decide(hot)[1] == "hold"  # streak 1/2
+    target, kind, reason = p.decide(hot)
+    assert (target, kind) == (4, "scale_out")
+    assert "2 slices" in reason
+
+
+def test_policy_one_spike_never_resizes():
+    """An alternating hot/cold load (the classic flap inducer) never
+    builds a streak, so the mesh size never moves."""
+    p = _policy()
+    for _ in range(6):
+        assert p.decide(hc.Observation(4, [50] * 4))[1] == "hold"
+        assert p.decide(hc.Observation(4, [0] * 4))[1] == "hold"
+
+
+def test_policy_cooldown_blocks_back_to_back_resizes():
+    p = _policy(hysteresis=1, cooldown=2)
+    assert p.decide(hc.Observation(2, [40, 40]))[1] == "scale_out"
+    # Cooldown: two slices hold even under sustained pressure...
+    assert p.decide(hc.Observation(4, [40] * 4))[1] == "hold"
+    assert p.decide(hc.Observation(4, [40] * 4))[1] == "hold"
+    # ...then the streak machinery re-engages.
+    assert p.decide(hc.Observation(4, [40] * 4))[1] == "scale_out"
+
+
+def test_policy_scale_in_waits_for_empty_inject_backlog():
+    p = _policy(hysteresis=1, cooldown=0)
+    idle_but_queued = hc.Observation(4, [0] * 4, inject_backlog=9)
+    assert p.decide(idle_but_queued)[1] == "hold"
+    target, kind, _ = p.decide(hc.Observation(4, [0] * 4))
+    assert (target, kind) == (2, "scale_in")
+
+
+def test_policy_bounds_respected():
+    p = _policy(min_devices=2, max_devices=4, hysteresis=1, cooldown=0)
+    assert p.decide(hc.Observation(4, [99] * 4))[1] == "hold"  # at max
+    assert p.decide(hc.Observation(2, [0, 0]))[1] == "hold"  # at min
+    with pytest.raises(ValueError, match="power of two"):
+        hc.AutoscalerPolicy(min_devices=3)
+    with pytest.raises(ValueError, match="oscillate|must be <"):
+        hc.AutoscalerPolicy(scale_out_backlog=4.0, scale_in_backlog=8.0)
+
+
+def test_policy_evacuation_bypasses_gates():
+    """A quarantined chip reshard-around fires at the FIRST observation
+    naming it - during cooldown, with zero streak - and drops to the
+    largest pof2 that fits the survivors."""
+    p = _policy(hysteresis=2, cooldown=3)
+    p.decide(hc.Observation(8, [40] * 8))  # prime a streak + no resize
+    target, kind, reason = p.decide(
+        hc.Observation(8, [1] * 8, quarantined=[5])
+    )
+    assert (target, kind) == (4, "evacuate")
+    assert "quarantined" in reason
+    # At min_devices there is nowhere to evacuate TO: hold, and say why.
+    p2 = _policy(min_devices=1)
+    target, kind, reason = p2.decide(
+        hc.Observation(1, [5], quarantined=[0])
+    )
+    assert (target, kind) == (1, "hold") and "watchdog" in reason
+
+
+def test_observation_from_info_reads_counts_and_quarantine():
+    from hclib_tpu.device.megakernel import C_HEAD, C_TAIL
+
+    counts = np.zeros((2, 8), np.int32)
+    counts[0, C_TAIL] = 7
+    counts[1, C_HEAD], counts[1, C_TAIL] = 2, 5
+    info = {
+        "per_device_counts": counts,
+        "pending": 11,
+        "executed": 30,
+        "fault_stats": [
+            {"quarantined": [1]}, {"quarantined": []},
+        ],
+        "inject_ctl": np.array(
+            [[4, 1, 1, 0, 0, 0, 0, 0], [2, 1, 2, 0, 0, 0, 0, 0]],
+            np.int32,
+        ),
+    }
+    obs = hc.Observation.from_info(2, info, executed_before=10,
+                                   slice_s=0.5)
+    assert obs.backlog == [7, 3]
+    assert obs.pending == 11
+    assert obs.executed_delta == 20
+    assert obs.inject_backlog == 3  # (4-1) + (2-2)
+    assert obs.quarantined == (1,)
+    assert obs.backlog_per_device == (7 + 3 + 3) / 2
+
+
+# ------------------------------------------------- events and telemetry
+
+
+def test_scale_events_metrics_and_trace_ring():
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(lambda n: None, _policy(), metrics=reg)
+    asc._event(hc.ScaleEvent("scale_out", 0, 2, 4, "r1"))
+    asc._event(hc.ScaleEvent("hold", 1, 4, 4, "r2"))
+    asc._event(hc.ScaleEvent("evacuate", 2, 4, 2, "r3",
+                             resize_latency_s=0.01))
+    snap = reg.snapshot()["metrics"]
+    assert snap["autoscale.scale_out.count"] == 1.0
+    assert snap["autoscale.evacuate.last.from_ndev"] == 4.0
+    assert snap["autoscale.state.events"] == 3.0
+    assert snap["autoscale.state.resizes"] == 2.0
+    tr = asc.trace_info()
+    recs = records_of(tr, TR_SCALE)
+    assert len(recs) == 3
+    assert int(recs[0][2]) == (2 << 8) | 4
+    assert [int(r[1]) for r in recs] == [0, 1, 2]  # slice timebase
+    # The Perfetto exporter renders the host ring (no dump needed).
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import timeline
+
+    doc = timeline.export_perfetto("", traces=[tr])
+    names = [e.get("name", "") for e in doc["traceEvents"]]
+    assert any(n.startswith("scale out 2→4") for n in names), names
+    assert any(n.startswith("evacuate 4→2") for n in names), names
+
+
+def test_autoscaler_close_unregisters_gauge():
+    """A retired controller must not stay reachable through the
+    registry: close() removes the live gauge source."""
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(lambda n: None, _policy(), metrics=reg)
+    assert "autoscale.state.ndev" in reg.snapshot()["metrics"]
+    asc.close()
+    assert "autoscale.state.ndev" not in reg.snapshot()["metrics"]
+
+
+def test_scale_event_validation_and_shape():
+    with pytest.raises(ValueError, match="kind"):
+        hc.ScaleEvent("embiggen", 0, 1, 2, "no")
+    ev = hc.ScaleEvent("scale_in", 5, 4, 2, "idle", backlog=3,
+                       pending=7, executed=100, resize_latency_s=0.25)
+    d = ev.as_dict()
+    assert d["kind"] == "scale_in" and d["resize_latency_s"] == 0.25
+    assert ev.resized and not hc.ScaleEvent("hold", 0, 2, 2, "x").resized
+
+
+# ------------------------------------------------------------- off-path
+
+
+def test_autoscaler_off_path_is_inert():
+    """ACCEPTANCE: the autoscaler is pure host-side composition - no
+    controller thread is spawned by construction or by policy decisions,
+    a non-checkpoint kernel factory is refused up front (never half-run),
+    and a Megakernel run outside the autoscaler carries no autoscale
+    state (byte-identical PR 5 behavior - the checkpoint-off device path
+    is covered by test_checkpoint's off-path bit-identity test)."""
+    from hclib_tpu.device.workloads import device_uts_mk
+
+    before = set(threading.enumerate())
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(lambda n: None, _policy(), metrics=reg)
+    for _ in range(4):
+        asc.policy.decide(hc.Observation(2, [1, 1]))
+    assert set(threading.enumerate()) == before  # no controller thread
+
+    class FakeRK:
+        class mk:
+            checkpoint = False
+
+        ndev = 2
+
+    with pytest.raises(ValueError, match="checkpoint=True"):
+        hc.Autoscaler(lambda n: FakeRK(), _policy())._kernel_for(2)
+    with pytest.raises(ValueError, match="exactly one"):
+        asc.run()
+
+    n1, i1 = device_uts_mk(max_depth=6, interpret=True)
+    assert "scale_events" not in i1  # plain runs carry no autoscale state
+    n2, i2 = device_uts_mk(max_depth=6, interpret=True)
+    assert n1 == n2 and i1["executed"] == i2["executed"]
+
+
+# ------------------------------------------------------- mesh end-to-end
+
+
+def _uts_kernel_factory(depth, dead_on_4=None, seed=0):
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.workloads import UTS_NODE, make_uts_megakernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    def make_kernel(ndev):
+        plan = None
+        if dead_on_4 is not None and ndev == 4:
+            plan = hc.DeviceFaultPlan(
+                seed=seed, dead_device=dead_on_4, dead_round=2,
+                heartbeat_timeout=2,
+            )
+        mk = make_uts_megakernel(seed=19 + seed, max_depth=depth,
+                                 interpret=True, checkpoint=True)
+        return ResidentKernel(
+            mk, cpu_mesh(ndev, axis_name="q"),
+            migratable_fns=[UTS_NODE], window=4, homed=False,
+            fault_plan=plan,
+        )
+
+    return make_kernel
+
+
+def _uts_builders(ndev, roots=8):
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import UTS_NODE
+
+    bs = [TaskGraphBuilder() for _ in range(ndev)]
+    for d in range(ndev):
+        for r in range(roots):
+            bs[d].add(UTS_NODE, args=[d * roots + r + 1, 0])
+    return bs
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_autoscale_storm_evacuates_dead_chip_totals_exact():
+    """ACCEPTANCE (the storm): an autoscaled UTS mesh scales OUT under
+    seeded backlog, the dead chip on the 4-device mesh is quarantined
+    and EVACUATED mid-stream, the idle tail scales IN - >= 3 typed
+    ScaleEvents including the evacuation - and the final totals are
+    bit-identical to an uninterrupted fault-free run (zero task loss)."""
+    make_kernel = _uts_kernel_factory(6, dead_on_4=3)
+    iv_f, _, info_f = _uts_kernel_factory(6)(2).run(
+        _uts_builders(2), quantum=8, max_rounds=1 << 14,
+    )
+    total = int(np.asarray(iv_f)[:, 0].sum())
+
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(
+        make_kernel,
+        hc.AutoscalerPolicy(min_devices=1, max_devices=4,
+                            scale_out_backlog=4.0, scale_in_backlog=1.0,
+                            hysteresis=1, cooldown=1),
+        slice_rounds=8, metrics=reg,
+    )
+    iv, _, info = asc.run(_uts_builders(2), quantum=8)
+    assert info["pending"] == 0
+    assert int(np.asarray(iv)[:, 0].sum()) == total
+    assert info["executed"] == info_f["executed"]
+    kinds = [e["kind"] for e in info["scale_events"]]
+    assert len(info["scale_events"]) >= 3, kinds
+    assert "evacuate" in kinds, kinds
+    ev = next(e for e in info["scale_events"] if e["kind"] == "evacuate")
+    assert ev["from_ndev"] == 4 and ev["to_ndev"] == 2
+    assert ev["resize_latency_s"] is not None
+    snap = reg.snapshot()["metrics"]
+    assert snap["autoscale.evacuate.count"] >= 1.0
+    recs = records_of(asc.trace_info(), TR_SCALE)
+    assert len(recs) == len(info["scale_events"])
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_autoscale_preempt_checkpoints_and_resumes():
+    """Preemption of an autoscaled deployment: the notice lands between
+    slices, the controller checkpoints (bundle on disk) and stops; a
+    fresh Autoscaler continues from the bundle and the totals are
+    exact."""
+    import os
+    import tempfile
+
+    make_kernel = _uts_kernel_factory(6, seed=1)
+    iv_f, _, info_f = make_kernel(2).run(
+        _uts_builders(2), quantum=8, max_rounds=1 << 14,
+    )
+    total = int(np.asarray(iv_f)[:, 0].sum())
+
+    resilience.reset_preempt()
+    ckdir = tempfile.mkdtemp(prefix="hclib-autoscale-")
+    asc = hc.Autoscaler(
+        make_kernel,
+        hc.AutoscalerPolicy(min_devices=1, max_devices=2,
+                            scale_out_backlog=1e9,
+                            scale_in_backlog=0.0, hysteresis=1),
+        slice_rounds=4, checkpoint_dir=ckdir,
+    )
+    try:
+        resilience.fire_preempt("test preemption")
+        iv, _, info = asc.run(_uts_builders(2), quantum=2)
+    finally:
+        resilience.reset_preempt()
+    assert info.get("preempted") is True
+    assert info["pending"] > 0  # genuinely mid-graph
+    assert os.path.isdir(info["bundle_path"])
+    assert [e["kind"] for e in info["scale_events"]][-1] == "checkpoint"
+
+    asc2 = hc.Autoscaler(make_kernel, hc.AutoscalerPolicy(
+        min_devices=1, max_devices=2, scale_out_backlog=1e9,
+        scale_in_backlog=0.0, hysteresis=1,
+    ), slice_rounds=1 << 12)
+    iv2, _, info2 = asc2.run(resume_bundle=info["bundle_path"],
+                             quantum=8)
+    assert info2["pending"] == 0
+    assert int(np.asarray(iv2)[:, 0].sum()) == total
+    assert info2["executed"] == info_f["executed"]

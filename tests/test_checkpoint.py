@@ -475,18 +475,32 @@ def _mesh_uts_builders(ndev):
 
 def test_resident_quiesce_validation_needs_no_mesh():
     """Host-side guards (no Mosaic needed): quiesce on a non-checkpoint
-    build, quiesce with waits, and resume_state conflicts all refuse
-    before any kernel builds."""
+    build, malformed waits, and resume_state conflicts all refuse before
+    any kernel builds. (Quiesce WITH pending waits is no longer refused -
+    the wait table exports with the snapshot; see
+    test_resident_quiesce_with_pending_waits_roundtrip.)"""
     rk = _mesh_uts_rk(2, checkpoint=False)
     with pytest.raises(ValueError, match="checkpoint=True"):
         rk.run(_mesh_uts_builders(2), quiesce=1)
     rk2 = _mesh_uts_rk(2, checkpoint=True)
-    with pytest.raises(ValueError, match="waits"):
+    # Wait validation still applies (this kernel declares no channels).
+    with pytest.raises(ValueError, match="bad channel id"):
         rk2.run(_mesh_uts_builders(2), quiesce=1, waits=[[(0, 1, 0)]])
     with pytest.raises(ValueError, match="exactly one"):
         rk2.run(_mesh_uts_builders(2), resume_state={})
     with pytest.raises(ValueError, match="exactly one"):
         rk2.run()
+    # resume_state with mismatched wait-table / ring shapes refuses with
+    # a diagnostic naming the device counts.
+    with pytest.raises(ValueError, match="wait table covers"):
+        rk2.run(resume_state={
+            "tasks": np.zeros((2, 4, 16), np.int32),
+            "succ": np.zeros((2, 8), np.int32),
+            "ready": np.zeros((2, 4), np.int32),
+            "counts": np.zeros((2, 8), np.int32),
+            "ivalues": np.zeros((2, 16), np.int32),
+            "waits": np.zeros((4, 65, 3), np.int32),
+        })
 
 
 def test_reshard_refuses_unsafe_rows():
@@ -547,6 +561,295 @@ def test_reshard_refuses_unsafe_rows():
         fake_bundle(dyn_out).reshard(1)
     with pytest.raises(CheckpointError, match="power-of-two"):
         fake_bundle(lambda t: None).reshard(3)
+
+
+def _fake_resident_bundle(ndev=2, cap=8, live_per_dev=1, extra=None):
+    """Minimal clean-quiesce resident bundle for host-side reshard tests
+    (live rows are ready + link-free)."""
+    from hclib_tpu.device.descriptor import (
+        DESC_WORDS, F_HOME, NO_TASK,
+    )
+
+    V = 16
+    tasks = np.zeros((ndev, cap, DESC_WORDS), np.int32)
+    tasks[:, :, 2:4] = NO_TASK  # F_SUCC0/F_SUCC1
+    tasks[:, :, F_HOME] = NO_TASK
+    counts = np.zeros((ndev, 8), np.int32)
+    counts[:, 1] = live_per_dev  # tail
+    counts[:, 2] = live_per_dev  # alloc
+    counts[:, 3] = live_per_dev  # pending
+    counts[:, 4] = 2  # value_alloc
+    ready = np.zeros((ndev, cap), np.int32)
+    arrays = {
+        "tasks": tasks, "succ": np.full((ndev, 8), -1, np.int32),
+        "ready": ready, "counts": counts,
+        "ivalues": np.zeros((ndev, V), np.int32),
+    }
+    arrays.update(extra or {})
+    return CheckpointBundle("resident", {"ndev": ndev}, arrays)
+
+
+def test_reshard_m_edge_cases_diagnosed():
+    """SATELLITE: M=1 and M>N re-home cleanly (totals conserved, empty
+    new devices legal); illegal/overfull targets get diagnostics naming
+    the fix, never shape errors."""
+    b = _fake_resident_bundle(ndev=2, live_per_dev=2)
+    one = b.reshard(1)  # M=1: everything folds onto the survivor
+    assert int(one.arrays["counts"][0][3]) == 4
+    big = _fake_resident_bundle(ndev=2, live_per_dev=2).reshard(8)
+    assert big.arrays["tasks"].shape[0] == 8  # M > N: empty devices ok
+    assert int(big.arrays["counts"][:, 3].sum()) == 4
+    assert big.meta["resharded_from"] == 2
+    with pytest.raises(CheckpointError, match="power-of-two"):
+        _fake_resident_bundle().reshard(3)
+    with pytest.raises(CheckpointError, match="power-of-two"):
+        _fake_resident_bundle().reshard(0)
+    with pytest.raises(CheckpointError, match="integer"):
+        _fake_resident_bundle().reshard("two")
+    # Overfull scale-in: the diagnostic names the minimum mesh size.
+    with pytest.raises(CheckpointError, match="scale in less"):
+        _fake_resident_bundle(ndev=2, cap=4, live_per_dev=3).reshard(1)
+
+
+def test_reshard_rehomes_ring_residue_and_refuses_pending_waits():
+    """SATELLITE (lifted limits, host half): inject-ring residue
+    re-deals across mesh sizes with its count conserved; a bundle with
+    PENDING waits refuses to reshard with a diagnostic (channel arrival
+    counts are per-device), while an empty wait table rides along."""
+    from hclib_tpu.device.inject import RING_ROW
+
+    R = 8
+    rr = np.zeros((2, R, RING_ROW), np.int32)
+    ic = np.zeros((2, 8), np.int32)
+    for d in range(2):
+        for i in range(3):
+            rr[d, i, 0] = 10 * d + i  # distinguishable payload
+        ic[d, 0] = 3
+        ic[d, 1] = 1
+    wz = np.zeros((2, 5, 3), np.int32)
+    b = _fake_resident_bundle(
+        ndev=2, live_per_dev=1,
+        extra={"ring_rows": rr, "ictl": ic, "waits": wz},
+    )
+    for m in (1, 4):
+        out = b.reshard(m)
+        assert int(out.arrays["ictl"][:, 0].sum()) == 6  # residue conserved
+        assert out.arrays["ring_rows"].shape[:2] == (m, R)
+        assert out.arrays["waits"].shape == (m, 5, 3)
+        assert (out.arrays["ictl"][:, 1] == 1).all()  # close flag survives
+        # Every payload survives exactly once.
+        vals = sorted(
+            int(out.arrays["ring_rows"][d, i, 0])
+            for d in range(m)
+            for i in range(int(out.arrays["ictl"][d, 0]))
+        )
+        assert vals == [0, 1, 2, 10, 11, 12], vals
+    wp = wz.copy()
+    wp[1, 0, 0] = 1  # one pending wait on device 1
+    bp = _fake_resident_bundle(
+        ndev=2, live_per_dev=1,
+        extra={"ring_rows": rr, "ictl": ic, "waits": wp},
+    )
+    with pytest.raises(CheckpointError, match="pending host-declared"):
+        bp.reshard(1)
+    # Ring overflow on aggressive scale-in diagnoses, not IndexErrors.
+    ic_full = ic.copy()
+    ic_full[:, 0] = R
+    bf = _fake_resident_bundle(
+        ndev=2, live_per_dev=1,
+        extra={"ring_rows": rr, "ictl": ic_full, "waits": wz},
+    )
+    with pytest.raises(CheckpointError, match="ring"):
+        bf.reshard(1)
+
+
+def test_bundle_diff():
+    """SATELLITE: the structural diff the bit-identity storms use -
+    equal bundles report equal; value, shape, and key differences are
+    named with counts."""
+    a = _fake_resident_bundle(ndev=2, live_per_dev=2)
+    b = _fake_resident_bundle(ndev=2, live_per_dev=2)
+    assert a.diff(b)["equal"] is True
+    b.arrays["ivalues"] = b.arrays["ivalues"].copy()
+    b.arrays["ivalues"][0, 0] = 7
+    d = a.diff(b)
+    assert d["equal"] is False
+    assert d["mismatched"]["ivalues"]["n"] == 1
+    assert d["mismatched"]["ivalues"]["max_abs"] == 7.0
+    c = _fake_resident_bundle(ndev=4, live_per_dev=2)
+    d2 = a.diff(c)
+    assert not d2["equal"] and "shape" in d2["mismatched"]["tasks"]
+    e = _fake_resident_bundle(
+        ndev=2, live_per_dev=2,
+        extra={"waits": np.zeros((2, 5, 3), np.int32)},
+    )
+    d3 = a.diff(e)
+    assert d3["only_other"] == ["waits"] and not d3["equal"]
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_resident_quiesce_with_pending_waits_roundtrip():
+    """ACCEPTANCE (lifted limit #1): a resident mesh with PENDING
+    host-declared waits quiesces - the live wait table exports through
+    the aliased output (needs rebased) - and the resumed run re-arms the
+    parked rows exactly: the late put still wakes its consumer, results
+    match the uninterrupted run."""
+    import jax
+
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    ROWS, COLS = 8, 128
+    BUMP, PUT, CONSUME = 0, 1, 2
+
+    def make_rk():
+        def bump(ctx):
+            ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+        def put(ctx):
+            ctx.pgas.put(ctx.arg(0), 0, ctx.arg(1), ctx.arg(2))
+
+        def consume(ctx):
+            ctx.set_value(ctx.arg(0), ctx.pgas.count(0))
+
+        mk = Megakernel(
+            kernels=[("bump", bump), ("put", put), ("consume", consume)],
+            data_specs={
+                "heap": jax.ShapeDtypeStruct((ROWS, COLS), np.int32)
+            },
+            capacity=128, num_values=64, succ_capacity=64,
+            interpret=True, checkpoint=True,
+        )
+        return ResidentKernel(
+            mk, cpu_mesh(2, axis_name="q"),
+            channels={"c0": ("heap", 1)}, window=4,
+        )
+
+    def heap():
+        h = np.zeros((2, ROWS, COLS), np.int32)
+        for d in range(2):
+            for r in range(ROWS):
+                h[d, r, :] = 1000 * d + r
+        return h
+
+    def build():
+        builders = [TaskGraphBuilder(), TaskGraphBuilder()]
+        # The put hides behind a serial bump chain, so an early quiesce
+        # cuts BEFORE it runs and the wait is still parked.
+        prev = builders[0].add(BUMP, args=[1])
+        for i in range(20):
+            prev = builders[0].add(BUMP, args=[i + 2], deps=[prev])
+        builders[0].add(PUT, args=[1, 3, 2], deps=[prev])
+        t = builders[1].add(CONSUME, args=[1])
+        return builders, [[], [(0, 1, t)]]
+
+    builders, waits = build()
+    iv_f, data_f, info_f = make_rk().run(
+        builders, data={"heap": heap()}, waits=waits, quantum=2,
+        max_rounds=4096,
+    )
+    assert int(np.asarray(iv_f)[1, 1]) == 1  # consumer saw the arrival
+
+    builders, waits = build()
+    rk = make_rk()
+    iv_q, _, info_q = rk.run(
+        builders, data={"heap": heap()}, waits=waits, quantum=2,
+        max_rounds=4096, quiesce=2,
+    )
+    assert info_q["quiesced"] is True
+    assert info_q["pending"] > 0
+    w = np.asarray(info_q["state"]["waits"])
+    assert int(w[1, 0, 0]) == 1, w[1]  # the wait is STILL parked
+    assert int(w[1, 1, 1]) >= 1  # rebased need is still positive
+    iv_r, data_r, info_r = rk.run(
+        resume_state=info_q["state"], quantum=2, max_rounds=4096,
+    )
+    assert info_r["pending"] == 0
+    assert info_r["executed"] == info_f["executed"]
+    assert int(np.asarray(iv_r)[1, 1]) == 1  # re-armed wait fired
+    assert np.array_equal(
+        np.asarray(data_r["heap"]), np.asarray(data_f["heap"])
+    )
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_resident_inject_cursor_survives_reshard():
+    """ACCEPTANCE (lifted limit #2): a mid-stream quiesce keeps
+    published-but-unconsumed inject rows as ring residue with the
+    consumed cursor; the bundle reshards 4 -> 2 (residue re-dealt,
+    conserved) and the resumed smaller mesh drains everything exactly."""
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    BUMP = 0
+
+    def make_rk(ndev):
+        def bump(ctx):
+            ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+        mk = Megakernel(
+            kernels=[("bump", bump)], capacity=256, num_values=1024,
+            succ_capacity=8, interpret=True, checkpoint=True,
+        )
+        return ResidentKernel(
+            mk, cpu_mesh(ndev, axis_name="q"), migratable_fns=[BUMP],
+            window=4, homed=False, inject=True,
+        )
+
+    ndev = 4
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    v = 0
+    for d in range(ndev):
+        for _ in range(2):
+            v += 1
+            builders[d].add(BUMP, args=[v])
+    inject_rows = []
+    for d in range(ndev):
+        rows = []
+        for _ in range(6):
+            v += 1
+            rows.append((BUMP, [v]))
+        inject_rows.append(rows)
+    want = v * (v + 1) // 2
+
+    rk = make_rk(ndev)
+    # quiesce=True: threshold round 0 - the poll never consumes, so ALL
+    # inject rows are residue and the cut is maximally mid-stream.
+    _, _, info_q = rk.run(
+        builders, inject_rows=inject_rows, quantum=4, max_rounds=4096,
+        quiesce=True,
+    )
+    assert info_q["quiesced"] is True
+    st = info_q["state"]
+    assert int(np.asarray(st["ictl"])[:, 0].sum()) == 4 * 6  # residue
+    bundle = snapshot_resident(rk, info_q)
+    small = bundle.reshard(2)
+    assert int(np.asarray(small.arrays["ictl"])[:, 0].sum()) == 24
+    rk2 = make_rk(2)
+    iv, _, info = rk2.run(
+        resume_state=small.state(), quantum=8, max_rounds=1 << 14,
+    )
+    assert info["pending"] == 0
+    assert int(np.asarray(iv)[:, 0].sum()) == want
+    assert info["executed"] == v
+    # Partial consumption: a later cut consumes some rounds' rows first;
+    # the cursor still reconciles (consumed + residue == published).
+    rk3 = make_rk(ndev)
+    _, _, info_q3 = rk3.run(
+        builders, inject_rows=inject_rows, quantum=4, max_rounds=4096,
+        quiesce=2,
+    )
+    if info_q3["quiesced"]:
+        ic = np.asarray(info_q3["inject_ctl"])
+        residue = int(np.asarray(info_q3["state"]["ictl"])[:, 0].sum())
+        assert int(ic[:, 2].sum()) + residue == int(ic[:, 0].sum())
+        iv3, _, info3 = rk3.run(
+            resume_state=info_q3["state"], quantum=8,
+            max_rounds=1 << 14,
+        )
+        assert int(np.asarray(iv3)[:, 0].sum()) == want
 
 
 @needs_mosaic

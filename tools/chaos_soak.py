@@ -26,11 +26,21 @@ live injection stream whose snapshot then drains exactly; and a
 resident-mesh checkpoint restored onto a SMALLER mesh (N->M re-homing,
 totals conserved - Mosaic-gated like the other mesh scenarios).
 
+``--storm`` adds the seeded PREEMPT-STORM scenarios (ISSUE 6): repeated
+fire_preempt cuts on a live injection stream (every cut resumed, grand
+total exact), >= 3 chained checkpoints on one UTS traversal with
+byte-identical bundles across storms (CheckpointBundle.diff), and the
+autoscaled resident mesh riding scale-out, a dead-chip EVACUATION
+mid-stream, and scale-in with totals bit-identical to an uninterrupted
+fault-free run (the autoscale half is Mosaic-gated like the other mesh
+scenarios).
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
     python tools/chaos_soak.py --mesh --seeds 1   # device-mesh chaos (CI)
     python tools/chaos_soak.py --preempt-only --seeds 1  # checkpoint (CI)
+    python tools/chaos_soak.py --storm-only --seeds 1  # preempt storms (CI)
 
 One JSON line per scenario; a machine-readable summary line last (seed
 base/count, faults injected, recoveries, failures, wall time) so CI and
@@ -469,6 +479,250 @@ def scenario_preempt_mesh_reshard(seed: int, scale: str) -> dict:
             "pending_at_cut": info_q["pending"]}
 
 
+# ------------------------------------- preempt storms + autoscale (ISSUE 6)
+
+def scenario_storm_stream(seed: int, scale: str) -> dict:
+    """Seeded PREEMPT STORM on a live injection stream: repeated
+    fire_preempt cuts (the SIGTERM path) interleaved with resumes - every
+    cut exports the ring residue + cursor, every resume drains exactly,
+    and the grand total is bit-identical to an uninterrupted stream."""
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.runtime import resilience
+    from hclib_tpu.runtime.checkpoint import checkpoint_on_preempt
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    def make_sm():
+        return StreamingMegakernel(
+            Megakernel(kernels=[("bump", bump)], capacity=512,
+                       num_values=64, succ_capacity=8, interpret=True,
+                       checkpoint=True),
+            ring_capacity=512,
+        )
+
+    n = 60 if scale == "smoke" else 240
+    cuts = 3
+    resilience.reset_preempt()
+    sm = make_sm()
+    b = TaskGraphBuilder()
+    for i in range(8):
+        b.add(0, args=[i + 1])
+    for i in range(8, n):
+        sm.inject(0, args=[i + 1])
+    state = None
+    quiesced = 0
+    try:
+        for cut in range(cuts):
+            # Each cut: the preemption notice lands WHILE the stream
+            # runs (a resume clears any pre-entry quiesce request by
+            # design - same-object resumes behave like fresh streams),
+            # so fire it from a delayed thread like a real SIGTERM.
+            delay = 0.1 + 0.02 * ((seed + cut) % 4)
+            t = threading.Thread(
+                target=lambda d=delay, c=cut: (
+                    time.sleep(d),
+                    resilience.fire_preempt(f"storm cut {c}"),
+                ),
+            )
+            with checkpoint_on_preempt(sm, after_executed=2):
+                t.start()
+                if state is None:
+                    iv, info = sm.run_stream(b, quantum=4,
+                                             deadline_s=120.0)
+                else:
+                    iv, info = sm.run_stream(resume_state=state,
+                                             quantum=4, deadline_s=120.0)
+                t.join()
+            resilience.reset_preempt()
+            assert info.get("quiesced"), f"cut {cut} never landed"
+            quiesced += 1
+            state = info["state"]
+        sm.close()
+        iv, info = sm.run_stream(resume_state=state, quantum=64,
+                                 deadline_s=120.0)
+    finally:
+        resilience.reset_preempt()
+    want = n * (n + 1) // 2
+    assert int(iv[0]) == want, (int(iv[0]), want)
+    assert info["pending"] == 0
+    st = sm.stats_dict()
+    assert st["quiesces"] == quiesced, st
+    return {"faults": quiesced, "recoveries": quiesced, "injected": n,
+            "cuts": quiesced, "total": want}
+
+
+def scenario_storm_megakernel_chain(seed: int, scale: str) -> dict:
+    """Chained checkpoint storm on the scalar tier: >= 3 quiesce cuts on
+    one UTS traversal (one through the on-disk bundle), final count
+    bit-identical; two independent storms produce byte-identical mid-cut
+    bundles (CheckpointBundle.diff - determinism of the cut itself)."""
+    import tempfile
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.workloads import (
+        UTS_NODE, device_uts_mk, make_uts_megakernel,
+    )
+    from hclib_tpu.runtime.checkpoint import (
+        CheckpointBundle, restore_megakernel, snapshot_megakernel,
+    )
+
+    kw = dict(seed=19 + seed, interpret=True,
+              max_depth=7 if scale == "smoke" else 9)
+    nodes, _ = device_uts_mk(**kw)
+
+    def storm(mk):
+        b = TaskGraphBuilder()
+        b.add(UTS_NODE, args=[1, 0])
+        # Absolute cut positions; quiesce= counts executed-since-ENTRY,
+        # so each resume's threshold is relative to the previous cut.
+        cuts = [max(1, nodes // 4), max(2, nodes // 2),
+                max(3, (3 * nodes) // 4)]
+        _, _, info = mk.run(b, quiesce=cuts[0])
+        assert info["quiesced"], info
+        bundles = [snapshot_megakernel(mk, info)]
+        for at in cuts[1:]:
+            rel = max(1, at - info["executed"])
+            _, _, info = mk.resume(info["state"], quiesce=rel)
+            assert info["quiesced"], info
+            bundles.append(snapshot_megakernel(mk, info))
+        return info, bundles
+
+    mk = make_uts_megakernel(checkpoint=True, **kw)
+    info, bundles = storm(mk)
+    # Cut 3 goes through disk onto a FRESH kernel.
+    d = tempfile.mkdtemp(prefix="hclib-storm-")
+    bundles[-1].save(d)
+    iv, _, done = restore_megakernel(
+        d, make_uts_megakernel(checkpoint=True, **kw)
+    )
+    assert int(iv[0]) == nodes and done["pending"] == 0, (int(iv[0]), nodes)
+    # Determinism of the storm itself: a second identical storm's
+    # bundles are byte-identical (diff reports equal).
+    _, bundles2 = storm(make_uts_megakernel(checkpoint=True, **kw))
+    for b1, b2 in zip(bundles, bundles2):
+        dd = b1.diff(b2)
+        assert dd["equal"], dd
+    # And a re-loaded bundle equals what was saved.
+    assert CheckpointBundle.load(d).diff(bundles[-1])["equal"]
+
+    # Cholesky under the same storm (batch tier + through-disk bf16):
+    # two chained cuts + a disk restore, L bit-identical to the
+    # uninterrupted factor.
+    import numpy as np
+
+    from hclib_tpu.device.cholesky import (
+        build_cholesky_graph, cholesky_buffers, make_cholesky_megakernel,
+    )
+    from hclib_tpu.models.cholesky import make_spd
+
+    nt = 2
+    a = make_spd(256).astype(np.float32)
+    _, data_full, info_full = make_cholesky_megakernel(
+        nt, interpret=True
+    ).run(build_cholesky_graph(nt), data=cholesky_buffers(a, nt))
+    L_full = np.asarray(data_full["tiles"])
+    mkc = make_cholesky_megakernel(nt, interpret=True, checkpoint=True)
+    _, _, qc = mkc.run(
+        build_cholesky_graph(nt), data=cholesky_buffers(a, nt), quiesce=2,
+    )
+    chol_cuts = 1
+    if qc["quiesced"] and qc["pending"] > 0:
+        _, _, q2 = mkc.resume(qc["state"], quiesce=2)
+        if q2["quiesced"]:
+            chol_cuts += 1
+            qc = q2
+        dc = tempfile.mkdtemp(prefix="hclib-storm-chol-")
+        snapshot_megakernel(mkc, qc).save(dc)
+        _, data_r, info_r = restore_megakernel(
+            dc, make_cholesky_megakernel(nt, interpret=True,
+                                         checkpoint=True)
+        )
+        assert info_r["executed"] == info_full["executed"]
+        assert np.array_equal(np.asarray(data_r["tiles"]), L_full)
+    return {"faults": len(bundles) + chol_cuts,
+            "recoveries": len(bundles) + chol_cuts,
+            "nodes": nodes, "cuts": len(bundles),
+            "cholesky_cuts": chol_cuts}
+
+
+def scenario_storm_autoscale(seed: int, scale: str) -> dict:
+    """The full elastic story under a seeded storm: an autoscaled UTS
+    mesh scales OUT under backlog, a dead chip mid-stream is detected,
+    quarantined, and EVACUATED by reshard, the idle tail scales IN - and
+    the final totals are bit-identical to an uninterrupted fault-free
+    run (zero task loss through >= 3 scale events)."""
+    skip = _mesh_prereq()
+    if skip:
+        return {"skipped": skip}
+    import numpy as np
+
+    import hclib_tpu as hc
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.workloads import UTS_NODE, make_uts_megakernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    depth = 6 if scale == "smoke" else 7
+
+    def make_kernel(ndev, faulty=True):
+        plan = None
+        if ndev == 4 and faulty:
+            # The storm's chip death: device 3 dies early in every
+            # 4-device slice; survivors quarantine it by heartbeat.
+            plan = hc.DeviceFaultPlan(
+                seed=seed, dead_device=3, dead_round=2,
+                heartbeat_timeout=2,
+            )
+        mk = make_uts_megakernel(seed=19 + seed, max_depth=depth,
+                                 interpret=True, checkpoint=True)
+        return ResidentKernel(
+            mk, cpu_mesh(ndev, axis_name="q"),
+            migratable_fns=[UTS_NODE], window=4, homed=False,
+            fault_plan=plan,
+        )
+
+    def builders(ndev):
+        bs = [TaskGraphBuilder() for _ in range(ndev)]
+        for d in range(ndev):
+            for r in range(8):
+                bs[d].add(UTS_NODE, args=[d * 8 + r + 1, 0])
+        return bs
+
+    # Uninterrupted, fault-free reference on the starting mesh size.
+    iv_f, _, info_f = make_kernel(2, faulty=False).run(
+        builders(2), quantum=8, max_rounds=1 << 14
+    )
+    total = int(np.asarray(iv_f)[:, 0].sum())
+
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(
+        make_kernel,
+        hc.AutoscalerPolicy(min_devices=1, max_devices=4,
+                            scale_out_backlog=4.0, scale_in_backlog=1.0,
+                            hysteresis=1, cooldown=1),
+        slice_rounds=8, metrics=reg,
+    )
+    iv, _, info = asc.run(builders(2), quantum=8)
+    assert info["pending"] == 0, info
+    assert int(np.asarray(iv)[:, 0].sum()) == total, (
+        int(np.asarray(iv)[:, 0].sum()), total
+    )
+    assert info["executed"] == info_f["executed"]
+    kinds = [e["kind"] for e in info["scale_events"]]
+    assert len(info["scale_events"]) >= 3, kinds
+    assert "evacuate" in kinds, kinds
+    resizes = [e for e in info["scale_events"]
+               if e["from_ndev"] != e["to_ndev"]]
+    snap = reg.snapshot()["metrics"]
+    assert snap.get("autoscale.evacuate.count", 0) >= 1, snap
+    return {"faults": 1, "recoveries": 1, "total": total,
+            "events": kinds, "resizes": len(resizes),
+            "ndev_final": info["ndev_final"]}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -486,6 +740,12 @@ PREEMPT_SCENARIOS = [
     ("preempt_checkpoint", scenario_preempt_checkpoint),
     ("preempt_stream", scenario_preempt_stream),
     ("preempt_mesh_reshard", scenario_preempt_mesh_reshard),
+]
+
+STORM_SCENARIOS = [
+    ("storm_stream", scenario_storm_stream),
+    ("storm_megakernel_chain", scenario_storm_megakernel_chain),
+    ("storm_autoscale", scenario_storm_autoscale),
 ]
 
 
@@ -506,6 +766,13 @@ def main(argv=None) -> int:
                          "conserved; incl. N->M mesh reshard)")
     ap.add_argument("--preempt-only", action="store_true",
                     help="run ONLY the preemption scenarios")
+    ap.add_argument("--storm", action="store_true",
+                    help="add the seeded preempt-storm scenarios "
+                         "(repeated cuts on a live stream, chained "
+                         "megakernel checkpoints, and the autoscaled "
+                         "mesh with a dead-chip evacuation mid-stream)")
+    ap.add_argument("--storm-only", action="store_true",
+                    help="run ONLY the preempt-storm scenarios")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -519,12 +786,16 @@ def main(argv=None) -> int:
     # on top of whatever remains, so every combination runs exactly the
     # groups it names (e.g. --mesh-only --preempt = mesh + preempt).
     scenarios = (
-        [] if (args.mesh_only or args.preempt_only) else list(SCENARIOS)
+        []
+        if (args.mesh_only or args.preempt_only or args.storm_only)
+        else list(SCENARIOS)
     )
     if args.mesh or args.mesh_only:
         scenarios += MESH_SCENARIOS
     if args.preempt or args.preempt_only:
         scenarios += PREEMPT_SCENARIOS
+    if args.storm or args.storm_only:
+        scenarios += STORM_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

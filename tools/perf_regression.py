@@ -169,13 +169,21 @@ def _checkpoint_overhead(quick: bool, trials: int) -> dict:
     --checkpoint-tolerance (it pays one qctl DMA per scheduling round).
     Also measures the quiesce LAG - how far past the requested round the
     boundary landed, in tasks - which must stay within one batch width
-    (the same overshoot contract fuel has)."""
+    (the same overshoot contract fuel has).
+
+    The third arm prices ``quiesce_stride`` (ISSUE 6): polling the qctl
+    word every Nth round instead of every round must land at or below
+    the per-round arm's cost (it does strictly fewer DMAs), and its
+    quiesce lag may grow by at most stride-1 rounds' worth of tasks -
+    both bounded here so the knob can never silently regress either
+    side of its trade."""
     from hclib_tpu.device.descriptor import TaskGraphBuilder
     from hclib_tpu.device.workloads import (
         UTS_NODE, make_uts_megakernel,
     )
 
     kw = dict(interpret=True, max_depth=6 if quick else 8)
+    STRIDE = 4
 
     def builder():
         b = TaskGraphBuilder()
@@ -184,10 +192,14 @@ def _checkpoint_overhead(quick: bool, trials: int) -> dict:
 
     mk_off = make_uts_megakernel(**kw)
     mk_on = make_uts_megakernel(checkpoint=True, **kw)
+    mk_strided = make_uts_megakernel(
+        checkpoint=True, quiesce_stride=STRIDE, **kw
+    )
     nodes = mk_off.run(builder())[2]["executed"]  # also warms the jit
     mk_on.run(builder())  # warm the enabled build too
+    mk_strided.run(builder())
     n = max(2, trials)
-    base, on = [], []
+    base, on, strided = [], [], []
     for _ in range(n):
         t0 = time.perf_counter_ns()
         mk_off.run(builder())
@@ -195,6 +207,9 @@ def _checkpoint_overhead(quick: bool, trials: int) -> dict:
         t0 = time.perf_counter_ns()
         mk_on.run(builder())
         on.append(time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
+        mk_strided.run(builder())
+        strided.append(time.perf_counter_ns() - t0)
     # Quiesce latency: request the cut at half the tree; the observed
     # boundary must not drift (lag in tasks) and the quiesced entry must
     # not cost more than an uninterrupted run (it does strictly less).
@@ -203,13 +218,19 @@ def _checkpoint_overhead(quick: bool, trials: int) -> dict:
     _, _, info_q = mk_on.run(builder(), quiesce=at)
     quiesce_ns = time.perf_counter_ns() - t0
     lag = info_q["quiesce"]["executed_at"] - at
+    _, _, info_qs = mk_strided.run(builder(), quiesce=at)
+    lag_s = info_qs["quiesce"]["executed_at"] - at
     return {
         "base_ns": min(base),
         "checkpoint_ns": min(on),
         "ratio": min(on) / min(base),
+        "stride": STRIDE,
+        "stride_ns": min(strided),
+        "stride_ratio": min(strided) / min(base),
         "nodes": nodes,
         "quiesce_entry_ns": quiesce_ns,
         "quiesce_lag_tasks": int(lag),
+        "stride_lag_tasks": int(lag_s),
     }
 
 
@@ -335,9 +356,11 @@ def main(argv=None) -> int:
             results["checkpoint-overhead"] = co
             line = (
                 f"{'checkpoint-overhead':15s} ratio {co['ratio']:5.2f}x "
-                f"({co['checkpoint_ns'] / 1e6:.1f} ms vs "
+                f"(stride-{co['stride']} {co['stride_ratio']:5.2f}x; "
+                f"{co['checkpoint_ns'] / 1e6:.1f} ms vs "
                 f"{co['base_ns'] / 1e6:.1f} ms, {co['nodes']} nodes; "
-                f"quiesce lag {co['quiesce_lag_tasks']} tasks)"
+                f"quiesce lag {co['quiesce_lag_tasks']} tasks, strided "
+                f"{co['stride_lag_tasks']})"
             )
             if co["ratio"] > args.checkpoint_tolerance:
                 failures.append(
@@ -347,6 +370,17 @@ def main(argv=None) -> int:
                     "word is taxing the round loop"
                 )
                 line += "  REGRESSED"
+            if co["stride_ratio"] > args.checkpoint_tolerance:
+                # The stride knob exists to CUT the enabled-idle tax; a
+                # strided build pricier than the bound means the poll
+                # skip is broken, not just slow.
+                failures.append(
+                    f"checkpoint-overhead: quiesce_stride={co['stride']} "
+                    f"(idle) is {co['stride_ratio']:.2f}x slower (bound "
+                    f"{args.checkpoint_tolerance:.2f}x) - the strided "
+                    "poll is not skipping DMAs"
+                )
+                line += "  STRIDE-REGRESSED"
             if co["quiesce_lag_tasks"] > 8:
                 failures.append(
                     f"checkpoint-overhead: quiesce landed "
@@ -355,6 +389,14 @@ def main(argv=None) -> int:
                     "width) regressed"
                 )
                 line += "  LAG-REGRESSED"
+            if co["stride_lag_tasks"] > 8 + co["stride"] - 1:
+                failures.append(
+                    f"checkpoint-overhead: strided quiesce landed "
+                    f"{co['stride_lag_tasks']} tasks past the requested "
+                    f"round (contract: one batch width + stride-1 = "
+                    f"{8 + co['stride'] - 1})"
+                )
+                line += "  STRIDE-LAG-REGRESSED"
             print(line, flush=True)
 
     if args.device:

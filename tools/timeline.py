@@ -399,6 +399,17 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                      "checkpoint (quiesce→export)",
                      {"pending": a, "ready_backlog": b})
                 quiesce_at = None
+            elif tag == tb.TR_SCALE:
+                # Autoscaler decision (host-emitted ring, slice index as
+                # timebase): label resizes with their mesh arrow so the
+                # control loop's story reads directly off the track.
+                frm, to = a >> 8, a & 0xFF
+                kind = tb.SC_NAMES.get(b, f"scale<{b}>")
+                name = (
+                    f"{kind} {frm}→{to}" if frm != to else kind
+                )
+                span(_TID_EVENTS, "autoscaler", t, 0.5, name,
+                     {"from_ndev": frm, "to_ndev": to, "slice": t})
             else:
                 name = tb.TAG_NAMES.get(tag, f"tag{tag}")
                 span(_TID_EVENTS, "events", t, 0.25, name,
