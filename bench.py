@@ -889,6 +889,79 @@ def bench_autoscale():
     return out
 
 
+def bench_multichip(quick: bool = False) -> None:
+    """8-device forest-steal through the sharded steal runner, BATCHED
+    arm first (ISSUE 7): the batched tasks/s headline JSON prints (and
+    flushes) before anything else can eat the driver budget - the same
+    rc=124-proofing the single-device path got in PR 3 - then per-device
+    occupancy/prefetch lines and the scalar-mesh comparison go to stderr,
+    budget-gated."""
+    from hclib_tpu.device import stress
+
+    kw = stress.FOREST_STEAL_QUICK if quick else stress.FOREST_STEAL_BENCH
+    try:
+        binfo = stress.forest_steal(batch_width=8, **kw)
+        print(
+            json.dumps(
+                {
+                    "metric": f"forest-steal mesh throughput (batched "
+                    f"dispatch, {kw['ndev']} devices, "
+                    f"{kw['roots']}x fib({kw['n']}))",
+                    "value": round(binfo["tasks_per_sec"]),
+                    "unit": "tasks/sec",
+                    "tasks": binfo["tasks"],
+                    "mean_occupancy": round(binfo["mean_occupancy"], 3),
+                    "devices_used": binfo["devices_used"],
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:
+        log(f"multichip batched bench failed: {e}")
+        print(
+            json.dumps(
+                {
+                    "metric": "multichip bench headline unavailable "
+                    f"({str(e)[:160]})",
+                    "value": 0,
+                    "unit": "none",
+                }
+            ),
+            flush=True,
+        )
+        return
+    for d, t in enumerate(binfo["tiers"]):
+        log(
+            f"device {d}: occupancy {t['batch_occupancy']:.2f} "
+            f"({t['batch_rounds']} batch rounds, {t['batch_tasks']} "
+            f"batched + {t['scalar_tasks']} scalar tasks, "
+            f"{t['prefetch_hits']} prefetch hits, {t['spilled']} lane "
+            f"spills)"
+        )
+    out = {"batched": {k: v for k, v in binfo.items() if k != "trace"}}
+    sinfo = section(
+        "scalar-mesh baseline", 180,
+        lambda: stress.forest_steal(**kw),
+    )
+    if sinfo:
+        mult = binfo["tasks_per_sec"] / sinfo["tasks_per_sec"]
+        log(
+            f"mesh batch dispatch vs scalar mesh: {mult:.2f}x "
+            f"({binfo['tasks_per_sec']:,.0f} vs "
+            f"{sinfo['tasks_per_sec']:,.0f} tasks/s; interpret-mode "
+            "wall time is weather/ordering-prone - the guard of record "
+            "is tools/perf_regression.py --multichip, which runs the "
+            "scalar arm first)"
+        )
+        out["scalar"] = dict(sinfo)
+        out["batch_vs_scalar"] = mult
+    os.makedirs("perf-logs", exist_ok=True)
+    path = os.path.join("perf-logs", f"{int(time.time())}.multichip.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, default=float)
+    log(f"multichip log written: {path}")
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -910,9 +983,31 @@ def main(argv=None) -> None:
         "tasks/s through a scale event) into perf-logs/ "
         "(budget-gated like the other sections)",
     )
+    ap.add_argument(
+        "--multichip", action="store_true",
+        help="8-device mesh mode: the batched forest-steal tasks/s "
+        "headline prints FIRST (stdout JSON), then per-device "
+        "occupancy/prefetch lines and the scalar-mesh comparison "
+        "(stderr); replaces the single-device suite for this run",
+    )
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="tiny multichip inputs (CI smoke; only affects --multichip)",
+    )
     args = ap.parse_args(argv)
     global _T0
     _T0 = time.monotonic()  # arm the wall budget for THIS driver run
+    if args.multichip:
+        # Must land before jax initializes: the mesh workloads need the
+        # CPU backend with 8 virtual devices.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        bench_multichip(quick=args.quick)
+        return
     # ---- headline FIRST: the stdout JSON line exists (and is flushed)
     # before any secondary section can eat the driver budget. Every
     # fallback rung is itself guarded: stdout MUST end up with one

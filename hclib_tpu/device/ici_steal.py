@@ -279,23 +279,34 @@ class ICIStealMegakernel:
         # Megakernel._kernel).
         mk = self.mk
         ndata = len(mk.data_specs)
+        nbatch = 1 if mk.batch_specs else 0
         ntrace = 1 if trace is not None else 0
         n_in = 6 + ndata  # + abort word (last input)
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata + ntrace]
-        rest = refs[n_in + 4 + ndata + ntrace :]
+        out_refs = refs[n_in : n_in + 4 + ndata + nbatch + ntrace]
+        rest = refs[n_in + 4 + ndata + nbatch + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
+        stail = list(rest[nscratch:])
         (
             free, vfree, candbuf, sendbuf, inbox, statsnd, statrcv,
             abuf, dsems, csems, asem,
-        ) = rest[nscratch:]
+        ) = stail[:11]
+        # Batched dispatch tier (ISSUE 7): lane scratch rides last; the
+        # spill discipline empties it at every sched() exit, so the steal
+        # export scan between rounds only ever sees ring rows. The length
+        # check keeps the positional bind loud: an edit to _build's
+        # scratch list that forgets these indices must fail at trace
+        # time, not scribble batch descriptors into a neighboring ref.
+        assert len(stail) == 11 + 2 * nbatch, len(stail)
+        lanes, lstate = (stail[11], stail[12]) if nbatch else (None, None)
         abort_in = in_refs[n_in - 1]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        tstats = out_refs[4 + ndata] if nbatch else None
         tr = (
-            Tracer(out_refs[4 + ndata], trace.capacity)
+            Tracer(out_refs[4 + ndata + nbatch], trace.capacity)
             if ntrace
             else NullTracer()
         )
@@ -306,6 +317,7 @@ class ICIStealMegakernel:
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True,
+            lanes=lanes, lstate=lstate, tstats=tstats,
             tracer=tr if tr.enabled else None,
         )
 
@@ -460,11 +472,19 @@ class ICIStealMegakernel:
         """
         mk = self.mk
         ndata = len(mk.data_specs)
+        nbatch = 1 if mk.batch_specs else 0
         ntrace = 1 if trace is not None else 0
-        n_in = 5 + ndata
+        # + abort word (last input; this body polls nothing but must
+        # count it - _build passes 6 + ndata inputs to whichever body it
+        # binds, and miscounting here shears every ref slice after the
+        # inputs). NOTE: run() delegates every pof2 mesh to
+        # ResidentKernel, so this body is unreachable today; it is kept
+        # aligned with _build so a direct build fails loudly (the
+        # scratch-tail length assert below) rather than silently.
+        n_in = 6 + ndata
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata + ntrace]
-        rest = refs[n_in + 4 + ndata + ntrace :]
+        out_refs = refs[n_in : n_in + 4 + ndata + nbatch + ntrace]
+        rest = refs[n_in + 4 + ndata + nbatch + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         nh = self._nh
@@ -472,20 +492,27 @@ class ICIStealMegakernel:
         free, vfree, candbuf, sendbuf, statsnd = tail[:5]
         statrcv = tail[5 : 5 + nh]
         inboxes = tail[5 + nh : 5 + 2 * nh]
-        ssems, rsems, csems = tail[5 + 2 * nh :]
+        ssems, rsems, csems = tail[5 + 2 * nh : 5 + 2 * nh + 3]
+        assert len(tail) == 5 + 2 * nh + 3 + 2 * nbatch, len(tail)
+        lanes, lstate = (
+            (tail[5 + 2 * nh + 3], tail[5 + 2 * nh + 4])
+            if nbatch else (None, None)
+        )
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        tstats = out_refs[4 + ndata] if nbatch else None
         if ntrace:
             # This body is only reachable on pof2 meshes, which run()
             # routes to ResidentKernel (the traced path) - but keep the
             # appended output deterministic if built directly.
             for w in range(_TR_HDR):
-                out_refs[4 + ndata][w] = 0
+                out_refs[4 + ndata + nbatch][w] = 0
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True,
+            lanes=lanes, lstate=lstate, tstats=tstats,
         )
 
         ndev = self.ndev
@@ -653,18 +680,23 @@ class ICIStealMegakernel:
     def _build(self, quantum: int, max_rounds: int):
         mk = self.mk
         ndata = len(mk.data_specs)
+        nbatch = 1 if mk.batch_specs else 0
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
         ntrace = 1 if mk.trace is not None else 0
         # Trailing abort-word input (HBM: the kernel re-reads it per round).
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [anyspace()]
         out_specs = tuple(
-            [smem()] * 4 + [anyspace()] * ndata + [smem()] * ntrace
+            [smem()] * 4 + [anyspace()] * ndata
+            + [smem()] * nbatch  # tstats (batch-routed builds)
+            + [smem()] * ntrace
         )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
             for s in mk.data_specs.values()
         ]
+        from .megakernel import TS_WORDS
+
         out_shape = tuple(
             [
                 jax.ShapeDtypeStruct((mk.capacity, DESC_WORDS), jnp.int32),
@@ -673,6 +705,10 @@ class ICIStealMegakernel:
                 jax.ShapeDtypeStruct((mk.num_values,), jnp.int32),
             ]
             + data_shapes
+            + (
+                [jax.ShapeDtypeStruct((TS_WORDS,), jnp.int32)]
+                if nbatch else []
+            )
             + ([mk.trace.out_shape()] if ntrace else [])
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
@@ -714,6 +750,17 @@ class ICIStealMegakernel:
                 pltpu.SemaphoreType.REGULAR((2,)),
                 pltpu.SemaphoreType.DMA((1,)),  # asem
             ]
+        if mk.batch_specs:
+            # Batched dispatch tier lane scratch (both bodies unpack it
+            # last): re-entrant across sched() entries via the spill
+            # discipline, so the steal exchange never sees a lane entry.
+            nb = len(mk.batch_specs)
+            from .megakernel import LS_WORDS
+
+            scratch += [
+                pltpu.SMEM((nb, mk.capacity), jnp.int32),  # lanes
+                pltpu.SMEM((nb, LS_WORDS), jnp.int32),  # lstate
+            ]
         kern = pl.pallas_call(
             functools.partial(body, quantum, max_rounds, mk.trace),
             out_shape=out_shape,
@@ -733,14 +780,14 @@ class ICIStealMegakernel:
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
             data_o = outs[4 : 4 + ndata]
-            trace_o = outs[4 + ndata :]
+            extra_o = outs[4 + ndata :]  # [tstats?, trace?]
             gcounts = jax.lax.psum(counts_o, self.axes)
             return (
                 counts_o[None],
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
-                *[t[None] for t in trace_o],
+                *[t[None] for t in extra_o],
             )
 
         nin = 6 + ndata
@@ -748,7 +795,7 @@ class ICIStealMegakernel:
             step,
             mesh=self.mesh,
             in_specs=(P(self.axes),) * nin,
-            out_specs=(P(self.axes),) * (3 + ndata + ntrace),
+            out_specs=(P(self.axes),) * (3 + ndata + nbatch + ntrace),
             check_vma=False,
         )
         return jax.jit(f)
@@ -794,6 +841,14 @@ class ICIStealMegakernel:
                 [tail[-1][d] for d in range(self.ndev)], t0_ns, t1_ns,
                 self.mk.trace.capacity,
             )
+        if self.mk.batch_specs and tail:
+            # Per-device batched-tier counters (tstats rides before the
+            # trace ring in the appended outputs).
+            trows = tail[0]
+            info["tiers"] = [
+                self.mk.decode_tier_stats(trows[d])
+                for d in range(self.ndev)
+            ]
         info["aborted"] = bool(abort_arr[:, 0].any()) and info["pending"] != 0
         if info["overflow"]:
             raise RuntimeError("ici steal: task-table overflow")

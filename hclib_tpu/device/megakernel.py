@@ -482,6 +482,14 @@ class BatchSpec:
     arbitrary DAGs; the body remains responsible for its slots writing
     disjoint data.
 
+    Lane pop order: non-prefetch specs pop the NEWEST queued descriptors
+    each round (LIFO, the scalar tier's owner-side discipline) - recursive
+    spawn-heavy families stay depth-first (bounded live set) and the
+    oldest entries stay cold for the multi-device steal exchanges.
+    ``prefetch=True`` switches the lane to FIFO pops, which the prefetch
+    pipeline requires (see below); the static tile DAGs that use prefetch
+    are order-insensitive.
+
     ``prefetch=True`` opts into the cross-round double-buffer protocol:
     the tier tells the body how many descriptors of the NEXT prospective
     batch to prefetch (``ctx.prefetch_count``) and, the round after, how
@@ -525,7 +533,8 @@ class BatchContext:
     """
 
     def __init__(self, kctx, lanes, li, head, count, width,
-                 prefetched, buf, prefetch_count, capacity):
+                 prefetched, buf, prefetch_count, capacity,
+                 ctx_hook=None):
         self.k = kctx
         self._lanes = lanes
         self._li = li
@@ -537,6 +546,11 @@ class BatchContext:
         self.buf = buf                    # 0/1 operand half holding them
         self.prefetch_count = prefetch_count  # next-batch slots to issue
         self._capacity = capacity
+        # The embedding runner's per-task context hook (attaches ctx.pgas
+        # on the resident/pgas runners): applied to every slot_ctx so a
+        # batch body's per-slot contexts carry the same facilities the
+        # scalar dispatch path would have handed the task.
+        self._ctx_hook = ctx_hook
 
     # -- current batch --
 
@@ -567,6 +581,27 @@ class BatchContext:
     def set_out(self, s, v) -> None:
         """Write slot ``s``'s output value (callers guard liveness)."""
         self.k.ivalues[self.out_slot(s)] = v
+
+    def slot_ctx(self, s):
+        """A KernelContext focused on slot ``s``'s descriptor row - for
+        batch bodies whose per-slot work is scalar-shaped (dynamic spawns,
+        continuation transfer) rather than one fused tile op. The returned
+        context shares every underlying ref with this batch, so
+        ``spawn``/``take_continuation``/``set_arg``/``row_values`` behave
+        exactly as they would under scalar dispatch of the same row; a
+        body that unrolls ``range(width)`` under ``pl.when(live(s))`` and
+        runs the scalar kernel per live slot computes bit-identical
+        results while skipping the per-descriptor ring pop + lax.switch
+        overhead (the batched spelling of spawn-heavy families like fib)."""
+        k = self.k
+        ctx = KernelContext(
+            self.idx(s), k._tasks, k._succ, k._ready, k._counts, k.ivalues,
+            k.data, k.scratch, k._capacity, k._free, k._num_values,
+            k._vfree, k._uses_row_values, k._tracks_home,
+        )
+        if self._ctx_hook is not None:
+            self._ctx_hook(ctx)
+        return ctx
 
     # -- prospective next batch (prefetch targets) --
 
@@ -878,10 +913,16 @@ class Megakernel:
         """
         capacity = self.capacity
         num_values = value_limit if value_limit is not None else self.num_values
-        # Batched same-kind dispatch tier: requires the per-kind lanes only
-        # Megakernel's own build allocates. The multi-device runners embed
-        # the scheduler without them - a batch-routed kind there would
-        # dispatch into its no-op switch stub and silently drop work, so
+        # Batched same-kind dispatch tier: requires the per-kind lane
+        # scratch. Every runner that embeds this core (Megakernel's own
+        # build, the sharded steal loop, resident/ici/pgas) allocates and
+        # passes it; the lane discipline is steal-round-RE-ENTRANT - sched()
+        # unconditionally spills unrun lane entries back to the ready ring
+        # at every exit (the fuel/quiesce path below), so between sched
+        # calls the ring is the ONLY live structure and the steal/export/
+        # checkpoint sides never see a lane-resident descriptor. A direct
+        # embedder that forgot the scratch would dispatch batch-routed
+        # kinds into their no-op switch stub and silently drop work, so
         # refuse at trace time instead.
         if self.batch_specs and lanes is None:
             routed = sorted(
@@ -889,11 +930,11 @@ class Megakernel:
             )
             raise ValueError(
                 f"batch-routed kernels ({routed}) "
-                "need the batched dispatch tier's lane scratch, which only "
-                "Megakernel.run/_build provide - the embedding runners "
-                "(resident/ici/pgas/inject) run every kind scalar, and the "
-                "sharded runner's steal/export side cannot see lane "
-                "entries; drop the BatchSpec routes for those"
+                "need the batched dispatch tier's lane scratch "
+                "(lanes/lstate/tstats): pass it through _make_core like "
+                "Megakernel._build and the multi-device runners "
+                "(sharded/resident/ici/pgas) do, or drop the BatchSpec "
+                "routes for this embedding"
             )
         use_batch = lanes is not None and len(self.batch_specs) > 0
         nbatch = len(self.batch_specs) if use_batch else 0
@@ -951,10 +992,17 @@ class Megakernel:
 
             # C_TAIL is the all-time push counter; once it passes capacity
             # the whole ring may be live (entries wrap), and raw C_TAIL as
-            # a bound would walk out of the ring.
+            # a bound would walk out of the ring. A NEGATIVE head (lane
+            # spills insert at the cold end, walking head below zero) also
+            # wraps the live window - positions [capacity+head, capacity)
+            # hold live entries a [0, tail) copy would drop.
             jax.lax.fori_loop(
                 0,
-                jnp.minimum(counts_in[C_TAIL], capacity),
+                jnp.where(
+                    counts_in[C_HEAD] < 0,
+                    capacity,
+                    jnp.minimum(counts_in[C_TAIL], capacity),
+                ),
                 copy_ready,
                 0,
             )
@@ -1053,7 +1101,7 @@ class Megakernel:
                 ctx_hook(kctx)
             return BatchContext(
                 kctx, lanes, li, head, take, spec.width, pre, buf, nxt,
-                capacity,
+                capacity, ctx_hook=ctx_hook,
             )
 
         def sched(fuel) -> None:
@@ -1072,8 +1120,22 @@ class Megakernel:
                 B = spec.width
                 fid = self.batch_specs[li][0]
                 head = lstate[li, LS_HEAD]
-                avail = lstate[li, LS_TAIL] - head
+                tail = lstate[li, LS_TAIL]
+                avail = tail - head
                 take = jnp.minimum(avail, B)
+                # Pop side of the lane. Prefetch specs pop FIFO (oldest
+                # first): the cross-round operand pipeline targets "the
+                # entries behind the current batch", which is only stable
+                # when pops and pushes use opposite ends. Non-prefetch
+                # specs pop LIFO (the NEWEST `take` as one contiguous
+                # block): that is the scalar tier's owner-side discipline
+                # - newest-first keeps recursive families depth-first
+                # (live set ~ width * depth, not a breadth frontier; a
+                # FIFO fib lane measured ~40% of the WHOLE tree live) and
+                # leaves the oldest entries cold in the lane, which is
+                # exactly what the multi-device steal exchanges expect to
+                # find spilled at the ring's cold end.
+                base = head if spec.prefetch else tail - take
                 # Cross-round prefetch handshake: an outstanding prefetch
                 # is ours iff it was issued for exactly this head (a spill
                 # or lane restage invalidates by clearing LS_PF_BASE).
@@ -1104,21 +1166,26 @@ class Megakernel:
                     @pl.when(nxt > 0)
                     def _():
                         tr.emit(TR_PREFETCH_ISSUE, rt, fid, nxt)
-                bctx = _make_bctx(li, spec, head, take, pre, buf, nxt)
+                bctx = _make_bctx(li, spec, base, take, pre, buf, nxt)
                 spec.body(bctx)
                 for s in range(B):
                     @pl.when(jnp.int32(s) < take)
                     def _(s=s):
-                        complete(lanes[li, (head + s) % capacity])
-                lstate[li, LS_HEAD] = head + take
-                lstate[li, LS_PF_BASE] = jnp.where(
-                    nxt > 0, head + take + 1, 0
-                )
-                lstate[li, LS_PF_N] = nxt
-                # The half a prefetch targets is always 1 - buf; the next
-                # round consumes (or on-demand-fills) that half, so the
-                # parity alternates every round.
-                lstate[li, LS_PF_BUF] = 1 - buf
+                        complete(lanes[li, (base + s) % capacity])
+                if spec.prefetch:
+                    lstate[li, LS_HEAD] = head + take
+                    lstate[li, LS_PF_BASE] = jnp.where(
+                        nxt > 0, head + take + 1, 0
+                    )
+                    lstate[li, LS_PF_N] = nxt
+                    # The half a prefetch targets is always 1 - buf; the
+                    # next round consumes (or on-demand-fills) that half,
+                    # so the parity alternates every round.
+                    lstate[li, LS_PF_BUF] = 1 - buf
+                else:
+                    # LIFO pop: the block came off the tail; head (and the
+                    # dormant prefetch words) stay put.
+                    lstate[li, LS_TAIL] = base
                 tstats[TS_BATCH_ROUNDS] = tstats[TS_BATCH_ROUNDS] + 1
                 tstats[TS_BATCH_TASKS] = tstats[TS_BATCH_TASKS] + take
                 tstats[TS_OFFERED] = tstats[TS_OFFERED] + B
@@ -1254,11 +1321,23 @@ class Megakernel:
                 (counts[C_PENDING], counts[C_EXECUTED], e0, jnp.bool_(False)),
             )
             if use_batch:
-                # Exit with unrun lane entries (fuel exhaustion): retire
-                # any in-flight prefetch, then spill the entries back to
-                # the ready ring - the ring is the only structure whose
-                # contents survive this call (outputs/readback, restage,
-                # host stall diagnosis).
+                # Exit with unrun lane entries (fuel exhaustion, quiesce):
+                # retire any in-flight prefetch, then spill the entries
+                # back to the ready ring - the ring is the only structure
+                # whose contents survive this call (outputs/readback,
+                # restage, steal/export scans, checkpoint export, host
+                # stall diagnosis). Entries spill to the HEAD side (the
+                # cold, steal-facing end of the Chase-Lev split): a lane
+                # holds the OLDEST ready descriptors of its kind (routing
+                # pops drained them off the ring before execution), so
+                # under the multi-device runners they are exactly the
+                # cold work a thief's head-side scan window must see -
+                # spilling to the tail would hide every lane-resident
+                # candidate behind the hot end and starve the steal
+                # exchange (observed: a batch-routed forest never
+                # spread). C_HEAD may go negative; every reader indexes
+                # the ring mod capacity, and stage() widens its copy to
+                # the whole ring when the window wraps below zero.
                 rt_x = tr.now()
                 for li, (fid, spec) in enumerate(self.batch_specs):
                     h = lstate[li, LS_HEAD]
@@ -1275,11 +1354,16 @@ class Megakernel:
                                 lstate[li, LS_PF_BUF], jnp.int32(0),
                             ))
 
-                    def spill(s, _, li=li, h=h):
-                        push_ready(lanes[li, (h + s) % capacity])
+                    head0 = counts[C_HEAD]
+
+                    def spill(s, _, li=li, h=h, head0=head0):
+                        ready[(head0 - 1 - s) % capacity] = lanes[
+                            li, (h + s) % capacity
+                        ]
                         return 0
 
                     jax.lax.fori_loop(0, t - h, spill, 0)
+                    counts[C_HEAD] = head0 - (t - h)
 
                     @pl.when(t > h)
                     def _(fid=fid, h=h, t=t):

@@ -92,6 +92,7 @@ from .megakernel import (
     C_PENDING,
     C_ROUNDS,
     C_TAIL,
+    LS_WORDS,
     Megakernel,
     VBLOCK,
 )
@@ -182,27 +183,38 @@ class PGASMegakernel:
         # Megakernel._kernel).
         mk = self.mk
         ndata = len(mk.data_specs)
+        nbatch = 1 if mk.batch_specs else 0
         ntrace = 1 if trace is not None else 0
         n_in = 7 + ndata  # + waits_in + abort word (last)
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 4 + ndata + ntrace]
-        rest = refs[n_in + 4 + ndata + ntrace :]
+        out_refs = refs[n_in : n_in + 4 + ndata + nbatch + ntrace]
+        rest = refs[n_in + 4 + ndata + nbatch + ntrace :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
+        stail = list(rest[nscratch:])
         (
             free, vfree,
             outq_tgt, outq_desc, ambuf, obctl, inbox, am_sent, am_recv, sent_round,
             data_sent, chan_recv, pstate, wait_tab,
             statsnd, statrcv, statacc, abuf,
             dsems, am_sem, chan_sems, csem, asem,
-        ) = rest[nscratch:]
+        ) = stail[:23]
+        # Batched dispatch tier (ISSUE 7): lane scratch rides last; the
+        # spill discipline empties it at every sched() exit, so the AM
+        # drain and ring fold between rounds only ever see ring rows. The
+        # length check keeps the positional bind loud: an edit to
+        # _build's scratch list that forgets these indices must fail at
+        # trace time, not scribble batch descriptors into a neighbor.
+        assert len(stail) == 23 + 2 * nbatch, len(stail)
+        lanes, lstate = (stail[23], stail[24]) if nbatch else (None, None)
         abort_in = in_refs[n_in - 1]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         waits_in = in_refs[5 + ndata]  # waits ride after the data inputs
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
+        tstats = out_refs[4 + ndata] if nbatch else None
         tr = (
-            Tracer(out_refs[4 + ndata], trace.capacity)
+            Tracer(out_refs[4 + ndata + nbatch], trace.capacity)
             if ntrace
             else NullTracer()
         )
@@ -313,6 +325,7 @@ class PGASMegakernel:
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True, ctx_hook,
+            lanes=lanes, lstate=lstate, tstats=tstats,
             tracer=tr if tr.enabled else None,
         )
 
@@ -613,6 +626,7 @@ class PGASMegakernel:
     def _build(self, quantum: int, max_rounds: int):
         mk = self.mk
         ndata = len(mk.data_specs)
+        nbatch = 1 if mk.batch_specs else 0
         ndev, nchan = self.ndev, self.nchan
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
@@ -620,12 +634,16 @@ class PGASMegakernel:
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [smem()]
         in_specs += [anyspace()]  # abort word (HBM: re-read per round)
         out_specs = tuple(
-            [smem()] * 4 + [anyspace()] * ndata + [smem()] * ntrace
+            [smem()] * 4 + [anyspace()] * ndata
+            + [smem()] * nbatch  # tstats (batch-routed builds)
+            + [smem()] * ntrace
         )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
             for s in mk.data_specs.values()
         ]
+        from .megakernel import TS_WORDS
+
         out_shape = tuple(
             [
                 jax.ShapeDtypeStruct((mk.capacity, DESC_WORDS), jnp.int32),
@@ -634,6 +652,10 @@ class PGASMegakernel:
                 jax.ShapeDtypeStruct((mk.num_values,), jnp.int32),
             ]
             + data_shapes
+            + (
+                [jax.ShapeDtypeStruct((TS_WORDS,), jnp.int32)]
+                if nbatch else []
+            )
             + ([mk.trace.out_shape()] if ntrace else [])
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
@@ -669,7 +691,18 @@ class PGASMegakernel:
                 pltpu.SemaphoreType.DMA((nchan,)),  # channel arrivals
                 pltpu.SemaphoreType.REGULAR,  # ring credit
                 pltpu.SemaphoreType.DMA((1,)),  # asem
-            ],
+            ]
+            + (
+                [
+                    # Batched dispatch tier lane scratch (unpacked last).
+                    pltpu.SMEM(
+                        (len(mk.batch_specs), mk.capacity), jnp.int32
+                    ),
+                    pltpu.SMEM((len(mk.batch_specs), LS_WORDS), jnp.int32),
+                ]
+                if mk.batch_specs
+                else []
+            ),
             input_output_aliases=aliases,
             interpret=interpret_mode() if mk.interpret else False,
         )
@@ -684,14 +717,14 @@ class PGASMegakernel:
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
             data_o = outs[4 : 4 + ndata]
-            trace_o = outs[4 + ndata :]
+            extra_o = outs[4 + ndata :]  # [tstats?, trace?]
             gcounts = jax.lax.psum(counts_o, self.axis)
             return (
                 counts_o[None],
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
-                *[t[None] for t in trace_o],
+                *[t[None] for t in extra_o],
             )
 
         nin = 7 + ndata
@@ -699,7 +732,7 @@ class PGASMegakernel:
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
-            out_specs=(P(self.axis),) * (3 + ndata + ntrace),
+            out_specs=(P(self.axis),) * (3 + ndata + nbatch + ntrace),
             check_vma=False,
         )
         return jax.jit(f)
@@ -789,6 +822,13 @@ class PGASMegakernel:
                 [tail[-1][d] for d in range(ndev)], t0_ns, t1_ns,
                 mk.trace.capacity,
             )
+        if mk.batch_specs and tail:
+            # Per-device batched-tier counters (tstats rides before the
+            # trace ring in the appended outputs).
+            trows = tail[0]
+            info["tiers"] = [
+                mk.decode_tier_stats(trows[d]) for d in range(ndev)
+            ]
         info["aborted"] = bool(abort_arr[:, 0].any()) and info["pending"] != 0
         if info["overflow"]:
             raise RuntimeError(

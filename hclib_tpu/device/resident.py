@@ -124,6 +124,7 @@ from .megakernel import (
     fault_mix,
     interpret_mode,
     C_EXECUTED,
+    LS_WORDS,
     OVF_LOCKQ,
     OVF_OUTBOX,
     OVF_WAITS,
@@ -134,6 +135,7 @@ from .megakernel import (
     C_TAIL,
     C_VBASE,
     Megakernel,
+    TS_WORDS,
     VBLOCK,
 )
 from .tracebuf import (
@@ -468,15 +470,17 @@ class ResidentKernel:
         # reading mk.trace here could disagree with the built out tree).
         mk = self.mk
         ndata = len(mk.data_specs)
+        nbatch = len(mk.batch_specs)
         ntrace = 1 if trace is not None else 0
         n_in = 7 + ndata + (2 if self.inject else 0)  # + abort word (last)
         in_refs = refs[:n_in]
-        # + fstats, then (checkpoint builds only) the exported wait table
-        # - the lifted scratch limit: quiesce with pending host-declared
-        # waits now exports them instead of refusing - then the optional
+        # + (batch-routed builds) the per-device tstats row, + fstats,
+        # then (checkpoint builds only) the exported wait table - the
+        # lifted scratch limit: quiesce with pending host-declared waits
+        # now exports them instead of refusing - then the optional
         # flight-recorder ring (always last).
         n_out = (
-            5 + ndata + (1 if self.inject else 0)
+            5 + ndata + (1 if self.inject else 0) + (1 if nbatch else 0)
             + (1 if self.checkpoint else 0) + ntrace
         )
         out_refs = refs[n_in : n_in + n_out]
@@ -504,6 +508,16 @@ class ResidentKernel:
         if self.inject:
             (isem,) = take(1)
         (abuf, asem) = take(2)  # abort-word staging + its DMA semaphore
+        if nbatch:
+            # Batched same-kind dispatch tier (ISSUE 7): the per-kind lane
+            # scratch, re-entrant across sched() entries by the spill
+            # discipline - every sched exit (quantum, quiesce hold)
+            # spills unrun lane entries to the ready ring's cold end, so
+            # the steal export scan, queue re-homing, and checkpoint
+            # export below only ever see ring rows.
+            (lanes, lstate) = take(2)
+        else:
+            lanes = lstate = None
         plan = self.plan
         if plan is not None:
             # Fault-layer state (per steal channel k / per peer device):
@@ -524,6 +538,13 @@ class ResidentKernel:
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
         if self.inject:
             ctl_out = out_refs[4 + ndata]
+        # Per-device batched-tier counters (appended after the ctl echo):
+        # decoded host-side into info['tiers'][d], the mesh occupancy the
+        # perf guard and the lane-firing-policy detector watch.
+        tstats = (
+            out_refs[4 + ndata + (1 if self.inject else 0)]
+            if nbatch else None
+        )
         fstats = out_refs[n_out - 1 - ntrace - nckpt]
         waits_out = out_refs[n_out - 1 - ntrace] if self.checkpoint else None
         tr = (
@@ -736,6 +757,7 @@ class ResidentKernel:
             tasks_in, ready_in, counts_in, ivalues_in, True, ctx_hook,
             complete_hook if (self.migratable and self.homed) else None,
             value_limit=RBASE,
+            lanes=lanes, lstate=lstate, tstats=tstats,
             tracer=tr if tr.enabled else None,
         )
 
@@ -1590,6 +1612,18 @@ class ResidentKernel:
             # promises) but keep the exchange machinery live until the
             # wire is empty; heartbeats keep ticking so the drain cannot
             # be mistaken for a dead chip.
+            #
+            # Batched-tier residue is handled INSIDE this sched call, the
+            # same way SF_INJ residue is handled by the poll below: every
+            # sched() exit - a drained quantum AND the fuel-0 hold rounds
+            # - retires any in-flight operand prefetch through the PR 3
+            # ``drain`` callback and spills unrun lane entries back to
+            # the ready ring's cold end. So by the time the fold, the
+            # steal export scan, or the settled exit below run, no
+            # prefetch DMA is outstanding and no descriptor is
+            # lane-resident: the checkpoint cut only ever sees ring rows
+            # and a quiet local DMA engine (prefetches are device-local,
+            # so they never gate the sent == recv wire settle).
             hold = am_dead
             if ckpt:
                 hold = hold | local_quiesce | (pstate[PS_QUIESCE] != 0)
@@ -1769,6 +1803,11 @@ class ResidentKernel:
         if self.inject:
             out_specs.append(smem())
             out_shape.append(jax.ShapeDtypeStruct((8,), jnp.int32))
+        if mk.batch_specs:
+            # Batched-tier counters (TS_* words) per device, appended
+            # after the ctl echo: decoded into info['tiers'][d].
+            out_specs.append(smem())
+            out_shape.append(jax.ShapeDtypeStruct((TS_WORDS,), jnp.int32))
         # Per-device fault/abort stats (FS_* words), then (checkpoint
         # builds) the exported wait table, then the optional flight-
         # recorder ring - appended outputs, existing indices intact.
@@ -1832,6 +1871,14 @@ class ResidentKernel:
             pltpu.SMEM((8,), jnp.int32),  # abuf (abort-word staging)
             pltpu.SemaphoreType.DMA((1,)),  # asem
         ]
+        if mk.batch_specs:
+            # Batched dispatch tier lane scratch (lanes + lane state);
+            # re-entrant across sched() entries via the spill discipline.
+            nb = len(mk.batch_specs)
+            scratch += [
+                pltpu.SMEM((nb, mk.capacity), jnp.int32),  # lanes
+                pltpu.SMEM((nb, LS_WORDS), jnp.int32),  # lstate
+            ]
         if self.plan is not None:
             nhk = max(1, nh)
             scratch += [
@@ -1868,6 +1915,14 @@ class ResidentKernel:
             data_o = outs[4 : 4 + ndata]
             ntrace = 1 if self.mk.trace is not None else 0
             nckpt = 1 if ckpt else 0
+            nbatch = 1 if self.mk.batch_specs else 0
+            # Per-device batched-tier counters (appended after the ctl
+            # echo, before fstats): surfaced so info['tiers'][d] reads
+            # mesh occupancy exactly like the single-device decode.
+            tstats_o = (
+                [outs[4 + ndata + (1 if self.inject else 0)]]
+                if nbatch else []
+            )
             fstats_o = outs[-1 - ntrace - nckpt]
             tail_o = ([outs[-1]] if ntrace else [])
             # Checkpoint builds export the mutated task table + ready
@@ -1885,17 +1940,19 @@ class ResidentKernel:
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
+                *[t[None] for t in tstats_o],
                 fstats_o[None],
                 *[s[None] for s in state_o],
                 *[t[None] for t in tail_o],
             )
 
         nin = 7 + ndata + (2 if self.inject else 0)
-        # fstats (and the trace ring / checkpoint state outputs, when
-        # built in) are per-device outputs too: out_specs must cover them
-        # or shard_map rejects the pytree at trace time.
+        # fstats (and the tstats / trace ring / checkpoint state outputs,
+        # when built in) are per-device outputs too: out_specs must cover
+        # them or shard_map rejects the pytree at trace time.
         nout = (
-            4 + ndata + (1 if self.mk.trace is not None else 0)
+            4 + ndata + (1 if self.mk.batch_specs else 0)
+            + (1 if self.mk.trace is not None else 0)
             + ((3 + (1 if self.inject else 0)) if ckpt else 0)
         )
         f = shard_map(
@@ -2148,6 +2205,14 @@ class ResidentKernel:
         fs = [decode_fault_stats(frows[d]) for d in range(ndev)]
         info["fault_stats"] = fs
         info["aborted"] = any(f["abort_round"] >= 0 for f in fs)
+        if mk.batch_specs:
+            # Per-device batched-tier occupancy (counters accumulate over
+            # the whole resident entry): the mesh lane-firing-policy
+            # signal the perf guard and MetricsRegistry gauges watch.
+            trows = tail[-2]
+            info["tiers"] = [
+                mk.decode_tier_stats(trows[d]) for d in range(ndev)
+            ]
         if self.checkpoint:
             info["quiesced"] = any(f["quiesce_round"] >= 0 for f in fs)
             if self.inject:

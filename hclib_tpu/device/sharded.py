@@ -52,6 +52,7 @@ from .megakernel import (
     C_TAIL,
     C_VALLOC,
     Megakernel,
+    TS_WORDS,
 )
 
 __all__ = [
@@ -200,17 +201,15 @@ class ShardedMegakernel:
     ) -> None:
         if len(mesh.axis_names) != 1:
             raise ValueError("ShardedMegakernel wants a 1D mesh (queue axis)")
-        if mk.batch_specs:
-            # _build_raw WOULD supply the lanes, but the steal/export side
-            # scans only the ready ring (lane entries would be invisible to
-            # thieves) and the appended tstats output breaks this runner's
-            # positional out_specs - refuse clearly instead of failing with
-            # an opaque shard_map pytree mismatch at trace time.
-            raise ValueError(
-                "ShardedMegakernel does not support batch-routed kernels "
-                f"({sorted(mk.kernel_names[fid] for fid, _ in mk.batch_specs)}); "
-                "drop the BatchSpec routes for the sharded runner"
-            )
+        # Batch-routed kernels ride this runner via the SPILL DISCIPLINE:
+        # _build_raw allocates the per-kind lanes, and sched() spills every
+        # unrun lane entry back to the ready ring at each kernel exit, so
+        # the bulk-synchronous steal/export pass between entries only ever
+        # scans ring rows - a lane-resident descriptor can never be
+        # invisible to a thief because lanes are empty whenever the
+        # exchange runs. The appended tstats output is threaded through
+        # both step functions below (accumulated across steal rounds) and
+        # decoded into per-device info['tiers'].
         # The trace ring cannot ride this runner: same appended-output
         # problem as tstats (positional out_specs), and the bulk-
         # synchronous steal loop re-enters the kernel per round (each
@@ -294,6 +293,7 @@ class ShardedMegakernel:
         with self._maybe_untraced():
             inner = self.mk._build_raw(fuel)
         ndata = len(self.mk.data_specs)
+        nbatch = 1 if self.mk.batch_specs else 0
         axis = self.axis
 
         def step(tasks, succ, ring, counts, iv, *data):
@@ -301,7 +301,10 @@ class ShardedMegakernel:
                 tasks[0], succ[0], ring[0], counts[0], iv[0], *[d[0] for d in data]
             )
             tasks_o, ready_o, counts_o, iv_o = outs[:4]
-            data_o = outs[4:]
+            data_o = outs[4 : 4 + ndata]
+            # Batched-tier counters ride last (appended by _build_raw when
+            # any kind is batch-routed): surfaced per device.
+            tstats_o = outs[4 + ndata :]
             # Global termination/health: executed/pending/overflow summed
             # across the mesh (the reference's done-flag join becomes a
             # collective - src/hclib-runtime.c:403-421).
@@ -311,6 +314,7 @@ class ShardedMegakernel:
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
+                *[t[None] for t in tstats_o],
             )
 
         nin = 5 + ndata
@@ -318,7 +322,7 @@ class ShardedMegakernel:
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
-            out_specs=(P(self.axis),) * (3 + ndata),
+            out_specs=(P(self.axis),) * (3 + ndata + nbatch),
             check_vma=False,
         )
         return jax.jit(f)
@@ -335,6 +339,7 @@ class ShardedMegakernel:
         with self._maybe_untraced():
             inner = self.mk._build_raw(quantum, stage_all_values=True)
         ndata = len(self.mk.data_specs)
+        nbatch = 1 if self.mk.batch_specs else 0
         axis = self.axis
         ndev = self.ndev
         cap = self.mk.capacity
@@ -441,26 +446,34 @@ class ShardedMegakernel:
                 return tasks, ring_, counts
 
             def cond(carry):
-                tasks, ring_, counts, iv, data, rounds = carry
+                tasks, ring_, counts, iv, data, tacc, rounds = carry
                 return (jax.lax.psum(counts[C_PENDING], axis) > 0) & (
                     rounds < max_rounds
                 )
 
             def body(carry):
-                tasks, ring_, counts, iv, data, rounds = carry
+                tasks, ring_, counts, iv, data, tacc, rounds = carry
                 outs = inner(tasks, succ0, ring_, counts, iv, *data)
                 tasks, ring_, counts, iv = outs[:4]
-                data = tuple(outs[4:])
+                data = tuple(outs[4 : 4 + ndata])
+                if nbatch:
+                    # tstats resets at every kernel entry (per-entry
+                    # scratch semantics), so the steal loop accumulates
+                    # the rounds' counters into a cumulative per-device
+                    # row - occupancy over the whole run, not the last
+                    # quantum.
+                    tacc = tacc + outs[4 + ndata]
                 for d in hop_dists:
                     perm = [(i, (i + d) % ndev) for i in range(ndev)]
                     tasks, ring_, counts = exchange(tasks, ring_, counts, perm)
-                return (tasks, ring_, counts, iv, data, rounds + 1)
+                return (tasks, ring_, counts, iv, data, tacc, rounds + 1)
 
             init = (
                 tasks[0], ring[0], counts[0], iv[0], tuple(d[0] for d in data),
+                jnp.zeros((TS_WORDS,), jnp.int32),
                 jnp.int32(0),
             )
-            tasks_o, ring_o, counts_o, iv_o, data_o, rounds = (
+            tasks_o, ring_o, counts_o, iv_o, data_o, tacc_o, rounds = (
                 jax.lax.while_loop(cond, body, init)
             )
             counts_o = counts_o.at[C_ROUNDS].set(rounds)
@@ -470,6 +483,7 @@ class ShardedMegakernel:
                 iv_o[None],
                 gcounts[None],
                 *[d[None] for d in data_o],
+                *([tacc_o[None]] if nbatch else []),
             )
 
         nin = 5 + ndata
@@ -477,7 +491,7 @@ class ShardedMegakernel:
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis),) * nin,
-            out_specs=(P(self.axis),) * (3 + ndata),
+            out_specs=(P(self.axis),) * (3 + ndata + nbatch),
             check_vma=False,
         )
         return jax.jit(f)
@@ -517,7 +531,16 @@ class ShardedMegakernel:
             self.mk, self.mesh, self.ndev, self._jitted[key], builders,
             data, ivalues, with_rounds=steal,
         )
-        info.pop("extra_outputs", None)  # internal plumbing, no trailing
+        tail = info.pop("extra_outputs", None)
+        if self.mk.batch_specs and tail:
+            # Per-device batched-tier counters (cumulative over the steal
+            # rounds on the steal path): info['tiers'][d] mirrors the
+            # single-device decode, so mesh occupancy reads the same way.
+            trows = tail[-1]
+            info["tiers"] = [
+                self.mk.decode_tier_stats(trows[d])
+                for d in range(self.ndev)
+            ]
         if info["overflow"]:
             raise RuntimeError("sharded megakernel task-table overflow")
         if info["pending"] != 0:

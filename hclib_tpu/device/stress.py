@@ -35,7 +35,16 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["forest_steal", "unified_load"]
+__all__ = ["forest_steal", "unified_load",
+           "FOREST_STEAL_BENCH", "FOREST_STEAL_QUICK"]
+
+# The benchmark-of-record forest-steal configuration, shared by the
+# scalar and batched arms in tools/perf_regression.py --multichip AND
+# bench.py's multichip headline: the mesh-batch-dispatch guard compares
+# the two arms' tasks/s, which is only meaningful while they run the
+# SAME workload - tune these here, not at a call site.
+FOREST_STEAL_BENCH = dict(ndev=8, roots=160, n=12, capacity=4096)
+FOREST_STEAL_QUICK = dict(ndev=8, roots=24, n=9, capacity=1024)
 
 
 def forest_steal(
@@ -45,13 +54,22 @@ def forest_steal(
     quantum: int = 256,
     window: int = 16,
     capacity: int = 4096,
+    batch_width: int = 0,
 ) -> Dict:
     """Maximally-skewed fib forest through the sharded steal runner.
 
     ``roots`` fib(``n``) seeds all on device 0; exact checks: the executed
     count equals roots * (FIB nodes + SUM joins) and the out slots sum to
     roots * fib(n) across the mesh (a migrated root writes its slot on the
-    thief's value buffer). Defaults: 160 x fib(12) = 111,520 tasks."""
+    thief's value buffer). Defaults: 160 x fib(12) = 111,520 tasks.
+
+    ``batch_width`` > 0 routes the FIB kind through the batched same-kind
+    dispatch tier (ISSUE 7): every device's scheduler fires same-kind fib
+    batches between steal rounds, lanes spill to the ring's cold end at
+    every kernel exit so the steal exchange sees the same candidates the
+    scalar mesh would, and the returned info carries per-device
+    ``tiers`` (occupancy / batch rounds / spills) beside the totals -
+    which stay exact and identical to the scalar arm."""
     from ..models.fib import fib_seq, task_count
     from ..parallel.mesh import cpu_mesh
     from .descriptor import TaskGraphBuilder
@@ -62,6 +80,7 @@ def forest_steal(
     mk = make_fib_megakernel(
         capacity=capacity, interpret=True,
         num_values=VBLOCK * capacity + max(64, roots),
+        batch_width=batch_width or None,
     )
     smk = ShardedMegakernel(mk, cpu_mesh(ndev, axis_name="q"),
                             migratable_fns=[FIB])
@@ -92,9 +111,11 @@ def forest_steal(
     assert got == roots * fib_seq(n), (got, roots * fib_seq(n))
     assert info["pending"] == 0
     per_dev = np.asarray(info["per_device_counts"])[:, 5]
+    tier_label = f" [batch w={batch_width}]" if batch_width else ""
     info = dict(info)
     info.update(
-        name=f"forest_steal {roots}x fib({n}) on {ndev} devices",
+        name=f"forest_steal {roots}x fib({n}) on {ndev} devices"
+        + tier_label,
         seconds=dt,
         tasks=expect_tasks,
         tasks_per_sec=expect_tasks / dt,
@@ -103,6 +124,25 @@ def forest_steal(
         imbalance=float(per_dev.max() * ndev / max(per_dev.sum(), 1)),
         per_device_counts=np.asarray(info["per_device_counts"]).tolist(),
     )
+    if batch_width:
+        # The mesh-batch acceptance: every device that executed work must
+        # have fired batch rounds (the tier engaged mesh-wide, not just on
+        # the seed device), and the tier totals must reconcile with the
+        # executed count.
+        tiers = info["tiers"]
+        batched = sum(t["batch_tasks"] for t in tiers)
+        scalar = sum(t["scalar_tasks"] for t in tiers)
+        assert batched + scalar == expect_tasks, (batched, scalar)
+        for d in range(ndev):
+            if per_dev[d] > 0:
+                assert tiers[d]["batch_rounds"] > 0, (d, tiers[d])
+        occ = [t["batch_occupancy"] for t in tiers if t["batch_rounds"]]
+        info.update(
+            batch_tasks=batched,
+            min_occupancy=min(occ),
+            mean_occupancy=sum(occ) / len(occ),
+            spilled=sum(t["spilled"] for t in tiers),
+        )
     return info
 
 
@@ -113,6 +153,7 @@ def unified_load(
     capacity: int = 1024,
     quantum: int = 32,
     window: int = 8,
+    batch_width: int = 0,
 ) -> Dict:
     """Dependency-bearing migration + PGAS under load, one resident kernel
     per device: a skewed fib(``n``) tree (every task carrying successor
@@ -120,13 +161,19 @@ def unified_load(
     completions) plus ``fadds`` remote fetch-adds hammering device 0's
     counter slot from every device. Totals exact: the fib value lands in
     the home slot, the counter equals the sum of all increments, and
-    executed matches the tree + AM task count."""
+    executed matches the tree + AM task count.
+
+    ``batch_width`` > 0 routes the FIB kind through the batched same-kind
+    dispatch tier inside the RESIDENT kernel (ISSUE 7): lanes spill to the
+    ready ring at every sched() exit, so the homed steal export, the AM
+    drains, and the termination fold only ever see ring rows; the info
+    carries per-device ``tiers`` and totals stay exact."""
     from ..models.fib import fib_seq, task_count
     from ..parallel.mesh import cpu_mesh
     from .descriptor import TaskGraphBuilder
     from .megakernel import Megakernel, VBLOCK
     from .resident import ResidentKernel
-    from .workloads import _fib_kernel, _sum_kernel
+    from .workloads import _fib_kernel, _sum_kernel, batch_of
 
     FIB5, SUM5, FADD5 = 0, 1, 2
 
@@ -141,6 +188,10 @@ def unified_load(
         succ_capacity=64,
         interpret=True,
         uses_row_values=True,
+        route=(
+            {"fib": batch_of(_fib_kernel, width=batch_width)}
+            if batch_width else None
+        ),
     )
     rk = ResidentKernel(
         mk, cpu_mesh(ndev, axis_name="q"),
@@ -175,10 +226,17 @@ def unified_load(
     expect += fadds
     assert info["executed"] == expect, (info["executed"], expect)
     per_dev = np.asarray(info["per_device_counts"])[:, 5]
+    if batch_width:
+        tiers = info["tiers"]
+        batched = sum(t["batch_tasks"] for t in tiers)
+        scalar = sum(t["scalar_tasks"] for t in tiers)
+        assert batched + scalar == expect, (batched, scalar, expect)
+        assert batched > 0, tiers
     info = dict(info)
     info.update(
         name=f"unified_load fib({n}) + {fadds} remote fetch-adds "
-        f"on {ndev} devices",
+        f"on {ndev} devices"
+        + (f" [batch w={batch_width}]" if batch_width else ""),
         seconds=dt,
         tasks=expect,
         tasks_per_sec=expect / dt,

@@ -16,7 +16,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .descriptor import TaskGraphBuilder
-from .megakernel import VBLOCK, KernelContext, Megakernel, fault_mix
+from .megakernel import (
+    VBLOCK,
+    BatchContext,
+    BatchSpec,
+    KernelContext,
+    Megakernel,
+    fault_mix,
+)
 
 __all__ = [
     "device_fib",
@@ -27,6 +34,7 @@ __all__ = [
     "make_uts_megakernel",
     "device_uts_mk",
     "UTS_NODE",
+    "batch_of",
 ]
 
 
@@ -66,11 +74,32 @@ def _sum_kernel(ctx: KernelContext) -> None:
     ctx.set_out(ctx.value(ctx.arg(0)) + ctx.value(ctx.arg(1)))
 
 
+def batch_of(scalar_kernel, width: int = 8) -> BatchSpec:
+    """Batched same-kind spelling of a scalar task kernel: one batch round
+    pops up to ``width`` same-kind descriptors and runs ``scalar_kernel``
+    once per live slot through ``BatchContext.slot_ctx`` - bit-identical to
+    scalar dispatch (the per-slot context shares every ref), but the per-
+    descriptor ring pop + lax.switch overhead is paid once per ROUND
+    instead of once per task. This is how spawn-heavy scalar families
+    (fib/UTS nodes) ride the batch tier; tile families with a genuinely
+    fused body (SW waves, Cholesky updrow) write their own BatchSpec."""
+
+    def body(ctx: BatchContext) -> None:
+        for s in range(ctx.width):
+            @pl.when(ctx.live(s))
+            def _(s=s):
+                scalar_kernel(ctx.slot_ctx(s))
+
+    return BatchSpec(body, width=width)
+
+
 def make_fib_megakernel(
     capacity: int = 768,  # SMEM windows pad scalars ~32B/word: ~800-row max
     interpret: Optional[bool] = None,
     num_values: Optional[int] = None,
     trace=None,
+    batch_width: Optional[int] = None,
+    checkpoint: Optional[bool] = None,
 ) -> Megakernel:
     # Descriptor rows recycle, and value blocks are row-owned (SUM reads
     # its children's results out of its own row's block), so both live
@@ -85,6 +114,15 @@ def make_fib_megakernel(
             f"fib uses row-owned value blocks: num_values must be >= "
             f"VBLOCK*capacity+16 = {need}, got {num_values}"
         )
+    # batch_width routes the FIB kind through the batched same-kind tier
+    # (one batch round runs up to batch_width fib bodies per-slot through
+    # slot_ctx - bit-identical to scalar dispatch); SUM stays scalar: join
+    # tasks become ready one at a time as their children complete, so a
+    # SUM lane would fire near-empty batches for pure routing overhead.
+    route = (
+        {"fib": batch_of(_fib_kernel, width=batch_width)}
+        if batch_width else None
+    )
     return Megakernel(
         kernels=[("fib", _fib_kernel), ("sum", _sum_kernel)],
         capacity=capacity,
@@ -93,6 +131,8 @@ def make_fib_megakernel(
         interpret=interpret,
         uses_row_values=True,
         trace=trace,
+        route=route,
+        checkpoint=checkpoint,
     )
 
 
@@ -165,6 +205,7 @@ def make_uts_megakernel(
     trace=None,
     checkpoint: Optional[bool] = None,
     quiesce_stride: Optional[int] = None,
+    batch_width: Optional[int] = None,
 ) -> Megakernel:
     """Seeded unbalanced-tree search on the scalar megakernel tier: the
     dynamic-spawn UTS-style workload (the reference's north-star tree,
@@ -202,6 +243,14 @@ def make_uts_megakernel(
                         nargs=2,
                     )
 
+    # batch_width: run node expansion through the batched same-kind tier
+    # (the whole tree is one kind, so every round past the root fires a
+    # near-full batch); rows stay link-free, so batched UTS remains
+    # migratable AND reshardable - the lanes-active checkpoint workload.
+    route = (
+        {"uts_node": batch_of(node, width=batch_width)}
+        if batch_width else None
+    )
     return Megakernel(
         kernels=[("uts_node", node)],
         capacity=capacity,
@@ -211,6 +260,7 @@ def make_uts_megakernel(
         trace=trace,
         checkpoint=checkpoint,
         quiesce_stride=quiesce_stride,
+        route=route,
     )
 
 

@@ -114,7 +114,12 @@ class MetricsRegistry:
         ``tiers``/``fault_stats`` pass through; the flight-recorder trace
         is summarized (per-tag counts, written/dropped) rather than
         carried raw; array-valued entries (per_device_counts) reduce to
-        per-device executed/rounds."""
+        per-device executed/rounds. A batch-routed run additionally gets
+        the ``lane_occupancy`` gauge - one value per device (mesh runs
+        return ``tiers`` as a per-device list; single-device runs read
+        as a one-entry list), exported as
+        ``<name>.lane_occupancy.<d>`` - the ROADMAP lane-firing-policy
+        detector a dashboard watches without digging through tiers."""
         keep: Dict[str, Any] = {}
         for k, v in info.items():
             if k == "trace":
@@ -132,6 +137,16 @@ class MetricsRegistry:
                 continue
             else:
                 keep[k] = v
+        tiers = keep.get("tiers")
+        if isinstance(tiers, Mapping):
+            tiers = [tiers]
+        if isinstance(tiers, (list, tuple)) and tiers:
+            try:
+                keep["lane_occupancy"] = [
+                    float(t["batch_occupancy"]) for t in tiers
+                ]
+            except (KeyError, TypeError):
+                pass
         self.record(name, keep)
 
     # -- snapshots --

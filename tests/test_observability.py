@@ -332,6 +332,35 @@ def test_metrics_registry_add_run_info_summarizes_device_shapes():
     assert not any(k.startswith("dev.extra_outputs") for k in m)
 
 
+def test_metrics_lane_occupancy_gauge_per_device():
+    """Batch-routed runs export one lane-occupancy gauge per device
+    (mesh runs return ``tiers`` as a per-device list; a single-device
+    dict normalizes to a one-entry list) - the ROADMAP lane-firing-
+    policy detector, readable straight off a Prometheus scrape."""
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.add_run_info("mesh", {
+        "executed": 12,
+        "tiers": [
+            {"batch_occupancy": 0.75, "batch_tasks": 6},
+            {"batch_occupancy": 0.5, "batch_tasks": 2},
+        ],
+    })
+    reg.add_run_info("solo", {
+        "executed": 3,
+        "tiers": {"batch_occupancy": 1.0, "batch_tasks": 3},
+    })
+    reg.add_run_info("scalar", {"executed": 1})  # no tiers: no gauge
+    m = reg.snapshot()["metrics"]
+    assert m["mesh.lane_occupancy.0"] == 0.75
+    assert m["mesh.lane_occupancy.1"] == 0.5
+    assert m["solo.lane_occupancy.0"] == 1.0
+    assert not any(k.startswith("scalar.lane_occupancy") for k in m)
+    prom = reg.to_prometheus()
+    assert "hclib_tpu_mesh_lane_occupancy_1 0.5" in prom
+
+
 def test_runtime_metrics_wiring():
     rt = hc.Runtime(nworkers=2, metrics=True)
 

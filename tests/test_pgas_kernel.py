@@ -29,7 +29,7 @@ SERVE = 3
 NOP = 4
 
 
-def _mk(interpret=True, ndev=8, capacity=256):
+def _mk(interpret=True, ndev=8, capacity=256, batch_width=0):
     """Kernel table used by every test in this file.
 
     PUT: put my heap row arg2 to device arg0's row arg1 on channel arg3.
@@ -66,6 +66,11 @@ def _mk(interpret=True, ndev=8, capacity=256):
     def nop(ctx):
         pass
 
+    # batch_width > 0 routes BUMP (the AM payload kind) through the
+    # batched same-kind tier - slot_ctx re-applies the pgas ctx_hook, so
+    # a batched AM task sees the same facilities scalar dispatch gives it.
+    from hclib_tpu.device.workloads import batch_of
+
     return Megakernel(
         kernels=[("put", put), ("consume", consume), ("bump", bump),
                  ("serve", serve), ("nop", nop)],
@@ -74,6 +79,8 @@ def _mk(interpret=True, ndev=8, capacity=256):
         num_values=64,
         succ_capacity=64,
         interpret=interpret,
+        route={"bump": batch_of(bump, width=batch_width)}
+        if batch_width else None,
     )
 
 
@@ -304,3 +311,58 @@ def test_pgas_compiles_and_runs_on_tpu():
     assert iv[0, 3] == 7
     assert (np.asarray(data["heap"])[0, 0] == 5).all()
     assert info["pending"] == 0
+
+
+# --------------------------------- batched dispatch under PGAS/AM (ISSUE 7)
+
+from hclib_tpu.jaxcompat import has_mosaic_interpret  # noqa: E402
+
+needs_mosaic = pytest.mark.skipif(
+    not has_mosaic_interpret(),
+    reason="needs pltpu.InterpretParams (Mosaic TPU interpret mode)",
+)
+
+
+@needs_mosaic
+def test_pgas_batch_routed_am_bumps_exact():
+    """ISSUE 7 acceptance (PGAS arm): AM-delivered BUMP tasks fire through
+    the batched same-kind tier - the lane scratch binds positionally at
+    the end of the PGAS body's 23-ref scratch tail, so this is the
+    coverage that a _build edit misplacing lanes/lstate/tstats fails
+    loudly. Every device AMs a BUMP at every other device; batched
+    delivery must land the exact all-senders sum on each device (slot_ctx
+    carries the pgas ctx_hook, so a batched AM task behaves exactly like
+    scalar dispatch), and tier counters reconcile with the executed
+    count."""
+    ndev = 4
+    mesh = cpu_mesh(ndev, axis_name="queues")
+    mk = _mk(ndev=ndev, capacity=128, batch_width=4)
+    pg = PGASMegakernel(
+        mk, mesh, channels={"c0": ("heap", 1), "reply": ("heap", 1)},
+        am_window=2,
+    )
+
+    SEND = 5
+
+    def send_all(ctx):
+        me = ctx.pgas.me
+        for d in range(ndev):
+            ctx.pgas.am(d, BUMP, args=[0, 1 + me])
+
+    mk.kernel_names.append("send_all")
+    mk.kernel_fns.append(send_all)
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    for d in range(ndev):
+        builders[d].add(SEND)
+    iv, _, info = pg.run(builders, data={"heap": _heap(ndev)})
+    expect = sum(1 + s for s in range(ndev))
+    for d in range(ndev):
+        assert iv[d, 0] == expect, (d, iv[d, 0])
+    assert info["executed"] == ndev + ndev * ndev
+    assert info["pending"] == 0
+    tiers = info["tiers"]
+    assert len(tiers) == ndev
+    batched = sum(t["batch_tasks"] for t in tiers)
+    scalar = sum(t["scalar_tasks"] for t in tiers)
+    assert batched + scalar == info["executed"], (batched, scalar)
+    assert batched > 0, tiers

@@ -275,6 +275,17 @@ def main(argv=None) -> int:
                     help="max checkpoint-enabled-but-idle slowdown ratio "
                     "(the quiesce-word overhead guard; the off path is "
                     "compiled out entirely)")
+    ap.add_argument("--mesh-batch-floor", type=float, default=0.5,
+                    help="mesh-batch-dispatch guard: minimum batched "
+                    "forest-steal tasks/s as a fraction of the scalar-"
+                    "mesh arm measured in the same run (interpret-mode "
+                    "wall time is weather-prone, so the floor price is "
+                    "'never collapses', not 'always faster')")
+    ap.add_argument("--mesh-batch-occupancy", type=float, default=0.5,
+                    help="mesh-batch-dispatch guard: minimum per-device "
+                    "batch-slot occupancy (from tstats) on devices that "
+                    "fired batch rounds - a collapse means the mesh "
+                    "stopped exposing same-kind width to the tier")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -436,12 +447,18 @@ def main(argv=None) -> int:
     if args.multichip:
         from hclib_tpu.device import stress
 
+        fs_kw = (
+            stress.FOREST_STEAL_QUICK if args.quick
+            else stress.FOREST_STEAL_BENCH
+        )
         mc = [
-            ("mc-forest-steal", lambda: stress.forest_steal(
-                ndev=8,
-                roots=24 if args.quick else 160,
-                n=9 if args.quick else 12,
-                capacity=1024 if args.quick else 4096,
+            ("mc-forest-steal", lambda: stress.forest_steal(**fs_kw)),
+            # The batched arm of the SAME workload (ISSUE 7): fib fires
+            # through per-device lanes between steal rounds; its rate and
+            # occupancy feed the mesh-batch-dispatch guard below, which
+            # is why both arms share the one config dict.
+            ("mc-forest-steal-batch", lambda: stress.forest_steal(
+                batch_width=8, **fs_kw
             )),
             ("mc-unified-resident", lambda: stress.unified_load(
                 ndev=8,
@@ -467,15 +484,28 @@ def main(argv=None) -> int:
                 "devices_used": info["devices_used"],
                 "imbalance": round(info["imbalance"], 3),
             }
-            with open(os.path.join(
-                    args.log_dir, f"{ts}.{name}.json"), "w") as f:
-                json.dump(info, f, indent=1)
             line = (
                 f"{name:20s} {info['tasks']:>8,} tasks in "
                 f"{info['seconds']:7.2f} s  ({rate:12,.0f} tasks/s, "
                 f"{info['devices_used']} devices, imbalance "
                 f"{info['imbalance']:.2f}x)"
             )
+            if "min_occupancy" in info:
+                results[name]["min_occupancy"] = round(
+                    info["min_occupancy"], 3
+                )
+                results[name]["mean_occupancy"] = round(
+                    info["mean_occupancy"], 3
+                )
+                results[name]["spilled"] = info["spilled"]
+                line += (
+                    f"  occ {info['mean_occupancy']:.2f} "
+                    f"(min {info['min_occupancy']:.2f}), "
+                    f"{info['spilled']} lane spills"
+                )
+            with open(os.path.join(
+                    args.log_dir, f"{ts}.{name}.json"), "w") as f:
+                json.dump(info, f, indent=1)
             if name in prev and "rate" in prev[name]:
                 ratio = rate / prev[name]["rate"]
                 line += f"  vs prev {ratio:5.2f}x"
@@ -484,6 +514,42 @@ def main(argv=None) -> int:
                         f"{name}: {1/ratio:.2f}x slower than previous log"
                     )
                     line += "  REGRESSED"
+            print(line, flush=True)
+
+        # mesh-batch-dispatch guard (ISSUE 7): the batched forest-steal
+        # arm must hold a tasks/s floor against the scalar arm measured
+        # in the SAME run (no cross-run weather), and its per-device
+        # lane occupancy must not collapse - either failing means the
+        # mesh multiplier silently regressed.
+        sc = results.get("mc-forest-steal")
+        bt = results.get("mc-forest-steal-batch")
+        if sc and bt and "rate" in sc and "rate" in bt:
+            ratio = bt["rate"] / sc["rate"]
+            occ = bt.get("min_occupancy", 0.0)
+            results["mesh-batch-dispatch"] = {
+                "batch_vs_scalar": round(ratio, 3),
+                "min_occupancy": occ,
+            }
+            line = (
+                f"{'mesh-batch-dispatch':20s} batched/scalar "
+                f"{ratio:5.2f}x  min occupancy {occ:.2f}"
+            )
+            if ratio < args.mesh_batch_floor:
+                failures.append(
+                    f"mesh-batch-dispatch: batched forest-steal is "
+                    f"{ratio:.2f}x the scalar mesh (floor "
+                    f"{args.mesh_batch_floor:.2f}x) - the mesh batch "
+                    "tier collapsed"
+                )
+                line += "  REGRESSED"
+            if occ < args.mesh_batch_occupancy:
+                failures.append(
+                    f"mesh-batch-dispatch: min per-device occupancy "
+                    f"{occ:.2f} under bound "
+                    f"{args.mesh_batch_occupancy:.2f} - the mesh stopped "
+                    "exposing same-kind width to the lanes"
+                )
+                line += "  OCC-REGRESSED"
             print(line, flush=True)
 
     os.makedirs(args.log_dir, exist_ok=True)

@@ -252,6 +252,25 @@ def render_device_report(info: Dict, width: int = 40) -> str:
     extra = info.get("migrated")
     if extra is not None:
         out.append(f"  migrated rows: {extra}")
+    tiers = info.get("tiers")
+    if isinstance(tiers, dict):
+        tiers = [tiers]
+    if tiers:
+        # Batched-dispatch tier per device (ISSUE 7): occupancy is the
+        # lane-firing-policy signal - a bar per device so a starving
+        # lane reads at a glance next to its load bar.
+        out.append("per-device batch-lane occupancy:")
+        for d, t in enumerate(tiers):
+            occ = float(t.get("batch_occupancy", 0.0))
+            detail = (
+                f" {t.get('batch_rounds', 0):>5} rounds, "
+                f"{t.get('batch_tasks', 0):>7,} batched, "
+                f"{t.get('scalar_tasks', 0):>6,} scalar, "
+                f"{t.get('prefetch_hits', 0):>5} pf hits, "
+                f"{t.get('spilled', 0):>5} spills"
+            )
+            out.append(f"  dev{d:<2d}|{_bar(occ, 1.0, width)}| "
+                       f"{occ:4.2f}{detail}")
     return "\n".join(out)
 
 
