@@ -889,6 +889,81 @@ def bench_autoscale():
     return out
 
 
+def bench_tenants(quick: bool = False) -> None:
+    """Multi-tenant ingress cost of record (ISSUE 8): a 3-lane weighted
+    front door (4:2:1) over the interpret-mode streaming kernel. The
+    headline JSON - aggregate admitted tasks/s through the WRR poll -
+    prints (and flushes) FIRST, rc=124-proofed like every other
+    headline; per-tenant tasks/s and p50/p99 admission-to-complete
+    latency go to stderr and perf-logs/<ts>.tenants.json."""
+    import jax
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.device.tenants import TenantSpec
+
+    per_tenant = 40 if quick else 150
+    weights = {"gold": 4, "silver": 2, "bronze": 1}
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    mk = Megakernel(
+        kernels=[("bump", bump)], capacity=3 * per_tenant + 64,
+        num_values=8, succ_capacity=8, interpret=True,
+    )
+    sm = StreamingMegakernel(
+        mk, ring_capacity=3 * max(per_tenant, 64),
+        tenants=[TenantSpec(t, weight=w) for t, w in weights.items()],
+    )
+    total = 0
+    for tid in weights:
+        for i in range(per_tenant):
+            assert sm.submit(tid, 0, args=[1])
+            total += 1
+    sm.close()
+    b = TaskGraphBuilder()
+    b.add(0, args=[0])
+    t0 = time.perf_counter()
+    iv, info = sm.run_stream(b)
+    wall = time.perf_counter() - t0
+    assert int(iv[0]) == total
+    rate = total / max(wall, 1e-9)
+    headline = {
+        "bench": "tenant_ingress",
+        "backend": jax.default_backend(),
+        "tenants": len(weights),
+        "tasks": total,
+        "tasks_per_sec": round(rate, 1),
+        "wall_s": round(wall, 4),
+    }
+    print(json.dumps(headline), flush=True)  # headline FIRST, always
+    detail = {}
+    for tid in weights:
+        ten = info["tenants"][tid]
+        lat = sm.tenants.latency_stats(tid)
+        detail[tid] = {
+            "weight": weights[tid],
+            "completed": ten["completed"],
+            "tasks_per_sec": round(ten["completed"] / max(wall, 1e-9), 1),
+            "p50_latency_s": round(lat.get("p50_s", 0.0), 4),
+            "p99_latency_s": round(lat.get("p99_s", 0.0), 4),
+        }
+        log(f"tenant [{tid}] w={weights[tid]}: "
+            f"{detail[tid]['completed']} tasks "
+            f"({detail[tid]['tasks_per_sec']:,} tasks/s), "
+            f"admission-to-complete p50 "
+            f"{detail[tid]['p50_latency_s'] * 1e3:.1f} ms / p99 "
+            f"{detail[tid]['p99_latency_s'] * 1e3:.1f} ms")
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.tenants.json")
+    with open(path, "w") as f:
+        json.dump({**headline, "per_tenant": detail}, f, indent=1)
+    log(f"tenant ingress bench written: {path}")
+
+
 def bench_multichip(quick: bool = False) -> None:
     """8-device forest-steal through the sharded steal runner, BATCHED
     arm first (ISSUE 7): the batched tasks/s headline JSON prints (and
@@ -984,6 +1059,14 @@ def main(argv=None) -> None:
         "(budget-gated like the other sections)",
     )
     ap.add_argument(
+        "--tenants", action="store_true",
+        help="multi-tenant ingress mode: 3 weighted lanes through the "
+        "streaming front door; the aggregate tasks/s headline prints "
+        "FIRST (stdout JSON), per-tenant rates + p50/p99 admission-to-"
+        "complete latency to stderr and perf-logs/<ts>.tenants.json; "
+        "replaces the single-device suite for this run",
+    )
+    ap.add_argument(
         "--multichip", action="store_true",
         help="8-device mesh mode: the batched forest-steal tasks/s "
         "headline prints FIRST (stdout JSON), then per-device "
@@ -992,11 +1075,14 @@ def main(argv=None) -> None:
     )
     ap.add_argument(
         "--quick", action="store_true",
-        help="tiny multichip inputs (CI smoke; only affects --multichip)",
+        help="tiny inputs (CI smoke; affects --multichip and --tenants)",
     )
     args = ap.parse_args(argv)
     global _T0
     _T0 = time.monotonic()  # arm the wall budget for THIS driver run
+    if args.tenants:
+        bench_tenants(quick=args.quick)
+        return
     if args.multichip:
         # Must land before jax initializes: the mesh workloads need the
         # CPU backend with 8 virtual devices.

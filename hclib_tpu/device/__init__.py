@@ -24,9 +24,13 @@ from .descriptor import (
 )
 from .megakernel import BatchContext, BatchSpec, KernelContext, Megakernel
 from .resident import ResidentKernel
+from .tenants import Admission, TenantSpec, TenantTable
 from .tracebuf import TraceRing, decode_ring, trace_to_jsonable
 
 __all__ = [
+    "Admission",
+    "TenantSpec",
+    "TenantTable",
     "ResidentKernel",
     "TraceRing",
     "decode_ring",

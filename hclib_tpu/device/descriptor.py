@@ -37,6 +37,22 @@ Word layout (all int32):
 Static DAGs (Cholesky, Smith-Waterman) are built host-side with
 ``TaskGraphBuilder``; dynamic tasks (fib, UTS) are allocated on-device by
 kernels via ``KernelContext.spawn``.
+
+Injection-ring row extension (multi-tenant ingress, device/tenants.py):
+ring rows are padded to 256 words (``RING_ROW``, device/inject.py) so any
+row offset DMA-aligns, and the pad words directly above the descriptor
+ABI carry *transport metadata* the scheduler never copies
+(``install_descriptor`` reads exactly ``DESC_WORDS`` words):
+
+    16 TEN_ID      tenant lane index of an injected row (0 = default lane)
+    17 TEN_EXPIRED nonzero = the row's admission deadline passed while it
+                   sat on the ring; the in-kernel tenant poll drops it
+                   (counted, a ``TenantExpired`` record) instead of
+                   installing it
+
+Because the words ride the row itself, tenant identity survives every
+path a row can travel: checkpoint residue export, ``reshard``'s
+round-robin re-deal, and resume re-publication.
 """
 
 from __future__ import annotations
@@ -59,6 +75,9 @@ __all__ = [
     "F_HOME",
     "F_HROW",
     "F_VMASK",
+    "RING_ROW",
+    "TEN_ID",
+    "TEN_EXPIRED",
     "TaskGraphBuilder",
 ]
 
@@ -77,6 +96,18 @@ F_HOME = 13
 F_HROW = 14
 F_VMASK = 15
 NUM_ARGS = 6
+
+# Injection-ring row width: descriptors padded to 1024 B so any row
+# offset is a legal dynamic DMA offset (Mosaic wants coarse alignment).
+# Canonical home of the constant device/inject.py and device/resident.py
+# share (both re-export it for their callers).
+RING_ROW = 256
+
+# Ring-row transport metadata (words beyond DESC_WORDS; see module
+# docstring). Valid only on RING_ROW-padded injection rows - task-table
+# rows are DESC_WORDS wide and never carry them.
+TEN_ID = 16
+TEN_EXPIRED = 17
 
 
 class TaskGraphBuilder:

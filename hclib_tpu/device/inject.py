@@ -29,6 +29,20 @@ ring** in HBM that the scheduler polls *from inside the kernel*:
   rows through the same row-allocation path spawns use, and reports its
   consumed count back through the aliased ctl output.
 
+Multi-tenant mode (``tenants=``, device/tenants.py): the ring is
+partitioned into per-tenant contiguous regions, each with its own
+tail/consumed cursors in a per-tenant ``tctl[T, 8]`` control block, and
+the in-kernel poll becomes a **weighted round-robin** over the lanes -
+at most ``weight`` rows per lane per poll, start lane rotating every
+round, rows host-marked expired dropped with a counted TR_TENANT record,
+and total installs bounded by the scheduler's live ``headroom()`` so a
+full task table turns into ring backpressure instead of an overflow.
+Admission (quotas, token buckets, deadlines, poison quarantine) is the
+host half, in device/tenants.py; ``submit()`` below is its entry point.
+A ``tenants=None`` build compiles none of this - no extra inputs,
+outputs, or scratch - and is bit-identical to the single-firehose path
+(the perf_regression ``ingress-overhead`` guard pins it).
+
 Execution model: ``StreamingMegakernel.run_stream`` re-enters the kernel in
 bounded quanta; each entry drains everything available (including rows that
 appear mid-entry: the poll runs between quanta INSIDE the kernel) and
@@ -58,21 +72,39 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..runtime import resilience
 from ..runtime.resilience import CancelledError, StallError
-from .descriptor import DESC_WORDS, NO_TASK, TaskGraphBuilder
+from .descriptor import (
+    DESC_WORDS,
+    NO_TASK,
+    RING_ROW,
+    TEN_EXPIRED,
+    TaskGraphBuilder,
+)
 from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
+from .tenants import (
+    TC_CONSUMED,
+    TC_DROPPED,
+    TC_EXPIRED,
+    TC_INSTALLED,
+    TC_PAUSE,
+    TC_TAIL,
+    TC_WEIGHT,
+    Admission,
+    TenantTable,
+    build_row,
+    normalize_tenants,
+)
 from .tracebuf import (
     NullTracer,
     TR_ABORT,
     TR_CKPT,
     TR_INJECT,
     TR_QUIESCE,
+    TR_TENANT,
     Tracer,
     trace_info,
 )
 
 __all__ = ["StreamingMegakernel", "RING_ROW"]
-
-RING_ROW = 256  # padded descriptor row (1024 B): any row offset DMA-aligns
 
 
 class StreamingMegakernel:
@@ -91,13 +123,38 @@ class StreamingMegakernel:
     per stream: capacity bounds TOTAL injected tasks per run_stream (keeps
     the producer/consumer index algebra trivial; streams needing more roll
     over to a fresh run_stream).
+
+    ``tenants=`` (the multi-tenant front door, device/tenants.py): an int
+    N, a sequence of TenantSpec/str/dict lane specs, or a prebuilt
+    TenantTable (deterministic-clock tests build their own). None reads
+    the ``HCLIB_TPU_TENANTS*`` env spelling; False forces single-firehose
+    mode regardless of env. With lanes enabled the ring splits into
+    per-tenant regions of ``ring_capacity // N`` rows (rounded up to
+    8-row DMA chunks), producers go through ``submit()`` for a typed
+    ``Admission`` verdict, and the in-kernel poll runs weighted
+    round-robin over the lanes.
     """
 
-    def __init__(self, mk: Megakernel, ring_capacity: int = 1024) -> None:
+    def __init__(self, mk: Megakernel, ring_capacity: int = 1024,
+                 tenants=None) -> None:
         self.mk = mk
         # Rounded up to a whole 8-row chunk: the kernel fetches the ring in
         # 8-row DMAs, and the final chunk must not run off the array.
         self.ring_capacity = -(-int(ring_capacity) // 8) * 8
+        if isinstance(tenants, TenantTable):
+            self.tenants: Optional[TenantTable] = tenants
+        else:
+            specs = normalize_tenants(tenants)
+            if specs is None:
+                self.tenants = None
+            else:
+                region = -(-self.ring_capacity // (8 * len(specs))) * 8
+                self.tenants = TenantTable(specs, region)
+        if self.tenants is not None:
+            # The ring is exactly the concatenation of the lane regions.
+            self.ring_capacity = (
+                len(self.tenants) * self.tenants.region_rows
+            )
         self._jitted: Dict[Any, Any] = {}
         self._lock = threading.Lock()
         self._pending_rows: List[np.ndarray] = []
@@ -182,9 +239,16 @@ class StreamingMegakernel:
                 self._quiesce_t = time.monotonic()
 
     def stats_dict(self) -> dict:
-        """Resilience counters for this stream (abort latency included)."""
+        """Resilience counters for this stream (abort latency included).
+        With tenant lanes enabled the snapshot folds in the per-tenant
+        admission counters (``tenants.<id>.backlog/accepted/rejected``
+        ...), so a StallError carrying these stats names the tenant that
+        wedged the stream, not just "the stream"."""
         with self._lock:
-            return dict(self._stats)
+            d = dict(self._stats)
+        if self.tenants is not None:
+            d["tenants"] = self.tenants.stats()
+        return d
 
     # ---- producer side (host; any thread) ----
 
@@ -199,11 +263,10 @@ class StreamingMegakernel:
     ) -> None:
         """Queue one descriptor for the stream (thread-safe; rows reach the
         device ring at the next entry boundary, or immediately on attached
-        hosts writing the pinned ring directly)."""
-        from .descriptor import (
-            F_A0, F_DEP, F_FN, F_HOME, F_OUT, F_SUCC0, F_SUCC1,
-        )
-
+        hosts writing the pinned ring directly). On a tenant-enabled
+        stream this is sugar for ``submit()`` on the first (default)
+        lane, raising if that lane rejects - quota-aware producers call
+        ``submit`` directly and handle the Admission verdict."""
         if dep_count != 0:
             # A dependent injected row would wait on predecessors, but the
             # host has no way to wire successor edges INTO a row whose
@@ -211,15 +274,18 @@ class StreamingMegakernel:
             # decrement it. (Successor edges OUT of injected rows, succ0/1
             # naming static-graph rows, are fine.)
             raise ValueError("injected tasks must have dep_count == 0")
-        row = np.zeros(RING_ROW, np.int32)
-        row[F_FN] = fn
-        row[F_DEP] = dep_count
-        row[F_SUCC0] = succ0
-        row[F_SUCC1] = succ1
-        for i, a in enumerate(args):
-            row[F_A0 + i] = int(a)
-        row[F_OUT] = out
-        row[F_HOME] = NO_TASK  # injected tasks are local to their device
+        if self.tenants is not None:
+            adm = self.submit(
+                self.tenants.ids[0], fn, args=args, out=out,
+                succ0=succ0, succ1=succ1,
+            )
+            if not adm:
+                raise RuntimeError(
+                    f"inject rejected by tenant lane "
+                    f"{self.tenants.ids[0]!r}: {adm.reason}"
+                )
+            return
+        row = build_row(fn, args, out, succ0, succ1)
         with self._lock:
             if self._closed:
                 reason = self._abort_reason
@@ -227,6 +293,81 @@ class StreamingMegakernel:
                     "stream closed" + (f" ({reason})" if reason else "")
                 )
             self._pending_rows.append(row)
+
+    def submit(
+        self,
+        tenant,
+        fn: int,
+        args: Sequence[int] = (),
+        out: int = 0,
+        succ0: int = NO_TASK,
+        succ1: int = NO_TASK,
+        deadline_s: Optional[float] = None,
+        cancel_scope=None,
+        wait: bool = False,
+        wait_timeout_s: float = 30.0,
+    ) -> Admission:
+        """Admit one task into a tenant lane (thread-safe; needs a
+        tenant-enabled stream). Returns the typed ``Admission`` verdict:
+        ACCEPTED (inside the lane's in-flight budget; publishes at the
+        next entry), QUEUED (over budget, host backlog has room), or
+        REJECTED(reason) - the explicit backpressure signal.
+
+        ``deadline_s``/``cancel_scope`` feed deadline-aware admission
+        (device/tenants.py): explicit deadline wins, else the scope
+        chain's nearest ``CancelScope.set_deadline``, else the lane's
+        default. Expired-at-admission rejects on the spot; later
+        expiries drop lazily (host pump or device poll, counted).
+
+        ``wait=True`` converts the *transient* rejections - "rate" (the
+        token bucket refills) and "backlog" (the pump drains the host
+        queue) - into a blocking wait with bounded exponential backoff,
+        up to ``wait_timeout_s`` or the submission's own deadline.
+        Terminal rejections (ring budget, quarantine, cancellation,
+        expiry, closed stream) return immediately either way."""
+        if self.tenants is None:
+            raise ValueError(
+                "submit() needs tenant lanes: build the stream with "
+                "tenants= (or set HCLIB_TPU_TENANTS)"
+            )
+        table = self.tenants
+        table._lane(tenant)  # unknown tenants raise KeyError up front
+        row = build_row(fn, args, out, succ0, succ1)
+        deadline_at = table.resolve_deadline(
+            tenant, deadline_s, cancel_scope
+        )
+        with self._lock:
+            closed = self._closed
+        if closed:
+            return table.record_reject(tenant, "closed")
+        if not wait:
+            return table.admit(tenant, row, deadline_at, cancel_scope)
+        # The timeout is a WALL-clock bound: an injected table clock
+        # (deterministic tests) governs admission/deadline semantics but
+        # must not be able to make "bounded wait" unbounded - a frozen
+        # fake clock would otherwise never reach t_end while time.sleep
+        # burns real time forever.
+        t_end = time.monotonic() + float(wait_timeout_s)
+        backoff = 0.0005
+        while True:
+            adm = table.admit(
+                tenant, row, deadline_at, cancel_scope,
+                record_reject=False,
+            )
+            if adm:
+                return adm
+            if adm.reason not in ("rate", "backlog"):
+                return table.record_reject(tenant, adm.reason)
+            if deadline_at is not None and table.clock() >= deadline_at:
+                return table.record_reject(tenant, "expired")
+            if time.monotonic() >= t_end:
+                return table.record_reject(tenant, adm.reason)
+            with self._lock:
+                if self._closed:
+                    return table.record_reject(tenant, "closed")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.05)
+        assert False, "unreachable"
 
     def close(self) -> None:
         """No more injections: the stream drains and run_stream returns."""
@@ -242,15 +383,18 @@ class StreamingMegakernel:
         mk = self.mk
         ndata = len(mk.data_specs)
         ntrace = 1 if trace is not None else 0
-        n_in = 7 + ndata  # + ring, ctl
+        nten = 1 if self.tenants is not None else 0
+        n_in = 7 + ndata + nten  # + ring, ctl (+ tctl, tenant lanes)
         in_refs = refs[:n_in]
-        out_refs = refs[n_in : n_in + 5 + ndata + ntrace]  # + ctl out
-        rest = refs[n_in + 5 + ndata + ntrace :]
+        # + ctl out (+ tctl echo, tenant lanes)
+        out_refs = refs[n_in : n_in + 5 + ndata + ntrace + nten]
+        rest = refs[n_in + 5 + ndata + ntrace + nten :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         free, vfree, ctlbuf, rowbuf, isem = rest[nscratch:]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         ring, ctl_in = in_refs[5], in_refs[6]
+        tctl_in = in_refs[7 + ndata] if nten else None
         tasks, ready, counts, ivalues = out_refs[:4]
         ctl_out = out_refs[4]
         data = dict(zip(mk.data_specs.keys(), out_refs[5 : 5 + ndata]))
@@ -259,6 +403,7 @@ class StreamingMegakernel:
             if ntrace
             else NullTracer()
         )
+        tctl_out = out_refs[5 + ndata + ntrace] if nten else None
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
@@ -304,6 +449,102 @@ class StreamingMegakernel:
             )
             return consumed, close
 
+        T = len(self.tenants) if nten else 0
+        region = self.tenants.region_rows if nten else 0
+
+        def tpoll(r):
+            """The tenant-lane poll: weighted round-robin over the lane
+            regions, start lane rotating with the round index. Per lane
+            visit it installs at most ``weight`` rows, never more than
+            the scheduler's live ``headroom()`` (a full task table turns
+            into ring backpressure the host reads off the cursor echo,
+            not an OVF_ROWS abort), drops rows the host marked expired
+            (counted, a TR_TENANT record), and sweeps paused lanes -
+            quarantine/cancel drains their published residue without
+            installing. Cursors and cumulative counters live in the
+            tctl echo (host-seeded, so they survive entries). Returns
+            rows installed this poll. The global ctl acquire DMA
+            (close/abort/quiesce words) stays with the caller."""
+            newly = jnp.int32(0)
+            for k in range(T):
+                lane = jax.lax.rem(r + k, T)
+                tail = tctl_out[lane, TC_TAIL]
+                cons = tctl_out[lane, TC_CONSUMED]
+                paused = tctl_out[lane, TC_PAUSE] != 0
+                avail = tail - cons
+                weight = tctl_out[lane, TC_WEIGHT]
+                take = jnp.where(
+                    paused,
+                    0,
+                    jnp.minimum(
+                        jnp.minimum(weight, avail), core.headroom()
+                    ),
+                )
+                target = cons + take
+
+                def chunk(carry, lane=lane, target=target):
+                    c, inst, exp = carry
+                    base = (c // 8) * 8
+                    rp = pltpu.make_async_copy(
+                        ring.at[pl.ds(lane * region + base, 8)], rowbuf,
+                        isem.at[1],
+                    )
+                    rp.start()
+                    rp.wait()
+                    n = jnp.minimum(target - c, 8 - (c - base))
+
+                    def ins(i, ie, c=c, base=base):
+                        inst0, exp0 = ie
+                        slot = c - base + i
+                        expired = rowbuf[slot, TEN_EXPIRED] != 0
+
+                        @pl.when(jnp.logical_not(expired))
+                        def _():
+                            install(slot)
+
+                        one = jnp.int32(1)
+                        return (
+                            inst0 + jnp.where(expired, 0, one),
+                            exp0 + jnp.where(expired, one, 0),
+                        )
+
+                    inst, exp = jax.lax.fori_loop(0, n, ins, (inst, exp))
+                    return c + n, inst, exp
+
+                c, inst, exp = jax.lax.while_loop(
+                    lambda cr, target=target: cr[0] < target,
+                    chunk,
+                    (cons, jnp.int32(0), jnp.int32(0)),
+                )
+                tctl_out[lane, TC_CONSUMED] = jnp.where(paused, tail, c)
+                tctl_out[lane, TC_DROPPED] = (
+                    tctl_out[lane, TC_DROPPED]
+                    + jnp.where(paused, avail, 0)
+                )
+                tctl_out[lane, TC_INSTALLED] = (
+                    tctl_out[lane, TC_INSTALLED] + inst
+                )
+                tctl_out[lane, TC_EXPIRED] = (
+                    tctl_out[lane, TC_EXPIRED] + exp
+                )
+
+                @pl.when((inst > 0) | (exp > 0))
+                def _(lane=lane, inst=inst, exp=exp):
+                    tr.emit(
+                        TR_TENANT, tr.now(), (lane << 16) | inst, exp
+                    )
+
+                newly = newly + inst
+            return newly
+
+        def lanes_drained():
+            d = jnp.bool_(True)
+            for i in range(T):
+                d = d & (
+                    tctl_out[i, TC_CONSUMED] == tctl_out[i, TC_TAIL]
+                )
+            return d
+
         ckpt = mk.checkpoint
 
         def cond(carry):
@@ -313,12 +554,27 @@ class StreamingMegakernel:
         def body(carry):
             r, consumed, _, abr, qr = carry
             core.sched(quantum)
-            c0 = consumed
-            consumed, close = poll(consumed)
+            if nten:
+                # Tenant lanes: the global ctl acquire DMA still lands
+                # every round (abort/close/quiesce words), but rows come
+                # off the per-lane regions through the WRR poll; lane
+                # cursors live in the tctl echo, not the loop carry.
+                cp = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
+                cp.start()
+                cp.wait()
+                newly = tpoll(r)
 
-            @pl.when(consumed > c0)
-            def _():
-                tr.emit(TR_INJECT, tr.now(), consumed - c0)
+                @pl.when(newly > 0)
+                def _():
+                    tr.emit(TR_INJECT, tr.now(), newly)
+
+            else:
+                c0 = consumed
+                consumed, close = poll(consumed)
+
+                @pl.when(consumed > c0)
+                def _():
+                    tr.emit(TR_INJECT, tr.now(), consumed - c0)
 
             # Host abort word (ctl[3]): re-read by the same acquire DMA as
             # the ring tail, so the abort lands INSIDE the round loop - a
@@ -350,9 +606,16 @@ class StreamingMegakernel:
             # Nothing runnable and nothing new: exit. The host re-enters
             # while the stream is open; a closed, drained stream is final.
             idle = counts[C_PENDING] == 0
-            done = (idle & (consumed == ctlbuf[0])) | aborted | qz
+            drained = lanes_drained() if nten else (consumed == ctlbuf[0])
+            done = (idle & drained) | aborted | qz
             return r + 1, consumed, done, abr, qr
 
+        if nten:
+            # Lane cursors + cumulative counters: host-seeded per entry,
+            # mutated in place by the WRR poll, echoed back at exit.
+            for i in range(T):
+                for w in range(8):
+                    tctl_out[i, w] = tctl_in[i, w]
         # Initial ctl fetch: the consumed cursor (slot 2) persists across
         # entries through the host-echoed ctl.
         cp0 = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
@@ -389,9 +652,14 @@ class StreamingMegakernel:
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
         # ring AND ctl live in ANY (HBM): the kernel re-reads them by DMA
         # on every poll - the consumer side of the pinned-host production
-        # path - instead of snapshotting them into SMEM at entry.
+        # path - instead of snapshotting them into SMEM at entry. The
+        # tenant tctl block (host-published per entry, tiny) rides SMEM;
+        # a tenants=None build compiles none of it.
+        nten = 1 if self.tenants is not None else 0
+        T = len(self.tenants) if nten else 0
         in_specs = (
             [smem()] * 5 + [anyspace(), anyspace()] + [anyspace()] * ndata
+            + [smem()] * nten
         )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -408,10 +676,11 @@ class StreamingMegakernel:
             ]
             + data_shapes
             + ([mk.trace.out_shape()] if ntrace else [])
+            + ([jax.ShapeDtypeStruct((T, 8), jnp.int32)] if nten else [])
         )
         out_specs = tuple(
             [smem()] * 4 + [smem()] + [anyspace()] * ndata
-            + [smem()] * ntrace
+            + [smem()] * ntrace + [smem()] * nten
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
@@ -516,6 +785,7 @@ class StreamingMegakernel:
             None if deadline_s is None else time.monotonic() + deadline_s
         )
         mk = self.mk
+        table = self.tenants
         ring = np.zeros((self.ring_capacity, RING_ROW), np.int32)
         ctl = np.zeros(8, np.int32)  # [tail, close, consumed, abort, ...]
         injected = 0
@@ -542,18 +812,35 @@ class StreamingMegakernel:
             # Residue: rows published-but-unconsumed at quiesce (plus any
             # host-queued rows the snapshot captured) re-publish from ring
             # slot 0 with a reset consumed cursor - installed rows already
-            # live in the task table.
-            residue = np.asarray(
-                st.get("ring_rows",
-                       np.zeros((0, RING_ROW), np.int32))
-            ).reshape(-1, RING_ROW)
-            if len(residue) > self.ring_capacity:
+            # live in the task table. Tenant-tagged residue instead
+            # re-enters its lanes' host backlogs (counters restored from
+            # the snapshot's tctl/tstats blocks) and the next pump
+            # re-publishes it per region - per-tenant counts conserved.
+            if table is not None:
+                table.resume_from(st)
+            elif "tctl" in st or "tstats" in st:
+                # The mirror of TenantTable.resume_from's guard: a
+                # tenant-tagged snapshot resumed on a plain stream would
+                # silently strip every row's tenant identity (and its
+                # counters) instead of conserving them.
                 raise ValueError(
-                    f"resume residue ({len(residue)} rows) exceeds this "
-                    f"stream's ring_capacity {self.ring_capacity}"
+                    "resume state carries per-tenant lane blocks "
+                    "(tctl/tstats): it was exported from a tenant-enabled "
+                    "stream and cannot resume on a plain one"
                 )
-            ring[: len(residue)] = residue
-            injected = len(residue)
+            else:
+                residue = np.asarray(
+                    st.get("ring_rows",
+                           np.zeros((0, RING_ROW), np.int32))
+                ).reshape(-1, RING_ROW)
+                if len(residue) > self.ring_capacity:
+                    raise ValueError(
+                        f"resume residue ({len(residue)} rows) exceeds "
+                        f"this stream's ring_capacity "
+                        f"{self.ring_capacity}"
+                    )
+                ring[: len(residue)] = residue
+                injected = len(residue)
         else:
             tasks, succ, ring0, counts = builder.finalize(
                 capacity=mk.capacity, succ_capacity=mk.succ_capacity
@@ -594,16 +881,24 @@ class StreamingMegakernel:
                 # kernel polls the word inside its round loop and exits
                 # within one quantum's worth of inner iterations, pending
                 # work abandoned where it stands and queued rows dropped.
-                # Then surface latency and raise.
+                # Then surface latency and raise. Tenant lanes get a
+                # frozen all-paused tctl: nothing publishes, nothing
+                # installs, remaining rows abandoned like the plain path.
                 e0 = int(state[2][C_EXECUTED])
                 ctl[0] = injected
                 ctl[1] = 1
                 ctl[3] = 1
+                extra = []
+                if table is not None:
+                    frozen = np.zeros((len(table), 8), np.int32)
+                    frozen[:, TC_PAUSE] = 1
+                    extra = [jnp.asarray(frozen)]
                 outs = jitted(
                     jnp.asarray(state[0]), jnp.asarray(succ),
                     jnp.asarray(state[1]), jnp.asarray(state[2]),
                     jnp.asarray(state[3]), jnp.asarray(ring),
                     jnp.asarray(ctl), *[jnp.asarray(d) for d in data_np],
+                    *extra,
                 )
                 counts_ab = np.asarray(outs[2])
                 ctl_ab = np.asarray(outs[4])
@@ -626,6 +921,7 @@ class StreamingMegakernel:
                 raise StallError(
                     f"run_stream deadline of {deadline_s}s exceeded "
                     f"(injected={injected}, closed={closed})",
+                    stats=self.stats_dict(),
                 )
             for row in rows:
                 if injected >= self.ring_capacity:
@@ -635,7 +931,13 @@ class StreamingMegakernel:
                     )
                 ring[injected] = row
                 injected += 1
-            ctl[0] = injected
+            if table is not None:
+                # Tenant lanes: the pump expires/publishes the host
+                # backlogs into the per-lane ring regions and builds the
+                # tctl block this entry uploads; the plain tail is unused.
+                tctl_np = table.pump(ring)
+                injected = table.total_published()
+                ctl[0] = 0
             ctl[1] = 1 if closed else 0
             if quiesce_after is not None:
                 # Publish the quiesce word + threshold: the kernel
@@ -643,19 +945,28 @@ class StreamingMegakernel:
                 # count passes the threshold and exits with its state.
                 ctl[5] = 1
                 ctl[6] = quiesce_after
+            if table is None:
+                ctl[0] = injected
             entry_t0_ns = time.monotonic_ns()
             outs = jitted(
                 jnp.asarray(state[0]), jnp.asarray(succ),
                 jnp.asarray(state[1]), jnp.asarray(state[2]),
                 jnp.asarray(state[3]), jnp.asarray(ring),
                 jnp.asarray(ctl), *[jnp.asarray(d) for d in data_np],
+                *([jnp.asarray(tctl_np)] if table is not None else []),
             )
             state = [np.asarray(o) for o in outs[:4]]
             ctl_o = np.asarray(outs[4])
             data_np = [np.asarray(o) for o in outs[5 : 5 + ndata]]
+            ntrace = 1 if mk.trace is not None else 0
             if mk.trace is not None:
                 trace_row = np.asarray(outs[5 + ndata])
                 entry_t1_ns = time.monotonic_ns()
+            if table is not None:
+                # Fold the lane-cursor echo back: consume cursors advance
+                # (freeing in-flight budget), cumulative install/expire/
+                # sweep counters refresh, admission latencies record.
+                table.absorb(np.asarray(outs[5 + ndata + ntrace]))
             counts_np = state[2]
             ctl[2] = ctl_o[2]  # device-consumed cursor persists
             if bool(counts_np[C_OVERFLOW]):
@@ -669,7 +980,10 @@ class StreamingMegakernel:
             drained_cut = (
                 quiesce_after is not None
                 and int(counts_np[C_PENDING]) == 0
-                and int(ctl_o[2]) == injected
+                and (
+                    table.drained() if table is not None
+                    else int(ctl_o[2]) == injected
+                )
             )
             if observed_round >= 0 or drained_cut:
                 # The quiesce point: export the live stream state and
@@ -689,7 +1003,6 @@ class StreamingMegakernel:
                         None if t0 is None
                         else round(time.monotonic() - t0, 6)
                     )
-                residue = list(ring[consumed:injected]) + list(late)
                 info = {
                     "executed": int(counts_np[C_EXECUTED]),
                     "pending": int(counts_np[C_PENDING]),
@@ -707,11 +1020,24 @@ class StreamingMegakernel:
                         "counts": state[2],
                         "ivalues": state[3],
                         "data": dict(zip(mk.data_specs.keys(), data_np)),
-                        "ring_rows": np.asarray(residue, np.int32).reshape(
-                            -1, RING_ROW
-                        ),
                     },
                 }
+                if table is not None:
+                    # Per-tenant residue (tenant-tagged rows) + the
+                    # cumulative tctl/tstats counter blocks: resume_from
+                    # re-seeds the lanes so per-tenant accepted/installed/
+                    # expired counts are conserved exactly across the cut.
+                    # (inject() on a tenant stream routes through
+                    # submit(), so _pending_rows holds no untagged rows.)
+                    assert not late, "tenant stream held untagged rows"
+                    info["state"].update(table.export_state(ring))
+                else:
+                    residue = (
+                        list(ring[consumed:injected]) + list(late)
+                    )
+                    info["state"]["ring_rows"] = np.asarray(
+                        residue, np.int32
+                    ).reshape(-1, RING_ROW)
                 if mk.trace is not None and trace_row is not None:
                     info["trace"] = trace_info(
                         [trace_row], entry_t0_ns, entry_t1_ns,
@@ -721,14 +1047,25 @@ class StreamingMegakernel:
             if (
                 closed
                 and int(counts_np[C_PENDING]) == 0
-                and int(ctl_o[2]) == injected
+                and (
+                    # Atomically drained-check AND close the front door:
+                    # a submit racing this exit gets "closed", never an
+                    # ACCEPTED row the returned stream will not run.
+                    table.close_if_drained() if table is not None
+                    else int(ctl_o[2]) == injected
+                )
                 and not self._pending_rows
             ):
                 info = {
                     "executed": int(counts_np[C_EXECUTED]),
                     "pending": int(counts_np[C_PENDING]),
-                    "injected": injected,
+                    "injected": (
+                        table.total_published() if table is not None
+                        else injected
+                    ),
                 }
+                if table is not None:
+                    info["tenants"] = table.stats()
                 if mk.trace is not None and trace_row is not None:
                     info["trace"] = trace_info(
                         [trace_row], entry_t0_ns, entry_t1_ns,

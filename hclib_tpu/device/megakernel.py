@@ -1418,9 +1418,22 @@ class Megakernel:
 
             return row
 
+        def headroom():
+            """Task-table slots available to adopt EXTERNAL rows right
+            now: tombstone-recycled rows on the free stack plus the unbump
+            tail of the table. The inject-ring poll hook for traffic
+            shaping (device/inject.py tenant lanes): a poll that consumes
+            at most ``headroom()`` rows can never trip OVF_ROWS - rows it
+            leaves on the ring are *backpressure* the host observes
+            through the consumed-cursor echo, instead of an overflow that
+            aborts the stream. (Spawning kernels still flag OVF_ROWS as
+            before; the hook only shapes externally-injected load.)"""
+            return free[0] + (capacity - counts[C_ALLOC])
+
         return types.SimpleNamespace(
             stage=stage, sched=sched, push_ready=push_ready,
             complete=complete, install_descriptor=install_descriptor,
+            headroom=headroom,
         )
 
     def _kernel(

@@ -117,9 +117,13 @@ from .descriptor import (
     F_VMASK,
     NO_TASK,
     NUM_ARGS,
+    RING_ROW,
+    TEN_EXPIRED,
+    TEN_ID,
     TaskGraphBuilder,
 )
 from ..runtime.resilience import DeviceFaultPlan, StallError
+from .tenants import build_row
 from .megakernel import (
     fault_mix,
     interpret_mode,
@@ -151,6 +155,7 @@ from .tracebuf import (
     TR_FAULT,
     TR_INJECT,
     TR_QUIESCE,
+    TR_TENANT,
     TR_XFER,
     Tracer,
     trace_info,
@@ -159,6 +164,7 @@ from .tracebuf import (
 __all__ = [
     "ResidentKernel",
     "decode_fault_stats",
+    "pack_inject_rows",
     "RC_COMPLETE",
     "RC_FADD",
     "RC_FADD_R",
@@ -182,7 +188,31 @@ RC_UNLOCK = -8    # [lbase, qcap]: release / grant next waiter
 RC_GRANT = -9     # [row]: lock granted - dep-decrement the parked row
 
 AMROW = 128  # padded AM wire row (SMEM DMA minor dim wants 128-word units)
-RING_ROW = 256  # injection ring row (matches device/inject.py)
+# RING_ROW (the padded injection-ring row, 256 words) now lives in
+# descriptor.py beside the TEN_* transport-metadata words it carries;
+# imported above and re-exported here for existing callers.
+
+
+def pack_inject_rows(rows: Sequence, R: int, dev: int = 0):
+    """Pack one device's ``inject_rows`` specs into its ``(R, RING_ROW)``
+    ring image: tuples ``(fn, args[, out[, tenant_lane]])`` or prebuilt
+    RING_ROW numpy rows (``tenants.build_row`` + a TEN_ID stamp - the
+    transport metadata rides the row, so tenant identity survives the
+    checkpoint residue export and reshard's round-robin re-deal).
+    Returns ``(ring, n)``."""
+    ring = np.zeros((R, RING_ROW), np.int32)
+    if len(rows) > R:
+        raise ValueError(f"device {dev}: injection ring overflow")
+    for i, spec in enumerate(rows):
+        if isinstance(spec, np.ndarray):
+            ring[i] = np.asarray(spec, np.int32).reshape(RING_ROW)
+            continue
+        fn, args = spec[0], spec[1]
+        out = spec[2] if len(spec) > 2 else 0
+        ring[i] = build_row(fn, args, out)
+        if len(spec) > 3:
+            ring[i, TEN_ID] = int(spec[3])
+    return ring, len(rows)
 
 
 def lock_block_slots(qcap: int) -> int:
@@ -204,6 +234,9 @@ FS_ABORT_ROUND = 7  # round the folded abort word was observed (-1: none)
 FS_STARVED = 8      # ((hop << 8) | granter) + 1 of my starved channel
 FS_HB = 9           # my final heartbeat
 FS_QUIESCE_ROUND = 10  # round the folded quiesce word was observed (-1)
+FS_TEN_EXPIRED = 11 # tenant-tagged ring rows I dropped expired (the
+                    # mesh half of deadline admission: the host marks
+                    # TEN_EXPIRED on published rows, the poll skips them)
 FS_WORDS = 16
 
 
@@ -226,6 +259,7 @@ def decode_fault_stats(row) -> Dict[str, Any]:
         ),
         "heartbeat": row[FS_HB],
         "quiesce_round": row[FS_QUIESCE_ROUND],
+        "tenant_expired": row[FS_TEN_EXPIRED],
     }
 
 
@@ -1255,7 +1289,28 @@ class ResidentKernel:
                     n = jnp.minimum(tl - c, 8 - (c - base))
 
                     def ins(i, _):
-                        install_fixed(lambda w: rowbuf[c - base + i, w])
+                        # Tenant deadline admission, mesh half: the host
+                        # marks TEN_EXPIRED on a published row whose
+                        # admission deadline lapsed; the poll drops it
+                        # (counted, TR_TENANT names the lane) instead of
+                        # installing stale work.
+                        slot = c - base + i
+                        expired = rowbuf[slot, TEN_EXPIRED] != 0
+
+                        @pl.when(jnp.logical_not(expired))
+                        def _():
+                            install_fixed(lambda w: rowbuf[slot, w])
+
+                        @pl.when(expired)
+                        def _():
+                            fstats[FS_TEN_EXPIRED] = (
+                                fstats[FS_TEN_EXPIRED] + 1
+                            )
+                            tr.emit(
+                                TR_TENANT, tr.now(),
+                                rowbuf[slot, TEN_ID] << 16, 1,
+                            )
+
                         return 0
 
                     jax.lax.fori_loop(0, n, ins, 0)
@@ -1981,9 +2036,12 @@ class ResidentKernel:
 
         ``waits[d]``: host-declared wait-sets (chan_id, need, task_index),
         as PGASMegakernel. ``inject_rows[d]``: descriptor tuples
-        ``(fn, args[, out])`` published on device d's injection ring
-        before entry (requires ``inject=True``); the in-kernel poll
-        discovers and installs them mid-run. Returns
+        ``(fn, args[, out[, tenant_lane]])`` - or prebuilt RING_ROW
+        numpy rows (``tenants.build_row``) - published on device d's
+        injection ring before entry (requires ``inject=True``); the
+        in-kernel poll discovers and installs them mid-run, dropping
+        rows whose ``TEN_EXPIRED`` word the host set (counted in
+        ``fault_stats['tenant_expired']``, TR_TENANT traced). Returns
         (ivalues[ndev, V], data, info).
 
         ``abort``: the host abort word - truthy (or a per-device sequence
@@ -2106,21 +2164,8 @@ class ResidentKernel:
                         ictl[d, 1] = 1  # single-entry run drains fully
             else:
                 for d, rows in enumerate(inject_rows or []):
-                    if len(rows) > R:
-                        raise ValueError(
-                            f"device {d}: injection ring overflow"
-                        )
-                    for i, spec in enumerate(rows):
-                        fn, args = spec[0], spec[1]
-                        out = spec[2] if len(spec) > 2 else 0
-                        iring[d, i, F_FN] = fn
-                        iring[d, i, F_SUCC0] = NO_TASK
-                        iring[d, i, F_SUCC1] = NO_TASK
-                        for j, a in enumerate(args):
-                            iring[d, i, F_A0 + j] = int(a)
-                        iring[d, i, F_OUT] = out
-                        iring[d, i, F_HOME] = NO_TASK
-                    ictl[d, 0] = len(rows)
+                    iring[d], n = pack_inject_rows(rows, R, dev=d)
+                    ictl[d, 0] = n
                     ictl[d, 1] = 1  # closed: single-entry run drains fully
             extra += [iring, ictl]
         elif inject_rows:

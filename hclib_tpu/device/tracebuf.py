@@ -69,6 +69,7 @@ __all__ = [
     "TR_QUIESCE",
     "TR_CKPT",
     "TR_SCALE",
+    "TR_TENANT",
     "SC_HOLD",
     "SC_OUT",
     "SC_IN",
@@ -106,6 +107,10 @@ TR_SCALE = 15          # a = (from_ndev << 8) | to_ndev, b = SC_* kind
                        # (host-emitted by runtime/autoscaler.py; rides
                        # the same record ABI so timeline.py renders
                        # scale events beside device rounds)
+TR_TENANT = 16         # a = (tenant_lane << 16) | rows installed this
+                       # poll, b = rows dropped expired (the counted
+                       # TenantExpired records) - emitted by the WRR
+                       # tenant inject poll, device/inject.py
 
 # TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
 SC_HOLD = 0
@@ -143,6 +148,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_QUIESCE: "quiesce",
     TR_CKPT: "ckpt_export",
     TR_SCALE: "scale",
+    TR_TENANT: "tenant",
 }
 
 # TR_CREDIT delta codes (b word).

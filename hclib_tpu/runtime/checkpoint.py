@@ -89,11 +89,12 @@ MAGIC = "hclib-tpu-checkpoint"
 BUNDLE_VERSION = 1
 
 # state dict keys serialized for every kind (data buffers ride as
-# ``data/<name>`` entries; the stream kind adds ``ring_rows``, the
-# resident kind adds its exported wait table and - when injecting - the
-# per-device ring residue + cursor words).
+# ``data/<name>`` entries; the stream kind adds ``ring_rows`` - plus the
+# per-tenant ``tctl``/``tstats`` counter blocks when the front door runs
+# tenant lanes - the resident kind adds its exported wait table and -
+# when injecting - the per-device ring residue + cursor words).
 _STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
-_OPT_KEYS = ("ring_rows", "waits", "ictl")
+_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats")
 
 # Descriptor-word indices, bound once (descriptor ABI, device/descriptor).
 from ..device.descriptor import (  # noqa: E402
@@ -547,6 +548,13 @@ def snapshot_stream(sm, info: Dict[str, Any],
     m["quiesce_latency_s"] = info.get("quiesce_latency_s")
     m["quiesce_round"] = info.get("quiesce_observed_round")
     m.update(meta or {})
+    # After the user meta: the roster is what restore_stream's
+    # mismatch guard validates - a descriptive meta={'tenants': ...}
+    # must not clobber (or counterfeit) it.
+    if getattr(sm, "tenants", None) is not None:
+        m["tenants"] = list(sm.tenants.ids)
+    else:
+        m.pop("tenants", None)
     return CheckpointBundle(
         "stream", m, CheckpointBundle._flatten_state(state, m)
     )
@@ -601,6 +609,20 @@ def restore_stream(bundle_or_path, sm, **run_stream_kw):
     if b.kind != "stream":
         raise CheckpointError(f"restore_stream got a {b.kind!r} bundle")
     _check_kernel_meta(sm.mk, b.meta)
+    # Tenant roster must match EXACTLY (ids AND order): residue rows and
+    # the tctl/tstats counter blocks are keyed by lane index, so a
+    # same-count reordered roster would silently credit one tenant's
+    # work and quotas to another.
+    want = b.meta.get("tenants")
+    have = None if getattr(sm, "tenants", None) is None else (
+        sm.tenants.ids
+    )
+    if (want or None) != (have or None):
+        raise CheckpointError(
+            f"tenant roster mismatch: bundle carries {want!r}, the "
+            f"target stream has {have!r} (ids and order must match - "
+            "lane state is keyed by index)"
+        )
     return sm.run_stream(resume_state=b.state(), **run_stream_kw)
 
 

@@ -119,7 +119,9 @@ class MetricsRegistry:
         return ``tiers`` as a per-device list; single-device runs read
         as a one-entry list), exported as
         ``<name>.lane_occupancy.<d>`` - the ROADMAP lane-firing-policy
-        detector a dashboard watches without digging through tiers."""
+        detector a dashboard watches without digging through tiers. A
+        tenant-enabled stream's ``info['tenants']`` additionally mirrors
+        under the canonical ``tenant.<id>.*`` prefix."""
         keep: Dict[str, Any] = {}
         for k, v in info.items():
             if k == "trace":
@@ -147,6 +149,24 @@ class MetricsRegistry:
                 ]
             except (KeyError, TypeError):
                 pass
+        tenants = keep.get("tenants")
+        if isinstance(tenants, Mapping):
+            # Multi-tenant ingress: mirror the per-tenant admission
+            # counters under the canonical ``tenant.<id>.*`` prefix
+            # (accepted/rejected/expired/completed/backlog ...), the
+            # series dashboards and the fairness tests key on -
+            # regardless of what ``name`` the run info landed under.
+            # (Records flatten after live sources at snapshot time, so
+            # this end-of-run mirror wins over a still-registered live
+            # ``tenant`` source's stale overlap.)
+            self.record(
+                "tenant",
+                {str(tid): s for tid, s in tenants.items()},
+            )
+            # One canonical series only: drop the copy that would
+            # otherwise also flatten as <name>.tenants.<id>.* and
+            # double every tenant counter's scrape cardinality.
+            keep.pop("tenants")
         self.record(name, keep)
 
     # -- snapshots --

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 __all__ = [
     "CancelScope",
     "CancelledError",
+    "TenantExpired",
     "StallError",
     "InjectedFault",
     "RetryPolicy",
@@ -74,6 +75,14 @@ LOG = logging.getLogger("hclib_tpu.resilience")
 class CancelledError(RuntimeError):
     """The enclosing scope was cancelled; a control signal, not a fault
     (the runtime does not record it as the run's first error)."""
+
+
+class TenantExpired(CancelledError):
+    """A tenant-lane submission's admission deadline passed - rejected at
+    admission, dropped from the host backlog, or lazily discarded by the
+    in-kernel tenant poll (device/tenants.py). A control signal like any
+    cancellation: counted per tenant (``tenant.<id>.expired``), never
+    recorded as the run's first error, and never retried."""
 
 
 class StallError(RuntimeError):
@@ -297,14 +306,26 @@ class CancelScope:
     ``cancelled()`` consults self and every ancestor, so cancelling a
     scope implicitly cancels all descendants - no child registry, no
     per-finish bookkeeping that could leak across millions of finishes.
+
+    A scope may also carry a **deadline** (``set_deadline``): an absolute
+    ``time.monotonic()`` instant after which admission-time consumers
+    (the multi-tenant front door's deadline-aware admission,
+    device/tenants.py) treat work bound to the scope as expired.
+    Deadlines inherit like cancellation - the nearest deadline on the
+    parent chain governs - but they are *advisory*: nothing polls them,
+    so a passed deadline does not wake parked waiters by itself; the
+    checker that observes it (``deadline_expired()``) decides whether to
+    cancel. That keeps the epoch fast path intact: an armed deadline
+    costs nothing until someone asks.
     """
 
-    __slots__ = ("parent", "reason", "_cancelled")
+    __slots__ = ("parent", "reason", "_cancelled", "deadline_t")
 
     def __init__(self, parent: Optional["CancelScope"] = None) -> None:
         self.parent = parent
         self.reason: Any = None
         self._cancelled = False
+        self.deadline_t: Optional[float] = None
 
     def cancel(self, reason: Any = None) -> None:
         """Cancel this scope (and, by inheritance, its descendants).
@@ -360,6 +381,41 @@ class CancelScope:
                 return s.reason
             s = s.parent
         return None
+
+    # -- deadlines (advisory; checked at admission points) --
+
+    def set_deadline(self, seconds: Optional[float] = None,
+                     at: Optional[float] = None) -> "CancelScope":
+        """Arm a deadline ``seconds`` from now (or at absolute monotonic
+        instant ``at``); the earliest armed deadline wins on re-arm.
+        Returns self for chaining: ``CancelScope().set_deadline(0.5)``."""
+        if (seconds is None) == (at is None):
+            raise ValueError("set_deadline wants exactly one of "
+                             "seconds= or at=")
+        t = time.monotonic() + float(seconds) if at is None else float(at)
+        if self.deadline_t is None or t < self.deadline_t:
+            self.deadline_t = t
+        return self
+
+    def effective_deadline(self) -> Optional[float]:
+        """The earliest deadline on self and every ancestor (deadlines
+        inherit like cancellation), or None when none is armed."""
+        best: Optional[float] = None
+        s: Optional[CancelScope] = self
+        while s is not None:
+            t = s.deadline_t
+            if t is not None and (best is None or t < best):
+                best = t
+            s = s.parent
+        return best
+
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        """True once the effective deadline has passed (``now`` defaults
+        to ``time.monotonic()``; injectable for deterministic tests)."""
+        t = self.effective_deadline()
+        if t is None:
+            return False
+        return (time.monotonic() if now is None else now) >= t
 
 
 # ------------------------------------------------------------- deterministic

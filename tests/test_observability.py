@@ -437,3 +437,85 @@ def test_timeline_renders_dump_and_reports(tmp_path):
     f.write_text(_json.dumps(info))
     rc = timeline.main([str(path), "--device", str(f)])
     assert rc == 0
+
+
+def test_tenant_metrics_series_live_and_recorded():
+    """SATELLITE (multi-tenant ingress): a live TenantTable source and a
+    recorded run info both surface the canonical ``tenant.<id>.*``
+    series (accepted/rejected/expired/completed/backlog) - the fairness
+    numbers a dashboard rates - and Prometheus export carries them."""
+    from hclib_tpu.device.tenants import TenantSpec, TenantTable
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    table = TenantTable(
+        [TenantSpec("alice"), TenantSpec("bob")], 16,
+        clock=lambda: 0.0,
+    )
+    import numpy as _np
+    from hclib_tpu.device.tenants import build_row
+
+    for i in range(3):
+        table.admit("alice", build_row(0, [i]))
+    table.admit("bob", build_row(0, [9]))
+    reg = MetricsRegistry()
+    reg.register("tenant", table.metrics)
+    m = reg.snapshot()["metrics"]
+    assert m["tenant.alice.accepted"] == 3.0
+    assert m["tenant.bob.accepted"] == 1.0
+    assert m["tenant.alice.backlog"] == 3.0
+    assert "tenant.alice.quarantine_reason" not in m  # strings dropped
+    prom = reg.to_prometheus()
+    assert "hclib_tpu_tenant_alice_accepted 3.0" in prom
+    # add_run_info mirrors a run's info['tenants'] under the SAME prefix
+    # even when the run landed under another name.
+    reg2 = MetricsRegistry()
+    reg2.add_run_info("stream", {
+        "executed": 4,
+        "tenants": {"alice": {"accepted": 3, "completed": 2,
+                              "expired": 1, "backlog": 0,
+                              "quarantine_reason": None}},
+    })
+    m2 = reg2.snapshot()["metrics"]
+    assert m2["stream.executed"] == 4.0
+    assert m2["tenant.alice.completed"] == 2.0
+    assert m2["tenant.alice.expired"] == 1.0
+    # One canonical series: no duplicate under the run-info name.
+    assert not any(k.startswith("stream.tenants.") for k in m2)
+
+
+def test_tr_tenant_perfetto_render(tmp_path):
+    """SATELLITE: TR_TENANT records land on a dedicated 'tenant ingress'
+    track with lane id, installs, and lazy expired drops decoded."""
+    import json
+
+    import numpy as np
+
+    from hclib_tpu.device import tracebuf as tb
+    from tools import timeline
+
+    trace = {
+        "epoch": {"t0_ns": 1_000_000, "t1_ns": 2_000_000},
+        "rings": [{
+            "written": 3, "dropped": 0, "capacity": 8,
+            "records": np.array(
+                [[tb.TR_TENANT, 0, (0 << 16) | 4, 0],
+                 [tb.TR_TENANT, 0, (1 << 16) | 2, 0],
+                 [tb.TR_TENANT, 1, (2 << 16) | 0, 3]],
+                dtype=np.int64),
+        }],
+    }
+    out = tmp_path / "tenants.perfetto.json"
+    doc = timeline.export_perfetto(str(out), traces=[trace])
+    evs = [e for e in doc["traceEvents"]
+           if e.get("cat") == "device" and e["name"].startswith("t")]
+    assert len(evs) == 3
+    by_lane = {e["args"]["lane"]: e for e in evs}
+    assert by_lane[0]["args"]["installed"] == 4
+    assert by_lane[1]["name"] == "t1 +2"
+    assert by_lane[2]["args"]["expired"] == 3
+    assert "expired" in by_lane[2]["name"]
+    tracks = [e for e in doc["traceEvents"]
+              if e.get("name") == "thread_name"
+              and e["args"]["name"] == "tenant ingress"]
+    assert tracks, "tenant ingress track must be named"
+    json.loads(out.read_text())  # the file is valid Chrome-trace JSON

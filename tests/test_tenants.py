@@ -1,0 +1,810 @@
+"""Multi-tenant streaming front door (device/tenants.py + inject.py).
+
+Host-side admission (quotas, token buckets, deadlines, poison ladder,
+cancellation) tests run against a deterministic injected clock and the
+numpy WRR reference model (``wrr_poll_reference`` - the executable spec
+of the in-kernel poll), so every decision is a pure function of the
+submission sequence. Device tests drive the real interpret-mode
+streaming kernel: exact per-tenant totals, isolation under a poisoned +
+greedy mix, and quiesce -> resume -> reshard conservation."""
+
+import time
+
+import numpy as np
+import pytest
+
+from hclib_tpu.device.descriptor import (
+    RING_ROW,
+    TEN_EXPIRED,
+    TEN_ID,
+    TaskGraphBuilder,
+)
+from hclib_tpu.device.inject import StreamingMegakernel
+from hclib_tpu.device.megakernel import Megakernel
+from hclib_tpu.device.tenants import (
+    ADMIT_ACCEPTED,
+    ADMIT_QUEUED,
+    TC_CONSUMED,
+    TC_DROPPED,
+    TC_INSTALLED,
+    TC_PAUSE,
+    TC_TAIL,
+    TC_WEIGHT,
+    TenantSpec,
+    TenantTable,
+    TokenBucket,
+    build_row,
+    normalize_tenants,
+    per_tenant_ring_counts,
+    tenants_from_env,
+    wrr_poll_reference,
+)
+from hclib_tpu.runtime.resilience import CancelScope, RetryPolicy
+
+BUMP = 0
+
+
+class FakeClock:
+    """Monotonic test clock: admission decisions become a pure function
+    of the submission sequence."""
+
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _table(specs, region=16, clock=None):
+    return TenantTable(specs, region, clock=clock or FakeClock())
+
+
+def _row(i=0):
+    return build_row(BUMP, [i])
+
+
+def _drive(table, ring, polls=64, headroom=1 << 20, start_round=0):
+    """One host entry + ``polls`` device rounds of the reference poll,
+    echo absorbed - the deterministic stand-in for run_stream's inner
+    loop."""
+    tctl = table.pump(ring)
+    installed = []
+    for r in range(start_round, start_round + polls):
+        installed += wrr_poll_reference(
+            ring, tctl, table.region_rows, r, headroom
+        )
+    table.absorb(tctl)
+    return installed
+
+
+# ---------------------------------------------------------------- host
+
+
+def test_admission_verdicts_accept_queue_and_every_reject_reason():
+    """The typed Admission ladder: ACCEPTED under the in-flight budget,
+    QUEUED over it, REJECTED("backlog") past queue_capacity,
+    REJECTED("rate") when the bucket is dry, REJECTED("ring") at region
+    exhaustion - checked cheapest-first, each reason machine-readable."""
+    clock = FakeClock()
+    t = _table(
+        [TenantSpec("a", max_in_flight=2, queue_capacity=5,
+                    rate=1.0, burst=8.0)],
+        region=16, clock=clock,
+    )
+    ring = np.zeros((16, RING_ROW), np.int32)
+    verdicts = [t.admit("a", _row(i)) for i in range(5)]
+    assert [v.status for v in verdicts] == [
+        ADMIT_ACCEPTED, ADMIT_ACCEPTED,            # within in-flight budget
+        ADMIT_QUEUED, ADMIT_QUEUED, ADMIT_QUEUED,  # over it, backlog ok
+    ]
+    assert verdicts[0] and verdicts[2]            # both truthy (admitted)
+    assert verdicts[0].accepted and verdicts[2].queued
+    over = t.admit("a", _row())
+    assert over.rejected and over.reason == "backlog"
+    assert not over
+    # Rate: burst exhausted (5 accepted + 1 rejected probe took none).
+    clock.advance(0.0)
+    t2 = _table([TenantSpec("b", rate=1.0, burst=2.0)], clock=clock)
+    assert t2.admit("b", _row()) and t2.admit("b", _row())
+    dry = t2.admit("b", _row())
+    assert dry.rejected and dry.reason == "rate"
+    clock.advance(1.0)  # one token refills at rate=1/s
+    assert t2.admit("b", _row()).accepted
+    # Ring: lifetime region budget (published + queued >= region_rows).
+    t3 = _table([TenantSpec("c", queue_capacity=100)], region=8)
+    for i in range(8):
+        assert t3.admit("c", _row(i))
+    full = t3.admit("c", _row())
+    assert full.rejected and full.reason == "ring"
+    # Unknown tenants raise, they don't silently reject - and negative
+    # indices never wrap around to the last lane.
+    with pytest.raises(KeyError):
+        t.admit("nobody", _row())
+    with pytest.raises(KeyError):
+        t.admit(-1, _row())
+    assert t3.stats()["c"]["rejected"] == 1
+
+
+def test_token_bucket_deterministic_under_fake_clock():
+    """Identical clock scripts produce identical token decisions -
+    admission determinism is the token bucket's determinism."""
+    def script(bucket, clock):
+        out = []
+        for dt in (0.0, 0.0, 0.3, 0.0, 0.5, 2.0, 0.0, 0.0):
+            clock.advance(dt)
+            out.append(bucket.try_take())
+        return out
+
+    runs = []
+    for _ in range(2):
+        clock = FakeClock()
+        runs.append(script(TokenBucket(2.0, 2.0, clock), clock))
+    assert runs[0] == runs[1]
+    assert runs[0] == [True, True, False, False, True, True, True, False]
+    b = TokenBucket(2.0, 2.0, FakeClock())
+    b.try_take(2)
+    assert b.wait_s(1) == pytest.approx(0.5)
+    assert TokenBucket(0.0, 1.0, FakeClock()).wait_s(2) == float("inf")
+    with pytest.raises(ValueError):
+        TokenBucket(-1.0, 1.0)
+
+
+def test_wrr_fairness_ratios_match_weights():
+    """Saturated lanes drain in exact weight proportion: the WRR poll
+    installs ``weight`` rows per lane per round, so a 4:2:1 spec yields
+    4:2:1 installs over any whole number of rounds."""
+    specs = [
+        TenantSpec("gold", weight=4, queue_capacity=256),
+        TenantSpec("silver", weight=2, queue_capacity=256),
+        TenantSpec("bronze", weight=1, queue_capacity=256),
+    ]
+    t = _table(specs, region=64)
+    ring = np.zeros((3 * 64, RING_ROW), np.int32)
+    for lane in range(3):
+        for i in range(56):  # 8 rounds' worth at the summed rate
+            t.admit(lane, _row(i))
+    installed = _drive(t, ring, polls=8)
+    got = {tid: s["completed"] for tid, s in t.stats().items()}
+    assert got == {"gold": 32, "silver": 16, "bronze": 8}
+    # Install order interleaves lanes (no head-of-line monopoly) and the
+    # rows carry their lane tag.
+    lanes_seen = [int(r[TEN_ID]) for r in installed]
+    assert set(lanes_seen) == {0, 1, 2}
+    assert lanes_seen[:7].count(0) == 4  # first round: 4 gold, 2 silver...
+
+
+def test_wrr_headroom_backpressure_not_overflow():
+    """A tiny scheduler headroom bounds TOTAL installs per poll; the
+    un-installed rows stay on the ring as host-visible backpressure
+    (consumed cursor lags tail) instead of tripping an overflow."""
+    t = _table([TenantSpec("a", weight=8), TenantSpec("b", weight=8)])
+    ring = np.zeros((32, RING_ROW), np.int32)
+    for lane in ("a", "b"):
+        for i in range(8):
+            t.admit(lane, _row(i))
+    tctl = t.pump(ring)
+    got = wrr_poll_reference(ring, tctl, t.region_rows, 0, headroom=3)
+    assert len(got) == 3
+    t.absorb(tctl)
+    s = t.stats()
+    assert s["a"]["completed"] + s["b"]["completed"] == 3
+    assert s["a"]["in_flight"] + s["b"]["in_flight"] == 13  # still ringed
+
+
+def test_deadline_admission_reject_drop_and_ring_mark():
+    """The three expiry points: expired-at-admission rejects on the
+    spot; expired-while-host-queued drops at the next pump (counted
+    host-side); expired-while-published is marked on the ring row and
+    lazily dropped by the poll (counted device-side) - and the
+    conservation identity accepted == completed + expired holds."""
+    clock = FakeClock()
+    t = _table(
+        [TenantSpec("a", weight=4, max_in_flight=4, queue_capacity=64)],
+        clock=clock,
+    )
+    ring = np.zeros((16, RING_ROW), np.int32)
+    # 1) expired at admission.
+    dead = t.admit("a", _row(), deadline_at=clock() - 1.0)
+    assert dead.rejected and dead.reason == "expired"
+    # 2) four rows publish now; four more queue behind the budget.
+    for i in range(8):
+        assert t.admit("a", _row(i), deadline_at=clock() + 5.0)
+    tctl = t.pump(ring)          # publishes the first 4
+    assert tctl[0, TC_TAIL] == 4
+    clock.advance(10.0)          # every deadline passes
+    # 3) next pump: published rows get the TEN_EXPIRED mark for the
+    # device to drop (the host-queued four stay parked: the in-flight
+    # budget is full, so their lazy drop waits for freed budget).
+    tctl = t.pump(ring)
+    assert all(ring[i, TEN_EXPIRED] == 1 for i in range(4))
+    installed = wrr_poll_reference(
+        ring, tctl, t.region_rows, 0, headroom=100
+    )
+    assert installed == []       # all four dropped at the poll
+    t.absorb(tctl)               # consumed cursor frees the budget...
+    t.pump(ring)                 # ...and this pump drops the queued four
+    s = t.stats()["a"]
+    assert s["accepted"] == 8 and s["completed"] == 0
+    assert s["expired"] == 8     # 4 device-dropped + 4 host-dropped
+    assert s["rejected"] == 1    # the at-admission one
+    assert s["accepted"] == s["completed"] + s["expired"]
+
+
+def test_cancel_scope_deadline_chain_feeds_admission():
+    """resolve_deadline precedence: explicit deadline_s beats the scope
+    chain's nearest deadline beats the lane default; CancelScope
+    deadlines inherit parent-to-child and the earliest wins."""
+    clock = FakeClock()
+    t = _table([TenantSpec("a", deadline_s=60.0)], clock=clock)
+    parent = CancelScope().set_deadline(at=clock() + 5.0)
+    child = CancelScope(parent=parent)
+    child.set_deadline(at=clock() + 30.0)
+    assert child.effective_deadline() == clock() + 5.0  # parent earlier
+    assert t.resolve_deadline("a", None, child) == clock() + 5.0
+    assert t.resolve_deadline("a", 1.0, child) == clock() + 1.0
+    assert t.resolve_deadline("a", None, None) == clock() + 60.0
+    assert not child.deadline_expired(now=clock() + 4.0)
+    assert child.deadline_expired(now=clock() + 5.0)
+    # Re-arm keeps the earliest; exactly-one-argument contract enforced.
+    parent.set_deadline(at=clock() + 99.0)
+    assert parent.deadline_t == clock() + 5.0
+    with pytest.raises(ValueError):
+        CancelScope().set_deadline()
+    # A cancelled scope rejects at admission as "cancelled".
+    child.cancel("user hit ^C")
+    adm = t.admit("a", _row(), cancel_scope=child)
+    assert adm.rejected and adm.reason == "cancelled"
+
+
+def test_deadline_budget_cancels_lane_without_touching_siblings():
+    """A tenant drowning in expirations (budget exhausted) gets its
+    per-lane CancelScope cancelled at the pump; the sibling lane keeps
+    flowing."""
+    clock = FakeClock()
+    t = _table(
+        [TenantSpec("doomed", deadline_budget=3, queue_capacity=64),
+         TenantSpec("fine", queue_capacity=64)],
+        clock=clock,
+    )
+    ring = np.zeros((32, RING_ROW), np.int32)
+    for i in range(4):
+        t.admit("doomed", _row(i), deadline_at=clock() + 1.0)
+    t.admit("fine", _row())
+    clock.advance(5.0)
+    _drive(t, ring, polls=2)  # pump drops the 4 expired, trips the budget
+    _drive(t, ring, polls=1)  # budget observed -> lane scope cancels
+    s = t.stats()
+    assert s["doomed"]["expired"] >= 3
+    adm = t.admit("doomed", _row())
+    assert adm.rejected and adm.reason == "cancelled"
+    assert t._lane("doomed").scope.cancelled()
+    assert not t.scope.cancelled()           # parent untouched
+    assert t.admit("fine", _row()).accepted  # sibling untouched
+    assert "deadline budget" in str(t._lane("doomed").scope.reason)
+
+
+def test_poison_ladder_throttles_then_quarantines_one_lane():
+    """Terminal failures climb throttle (WRR weight clamps to 1) ->
+    quarantine (lane paused, backlog dropped, submissions rejected);
+    the sibling lane never notices. Cancellation never poisons."""
+    t = _table(
+        [TenantSpec("bad", weight=4, poison_throttle=2,
+                    poison_quarantine=4),
+         TenantSpec("good", weight=2)],
+    )
+    ring = np.zeros((32, RING_ROW), np.int32)
+    for i in range(6):
+        t.admit("bad", _row(i))
+    from hclib_tpu.runtime.resilience import CancelledError
+    t.report_failure("bad", CancelledError("control"))  # not poison
+    assert t.stats()["bad"]["poisoned"] == 0
+    t.report_failure("bad")
+    t.report_failure("bad")
+    tctl = t.pump(ring)
+    assert tctl[0, TC_WEIGHT] == 1   # weight clamped: throttled
+    assert t.stats()["bad"]["throttled"] == 1
+    t.report_failure("bad")
+    t.report_failure("bad")          # 4th terminal failure: quarantine
+    s = t.stats()["bad"]
+    assert s["quarantined"] == 1 and "poison" in s["quarantine_reason"]
+    adm = t.admit("bad", _row())
+    assert adm.rejected and adm.reason == "quarantined"
+    # The paused lane's published residue is swept, not installed, and
+    # the good lane keeps flowing.
+    t.admit("good", _row())
+    tctl = t.pump(ring)
+    assert tctl[0, TC_PAUSE] == 1 and tctl[1, TC_PAUSE] == 0
+    installed = wrr_poll_reference(ring, tctl, t.region_rows, 0, 100)
+    assert [int(r[TEN_ID]) for r in installed] == [1]
+    assert int(tctl[0, TC_DROPPED]) > 0
+    assert int(tctl[0, TC_CONSUMED]) == int(tctl[0, TC_TAIL])  # swept
+    t.absorb(tctl)
+    assert t.stats()["good"]["completed"] == 1
+    # Swept rows land in dropped (conservation holds for the paused
+    # lane) and never pollute the install-latency reservoir.
+    sb = t.stats()["bad"]
+    assert sb["dropped"] == 6
+    assert sb["accepted"] == (
+        sb["completed"] + sb["expired"] + sb["dropped"]
+    )
+    assert t.latency_stats("bad")["n"] == 0
+    assert t.drained()               # a quarantined lane can't wedge exit
+
+
+def test_validator_retry_policy_and_control_signal_drops():
+    """The lane validator retries per its RetryPolicy before poisoning;
+    a control-signal failure (CancelledError) drops the row without
+    climbing the ladder."""
+    calls = {"n": 0}
+
+    def flaky(row):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+
+    t = _table(
+        [TenantSpec("a", validator=flaky,
+                    retry=RetryPolicy(max_attempts=3, backoff_s=0.0))],
+    )
+    ring = np.zeros((16, RING_ROW), np.int32)
+    t.admit("a", _row())
+    t.pump(ring)
+    assert calls["n"] == 3                      # retried to success
+    assert t.stats()["a"]["poisoned"] == 0
+    from hclib_tpu.runtime.resilience import CancelledError
+
+    def cancels(row):
+        raise CancelledError("scope died")
+
+    t2 = _table([TenantSpec("b", validator=cancels)])
+    t2.admit("b", _row())
+    t2.pump(ring)
+    s = t2.stats()["b"]
+    assert s["poisoned"] == 0 and s["dropped"] == 1
+
+
+def test_per_tenant_cancel_drops_backlog_prospectively():
+    """cancel(tenant) cancels that lane's scope, drops its host
+    backlog, and pauses its lane at the next pump - completed work
+    stays completed, siblings untouched."""
+    t = _table(
+        [TenantSpec("a", weight=2, max_in_flight=2, queue_capacity=64),
+         TenantSpec("b")],
+    )
+    ring = np.zeros((32, RING_ROW), np.int32)
+    for i in range(6):
+        t.admit("a", _row(i))
+    _drive(t, polls=1, ring=ring)    # 2 in flight install
+    t.cancel("a", "tenant offboarded")
+    s = t.stats()["a"]
+    assert s["completed"] == 2 and s["queued"] == 0 and s["dropped"] == 4
+    adm = t.admit("a", _row())
+    assert adm.rejected and adm.reason == "cancelled"
+    assert t.admit("b", _row()).accepted
+    tctl = t.pump(ring)
+    assert tctl[0, TC_PAUSE] == 1 and tctl[1, TC_PAUSE] == 0
+
+
+def test_export_resume_conserves_per_tenant_counts():
+    """The survivability core, host half: quiesce-export mid-stream,
+    resume into a FRESH table, finish - per-tenant accepted/completed/
+    expired counts and residue all conserved exactly."""
+    clock = FakeClock()
+    specs = lambda: [  # noqa: E731
+        TenantSpec("x", weight=2, queue_capacity=64),
+        TenantSpec("y", queue_capacity=64),
+        TenantSpec("z", queue_capacity=64),
+    ]
+    t = _table(specs(), clock=clock)
+    ring = np.zeros((3 * 16, RING_ROW), np.int32)
+    sub = {"x": 10, "y": 7, "z": 4}
+    for tid, n in sub.items():
+        for i in range(n):
+            t.admit(tid, _row(i))
+    _drive(t, ring, polls=2)         # partial consumption
+    done_before = {
+        tid: s["completed"] for tid, s in t.stats().items()
+    }
+    state = t.export_state(ring)
+    # A submit that loses the race with the quiesce cut gets a clean
+    # "closed" verdict - never a silently-dropped ACCEPTED row.
+    late = t.admit("x", _row(99))
+    assert late.rejected and late.reason == "closed"
+    # Residue is tenant-tagged and accounts for everything un-consumed.
+    res_counts = per_tenant_ring_counts(state["ring_rows"])
+    for i, (tid, n) in enumerate(sub.items()):
+        assert res_counts.get(i, 0) == n - done_before[tid]
+    # Resume into a fresh table + fresh ring: the next pump re-publishes
+    # residue per lane from region slot 0.
+    t2 = _table(specs(), clock=clock)
+    t2.resume_from(state)
+    ring2 = np.zeros((3 * 16, RING_ROW), np.int32)
+    _drive(t2, ring2, polls=64)      # drain fully
+    s2 = t2.stats()
+    for tid, n in sub.items():
+        assert s2[tid]["accepted"] == n
+        assert s2[tid]["completed"] == n
+        assert s2[tid]["expired"] == 0
+    assert t2.drained()
+    # resume_from reopens the front door the export closed.
+    assert t2.admit("x", _row(0))
+    # Lane-count mismatch is diagnosed, not silently misfiled.
+    with pytest.raises(ValueError, match="lanes"):
+        _table([TenantSpec("only")]).resume_from(state)
+    # So is a same-count REORDERED roster: lane state is keyed by
+    # index, so resuming x/y/z residue into y/x/z would silently
+    # credit one tenant's work and quotas to another.
+    t3 = _table([TenantSpec("y"), TenantSpec("x"), TenantSpec("z")],
+                clock=clock)
+    with pytest.raises(ValueError, match="roster"):
+        t3.resume_from(state)
+    # A tenant-LESS snapshot (plain stream: ring_rows only) is refused
+    # rather than misfiling every row into lane 0.
+    with pytest.raises(ValueError, match="without\\s+tenant lanes"):
+        _table(specs(), clock=clock).resume_from(
+            {"ring_rows": state["ring_rows"]}
+        )
+    # Oversized residue is diagnosed at resume, not a forever-wedge.
+    t4 = _table([TenantSpec("only")], region=8)
+    with pytest.raises(ValueError, match="exceeds"):
+        t4.resume_from({
+            "ring_rows": np.stack([_row(i) for i in range(10)]),
+            "tctl": np.zeros((1, 8), np.int32),
+            "tstats": np.zeros((1, 8), np.int32),
+        })
+
+
+def test_submit_wait_true_blocks_through_transient_rejection():
+    """submit(wait=True) converts a dry token bucket into a bounded
+    blocking wait; terminal rejections (quarantine) return immediately."""
+    mk = _bump_mk()
+    sm = StreamingMegakernel(
+        mk, ring_capacity=32,
+        tenants=[TenantSpec("a", rate=50.0, burst=1.0)],
+    )
+    assert sm.submit("a", BUMP, args=[1]).accepted   # burst token
+    t0 = time.monotonic()
+    adm = sm.submit("a", BUMP, args=[2], wait=True, wait_timeout_s=5.0)
+    waited = time.monotonic() - t0
+    assert adm.accepted
+    assert 0.001 < waited < 2.0      # blocked for roughly a refill
+    sm.tenants.quarantine("a", "test")
+    t0 = time.monotonic()
+    adm = sm.submit("a", BUMP, args=[3], wait=True, wait_timeout_s=5.0)
+    assert adm.rejected and adm.reason == "quarantined"
+    assert time.monotonic() - t0 < 1.0  # terminal: no blocking
+    # Wait respects the submission's own deadline.
+    sm2 = StreamingMegakernel(
+        _bump_mk(), ring_capacity=32,
+        tenants=[TenantSpec("b", rate=0.01, burst=1.0)],
+    )
+    sm2.submit("b", BUMP, args=[1])
+    adm = sm2.submit(
+        "b", BUMP, args=[2], wait=True, deadline_s=0.05,
+        wait_timeout_s=30.0,
+    )
+    assert adm.rejected and adm.reason == "expired"
+
+
+def test_normalize_and_env_spelling(monkeypatch):
+    """tenants= plumbing: int, str/dict/TenantSpec sequences, False;
+    the HCLIB_TPU_TENANTS* env spelling incl. weight override."""
+    assert normalize_tenants(False) is None
+    assert [s.id for s in normalize_tenants(3)] == ["t0", "t1", "t2"]
+    specs = normalize_tenants(
+        ["a", {"id": "b", "weight": 5}, TenantSpec("c")]
+    )
+    assert [s.id for s in specs] == ["a", "b", "c"]
+    assert specs[1].weight == 5
+    with pytest.raises(TypeError):
+        normalize_tenants([42])
+    with pytest.raises(ValueError):
+        normalize_tenants(0)
+    # bool is an int: True must not silently become one anonymous lane.
+    with pytest.raises(ValueError, match="ambiguous"):
+        normalize_tenants(True)
+    monkeypatch.delenv("HCLIB_TPU_TENANTS", raising=False)
+    monkeypatch.delenv("HCLIB_TPU_TENANT_WEIGHTS", raising=False)
+    assert tenants_from_env() is None
+    assert normalize_tenants(None) is None
+    monkeypatch.setenv("HCLIB_TPU_TENANTS", "2")
+    got = normalize_tenants(None)
+    assert [s.id for s in got] == ["t0", "t1"]
+    # Both set and disagreeing is a loud config error, not a silent
+    # lane-count change.
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,2,1")
+    with pytest.raises(ValueError, match="disagrees"):
+        tenants_from_env()
+    monkeypatch.delenv("HCLIB_TPU_TENANTS")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_RATE", "10")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_DEADLINE_S", "1.5")
+    got = tenants_from_env()
+    assert [s.weight for s in got] == [4, 2, 1]  # weights alone set N
+    assert got[0].rate == 10.0 and got[2].deadline_s == 1.5
+    # A spec'd table validates its shape contracts.
+    with pytest.raises(ValueError, match="duplicate"):
+        TenantTable([TenantSpec("a"), TenantSpec("a")], 16)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        TenantTable([TenantSpec("a")], 12)
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec("w", weight=0)
+    # Malformed env values raise loudly - a typo must not silently run
+    # the stream as a single anonymous firehose (or drop a quota).
+    monkeypatch.setenv("HCLIB_TPU_TENANTS", "three")
+    monkeypatch.delenv("HCLIB_TPU_TENANT_WEIGHTS", raising=False)
+    with pytest.raises(ValueError, match="HCLIB_TPU_TENANTS"):
+        tenants_from_env()
+    monkeypatch.setenv("HCLIB_TPU_TENANTS", "3")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4;2;1")
+    with pytest.raises(ValueError, match="WEIGHTS"):
+        tenants_from_env()
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,2,1")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_RATE", "fast")
+    with pytest.raises(ValueError, match="RATE"):
+        tenants_from_env()
+    monkeypatch.delenv("HCLIB_TPU_TENANT_RATE")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,0,1")
+    with pytest.raises(ValueError, match="weights must be >= 1"):
+        tenants_from_env()
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,,1")
+    with pytest.raises(ValueError, match="comma-separated"):
+        tenants_from_env()  # empty entry = typo, not a shorter roster
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,2,1")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_INFLIGHT", "2.9")
+    with pytest.raises(ValueError, match="whole"):
+        tenants_from_env()
+    monkeypatch.delenv("HCLIB_TPU_TENANT_INFLIGHT")
+    monkeypatch.setenv("HCLIB_TPU_TENANT_BURST", "16")
+    with pytest.raises(ValueError, match="BURST needs"):
+        tenants_from_env()  # burst without rate builds no bucket
+    monkeypatch.delenv("HCLIB_TPU_TENANT_BURST")
+
+
+def test_submit_wait_timeout_is_wall_clock_bounded():
+    """wait_timeout_s is a WALL-clock bound: a frozen injected table
+    clock (whose token bucket therefore never refills) must yield a
+    bounded 'rate' rejection, not an unbounded spin."""
+    sm = StreamingMegakernel(
+        _bump_mk(), ring_capacity=32,
+        tenants=TenantTable(
+            [TenantSpec("a", rate=10.0, burst=1.0)], 32,
+            clock=lambda: 0.0,
+        ),
+    )
+    assert sm.submit("a", BUMP, args=[1]).accepted   # burst token
+    t0 = time.monotonic()
+    adm = sm.submit("a", BUMP, args=[2], wait=True, wait_timeout_s=0.3)
+    assert adm.rejected and adm.reason == "rate"
+    assert time.monotonic() - t0 < 5.0
+
+
+# -------------------------------------------------------------- device
+
+
+def _bump_mk(checkpoint=False, trace=None):
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    return Megakernel(
+        kernels=[("bump", bump)], capacity=128, num_values=4,
+        succ_capacity=8, interpret=True, checkpoint=checkpoint,
+        trace=trace,
+    )
+
+
+def _seed_builder():
+    b = TaskGraphBuilder()
+    b.add(BUMP, args=[1000])
+    return b
+
+
+def test_stream_wrr_exact_totals_and_stats_fold():
+    """DEVICE: a 3-lane weighted stream executes every admitted task
+    exactly once (value algebra proves it) and stats_dict names each
+    tenant's counters - the StallError-names-the-tenant satellite."""
+    sm = StreamingMegakernel(
+        _bump_mk(), ring_capacity=96,
+        tenants=[TenantSpec("gold", weight=4), TenantSpec("silver",
+                 weight=2), TenantSpec("bronze")],
+    )
+    expect = 1000
+    for i, tid in enumerate(("gold", "silver", "bronze")):
+        for k in range(6 + 4 * i):
+            sm.submit(tid, BUMP, args=[k + 1])
+            expect += k + 1
+    sm.close()
+    iv, info = sm.run_stream(_seed_builder())
+    assert int(iv[0]) == expect
+    ten = info["tenants"]
+    assert ten["gold"]["completed"] == 6
+    assert ten["silver"]["completed"] == 10
+    assert ten["bronze"]["completed"] == 14
+    assert all(s["backlog"] == 0 for s in ten.values())
+    sd = sm.stats_dict()
+    assert sd["tenants"]["gold"]["accepted"] == 6
+    # The drain exit closed the front door atomically: a submit that
+    # raced it gets "closed", never an ACCEPTED row that will not run.
+    late = sm.tenants.admit("gold", _row(1))
+    assert late.rejected and late.reason == "closed"
+    # inject() sugar routes through the default (first) lane.
+    sm2 = StreamingMegakernel(
+        _bump_mk(), ring_capacity=32, tenants=2,
+    )
+    sm2.inject(BUMP, args=[7])
+    sm2.close()
+    iv2, info2 = sm2.run_stream(_seed_builder())
+    assert int(iv2[0]) == 1007
+    assert info2["tenants"]["t0"]["completed"] == 1
+
+
+def test_stream_greedy_and_poisoned_tenants_isolated():
+    """DEVICE ISOLATION PROOF (single-chip half): one tenant poisoned
+    via its validator, one greedy tenant pushing far past its quota -
+    the victim lane still completes its exact totals."""
+    def poison(row):
+        raise RuntimeError("boom")
+
+    sm = StreamingMegakernel(
+        _bump_mk(), ring_capacity=96,
+        tenants=[
+            TenantSpec("bad", validator=poison, poison_throttle=1,
+                       poison_quarantine=2),
+            TenantSpec("greedy", max_in_flight=2, queue_capacity=4),
+            TenantSpec("victim", weight=2),
+        ],
+    )
+    expect = 1000
+    for i in range(6):
+        sm.submit("bad", BUMP, args=[10_000])  # would wreck the value
+    greedy_admitted = 0
+    greedy_rejected = 0
+    for i in range(40):
+        adm = sm.submit("greedy", BUMP, args=[1])
+        if adm:
+            greedy_admitted += 1
+        else:
+            greedy_rejected += 1
+            assert adm.reason == "backlog"
+    assert greedy_rejected > 0       # quota actually pushed back
+    expect += greedy_admitted
+    for k in range(12):
+        assert sm.submit("victim", BUMP, args=[100])
+        expect += 100
+    sm.close()
+    iv, info = sm.run_stream(_seed_builder())
+    assert int(iv[0]) == expect      # no poison row ever executed
+    ten = info["tenants"]
+    assert ten["victim"]["completed"] == 12
+    assert ten["greedy"]["completed"] == greedy_admitted
+    assert ten["bad"]["completed"] == 0
+    assert ten["bad"]["quarantined"] == 1
+    assert ten["bad"]["poisoned"] >= 2
+
+
+def test_stream_tenant_quiesce_resume_conserves_counts():
+    """DEVICE SURVIVABILITY PROOF (stream half): quiesce mid-stream with
+    3 tenants live, residue tenant-tagged, resume re-publishes per lane
+    - per-tenant accepted/completed/expired conserved exactly and the
+    final value is bit-identical to an uninterrupted run."""
+    def fresh(n=64):
+        return StreamingMegakernel(
+            _bump_mk(checkpoint=True), ring_capacity=n,
+            tenants=["x", "y", "z"],
+        )
+
+    sub = {"x": 9, "y": 6, "z": 3}
+    expect = 1000 + sum((tid_i + 1) * n
+                        for tid_i, n in enumerate(sub.values()))
+    sm = fresh()
+    for i, (tid, n) in enumerate(sub.items()):
+        for _ in range(n):
+            sm.submit(tid, BUMP, args=[i + 1])
+    sm.quiesce(after_executed=4)
+    iv, info = sm.run_stream(_seed_builder())
+    assert info["quiesced"] is True
+    st = info["state"]
+    res = per_tenant_ring_counts(st["ring_rows"])
+    ten_q = {i: int(st["tctl"][i, TC_INSTALLED]) for i in range(3)}
+    for i, n in enumerate(sub.values()):
+        assert ten_q[i] + res.get(i, 0) == n   # conserved at the cut
+    # The bundle path refuses a reordered roster (lane state is keyed
+    # by index) instead of silently crediting the wrong tenant.
+    from hclib_tpu.runtime.checkpoint import (
+        CheckpointError, restore_stream, snapshot_stream,
+    )
+    bundle = snapshot_stream(sm, info)
+    assert bundle.meta["tenants"] == ["x", "y", "z"]
+    reordered = StreamingMegakernel(
+        _bump_mk(checkpoint=True), ring_capacity=64,
+        tenants=["y", "x", "z"],
+    )
+    with pytest.raises(CheckpointError, match="roster"):
+        restore_stream(bundle, reordered)
+    plain = StreamingMegakernel(
+        _bump_mk(checkpoint=True), ring_capacity=64,
+    )
+    with pytest.raises(CheckpointError, match="roster"):
+        restore_stream(bundle, plain)
+    sm2 = fresh()
+    sm2.close()
+    iv2, info2 = sm2.run_stream(resume_state=st)
+    assert int(iv2[0]) == expect
+    ten = info2["tenants"]
+    for tid, n in sub.items():
+        assert ten[tid]["accepted"] == n and ten[tid]["completed"] == n
+    # Uninterrupted reference run: bit-identical final value.
+    sm3 = fresh()
+    for i, (tid, n) in enumerate(sub.items()):
+        for _ in range(n):
+            sm3.submit(tid, BUMP, args=[i + 1])
+    sm3.close()
+    iv3, _ = sm3.run_stream(_seed_builder())
+    assert int(iv3[0]) == int(iv2[0])
+
+
+def test_reshard_conserves_tenant_tagged_ring_residue():
+    """SURVIVABILITY PROOF (mesh half, host-side): a resident bundle's
+    per-device inject-ring residue carries TEN_ID on the row, so
+    reshard(4 -> 2) and (4 -> 8) re-deal conserves per-tenant counts
+    exactly - by construction, checked by the probe the chaos soak
+    uses."""
+    from hclib_tpu.device.descriptor import DESC_WORDS, F_HOME, NO_TASK
+    from hclib_tpu.runtime.checkpoint import CheckpointBundle
+
+    ndev, cap, R = 4, 8, 8
+    rr = np.zeros((ndev, R, RING_ROW), np.int32)
+    ic = np.zeros((ndev, 8), np.int32)
+    lane_of = lambda d, i: (d + i) % 3  # noqa: E731 - mixed ownership
+    for d in range(ndev):
+        for i in range(4):
+            rr[d, i] = build_row(BUMP, [d * 10 + i])
+            rr[d, i, TEN_ID] = lane_of(d, i)
+        ic[d, 0] = 4
+    before = per_tenant_ring_counts(rr, ic)
+    assert sum(before.values()) == 16
+    # Minimal clean-quiesce resident bundle (live rows ready+link-free).
+    tasks = np.zeros((ndev, cap, DESC_WORDS), np.int32)
+    tasks[:, :, 2:4] = NO_TASK  # F_SUCC0/F_SUCC1
+    tasks[:, :, F_HOME] = NO_TASK
+    counts = np.zeros((ndev, 8), np.int32)
+    counts[:, 1:4] = 1  # tail / alloc / pending
+    counts[:, 4] = 2    # value_alloc
+    b = CheckpointBundle("resident", {"ndev": ndev}, {
+        "tasks": tasks, "succ": np.full((ndev, 8), -1, np.int32),
+        "ready": np.zeros((ndev, cap), np.int32), "counts": counts,
+        "ivalues": np.zeros((ndev, 16), np.int32),
+        "ring_rows": rr, "ictl": ic,
+    })
+    for m in (2, 8):
+        out = b.reshard(m)
+        after = per_tenant_ring_counts(
+            out.arrays["ring_rows"], out.arrays["ictl"]
+        )
+        assert after == before
+    with pytest.raises(ValueError, match="ictl"):
+        per_tenant_ring_counts(rr)  # 3-D residue needs the cursors
+
+
+def test_resident_inject_rows_accept_tenant_tags():
+    """Mesh-side plumbing: ResidentKernel.run's ring packer takes
+    (fn, args[, out[, tenant]]) tuples and prebuilt RING_ROW rows; both
+    land on the per-device ring with TEN_ID stamped (the full mesh run
+    is the Mosaic-gated chaos soak's job)."""
+    from hclib_tpu.device.descriptor import F_A0, F_FN, F_OUT
+    from hclib_tpu.device.resident import pack_inject_rows
+
+    tagged = build_row(BUMP, [5])
+    tagged[TEN_ID] = 2
+    ring, n = pack_inject_rows([(BUMP, (1,), 3, 1), tagged], R=4)
+    assert n == 2
+    assert ring[0, F_FN] == BUMP and ring[0, F_A0] == 1
+    assert ring[0, F_OUT] == 3 and ring[0, TEN_ID] == 1
+    assert (ring[1] == tagged).all()
+    ic = np.zeros((1, 8), np.int32)
+    ic[0, 0] = 2
+    assert per_tenant_ring_counts(ring[None], ic) == {1: 1, 2: 1}
+    with pytest.raises(ValueError, match="overflow"):
+        pack_inject_rows([(BUMP, ())] * 5, R=4)

@@ -35,6 +35,17 @@ mid-stream, and scale-in with totals bit-identical to an uninterrupted
 fault-free run (the autoscale half is Mosaic-gated like the other mesh
 scenarios).
 
+``--tenants`` adds the seeded MULTI-TENANT INGRESS scenarios (ISSUE 8):
+a greedy tenant pushing far past its quota while its siblings complete
+their exact totals with WRR fairness in exact weight proportion; a
+poison tenant throttled then quarantined while the others' task algebra
+stays exact; a deadline storm whose per-tenant
+``accepted == completed + expired`` identity reconciles exactly across
+every expiry point (admission / host queue / on-ring lazy drop); and
+fire_preempt landing mid-stream with three tenants live, per-tenant
+accepted/completed/residue conserved across the checkpoint/resume cut.
+All four run on the interpret-mode streaming kernel (no Mosaic needed).
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
@@ -723,6 +734,254 @@ def scenario_storm_autoscale(seed: int, scale: str) -> dict:
             "ndev_final": info["ndev_final"]}
 
 
+# ------------------------------------- multi-tenant ingress (ISSUE 8)
+
+def _tenant_sm(specs, ring=768, checkpoint=False):
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    return StreamingMegakernel(
+        Megakernel(kernels=[("bump", bump)], capacity=512,
+                   num_values=64, succ_capacity=8, interpret=True,
+                   checkpoint=checkpoint),
+        ring_capacity=ring, tenants=specs,
+    )
+
+
+def scenario_tenant_greedy_quota(seed: int, scale: str) -> dict:
+    """A greedy tenant pushes 4x past its quota: the quota pushes back
+    (typed backlog rejections, never a wedge), both sibling lanes
+    complete their exact totals, and the WRR reference model proves
+    install fairness stays in exact weight proportion."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.tenants import (
+        TenantSpec, TenantTable, build_row, wrr_poll_reference,
+    )
+
+    rng = np.random.default_rng(1000 + seed)
+    n1, n2 = int(rng.integers(15, 30)), int(rng.integers(15, 30))
+    specs = lambda: [  # noqa: E731
+        TenantSpec("victim1", weight=2),
+        TenantSpec("victim2", weight=1),
+        TenantSpec("greedy", weight=1, max_in_flight=4,
+                   queue_capacity=8),
+    ]
+    sm = _tenant_sm(specs())
+    expect, admitted, rejected = 0, 0, 0
+    for k in range(n1):
+        assert sm.submit("victim1", 0, args=[k + 1])
+        expect += k + 1
+    for k in range(n2):
+        assert sm.submit("victim2", 0, args=[100])
+        expect += 100
+    for _ in range(4 * (n1 + n2)):
+        adm = sm.submit("greedy", 0, args=[1])
+        if adm:
+            admitted += 1
+        else:
+            rejected += 1
+            assert adm.reason == "backlog", adm.reason
+    expect += admitted
+    sm.close()
+    iv, info = sm.run_stream(TaskGraphBuilder(), deadline_s=120.0)
+    assert int(iv[0]) == expect, (int(iv[0]), expect)
+    ten = info["tenants"]
+    assert ten["victim1"]["completed"] == n1
+    assert ten["victim2"]["completed"] == n2
+    assert rejected > 0, "quota never pushed back"
+    # Fairness bound (reference model, saturated lanes): installs per
+    # whole WRR cycle are EXACTLY weight-proportional. Quotas off here -
+    # fairness is the WRR weights' property; the quota's pushback was
+    # asserted above on the live stream.
+    table = TenantTable(
+        [TenantSpec("victim1", weight=2), TenantSpec("victim2"),
+         TenantSpec("greedy")],
+        64, clock=lambda: 0.0,
+    )
+    ring = np.zeros((3 * 64, 256), np.int32)
+    for lane in range(3):
+        for i in range(32):
+            table.admit(lane, build_row(0, [i]))
+    tctl = table.pump(ring)
+    for r in range(8):
+        wrr_poll_reference(ring, tctl, 64, r, 1 << 20)
+    table.absorb(tctl)
+    done = {t: s["completed"] for t, s in table.stats().items()}
+    assert done["victim1"] == 2 * done["victim2"] == 2 * done["greedy"]
+    return {"faults": rejected, "recoveries": 1, "greedy_admitted":
+            admitted, "greedy_rejected": rejected,
+            "victim_tasks": n1 + n2}
+
+
+def scenario_tenant_poison_quarantine(seed: int, scale: str) -> dict:
+    """A poison tenant (validator explodes on seeded rows) climbs
+    throttle -> quarantine; the other tenants complete exactly - no
+    poison row ever executes, quarantine never wedges the drain."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.tenants import TenantSpec
+
+    rng = np.random.default_rng(2000 + seed)
+    n_ok = int(rng.integers(20, 40))
+
+    def poison(row):
+        raise RuntimeError(f"poison row (seed {seed})")
+
+    sm = _tenant_sm([
+        TenantSpec("poison", validator=poison, poison_throttle=1,
+                   poison_quarantine=2),
+        TenantSpec("steady", weight=2),
+        TenantSpec("bursty"),
+    ])
+    for _ in range(6):
+        sm.submit("poison", 0, args=[999_999])
+    expect, nb = 0, 0
+    for k in range(n_ok):
+        assert sm.submit("steady", 0, args=[k + 1])
+        expect += k + 1
+        if rng.random() < 0.5:
+            assert sm.submit("bursty", 0, args=[10])
+            expect += 10
+            nb += 1
+    sm.close()
+    iv, info = sm.run_stream(TaskGraphBuilder(), deadline_s=120.0)
+    assert int(iv[0]) == expect, (int(iv[0]), expect)
+    ten = info["tenants"]
+    assert ten["steady"]["completed"] == n_ok
+    assert ten["bursty"]["completed"] == nb
+    assert ten["poison"]["completed"] == 0
+    assert ten["poison"]["quarantined"] == 1
+    return {"faults": ten["poison"]["poisoned"], "recoveries": 1,
+            "steady": n_ok, "bursty": nb}
+
+
+def scenario_tenant_deadline_storm(seed: int, scale: str) -> dict:
+    """Deadline storm under a deterministic clock: seeded mix of live
+    and doomed submissions across 3 lanes; every expiry point exercised
+    and the per-tenant accepted == completed + expired identity
+    reconciles exactly."""
+    import numpy as np
+
+    from hclib_tpu.device.tenants import (
+        TenantSpec, TenantTable, build_row, wrr_poll_reference,
+    )
+
+    rng = np.random.default_rng(3000 + seed)
+    t_now = [100.0]
+    clock = lambda: t_now[0]  # noqa: E731
+    table = TenantTable(
+        [TenantSpec("a", weight=2, max_in_flight=8, queue_capacity=512),
+         TenantSpec("b", queue_capacity=512),
+         TenantSpec("c", deadline_s=0.5, queue_capacity=512)],
+        64, clock=clock,
+    )
+    ring = np.zeros((3 * 64, 256), np.int32)
+    n = 60 if scale == "smoke" else 240
+    rejected_expired = 0
+    for i in range(n):
+        lane = int(rng.integers(0, 3))
+        doomed = rng.random() < 0.4
+        dl = clock() + (0.01 if doomed else 60.0)
+        if rng.random() < 0.1:
+            dl = clock() - 1.0  # already expired at admission
+        adm = table.admit(lane, build_row(0, [i]), deadline_at=dl)
+        if not adm:
+            assert adm.reason == "expired"
+            rejected_expired += 1
+        # Seeded clock jitter + a pump/poll slice every few admits.
+        t_now[0] += float(rng.random() * 0.02)
+        if i % 8 == 7:
+            tctl = table.pump(ring)
+            for r in range(2):
+                wrr_poll_reference(ring, tctl, 64, i + r, 1 << 20)
+            table.absorb(tctl)
+            t_now[0] += float(rng.random() * 0.05)
+    # Drain: advance past every live deadline's horizon is NOT done -
+    # live rows must complete, doomed rows must expire.
+    for r in range(256):
+        tctl = table.pump(ring)
+        wrr_poll_reference(ring, tctl, 64, r, 1 << 20)
+        table.absorb(tctl)
+        if table.drained():
+            break
+    assert table.drained(), "deadline storm wedged the drain"
+    total_exp = total_done = 0
+    for tid, s in table.stats().items():
+        assert s["accepted"] == s["completed"] + s["expired"], (tid, s)
+        total_exp += s["expired"]
+        total_done += s["completed"]
+    assert total_exp > 0 and total_done > 0
+    return {"faults": total_exp + rejected_expired, "recoveries": 1,
+            "admitted": total_done + total_exp,
+            "expired": total_exp, "completed": total_done,
+            "rejected_at_admission": rejected_expired}
+
+
+def scenario_tenant_preempt_stream(seed: int, scale: str) -> dict:
+    """fire_preempt lands mid-stream with THREE tenants live: the bound
+    hook quiesces, per-tenant residue rides the snapshot tenant-tagged,
+    and the resumed drain conserves per-tenant accepted/completed
+    counts exactly (grand total exact by value algebra)."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.tenants import per_tenant_ring_counts
+    from hclib_tpu.runtime import resilience
+    from hclib_tpu.runtime.checkpoint import checkpoint_on_preempt
+
+    rng = np.random.default_rng(4000 + seed)
+    subs = {t: int(rng.integers(20, 40))
+            for t in ("alpha", "beta", "gamma")}
+    resilience.reset_preempt()
+    sm = _tenant_sm(list(subs), checkpoint=True)
+    expect = 0
+    for i, (tid, cnt) in enumerate(subs.items()):
+        for _ in range(cnt):
+            assert sm.submit(tid, 0, args=[i + 1])
+            expect += i + 1
+
+    def preempter():
+        time.sleep(0.05 + 0.01 * (seed % 3))
+        resilience.fire_preempt(f"tenant soak preemption seed {seed}")
+
+    t = threading.Thread(target=preempter)
+    t.start()
+    try:
+        with checkpoint_on_preempt(sm, after_executed=5):
+            iv, info = sm.run_stream(
+                TaskGraphBuilder(), quantum=8, deadline_s=120.0,
+            )
+    finally:
+        t.join()
+        resilience.reset_preempt()
+    assert info.get("quiesced"), "preemption never quiesced the stream"
+    st = info["state"]
+    residue = per_tenant_ring_counts(st["ring_rows"])
+    installed_at_cut = {
+        i: int(st["tctl"][i, 5]) for i in range(3)  # TC_INSTALLED
+    }
+    for i, cnt in enumerate(subs.values()):
+        assert installed_at_cut[i] + residue.get(i, 0) == cnt
+    sm2 = _tenant_sm(list(subs), checkpoint=True)
+    sm2.close()
+    iv2, info2 = sm2.run_stream(resume_state=st, deadline_s=120.0)
+    assert int(iv2[0]) == expect, (int(iv2[0]), expect)
+    ten = info2["tenants"]
+    for tid, cnt in subs.items():
+        assert ten[tid]["accepted"] == cnt
+        assert ten[tid]["completed"] == cnt
+    return {"faults": 1, "recoveries": 1,
+            "executed_at_cut": info["executed"],
+            "residue_rows": int(sum(residue.values())),
+            **{f"tasks_{t}": c for t, c in subs.items()}}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -746,6 +1005,13 @@ STORM_SCENARIOS = [
     ("storm_stream", scenario_storm_stream),
     ("storm_megakernel_chain", scenario_storm_megakernel_chain),
     ("storm_autoscale", scenario_storm_autoscale),
+]
+
+TENANT_SCENARIOS = [
+    ("tenant_greedy_quota", scenario_tenant_greedy_quota),
+    ("tenant_poison_quarantine", scenario_tenant_poison_quarantine),
+    ("tenant_deadline_storm", scenario_tenant_deadline_storm),
+    ("tenant_preempt_stream", scenario_tenant_preempt_stream),
 ]
 
 
@@ -773,6 +1039,13 @@ def main(argv=None) -> int:
                          "mesh with a dead-chip evacuation mid-stream)")
     ap.add_argument("--storm-only", action="store_true",
                     help="run ONLY the preempt-storm scenarios")
+    ap.add_argument("--tenants", action="store_true",
+                    help="add the seeded multi-tenant ingress scenarios "
+                         "(greedy tenant vs quota with WRR fairness, "
+                         "poison tenant quarantined, deadline storm "
+                         "reconciliation, preempt with 3 tenants live)")
+    ap.add_argument("--tenants-only", action="store_true",
+                    help="run ONLY the multi-tenant ingress scenarios")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -787,7 +1060,8 @@ def main(argv=None) -> int:
     # groups it names (e.g. --mesh-only --preempt = mesh + preempt).
     scenarios = (
         []
-        if (args.mesh_only or args.preempt_only or args.storm_only)
+        if (args.mesh_only or args.preempt_only or args.storm_only
+            or args.tenants_only)
         else list(SCENARIOS)
     )
     if args.mesh or args.mesh_only:
@@ -796,6 +1070,8 @@ def main(argv=None) -> int:
         scenarios += PREEMPT_SCENARIOS
     if args.storm or args.storm_only:
         scenarios += STORM_SCENARIOS
+    if args.tenants or args.tenants_only:
+        scenarios += TENANT_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

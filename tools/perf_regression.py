@@ -15,7 +15,12 @@ spawn-storm workload with the EventLog recorder off vs on, failing when
 the ratio exceeds ``--instrument-tolerance`` (default 3x; the
 recorder measures ~1.2-1.8x on no-op spawn storms, but a loaded CI box
 swings the denominator) - the
-observability layer must never silently tax the hot path.
+observability layer must never silently tax the hot path. The
+**ingress-overhead guard** bounds the multi-tenant front door the same
+way: tenancy-off streams compile zero new device words and stay
+bit-identical to seed, and the 1-tenant enabled path is bounded vs the
+plain streaming-inject baseline in the SAME run
+(``--ingress-tolerance``).
 
 Usage:
   python tools/perf_regression.py               # full sizes, 3 trials
@@ -160,6 +165,95 @@ def _instrument_overhead(quick: bool, trials: int) -> dict:
     }
 
 
+def _ingress_overhead(quick: bool, trials: int) -> dict:
+    """Multi-tenant ingress tax guard (ISSUE 8), same-run arms: the same
+    injected workload through (a) the plain single-firehose stream -
+    tenancy OFF compiles zero new device words (no tctl input/echo, no
+    WRR poll; ``tenants=False`` overrides any env spelling) and must
+    stay bit-identical to the seed path - and (b) a 1-tenant enabled
+    stream, whose results must be bit-identical to (a) and whose wall
+    time is bounded by --ingress-tolerance (it pays the tctl copy + one
+    lane's WRR bookkeeping per round)."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+
+    ntasks = 48 if quick else 160
+
+    def mark(ctx):
+        # Every task writes its OWN value slot: the cross-arm compare is
+        # over the whole ivalues vector, so a dropped or misrouted ring
+        # row shows up as a wrong slot even when an aggregate sum would
+        # come out equal by coincidence.
+        ctx.set_value(ctx.arg(1), ctx.arg(0))
+
+    def mk():
+        return Megakernel(
+            kernels=[("mark", mark)], capacity=max(256, ntasks + 8),
+            num_values=ntasks + 8, succ_capacity=8, interpret=True,
+        )
+
+    def run_once(tenants) -> Tuple[int, bytes]:
+        sm = StreamingMegakernel(mk(), ring_capacity=max(256, ntasks),
+                                 tenants=tenants)
+        if tenants is False:
+            assert sm.tenants is None  # zero new device words: no tctl ABI
+            for i in range(ntasks):
+                sm.inject(0, args=[i + 1, i + 1])
+        else:
+            for i in range(ntasks):
+                assert sm.submit("t0", 0, args=[i + 1, i + 1])
+        sm.close()
+        b = TaskGraphBuilder()
+        b.add(0, args=[0, 0])
+        t0 = time.perf_counter_ns()
+        iv, info = sm.run_stream(b)
+        dt = time.perf_counter_ns() - t0
+        iv = np.asarray(iv)
+        expect = np.zeros(ntasks + 8, iv.dtype)
+        expect[1 : ntasks + 1] = np.arange(1, ntasks + 1)
+        if not np.array_equal(iv, expect):
+            raise AssertionError(
+                f"ingress-overhead: arm (tenants={tenants!r}) dropped "
+                f"or misrouted rows: {np.flatnonzero(iv != expect)}"
+            )
+        if tenants is False:
+            # Tenancy off = seed ABI: no tenant echo anywhere in the
+            # run's surfaces.
+            assert "tenants" not in info and "tenants" not in (
+                sm.stats_dict()
+            )
+        else:
+            assert info["tenants"]["t0"]["completed"] == ntasks
+        return dt, iv.tobytes()
+
+    run_once(False)  # warm both jits outside the timed arms
+    run_once(1)
+    n = max(2, trials)
+    base, ten, values = [], [], set()
+    for _ in range(n):
+        dt, v = run_once(False)
+        base.append(dt)
+        values.add(v)
+        dt, v = run_once(1)
+        ten.append(dt)
+        values.add(v)
+    if len(values) != 1:
+        raise AssertionError(
+            "ingress-overhead: tenancy-on ivalues diverged from the "
+            f"plain stream ({len(values)} distinct result vectors)"
+        )
+    return {
+        "base_ns": min(base),
+        "tenant_ns": min(ten),
+        "ratio": min(ten) / min(base),
+        "tasks": ntasks,
+        "bit_identical": True,
+    }
+
+
 def _checkpoint_overhead(quick: bool, trials: int) -> dict:
     """Checkpoint-tax guard (ISSUE 5): the same seeded UTS megakernel
     traversal with checkpoint support off vs compiled-in-but-never-
@@ -271,6 +365,11 @@ def main(argv=None) -> int:
     ap.add_argument("--instrument-tolerance", type=float, default=3.0,
                     help="max instrument=True slowdown ratio (the "
                     "flight-recorder/EventLog overhead guard)")
+    ap.add_argument("--ingress-tolerance", type=float, default=3.0,
+                    help="max enabled(1-tenant)/plain-stream wall ratio "
+                         "for the ingress-overhead guard (interpret-mode "
+                         "walls swing; results must be bit-identical "
+                         "regardless)")
     ap.add_argument("--checkpoint-tolerance", type=float, default=3.0,
                     help="max checkpoint-enabled-but-idle slowdown ratio "
                     "(the quiesce-word overhead guard; the off path is "
@@ -353,6 +452,30 @@ def main(argv=None) -> int:
                     f"{ov['ratio']:.2f}x slower (bound "
                     f"{args.instrument_tolerance:.2f}x) - the recorder is "
                     "taxing the hot path"
+                )
+                line += "  REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "ingress-overhead" in wanted:
+        try:
+            io = _ingress_overhead(args.quick, args.trials)
+        except Exception as e:
+            print(f"ingress-overhead FAILED: {e}", file=sys.stderr)
+            failures.append(f"ingress-overhead: failed ({e})")
+        else:
+            results["ingress-overhead"] = io
+            line = (
+                f"{'ingress-overhead':15s} ratio {io['ratio']:5.2f}x "
+                f"({io['tenant_ns'] / 1e6:.1f} ms 1-tenant vs "
+                f"{io['base_ns'] / 1e6:.1f} ms plain, {io['tasks']} "
+                f"tasks, bit-identical)"
+            )
+            if io["ratio"] > args.ingress_tolerance:
+                failures.append(
+                    f"ingress-overhead: the 1-tenant front door is "
+                    f"{io['ratio']:.2f}x slower than the plain stream "
+                    f"(bound {args.ingress_tolerance:.2f}x) - the WRR "
+                    "poll is taxing the round loop"
                 )
                 line += "  REGRESSED"
             print(line, flush=True)

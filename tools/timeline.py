@@ -343,7 +343,9 @@ def _host_events(dump_path: str) -> List[Dict]:
 # Lane-thread base tid inside a device process: tids [0, _TID_LANES) are
 # the fixed tracks (rounds / scalar / events), lane fid f maps to
 # _TID_LANES + f.
-_TID_ROUNDS, _TID_SCALAR, _TID_EVENTS, _TID_LANES = 0, 1, 2, 16
+_TID_ROUNDS, _TID_SCALAR, _TID_EVENTS, _TID_TENANTS, _TID_LANES = (
+    0, 1, 2, 3, 16
+)
 
 
 def _device_events(trace: Dict, pid0: int) -> List[Dict]:
@@ -418,6 +420,16 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                      "checkpoint (quiesce→export)",
                      {"pending": a, "ready_backlog": b})
                 quiesce_at = None
+            elif tag == tb.TR_TENANT:
+                # One WRR tenant-poll visit: installs and lazy expired
+                # drops per lane, on a dedicated track so per-tenant
+                # ingress fairness reads directly off the timeline.
+                lane, inst = a >> 16, a & 0xFFFF
+                name = f"t{lane} +{inst}"
+                if b:
+                    name += f" ({b} expired)"
+                span(_TID_TENANTS, "tenant ingress", t, 0.5, name,
+                     {"lane": lane, "installed": inst, "expired": b})
             elif tag == tb.TR_SCALE:
                 # Autoscaler decision (host-emitted ring, slice index as
                 # timebase): label resizes with their mesh arrow so the
