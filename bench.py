@@ -964,6 +964,113 @@ def bench_tenants(quick: bool = False) -> None:
     log(f"tenant ingress bench written: {path}")
 
 
+def bench_forasync(quick: bool = False) -> None:
+    """forasync device tier cost of record (ISSUE 9): the 2D Jacobi-style
+    stencil and the map-style batched-apply loop through the tile tier
+    (batch lanes + double-buffered operand prefetch). The headline JSON -
+    combined tiles/s across both loops - prints (and flushes) FIRST,
+    rc=124-proofed like every other headline; per-tile-size occupancy /
+    prefetch lines go to stderr budget-gated, and the full detail lands
+    in perf-logs/<ts>.forasync.json."""
+    import jax
+    import numpy as np
+
+    from hclib_tpu.device.forasync_tier import run_forasync_device
+    from hclib_tpu.device.workloads import (
+        map_data, map_loop, map_reference, stencil_data, stencil_loop,
+        stencil_reference,
+    )
+
+    H, W = (16, 512) if quick else (64, 1024)
+    T = 16 if quick else 64
+    tk_s, bounds_s, tile_s = stencil_loop(H, W)
+    gin, gout = stencil_data(H, W)
+    ref_s = stencil_reference(gin)
+    tk_m, bounds_m, tile_m = map_loop(T)
+    vin, vout = map_data(T)
+    ref_m = map_reference(vin)
+
+    def arm(tk, bounds, tile, data, ref, out_name, width):
+        from hclib_tpu.device.forasync_tier import make_forasync_megakernel
+
+        # One megakernel reused across warm + timed runs: the timed arm
+        # measures the steady-state tile rate, not the XLA compile.
+        mk = make_forasync_megakernel(tk, width=width, interpret=True)
+        d, info = run_forasync_device(
+            tk, bounds, tile, dict(data), width=width, mk=mk
+        )  # warm the jit
+        t0 = time.perf_counter()
+        d, info = run_forasync_device(
+            tk, bounds, tile, dict(data), width=width, mk=mk
+        )
+        wall = time.perf_counter() - t0
+        assert np.array_equal(np.asarray(d[out_name]), ref), "wrong result"
+        return info, wall
+
+    info_s, wall_s = arm(
+        tk_s, bounds_s, tile_s, {"gin": gin, "gout": gout}, ref_s,
+        "gout", 8,
+    )
+    info_m, wall_m = arm(
+        tk_m, bounds_m, tile_m, {"vin": vin, "vout": vout}, ref_m,
+        "vout", 8,
+    )
+    tiles_s = info_s["executed"]
+    tiles_m = info_m["executed"]
+    rate_s = tiles_s / max(wall_s, 1e-9)
+    rate_m = tiles_m / max(wall_m, 1e-9)
+    headline = {
+        "bench": "forasync_tile_tier",
+        "backend": jax.default_backend(),
+        "tasks": tiles_s + tiles_m,
+        "tasks_per_sec": round(
+            (tiles_s + tiles_m) / max(wall_s + wall_m, 1e-9), 1
+        ),
+        "stencil_tasks_per_sec": round(rate_s, 1),
+        "map_tasks_per_sec": round(rate_m, 1),
+        "stencil_occupancy": round(
+            info_s["tiers"]["batch_occupancy"], 3
+        ),
+        "map_occupancy": round(info_m["tiers"]["batch_occupancy"], 3),
+    }
+    print(json.dumps(headline), flush=True)  # headline FIRST, always
+    log(f"forasync stencil: {tiles_s} tiles ({H}x{W}/8x128) at "
+        f"{rate_s:,.0f} tiles/s, occupancy "
+        f"{info_s['tiers']['batch_occupancy']:.2f}, "
+        f"{info_s['tiers']['prefetch_hits']} prefetch hits")
+    log(f"forasync map: {tiles_m} tiles at {rate_m:,.0f} tiles/s, "
+        f"occupancy {info_m['tiers']['batch_occupancy']:.2f}, "
+        f"{info_m['tiers']['prefetch_hits']} prefetch hits")
+
+    # Per-tile-size sweep (stderr, budget-gated): occupancy + prefetch
+    # behavior as the batch width changes - the knob a workload tunes.
+    detail = {"widths": {}}
+
+    def sweep():
+        for width in (2, 4, 8):
+            d, info = run_forasync_device(
+                tk_m, bounds_m, tile_m, {"vin": vin, "vout": vout.copy()},
+                width=width,
+            )
+            t = info["tiers"]
+            detail["widths"][width] = {
+                "occupancy": round(t["batch_occupancy"], 3),
+                "batch_rounds": t["batch_rounds"],
+                "prefetch_hits": t["prefetch_hits"],
+            }
+            log(f"forasync width={width}: occupancy "
+                f"{t['batch_occupancy']:.2f}, {t['batch_rounds']} rounds, "
+                f"{t['prefetch_hits']} prefetch hits")
+
+    section("forasync width sweep", 60, sweep)
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.forasync.json")
+    with open(path, "w") as f:
+        json.dump({**headline, **detail}, f, indent=1)
+    log(f"forasync bench written: {path}")
+
+
 def bench_multichip(quick: bool = False) -> None:
     """8-device forest-steal through the sharded steal runner, BATCHED
     arm first (ISSUE 7): the batched tasks/s headline JSON prints (and
@@ -1067,6 +1174,14 @@ def main(argv=None) -> None:
         "replaces the single-device suite for this run",
     )
     ap.add_argument(
+        "--forasync", action="store_true",
+        help="forasync device-tier mode: stencil + map-loop tiles/s "
+        "through the batch-lane tile tier; the combined tasks/s headline "
+        "prints FIRST (stdout JSON), per-tile-size occupancy/prefetch "
+        "lines to stderr and perf-logs/<ts>.forasync.json; replaces the "
+        "single-device suite for this run",
+    )
+    ap.add_argument(
         "--multichip", action="store_true",
         help="8-device mesh mode: the batched forest-steal tasks/s "
         "headline prints FIRST (stdout JSON), then per-device "
@@ -1082,6 +1197,9 @@ def main(argv=None) -> None:
     _T0 = time.monotonic()  # arm the wall budget for THIS driver run
     if args.tenants:
         bench_tenants(quick=args.quick)
+        return
+    if args.forasync:
+        bench_forasync(quick=args.quick)
         return
     if args.multichip:
         # Must land before jax initializes: the mesh workloads need the

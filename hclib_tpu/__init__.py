@@ -32,6 +32,7 @@ from .runtime import (  # noqa: F401
     Locale,
     LocalityGraph,
     MaxReducer,
+    MeshPlacement,
     MetricsRegistry,
     Module,
     Observation,
@@ -73,8 +74,10 @@ from .runtime import (  # noqa: F401
     num_workers,
     register_dist_func,
     register_module,
+    resolve_placement,
     run_on_main,
     start_finish,
+    steal_hop_order,
     unregister_all_modules,
     yield_,
 )

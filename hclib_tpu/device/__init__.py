@@ -22,6 +22,14 @@ from .descriptor import (
     NO_TASK,
     TaskGraphBuilder,
 )
+from .forasync_tier import (
+    Slab,
+    TileKernel,
+    make_forasync_megakernel,
+    place_tiles,
+    run_forasync_device,
+    seed_tiles,
+)
 from .megakernel import BatchContext, BatchSpec, KernelContext, Megakernel
 from .resident import ResidentKernel
 from .tenants import Admission, TenantSpec, TenantTable
@@ -29,6 +37,12 @@ from .tracebuf import TraceRing, decode_ring, trace_to_jsonable
 
 __all__ = [
     "Admission",
+    "Slab",
+    "TileKernel",
+    "make_forasync_megakernel",
+    "place_tiles",
+    "run_forasync_device",
+    "seed_tiles",
     "TenantSpec",
     "TenantTable",
     "ResidentKernel",

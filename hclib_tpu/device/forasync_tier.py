@@ -1,0 +1,564 @@
+"""forasync device tier: tile loops lowered onto the batch-lane dispatcher.
+
+The reference's flagship data-parallel construct - forasync1D/2D/3D with
+flat tiling and dist-func locale placement (src/hclib.c:158-416,
+inc/hclib-forasync.h) - rendered for the megakernel: every tile of the
+iteration space becomes one task descriptor, and because all tiles of one
+loop share one body, a tile IS a same-kind batch - the whole loop lowers
+straight onto the PR 3 per-F_FN batch lanes. Each batch round pops up to
+``width`` tile descriptors and runs ONE tiled Pallas body over the group,
+with the cross-round double-buffered operand prefetch riding underneath
+(a tile's operand slab is exactly the entries-behind-head pattern the
+prefetch pipeline targets), so per-task scalar dispatch - the ring pop +
+``lax.switch`` per descriptor that dominates map-style loops - is paid
+once per ROUND instead of once per tile.
+
+The lowering is organized around **slab pipelines**: a ``TileKernel``
+declares its operand slabs (windows of named HBM data buffers addressed
+by the tile's loop offsets), a pure compute function from loaded slab
+values to output slab values, and its output slabs. From that one
+declaration the tier derives BOTH dispatch spellings:
+
+- the scalar-tier kernel (DMA in -> compute -> DMA out, one tile per
+  ``lax.switch`` dispatch) - the bit-identity reference arm, and
+- the batched body (all live slots' loads in flight before the first
+  wait; the prospective next batch's slabs prefetched into the other
+  VMEM half during this round's compute; one store wave) plus its
+  ``drain`` callback, so the scheduler can retire in-flight prefetches
+  when it exits with lane entries unrun (fuel, quiesce).
+
+On a mesh, placement is DATA, not code: a dist-func or JSON placement
+descriptor (runtime/locality.py ``MeshPlacement``, resolved against
+``locality_graphs/*.json``) maps each flat tile to a device, seeding the
+per-device ready rings of the sharded/resident runners; the machine
+graph additionally orders the steal scan near-neighbors-first
+(``steal_hop_order``), so a skewed or stale placement degrades into
+recoverable work stealing instead of a wrong or wedged run.
+
+Tiles are successor-free descriptors whose kernels only read their args
+and write disjoint output slabs, so they are migratable by construction;
+on the mesh every device runs the loop over a replicated input and
+writes its executed tiles into its own output copy, and the host sums
+the per-device outputs (each tile executes exactly once mesh-wide, and
+output buffers are required to start zero).
+
+Device-path constraints (both explicit ``ValueError``\\ s):
+
+- bounds must divide exactly by the tile (slab shapes are static; the
+  reference's ragged last tile would need dynamic DMA sizes), and
+- ``mode=FLAT`` only (recursive splitting produces unaligned piece
+  shapes; RECURSIVE remains a host-tier mode).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..runtime.locality import MeshPlacement, resolve_placement
+from .descriptor import TaskGraphBuilder
+from .megakernel import BatchSpec, Megakernel, _batch_stub
+
+__all__ = [
+    "Slab",
+    "TileKernel",
+    "tile_grid",
+    "tile_args",
+    "seed_tiles",
+    "place_tiles",
+    "make_forasync_megakernel",
+    "run_forasync_device",
+    "FA_TILE",
+]
+
+# The tile kernel's table index: the tier builds single-kind megakernels
+# (one loop body per kernel), so the id is fixed.
+FA_TILE = 0
+
+
+# ------------------------------------------------------------- tiling math
+
+
+def tile_grid(
+    bounds: Sequence, tile: Sequence
+) -> Tuple[List[Tuple[int, int]], List[int], List[int], int]:
+    """Normalize (bounds, tile) into (dims, tile_dims, tile_counts,
+    total) with the EXACT-DIVISION constraint the device tier needs:
+    slab shapes are static per kernel, so a ragged last tile (which the
+    host tier handles by clamping) is refused with a sizing hint."""
+    dims: List[Tuple[int, int]] = []
+    for b in bounds:
+        if isinstance(b, int):
+            dims.append((0, b))
+        else:
+            lo, hi = b
+            dims.append((int(lo), int(hi)))
+    if not 1 <= len(dims) <= 3:
+        raise ValueError("forasync supports 1-3 dimensions")
+    if isinstance(tile, int):
+        tile_dims = [tile] * len(dims)
+    else:
+        tile_dims = [int(t) for t in tile]
+    if len(tile_dims) != len(dims):
+        raise ValueError("tile rank must match loop rank")
+    counts = []
+    for (lo, hi), t in zip(dims, tile_dims):
+        n = hi - lo
+        if t < 1 or n < 1:
+            raise ValueError(f"empty dimension or tile: {(lo, hi)} / {t}")
+        if n % t:
+            raise ValueError(
+                f"device forasync tiles must divide the bounds exactly "
+                f"(dimension {(lo, hi)} is {n} long, tile {t}): pad the "
+                "iteration space or pick a dividing tile"
+            )
+        counts.append(n // t)
+    return dims, tile_dims, counts, math.prod(counts)
+
+
+def tile_args(
+    dims: Sequence[Tuple[int, int]],
+    tile_dims: Sequence[int],
+    counts: Sequence[int],
+    flat: int,
+) -> List[int]:
+    """Descriptor args of flat tile ``flat``: ``[flat, lo0, lo1, lo2]``
+    (trailing los zero below rank 3) - the lo corner in LOOP coordinates;
+    slab index functions add their own data-layout offsets."""
+    idx = []
+    rem = flat
+    for c in reversed(list(counts)):
+        idx.append(rem % c)
+        rem //= c
+    idx.reverse()
+    los = [lo + i * t for (lo, _), t, i in zip(dims, tile_dims, idx)]
+    return [flat] + los + [0] * (3 - len(los))
+
+
+def seed_tiles(
+    builder: TaskGraphBuilder,
+    bounds: Sequence,
+    tile: Sequence,
+    fn: int = FA_TILE,
+) -> int:
+    """Add one descriptor per flat tile (flat order); returns the total."""
+    dims, tile_dims, counts, total = tile_grid(bounds, tile)
+    for flat in range(total):
+        builder.add(fn, args=tile_args(dims, tile_dims, counts, flat))
+    return total
+
+
+def place_tiles(
+    builders: Sequence[TaskGraphBuilder],
+    bounds: Sequence,
+    tile: Sequence,
+    placement,
+    fn: int = FA_TILE,
+) -> List[int]:
+    """Seed per-device ready rings from a placement: every flat tile's
+    descriptor lands in ``builders[device_of(flat)]``. ``placement`` is
+    anything ``runtime.locality.resolve_placement`` accepts (descriptor
+    object / dict / JSON path / dist-func). Returns the per-device tile
+    counts - totals are conserved by construction (each flat index is
+    placed exactly once), which the placement acceptance pins down."""
+    ndev = len(builders)
+    dims, tile_dims, counts, total = tile_grid(bounds, tile)
+    p = resolve_placement(placement, ndev=ndev)
+    dev_of = p.device_of if isinstance(p, MeshPlacement) else (
+        lambda flat, tot: p(len(dims), flat, tot)
+    )
+    out = [0] * ndev
+    for flat in range(total):
+        d = int(dev_of(flat, total))
+        if not 0 <= d < ndev:
+            raise ValueError(
+                f"placement sent tile {flat} to device {d} "
+                f"(mesh has {ndev})"
+            )
+        builders[d].add(fn, args=tile_args(dims, tile_dims, counts, flat))
+        out[d] += 1
+    return out
+
+
+# ---------------------------------------------------------- slab pipeline
+
+
+class Slab:
+    """One operand (or output) window of a named HBM data buffer.
+
+    ``index(args)`` receives the tile's descriptor args ``(flat, lo0,
+    lo1, lo2)`` as traced int32 and returns the indexer tuple applied as
+    ``data_ref.at[...]`` - scalars for picked leading axes, ``pl.ds``
+    for sliced ones. ``shape`` is the (static) slab shape the DMA moves;
+    it must agree with what the indexer selects."""
+
+    def __init__(
+        self,
+        name: str,
+        data: str,
+        index: Callable[[Sequence], Tuple],
+        shape: Tuple[int, ...],
+    ) -> None:
+        self.name = name
+        self.data = data
+        self.index = index
+        self.shape = tuple(int(s) for s in shape)
+
+
+class TileKernel:
+    """The device body of a forasync tile loop, as a slab pipeline:
+    ``compute`` maps loaded input-slab VALUES (dict keyed by slab name)
+    to output-slab values - pure jnp, no refs - and the tier derives the
+    scalar kernel, the batched body, and its prefetch drain from the
+    slab declarations. One ``TileKernel`` therefore has ONE arithmetic
+    trace, which is what makes the scalar-vs-tile-tier bit-identity of
+    the acceptance runs hold by construction.
+
+    ``data_specs`` declares every named buffer the slabs touch (the
+    megakernel's ``data_specs``); output buffers must be disjointly
+    written across tiles (the same contract batch bodies always carry).
+    """
+
+    def __init__(
+        self,
+        loads: Sequence[Slab],
+        stores: Sequence[Slab],
+        compute: Callable[[Dict[str, Any]], Dict[str, Any]],
+        data_specs: Dict[str, jax.ShapeDtypeStruct],
+        name: str = "fa_tile",
+    ) -> None:
+        names = [s.name for s in list(loads) + list(stores)]
+        if len(set(names)) != len(names):
+            raise ValueError(f"slab names must be unique: {names}")
+        for s in list(loads) + list(stores):
+            if s.data not in data_specs:
+                raise ValueError(
+                    f"slab {s.name!r} targets undeclared buffer {s.data!r}"
+                )
+        self.loads = list(loads)
+        self.stores = list(stores)
+        self.compute = compute
+        self.data_specs = dict(data_specs)
+        self.name = name
+        # Output buffers (store targets): the mesh path requires them to
+        # start zero so per-device copies combine by sum.
+        self.out_names = sorted({s.data for s in self.stores})
+
+    def _dtype(self, slab: Slab):
+        return self.data_specs[slab.data].dtype
+
+    # -- scalar-tier spelling --
+
+    def scalar_scratch(self) -> Dict[str, Any]:
+        sc: Dict[str, Any] = {}
+        for s in self.loads + self.stores:
+            sc[f"fa_{s.name}"] = pltpu.VMEM(s.shape, self._dtype(s))
+        sc["fa_lsem"] = pltpu.SemaphoreType.DMA((1,))
+        sc["fa_ssem"] = pltpu.SemaphoreType.DMA((1,))
+        return sc
+
+    def scalar_kernel(self, ctx) -> None:
+        a = tuple(ctx.arg(i) for i in range(4))
+        lsem = ctx.scratch["fa_lsem"]
+        ssem = ctx.scratch["fa_ssem"]
+        # All loads in flight before the first wait (one sem counts all
+        # streams - every start is matched by exactly one wait).
+        for wait in (False, True):
+            for s in self.loads:
+                cp = pltpu.make_async_copy(
+                    ctx.data[s.data].at[s.index(a)],
+                    ctx.scratch[f"fa_{s.name}"],
+                    lsem.at[0],
+                )
+                (cp.wait if wait else cp.start)()
+        ins = {s.name: ctx.scratch[f"fa_{s.name}"][...] for s in self.loads}
+        outs = self.compute(ins)
+        for s in self.stores:
+            ctx.scratch[f"fa_{s.name}"][...] = outs[s.name]
+        for wait in (False, True):
+            for s in self.stores:
+                cp = pltpu.make_async_copy(
+                    ctx.scratch[f"fa_{s.name}"],
+                    ctx.data[s.data].at[s.index(a)],
+                    ssem.at[0],
+                )
+                (cp.wait if wait else cp.start)()
+
+    # -- batch-tier spelling --
+
+    def batch_scratch(self, width: int) -> Dict[str, Any]:
+        sc: Dict[str, Any] = {}
+        for s in self.loads:
+            # Double-buffered (leading 2): one half computes while the
+            # tier's cross-round prefetch fills the other.
+            sc[f"fa_{s.name}"] = pltpu.VMEM(
+                (2, width) + s.shape, self._dtype(s)
+            )
+        for s in self.stores:
+            sc[f"fa_{s.name}"] = pltpu.VMEM((width,) + s.shape, self._dtype(s))
+        # One DMA semaphore per (half, slot) counting every load stream
+        # of that slot; one per slot for the store wave.
+        sc["fa_lsem"] = pltpu.SemaphoreType.DMA((2, width))
+        sc["fa_ssem"] = pltpu.SemaphoreType.DMA((width,))
+        return sc
+
+    def _slot_loads(self, ctx, buf, slot: int, a, wait: bool) -> None:
+        """Start (or retire) every load copy of batch slot ``slot`` into
+        operand half ``buf``; args ``a`` name the tile whose slabs move.
+        Starts and waits use the same (src, dst, sem) triples under the
+        same predicates, so each start has exactly one matching wait."""
+        sem = ctx.scratch["fa_lsem"].at[buf, slot]
+        for s in self.loads:
+            cp = pltpu.make_async_copy(
+                ctx.data[s.data].at[s.index(a)],
+                ctx.scratch[f"fa_{s.name}"].at[buf, slot],
+                sem,
+            )
+            (cp.wait if wait else cp.start)()
+
+    def batch_body(self, ctx) -> None:
+        width = ctx.width
+        buf = ctx.buf
+
+        def args_of(s):
+            return tuple(ctx.arg(s, i) for i in range(4))
+
+        # Phase 1: start operand copies for live slots the prefetch
+        # didn't already cover.
+        for b in range(width):
+            @pl.when(ctx.live(b) & (jnp.int32(b) >= ctx.prefetched))
+            def _(b=b):
+                self._slot_loads(ctx, buf, b, args_of(b), wait=False)
+
+        # Phase 2: the prospective NEXT batch's slabs start into the
+        # other half now - they land under this round's compute, so the
+        # next round opens without a single operand-DMA stall.
+        obuf = 1 - buf
+        for b in range(width):
+            @pl.when(jnp.int32(b) < ctx.prefetch_count)
+            def _(b=b):
+                nxt = tuple(ctx.next_arg(b, i) for i in range(4))
+                self._slot_loads(ctx, obuf, b, nxt, wait=False)
+
+        # Phase 3: retire this round's loads (prefetched slots wait the
+        # copies LAST round's phase 2 started into this half).
+        for b in range(width):
+            @pl.when(ctx.live(b))
+            def _(b=b):
+                self._slot_loads(ctx, buf, b, args_of(b), wait=True)
+
+        # Phase 4: per-slot compute into the store staging.
+        for b in range(width):
+            @pl.when(ctx.live(b))
+            def _(b=b):
+                ins = {
+                    s.name: ctx.scratch[f"fa_{s.name}"][buf, b]
+                    for s in self.loads
+                }
+                outs = self.compute(ins)
+                for s in self.stores:
+                    ctx.scratch[f"fa_{s.name}"][b] = outs[s.name]
+
+        # Phase 5: one store wave - all starts, then all waits, so
+        # nothing is still in flight toward the output buffers when the
+        # batch's completions run.
+        for wait in (False, True):
+            for b in range(width):
+                @pl.when(ctx.live(b))
+                def _(b=b, wait=wait):
+                    a = args_of(b)
+                    sem = ctx.scratch["fa_ssem"].at[b]
+                    for s in self.stores:
+                        cp = pltpu.make_async_copy(
+                            ctx.scratch[f"fa_{s.name}"].at[b],
+                            ctx.data[s.data].at[s.index(a)],
+                            sem,
+                        )
+                        (cp.wait if wait else cp.start)()
+
+    def batch_drain(self, ctx) -> None:
+        """Retire an in-flight prefetch whose target entries will be
+        spilled instead of batched (scheduler exit - fuel, quiesce): wait
+        the same copies phase 2 started, so no DMA outlives the round
+        loop and checkpoint export sees only settled buffers."""
+        for b in range(ctx.width):
+            @pl.when(jnp.int32(b) < ctx.prefetched)
+            def _(b=b):
+                a = tuple(ctx.arg(b, i) for i in range(4))
+                self._slot_loads(ctx, ctx.buf, b, a, wait=True)
+
+
+# ------------------------------------------------------------ megakernel
+
+
+def make_forasync_megakernel(
+    tk: TileKernel,
+    *,
+    width: int = 0,
+    prefetch: bool = True,
+    capacity: int = 256,
+    interpret: Optional[bool] = None,
+    trace=None,
+    checkpoint: Optional[bool] = None,
+    quiesce_stride: Optional[int] = None,
+) -> Megakernel:
+    """Build the loop's megakernel. ``width=0`` is the scalar-dispatch
+    arm (one tile per ``lax.switch`` round - the bit-identity reference);
+    ``width>0`` routes the tile kind through the batch lanes, with the
+    double-buffered operand prefetch on by default."""
+    if width:
+        spec = BatchSpec(
+            tk.batch_body,
+            width=width,
+            prefetch=prefetch,
+            drain=tk.batch_drain if prefetch else None,
+        )
+        kernels = [(tk.name, _batch_stub)]
+        route = {tk.name: spec}
+        scratch = tk.batch_scratch(width)
+    else:
+        kernels = [(tk.name, tk.scalar_kernel)]
+        route = None
+        scratch = tk.scalar_scratch()
+    return Megakernel(
+        kernels=kernels,
+        route=route,
+        data_specs=tk.data_specs,
+        scratch_specs=scratch,
+        capacity=capacity,
+        num_values=16,
+        succ_capacity=8,
+        interpret=interpret,
+        trace=trace,
+        checkpoint=checkpoint,
+        quiesce_stride=quiesce_stride,
+    )
+
+
+def _default_width() -> int:
+    """Batch width when the caller leaves it unset: 8, overridable
+    process-wide with HCLIB_TPU_FORASYNC_WIDTH (>= 1; malformed values
+    raise - a typo must not silently change the dispatch tier)."""
+    env = os.environ.get("HCLIB_TPU_FORASYNC_WIDTH", "")
+    if not env:
+        return 8
+    w = int(env)
+    if w < 1:
+        raise ValueError(
+            f"HCLIB_TPU_FORASYNC_WIDTH must be >= 1, got {env!r}"
+        )
+    return w
+
+
+def run_forasync_device(
+    tk: TileKernel,
+    bounds: Sequence,
+    tile: Sequence,
+    data: Dict[str, np.ndarray],
+    *,
+    width: Optional[int] = None,
+    prefetch: bool = True,
+    placement=None,
+    mesh=None,
+    steal: bool = True,
+    quantum: int = 64,
+    window: int = 16,
+    hop_order: Optional[Sequence[int]] = None,
+    capacity: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    trace=None,
+    fuel: int = 1 << 22,
+    mk: Optional[Megakernel] = None,
+) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Run one forasync tile loop on the device tier to completion;
+    returns ``(data_out, info)``.
+
+    Single device when ``placement`` is None. With a placement (and an
+    optional ``mesh``; defaults to a CPU mesh sized by the placement),
+    tiles seed the per-device ready rings through ``place_tiles``, inputs
+    replicate, tile descriptors migrate through the bulk-synchronous
+    steal exchange (scan ordered by the placement's machine graph unless
+    ``hop_order`` overrides), and per-device output copies combine by
+    sum - which requires every output buffer to start zero (checked).
+    ``info['placement_counts']`` carries the seeded per-device counts."""
+    w = _default_width() if width is None else int(width)
+    dims, tile_dims, tcounts, total = tile_grid(bounds, tile)
+    cap = capacity or max(64, total + 8)
+    if mk is not None:
+        # A prebuilt kernel OWNS the dispatch configuration: verify the
+        # caller's width agrees, so a benchmark arm can never believe it
+        # measured the batch tier while running a scalar build (or vice
+        # versa) - the results would still be bit-identical, hiding the
+        # swap.
+        mk_width = (
+            mk.batch_specs[0][1].width if mk.batch_specs else 0
+        )
+        if width is not None and int(width) != mk_width:
+            raise ValueError(
+                f"width={width} disagrees with the prebuilt megakernel "
+                f"(its tile kind is "
+                f"{f'batch-routed at width {mk_width}' if mk_width else 'scalar-dispatched'})"
+            )
+        kernel = mk
+    else:
+        kernel = make_forasync_megakernel(
+            tk, width=w, prefetch=prefetch, capacity=cap,
+            interpret=interpret, trace=trace,
+        )
+    if placement is None:
+        b = TaskGraphBuilder()
+        seed_tiles(b, bounds, tile)
+        _, data_out, info = kernel.run(b, data=dict(data), fuel=fuel)
+        return data_out, info
+
+    p = resolve_placement(placement)
+    from ..parallel.mesh import cpu_mesh
+    from .sharded import ShardedMegakernel
+
+    if mesh is None:
+        if not isinstance(p, MeshPlacement):
+            raise ValueError(
+                "a dist-func placement needs an explicit mesh= (a "
+                "MeshPlacement knows its own device count)"
+            )
+        mesh = cpu_mesh(p.ndev, axis_name="q")
+    ndev = int(np.prod(mesh.devices.shape))
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    pcounts = place_tiles(builders, bounds, tile, p)
+    if hop_order is None and isinstance(p, MeshPlacement):
+        hop_order = p.hop_order()
+    for name in tk.out_names:
+        if np.asarray(data[name]).any():
+            raise ValueError(
+                f"mesh forasync output buffer {name!r} must start zero: "
+                "per-device copies combine by sum"
+            )
+    stacked = {
+        k: np.broadcast_to(
+            np.asarray(v), (ndev,) + np.asarray(v).shape
+        ).copy()
+        for k, v in data.items()
+    }
+    smk = ShardedMegakernel(kernel, mesh, migratable_fns=[FA_TILE])
+    _, data_o, info = smk.run(
+        builders, data=stacked, steal=steal, quantum=quantum,
+        window=window, hop_order=hop_order,
+    )
+    out: Dict[str, np.ndarray] = {}
+    for k, v in data_o.items():
+        arr = np.asarray(v)
+        out[k] = (
+            arr.sum(axis=0, dtype=arr.dtype)
+            if k in tk.out_names
+            else arr[0]
+        )
+    info["placement_counts"] = pcounts
+    info["hop_order"] = list(hop_order) if hop_order else None
+    return out, info

@@ -1257,6 +1257,22 @@ class Megakernel:
                 # their lane within a handful of rounds, so the added
                 # latency is noise against one kernel body. One dispatch
                 # per round; among eligible lanes the lowest F_FN wins.
+                # KNOWN TRADE (the ROADMAP lane-policy watch item): a
+                # dynamic spawner that keeps the ring hot - a forasync-
+                # style producer chained task-by-task - starves the lanes
+                # into long runs of width-1 partial fires. The DETECTOR
+                # is live: trace a run (trace=N) and read
+                # info['tiers']['lane_partial_age'] (longest consecutive
+                # partial-fire streak in rounds, tracebuf.lane_partial_age
+                # off the TR_FIRE_BATCH records; exported as a metrics
+                # gauge by MetricsRegistry.add_run_info). Knob trail if a
+                # workload trips it: (1) widen the spawner's spawn fan-out
+                # so each ring drain deposits >= width same-kind entries;
+                # (2) shrink the lane's BatchSpec width toward the
+                # workload's actual same-kind concurrency; (3) the policy
+                # fix itself - an age-triggered fire that lets a lane jump
+                # the ring after K starved rounds - is future work and
+                # belongs HERE, guarded by that gauge.
                 # (``fired`` starts at the quiesce flag: an observed
                 # quiesce suppresses both the batch fire and the scalar
                 # pop, so the exit below sees an untouched round.)
@@ -1882,6 +1898,22 @@ class Megakernel:
                 [packed[off : off + self.trace.words]], t0_ns, t1_ns,
                 self.trace.capacity,
             )
+            if self.batch_specs and "tiers" in info:
+                # Partial-batch starvation gauge (the lane-policy watch
+                # item): longest consecutive-partial-fire streak per
+                # lane, in rounds, off the TR_FIRE_BATCH records; the
+                # max rides info['tiers'] so MetricsRegistry.add_run_info
+                # exports it beside lane_occupancy.
+                from .tracebuf import lane_partial_age
+
+                ages = lane_partial_age(
+                    info["trace"],
+                    {fid: spec.width for fid, spec in self.batch_specs},
+                )
+                info["tiers"]["lane_partial_ages"] = ages
+                info["tiers"]["lane_partial_age"] = max(
+                    ages.values(), default=0
+                )
         if quiesced:
             # The exported scheduler snapshot: everything resume() (and
             # CheckpointBundle) needs to relaunch mid-graph. succ is

@@ -85,6 +85,17 @@ minor axis, so each hypercube hop flips exactly one mesh coordinate).
 Tested on 8-device 1D, 4x2, and 2x2x2 interpret meshes (including under
 the Mosaic race detector) and compiled/run on the real 1-device TPU
 (self-loop AMs, atomics, locks).
+
+**Placement seeding (forasync device tier, ISSUE 9).** The per-device
+ready rings this runner stages are seeded by whatever the caller put in
+its builders - ``device.forasync_tier.place_tiles`` maps a tile loop's
+flat tiles onto the roster through a JSON placement descriptor or dist
+func (runtime/locality.py), so data-driven placement works here exactly
+as on the sharded runner (tests/test_forasync_device.py's resident
+seeding test). The XOR-hop exchange order is fixed by bit position
+(minor axis first); a graph-derived reordering like the sharded runner's
+``hop_order`` is future work - the per-axis decomposition already makes
+each hop a single-coordinate ICI neighbor, so the win is smaller here.
 """
 
 from __future__ import annotations

@@ -327,9 +327,22 @@ class ShardedMegakernel:
         )
         return jax.jit(f)
 
-    def _build_steal(self, quantum: int, window: int, max_rounds: int):
+    def _build_steal(
+        self, quantum: int, window: int, max_rounds: int,
+        hop_order: Optional[Sequence[int]] = None,
+    ):
         """Steal-round executor: run-for-quantum, migrate surplus over the
-        device ring, repeat until psum(pending) == 0."""
+        device ring, repeat until psum(pending) == 0.
+
+        ``hop_order`` overrides the default hypercube hop sequence
+        [1, 2, 4, ...] with a caller-supplied scan order - the locality
+        hook: ``runtime.locality.steal_hop_order`` derives it from a
+        machine graph so each exchange reaches graph-NEAR peers first
+        (on a 2x2 ICI ring, hop 2 is the adjacent chip and hop 1 the
+        diagonal, so the graph flips the scan to [2, 1]). Any nonempty
+        set of distances in [1, ndev) terminates - backlog still
+        diffuses every round and the psum decides completion - but
+        covering the hypercube set keeps one-round full diffusion."""
         # Full value staging: the round loop re-enters the kernel, and value
         # slots above value_alloc (row-owned blocks, bump allocations) carry
         # live results between entries. Descriptor rows freed in earlier
@@ -353,7 +366,17 @@ class ShardedMegakernel:
         # round - the SPMD rendering of the reference thief scanning ALL
         # victims along its steal path (src/hclib-locality-graph.c:843-888),
         # rather than only the adjacent one.
-        hop_dists = [d for d in (1 << k for k in range(16)) if d < ndev]
+        if hop_order is None:
+            hop_dists = [d for d in (1 << k for k in range(16)) if d < ndev]
+        else:
+            hop_dists = [int(d) for d in hop_order]
+            if not hop_dists or any(
+                not 1 <= d < ndev for d in hop_dists
+            ):
+                raise ValueError(
+                    f"hop_order must be nonempty distances in "
+                    f"[1, {ndev}), got {hop_dists}"
+                )
 
         def step(tasks, succ, ring, counts, iv, *data):
             succ0 = succ[0]
@@ -510,20 +533,26 @@ class ShardedMegakernel:
         quantum: int = 256,
         window: int = 32,
         max_rounds: int = 1 << 16,
+        hop_order: Optional[Sequence[int]] = None,
     ):
         """Execute all partitions; returns (ivalues[ndev, V], data, info).
 
         ``steal=True`` enables bulk-synchronous work stealing: devices run
         ``quantum`` tasks per round, then up to ``window`` surplus migratable
-        ready tasks hop one device along the ring between rounds."""
+        ready tasks hop one device along the ring between rounds.
+        ``hop_order`` reorders the exchange's hop-distance scan (see
+        ``_build_steal``; ``runtime.locality.steal_hop_order`` derives a
+        near-neighbors-first order from a machine graph)."""
         # fuel is unused on the steal path (each round runs `quantum`), so
         # keep it out of that cache key - varying fuel must not recompile.
+        hops = tuple(hop_order) if hop_order is not None else None
         key = (
-            (True, quantum, window, max_rounds) if steal else (False, fuel)
+            (True, quantum, window, max_rounds, hops)
+            if steal else (False, fuel)
         )
         if key not in self._jitted:
             self._jitted[key] = (
-                self._build_steal(quantum, window, max_rounds)
+                self._build_steal(quantum, window, max_rounds, hops)
                 if steal
                 else self._build(fuel)
             )

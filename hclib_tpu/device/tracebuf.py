@@ -49,6 +49,7 @@ __all__ = [
     "Tracer",
     "NullTracer",
     "decode_ring",
+    "lane_partial_age",
     "trace_info",
     "trace_to_jsonable",
     "records_of",
@@ -368,6 +369,50 @@ def trace_from_jsonable(obj: Dict[str, Any]) -> Dict[str, Any]:
             for r in obj["rings"]
         ],
     }
+
+
+def lane_partial_age(
+    trace: Dict[str, Any], widths: Dict[int, int], ring: int = 0,
+    max_gap: int = 8,
+) -> Dict[int, int]:
+    """Partial-batch starvation detector (the ROADMAP lane-firing-policy
+    watch item), computed off TR_FIRE_BATCH occupancy records: for each
+    batch lane, the longest streak of CONSECUTIVE partial fires
+    (``take < width``), measured in rounds spanned (last.t - first.t + 1
+    of the streak). A healthy static tile set fires full batches with at
+    most one partial tail (age <= 1); a dynamic spawner that keeps the
+    ready ring hot under the ring-drain-first policy starves the lanes
+    into long runs of width-1 fires - exactly what this gauge surfaces
+    (exported as ``lane_partial_age`` by ``MetricsRegistry.add_run_info``
+    via ``info['tiers']``). ``widths`` maps lane F_FN -> batch width
+    (``Megakernel`` passes its routed specs').
+
+    ``max_gap`` bounds what "consecutive" means in rounds: a starved
+    lane still fires every few rounds (each momentary ring drain fires
+    it), so a silence longer than ``max_gap`` rounds means the lane was
+    EMPTY - no entry was waiting - and two partial tails separated by a
+    long idle stretch must read as two short streaks, not one huge
+    starvation age."""
+    recs = records_of(trace, TR_FIRE_BATCH, ring)
+    out: Dict[int, int] = {int(f): 0 for f in widths}
+    streak_start: Dict[int, Optional[int]] = {int(f): None for f in widths}
+    last_t: Dict[int, int] = {}
+    for tag, t, a, _b in recs:
+        fid = int(a) >> 16
+        take = int(a) & 0xFFFF
+        if fid not in out:
+            continue
+        if take < widths[fid]:
+            if (
+                streak_start[fid] is None
+                or int(t) - last_t[fid] > max_gap
+            ):
+                streak_start[fid] = int(t)
+            last_t[fid] = int(t)
+            out[fid] = max(out[fid], last_t[fid] - streak_start[fid] + 1)
+        else:
+            streak_start[fid] = None
+    return out
 
 
 def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:

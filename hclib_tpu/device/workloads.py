@@ -35,6 +35,14 @@ __all__ = [
     "device_uts_mk",
     "UTS_NODE",
     "batch_of",
+    "stencil_loop",
+    "stencil_body",
+    "stencil_reference",
+    "stencil_data",
+    "map_loop",
+    "map_body",
+    "map_reference",
+    "map_data",
 ]
 
 
@@ -304,6 +312,143 @@ def device_nqueens(
     b.add(VNQUEENS, args=[0], out=0)
     ivalues, _, info = mk.run(b)
     return int(ivalues[0]), info
+
+
+# --------------------------------------- forasync tile loops (device tier)
+#
+# The two acceptance workloads of the forasync device tier
+# (device/forasync_tier.py): a 2D Jacobi-style 5-point stencil and a
+# map-style batched-apply loop. Both are int32 so "bit-identical across
+# host forasync, scalar device dispatch, and the tile tier" is airtight
+# (no float summation-order caveats); inputs are bounded so no arithmetic
+# wraps. Each workload ships four spellings of the SAME computation:
+# the TileKernel (device, both dispatch tiers derive from it), the
+# per-index host-forasync body, a vectorized numpy reference, and a data
+# factory - tests/bench/CI compare the spellings instead of trusting any
+# one of them.
+
+MAP_MUL = 3
+MAP_ADD = 7
+
+
+def stencil_loop(H: int, W: int, th: int = 8, tw: int = 128):
+    """2D Jacobi-style stencil over an (H, W) interior held in (H+2, W+2)
+    halo-padded int32 grids ``gin`` -> ``gout``:
+
+        gout[i, j] = gin[i, j] + gin[i-1, j] + gin[i+1, j]
+                   + gin[i, j-1] + gin[i, j+1]      (padded coordinates)
+
+    Returns ``(tile_kernel, bounds, tile)`` for the forasync entry
+    points. Each (th, tw) tile's operand slab is the (th+2, tw+2) window
+    around it - exactly the slab shape the tier's double-buffered
+    prefetch pipeline moves one round early."""
+    from .forasync_tier import Slab, TileKernel
+
+    pad = jax.ShapeDtypeStruct((H + 2, W + 2), jnp.int32)
+
+    def compute(ins):
+        v = ins["vin"]
+        c = v[1:-1, 1:-1]
+        return {
+            "vout": (
+                c + v[:-2, 1:-1] + v[2:, 1:-1] + v[1:-1, :-2] + v[1:-1, 2:]
+            )
+        }
+
+    tk = TileKernel(
+        loads=[Slab(
+            "vin", "gin",
+            # Interior row i lives at padded row i+1: the slab around
+            # interior rows [lo0, lo0+th) is padded rows [lo0, lo0+th+2).
+            lambda a: (pl.ds(a[1], th + 2), pl.ds(a[2], tw + 2)),
+            (th + 2, tw + 2),
+        )],
+        stores=[Slab(
+            "vout", "gout",
+            lambda a: (pl.ds(a[1] + 1, th), pl.ds(a[2] + 1, tw)),
+            (th, tw),
+        )],
+        compute=compute,
+        data_specs={"gin": pad, "gout": pad},
+        name="fa_stencil",
+    )
+    return tk, [H, W], [th, tw]
+
+
+def stencil_body(gin: np.ndarray, gout: np.ndarray):
+    """Per-index host-forasync body over the padded numpy grids (the
+    host arm of the three-way bit-identity acceptance)."""
+
+    def body(i, j):
+        gout[i + 1, j + 1] = (
+            gin[i + 1, j + 1] + gin[i, j + 1] + gin[i + 2, j + 1]
+            + gin[i + 1, j] + gin[i + 1, j + 2]
+        )
+
+    return body
+
+
+def stencil_reference(gin: np.ndarray) -> np.ndarray:
+    """Vectorized numpy oracle (padded in -> padded out, halo zero)."""
+    out = np.zeros_like(gin)
+    out[1:-1, 1:-1] = (
+        gin[1:-1, 1:-1] + gin[:-2, 1:-1] + gin[2:, 1:-1]
+        + gin[1:-1, :-2] + gin[1:-1, 2:]
+    )
+    return out
+
+
+def stencil_data(H: int, W: int, seed: int = 0):
+    """Padded (gin, gout) int32 grids; values bounded so the 5-point sum
+    never wraps."""
+    rng = np.random.default_rng(seed)
+    gin = np.zeros((H + 2, W + 2), np.int32)
+    gin[1:-1, 1:-1] = rng.integers(0, 1 << 20, size=(H, W), dtype=np.int32)
+    return gin, np.zeros_like(gin)
+
+
+def map_loop(T: int, th: int = 8, tw: int = 128):
+    """Map-style batched-apply loop (the batched-inference shape): block
+    t of the (T, th, tw) int32 input maps elementwise through
+    ``x * MAP_MUL + MAP_ADD`` into the output block. The 1D loop runs
+    over all T*th*tw elements with one (th*tw)-element tile per block,
+    so the flat tile index IS the block index."""
+    from .forasync_tier import Slab, TileKernel
+
+    spec = jax.ShapeDtypeStruct((T, th, tw), jnp.int32)
+
+    def compute(ins):
+        return {"vout": ins["vin"] * MAP_MUL + MAP_ADD}
+
+    tk = TileKernel(
+        loads=[Slab("vin", "vin", lambda a: (a[0],), (th, tw))],
+        stores=[Slab("vout", "vout", lambda a: (a[0],), (th, tw))],
+        compute=compute,
+        data_specs={"vin": spec, "vout": spec},
+        name="fa_map",
+    )
+    return tk, [T * th * tw], [th * tw]
+
+
+def map_body(vin: np.ndarray, vout: np.ndarray):
+    """Per-index host-forasync body over flat views of the block arrays."""
+    fin = vin.reshape(-1)
+    fout = vout.reshape(-1)
+
+    def body(i):
+        fout[i] = fin[i] * MAP_MUL + MAP_ADD
+
+    return body
+
+
+def map_reference(vin: np.ndarray) -> np.ndarray:
+    return (vin * MAP_MUL + MAP_ADD).astype(np.int32)
+
+
+def map_data(T: int, th: int = 8, tw: int = 128, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    vin = rng.integers(0, 1 << 20, size=(T, th, tw), dtype=np.int32)
+    return vin, np.zeros_like(vin)
 
 
 # --------------------------------------------------------------- arrayadd

@@ -10,8 +10,11 @@ from .forasync import FLAT, RECURSIVE, forasync, forasync_future, register_dist_
 from .locality import (
     Locale,
     LocalityGraph,
+    MeshPlacement,
     generate_default_graph,
     load_locality_file,
+    resolve_placement,
+    steal_hop_order,
 )
 from .autoscaler import (
     Autoscaler,

@@ -12,10 +12,19 @@ Mirrors the reference semantics (src/hclib.c:158-473, inc/hclib-forasync.h):
 - A registered *distribution function* maps each flat tile to a locale
   (hclib_register_dist_func / loop_dist_func, src/hclib.c:19-30,
   inc/hclib-forasync.h:349-380); the default places tiles at the central
-  locale.
+  locale. RECURSIVE mode sees the SAME flat-tile -> locale mapping: a leaf
+  piece is keyed by the flat index of the tile holding its low corner, so a
+  flat-index dist func places both modes identically whenever the recursion
+  lands on the flat tile grid (power-of-two tile counts) and consistently
+  otherwise.
 
-On the device path, flat forasync tiles become task descriptors executed by
-the Pallas megakernel grid; see device/.
+``place="device"`` lowers the loop onto the TPU megakernel's batched
+same-kind dispatch lanes instead of spawning host tasks
+(device/forasync_tier.py): the body is then a ``TileKernel`` slab pipeline,
+``dist_func`` doubles as the mesh placement (dist-func callable or JSON
+placement descriptor resolved against ``locality_graphs/``), and the call
+returns ``(data_out, info)``. The device tier is FLAT-mode only and
+requires tiles that divide the bounds exactly (slab shapes are static).
 """
 
 from __future__ import annotations
@@ -87,6 +96,23 @@ def _run_tile(fn: Callable, ranges: Tuple[Tuple[int, int], ...]) -> None:
                     fn(i, j, k)
 
 
+def _tile_counts(dims, tile_dims):
+    return [math.ceil((hi - lo) / t) for (lo, hi), t in zip(dims, tile_dims)]
+
+
+def _flat_of_ranges(ranges, dims, tile_dims, tile_counts) -> int:
+    """Flat tile index of the piece whose low corner is ``ranges``'s -
+    the key RECURSIVE leaves use so a flat-index dist func sees the same
+    tile -> locale mapping as FLAT mode. When the recursion lands exactly
+    on the flat tile grid (power-of-two tile counts) the piece IS that
+    flat tile; otherwise the low corner picks the covering tile."""
+    flat = 0
+    for (plo, _), (lo, _), t, c in zip(ranges, dims, tile_dims, tile_counts):
+        idx = min((plo - lo) // t, c - 1)
+        flat = flat * c + idx
+    return flat
+
+
 def _spawn_flat(fn, dims, tile_dims, dist_func) -> None:
     ndim = len(dims)
     if isinstance(dist_func, str):
@@ -96,7 +122,7 @@ def _spawn_flat(fn, dims, tile_dims, dist_func) -> None:
         # (hclib's default loop_dist_func, src/hclib-runtime.c:231-239).
         central = current_runtime().graph.central_locale()
         dist_func = lambda ndim_, tile_, total_: central  # noqa: E731
-    tile_counts = [math.ceil((hi - lo) / t) for (lo, hi), t in zip(dims, tile_dims)]
+    tile_counts = _tile_counts(dims, tile_dims)
     total = math.prod(tile_counts)
     for flat in range(total):
         idx = []
@@ -112,15 +138,28 @@ def _spawn_flat(fn, dims, tile_dims, dist_func) -> None:
         async_(_run_tile, fn, ranges, at=dist_func(ndim, flat, total))
 
 
-def _spawn_recursive(fn, ranges, tile_dims) -> None:
+def _spawn_recursive(fn, ranges, tile_dims, dims=None, dist_func=None) -> None:
     # Split the largest over-tile dimension in half; recurse via new tasks
-    # (reference: src/hclib.c:158-314).
+    # (reference: src/hclib.c:158-314). ``dims``/``dist_func`` thread the
+    # flat-tile placement context down to the leaves: a leaf piece spawns
+    # at ``dist_func(ndim, flat-of-low-corner, total)``, the SAME mapping
+    # FLAT mode applies, so placement policy is mode-independent. With no
+    # dist func, leaves run inline/at the spawner's locale as before.
     widest, wdim = -1, -1
     for d, ((lo, hi), t) in enumerate(zip(ranges, tile_dims)):
         if hi - lo > t and hi - lo > widest:
             widest, wdim = hi - lo, d
     if wdim < 0:
-        _run_tile(fn, tuple(ranges))
+        if dist_func is not None:
+            tile_counts = _tile_counts(dims, tile_dims)
+            flat = _flat_of_ranges(ranges, dims, tile_dims, tile_counts)
+            total = math.prod(tile_counts)
+            async_(
+                _run_tile, fn, tuple(ranges),
+                at=dist_func(len(dims), flat, total),
+            )
+        else:
+            _run_tile(fn, tuple(ranges))
         return
     lo, hi = ranges[wdim]
     mid = (lo + hi) // 2
@@ -128,8 +167,17 @@ def _spawn_recursive(fn, ranges, tile_dims) -> None:
     right = list(ranges)
     left[wdim] = (lo, mid)
     right[wdim] = (mid, hi)
-    async_(_spawn_recursive, fn, left, tile_dims)
-    _spawn_recursive(fn, right, tile_dims)
+    async_(_spawn_recursive, fn, left, tile_dims, dims, dist_func)
+    _spawn_recursive(fn, right, tile_dims, dims, dist_func)
+
+
+def _spawn_all(fn, dims, tile_dims, mode, dist_func) -> None:
+    if mode == FLAT:
+        _spawn_flat(fn, dims, tile_dims, dist_func)
+    else:
+        if isinstance(dist_func, str):
+            dist_func = lookup_dist_func(dist_func)
+        _spawn_recursive(fn, dims, tile_dims, dims, dist_func)
 
 
 def forasync(
@@ -139,30 +187,63 @@ def forasync(
     mode: str = FLAT,
     dist_func: Optional[Callable[[int, int, int], Any]] = None,
     blocking: bool = True,
-) -> None:
+    place: Optional[str] = None,
+    **device_kw,
+):
     """Parallel loop over a 1-3D iteration space.
 
     ``bounds`` is a sequence of ``int`` (upper bound, from 0) or ``(lo, hi)``
     pairs, one per dimension. ``fn`` receives one index per dimension.
+
+    ``place="device"`` runs the loop on the TPU megakernel's batch-lane
+    tier instead (see module docstring): ``fn`` must be a
+    ``device.forasync_tier.TileKernel``, ``tile`` is required,
+    ``dist_func`` doubles as the mesh placement, and extra keywords
+    (``data=``, ``width=``, ``mesh=``, ...) forward to
+    ``run_forasync_device``, whose ``(data_out, info)`` is returned.
     """
-    if not 1 <= len(bounds) <= 3:
-        raise ValueError("forasync supports 1-3 dimensions")
     if mode not in (FLAT, RECURSIVE):
         raise ValueError(f"unknown forasync mode {mode!r}")
+    if place not in (None, "host", "device"):
+        raise ValueError(f"unknown forasync place {place!r}")
+    if place == "device":
+        if mode != FLAT:
+            raise ValueError(
+                "place='device' supports mode=FLAT only: recursive "
+                "splitting produces unaligned piece shapes, and device "
+                "slab DMAs are static-shaped"
+            )
+        if tile is None:
+            raise ValueError(
+                "place='device' needs an explicit tile= (auto-tile is a "
+                "host-worker-count policy; device tiles size the slabs)"
+            )
+        if not blocking:
+            raise ValueError(
+                "place='device' is synchronous (the megakernel runs the "
+                "loop to completion and returns its results): "
+                "blocking=False has no device spelling"
+            )
+        from ..device.forasync_tier import run_forasync_device
+
+        return run_forasync_device(
+            fn, bounds, tile, placement=dist_func, **device_kw
+        )
+    if device_kw:
+        raise TypeError(
+            f"unexpected arguments {sorted(device_kw)} (device-tier "
+            "options need place='device')"
+        )
+    if not 1 <= len(bounds) <= 3:
+        raise ValueError("forasync supports 1-3 dimensions")
     rt = current_runtime()
     dims, tile_dims = _normalize(bounds, tile, rt.nworkers)
 
-    def spawn_all() -> None:
-        if mode == FLAT:
-            _spawn_flat(fn, dims, tile_dims, dist_func)
-        else:
-            _spawn_recursive(fn, dims, tile_dims)
-
     if blocking:
         with finish():
-            spawn_all()
+            _spawn_all(fn, dims, tile_dims, mode, dist_func)
     else:
-        spawn_all()
+        _spawn_all(fn, dims, tile_dims, mode, dist_func)
 
 
 def forasync_future(
@@ -179,8 +260,5 @@ def forasync_future(
     rt = current_runtime()
     dims, tile_dims = _normalize(bounds, tile, rt.nworkers)
     fin = start_finish()
-    if mode == FLAT:
-        _spawn_flat(fn, dims, tile_dims, dist_func)
-    else:
-        _spawn_recursive(fn, dims, tile_dims)
+    _spawn_all(fn, dims, tile_dims, mode, dist_func)
     return end_finish_nonblocking(fin)

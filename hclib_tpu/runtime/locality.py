@@ -22,12 +22,23 @@ parallel/mesh.py synthesizes a graph with one ``tpu`` locale per device plus
 
 from __future__ import annotations
 
+import bisect
 import json
+import os
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
-__all__ = ["Locale", "LocalityGraph", "generate_default_graph", "load_locality_file"]
+__all__ = [
+    "Locale",
+    "LocalityGraph",
+    "generate_default_graph",
+    "load_locality_file",
+    "MeshPlacement",
+    "resolve_placement",
+    "steal_hop_order",
+    "device_distance_matrix",
+]
 
 
 @dataclass
@@ -192,3 +203,296 @@ def graph_from_dict(doc: dict, nworkers: Optional[int] = None) -> LocalityGraph:
 def load_locality_file(path: str, nworkers: Optional[int] = None) -> LocalityGraph:
     with open(path) as f:
         return graph_from_dict(json.load(f), nworkers)
+
+
+# ------------------------------------------------- device-tier placement
+#
+# The forasync device tier (device/forasync_tier.py) treats placement as
+# DATA: a flat tile index maps to a device ordinal through either a
+# classic dist-func callable or a JSON mesh-placement descriptor resolved
+# against a machine graph in locality_graphs/ - the same files the host
+# runtime loads, now consumed by the device path too. The graph's ``tpu``
+# locales define the device roster AND the steal-scan ordering: a device
+# prefers stealing from graph-near neighbors first (ICI hops), so a
+# misplaced tile is recovered from next door before the far side of the
+# mesh is scanned.
+
+
+def _tpu_ordinal(loc: Locale) -> int:
+    """Device ordinal of a tpu locale: explicit metadata wins, else the
+    trailing integer of the name (tpu_3 -> 3, tpu3 -> 3)."""
+    if "device" in loc.metadata:
+        return int(loc.metadata["device"])  # type: ignore[arg-type]
+    m = re.search(r"(\d+)$", loc.name)
+    if not m:
+        raise ValueError(f"tpu locale {loc.name!r} has no ordinal")
+    return int(m.group(1))
+
+
+def device_distance_matrix(graph: LocalityGraph) -> List[List[int]]:
+    """All-pairs BFS hop distances over the graph's ``tpu`` locales,
+    walking ONLY tpu-to-tpu reachability edges (the ICI topology; going
+    through hbm/sysmem would make every device 2 hops from every other
+    and erase the mesh shape). Row/column order is device ordinal.
+    Unreachable pairs read as ndev (an effective +inf that still sorts)."""
+    tpus = graph.locales_of_type("tpu")
+    if not tpus:
+        raise ValueError("graph has no tpu locales")
+    by_ord = {_tpu_ordinal(l): l for l in tpus}
+    if sorted(by_ord) != list(range(len(tpus))):
+        raise ValueError(
+            f"tpu ordinals {sorted(by_ord)} are not dense from 0"
+        )
+    ndev = len(tpus)
+    tpu_ids = {l.id for l in tpus}
+    dist = [[ndev] * ndev for _ in range(ndev)]
+    for d in range(ndev):
+        start = by_ord[d]
+        dist[d][d] = 0
+        frontier = [start]
+        hops = 0
+        seen = {start.id}
+        while frontier:
+            hops += 1
+            nxt: List[Locale] = []
+            for loc in frontier:
+                for nid in loc.reachable:
+                    if nid in tpu_ids and nid not in seen:
+                        seen.add(nid)
+                        nb = graph.locales[nid]
+                        dist[d][_tpu_ordinal(nb)] = hops
+                        nxt.append(nb)
+            frontier = nxt
+    return dist
+
+
+def steal_hop_order(
+    graph: Union[LocalityGraph, str], ndev: Optional[int] = None
+) -> List[int]:
+    """Hypercube hop distances for the bulk-synchronous steal exchange
+    (device/sharded.py), ordered NEAR-NEIGHBORS-FIRST by the machine
+    graph: for each candidate hop d the mean ICI distance between every
+    device i and its partner (i + d) % ndev is computed over the tpu
+    reachability edges, and hops sort ascending by that mean (ties break
+    toward the smaller hop). The default scan order [1, 2, 4, ...] is
+    flat-ring thinking; on a 2x2 ICI ring (v5e_4.json) every hop-2
+    partner is a direct neighbor while half the hop-1 partners sit two
+    hops out, so the graph reorders the scan to [2, 1] - and swapping
+    the JSON swaps the scan with zero code changes."""
+    if isinstance(graph, str):
+        graph = load_locality_file(graph)
+    dist = device_distance_matrix(graph)
+    n = len(dist)
+    if ndev is None:
+        ndev = n
+    if ndev != n:
+        raise ValueError(
+            f"graph describes {n} tpu devices, mesh has {ndev}"
+        )
+    hops = [d for d in (1 << k for k in range(16)) if d < ndev]
+    mean = {
+        d: sum(dist[i][(i + d) % ndev] for i in range(ndev)) / ndev
+        for d in hops
+    }
+    return sorted(hops, key=lambda d: (mean[d], d))
+
+
+class MeshPlacement:
+    """Data-driven flat-tile -> device mapping for the forasync device
+    tier: the device-side rendering of the reference's loop dist-funcs
+    (hclib_register_dist_func, inc/hclib-forasync.h:349-380), where the
+    policy is a JSON document instead of compiled code.
+
+    Descriptor schema (see locality_graphs/README.md)::
+
+        {
+          "graph":   "v5e_4.json",        # machine graph (optional; gives
+                                          #  ndev + the steal-scan order)
+          "devices": 4,                   # explicit ndev (optional when
+                                          #  "graph" provides it)
+          "policy":  "block",             # block | cyclic | weights | single
+          "weights": [4, 2, 1, 1],        # policy=weights: proportional
+                                          #  block sizes per device
+          "device":  0                    # policy=single: the one target
+        }
+
+    ``device_of(flat, total)`` is a pure function of the descriptor, so a
+    placement is reproducible from the file alone; ``counts(total)``
+    returns the per-device initial tile counts the seeded ready rings
+    will hold (the quantity the placement acceptance tests pin down).
+    """
+
+    POLICIES = ("block", "cyclic", "weights", "single")
+
+    def __init__(
+        self,
+        ndev: int,
+        policy: str = "block",
+        weights: Optional[Sequence[float]] = None,
+        device: int = 0,
+        graph: Optional[LocalityGraph] = None,
+    ) -> None:
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"unknown placement policy {policy!r} "
+                f"(one of {self.POLICIES})"
+            )
+        if ndev < 1:
+            raise ValueError(f"need >= 1 device, got {ndev}")
+        self.ndev = int(ndev)
+        self.policy = policy
+        self.graph = graph
+        if policy == "weights":
+            if weights is None or len(weights) != ndev:
+                raise ValueError(
+                    f"policy=weights wants {ndev} weights, got "
+                    f"{None if weights is None else len(weights)}"
+                )
+            if any(w < 0 for w in weights) or sum(weights) <= 0:
+                raise ValueError(f"weights must be >= 0, sum > 0: {weights}")
+            self.weights = [float(w) for w in weights]
+        else:
+            self.weights = None
+        if policy == "single" and not 0 <= device < ndev:
+            raise ValueError(f"device {device} out of range [0, {ndev})")
+        self.device = int(device)
+        # Block boundaries depend only on ``total``: memoized so the
+        # per-tile device_of scan over a large loop does not rebuild the
+        # cumulative list per call (place_tiles is O(total)).
+        self._bounds_cache: Dict[int, List[int]] = {}
+
+    @classmethod
+    def from_dict(
+        cls, doc: Dict, base_dir: Optional[str] = None
+    ) -> "MeshPlacement":
+        # Unknown keys raise (the PR 8 malformed-env convention): a
+        # typoed "polcy" must not silently fall back to block placement.
+        unknown = set(doc) - {"graph", "devices", "policy", "weights",
+                              "device"}
+        if unknown:
+            raise ValueError(
+                f"unknown placement-descriptor keys {sorted(unknown)} "
+                "(schema: graph, devices, policy, weights, device)"
+            )
+        graph = None
+        ndev = doc.get("devices")
+        gname = doc.get("graph")
+        if gname:
+            gpath = (
+                gname
+                if os.path.isabs(gname) or base_dir is None
+                else os.path.join(base_dir, gname)
+            )
+            graph = load_locality_file(gpath)
+            gdev = len(graph.locales_of_type("tpu"))
+            if ndev is None:
+                ndev = gdev
+            elif int(ndev) != gdev:
+                raise ValueError(
+                    f"descriptor says devices={ndev} but graph "
+                    f"{gname!r} has {gdev} tpu locales"
+                )
+        if ndev is None:
+            raise ValueError(
+                "placement descriptor needs 'devices' or a 'graph' "
+                "whose tpu locales define the roster"
+            )
+        return cls(
+            int(ndev),
+            policy=doc.get("policy", "block"),
+            weights=doc.get("weights"),
+            device=int(doc.get("device", 0)),
+            graph=graph,
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "MeshPlacement":
+        """Load a JSON placement descriptor; a relative ``graph`` entry
+        resolves against the descriptor's own directory, so the files in
+        locality_graphs/ reference each other by name."""
+        with open(path) as f:
+            doc = json.load(f)
+        return cls.from_dict(doc, base_dir=os.path.dirname(os.path.abspath(path)))
+
+    # -- the mapping --
+
+    def _bounds(self, total: int) -> List[int]:
+        """Cumulative block boundaries for block/weights policies
+        (memoized per ``total``)."""
+        b = self._bounds_cache.get(total)
+        if b is None:
+            if self.policy == "weights":
+                w = self.weights
+            else:
+                w = [1.0] * self.ndev
+            acc, b, s = 0.0, [0], sum(w)
+            for wi in w:
+                acc += wi
+                b.append(int(round(total * acc / s)))
+            self._bounds_cache[total] = b
+        return b
+
+    def device_of(self, flat: int, total: int) -> int:
+        """Device ordinal for flat tile ``flat`` of ``total``."""
+        if not 0 <= flat < total:
+            raise ValueError(f"flat {flat} out of range [0, {total})")
+        if self.policy == "single":
+            return self.device
+        if self.policy == "cyclic":
+            return flat % self.ndev
+        b = self._bounds(total)
+        return min(bisect.bisect_right(b, flat) - 1, self.ndev - 1)
+
+    def counts(self, total: int) -> List[int]:
+        """Initial tiles per device - what the seeded ready rings hold."""
+        if self.policy == "single":
+            out = [0] * self.ndev
+            out[self.device] = total
+            return out
+        if self.policy == "cyclic":
+            return [
+                total // self.ndev + (1 if d < total % self.ndev else 0)
+                for d in range(self.ndev)
+            ]
+        b = self._bounds(total)
+        return [b[d + 1] - b[d] for d in range(self.ndev)]
+
+    def dist_func(self) -> Callable[[int, int, int], int]:
+        """Classic ``(ndim, flat, total) -> locale`` dist-func spelling,
+        usable wherever runtime/forasync.py accepts one."""
+        return lambda ndim, flat, total: self.device_of(flat, total)
+
+    def hop_order(self) -> Optional[List[int]]:
+        """Graph-derived steal-scan order; None without a graph AND on a
+        1-device roster (no hops exist - callers must fall back to the
+        runner's default rather than pass an empty override)."""
+        if self.graph is None:
+            return None
+        return steal_hop_order(self.graph, self.ndev) or None
+
+
+def resolve_placement(
+    placement: Union["MeshPlacement", Dict, str, Callable],
+    ndev: Optional[int] = None,
+) -> "MeshPlacement | Callable":
+    """Normalize a placement argument: a MeshPlacement passes through, a
+    dict is a descriptor, a str is a descriptor file path, and a callable
+    is a dist-func ``(ndim, flat, total) -> device`` used as-is."""
+    if isinstance(placement, MeshPlacement):
+        mp = placement
+    elif isinstance(placement, dict):
+        mp = MeshPlacement.from_dict(placement)
+    elif isinstance(placement, str):
+        mp = MeshPlacement.from_file(placement)
+    elif callable(placement):
+        return placement
+    else:
+        raise TypeError(
+            f"placement must be MeshPlacement | dict | path | dist-func, "
+            f"got {type(placement).__name__}"
+        )
+    if ndev is not None and mp.ndev != ndev:
+        raise ValueError(
+            f"placement describes {mp.ndev} devices, mesh has {ndev}"
+        )
+    return mp

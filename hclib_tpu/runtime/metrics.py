@@ -149,6 +149,20 @@ class MetricsRegistry:
                 ]
             except (KeyError, TypeError):
                 pass
+            # Partial-batch starvation gauge (the lane-policy watch
+            # item): present only on traced batch-routed runs (the
+            # detector needs TR_FIRE_BATCH records), exported per device
+            # like lane_occupancy so a dashboard alerts on starvation
+            # without digging through trace rings.
+            ages = [
+                t.get("lane_partial_age")
+                for t in tiers
+                if isinstance(t, Mapping)
+            ]
+            if any(a is not None for a in ages):
+                keep["lane_partial_age"] = [
+                    float(a) for a in ages if a is not None
+                ]
         tenants = keep.get("tenants")
         if isinstance(tenants, Mapping):
             # Multi-tenant ingress: mirror the per-tenant admission

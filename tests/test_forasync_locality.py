@@ -99,6 +99,67 @@ def test_forasync_dist_func():
     assert sorted(placed) == [0, 1, 2, 3]
 
 
+def test_recursive_dist_func_matches_flat():
+    """Cross-mode placement determinism (ISSUE 9 satellite): a flat-index
+    dist func sees the SAME tile -> locale mapping in RECURSIVE mode as
+    in FLAT mode. Power-of-two tile counts make the recursion land
+    exactly on the flat tile grid, so the (flat, locale) call sets must
+    be identical - previously RECURSIVE ignored the dist func entirely."""
+    import threading
+
+    lock = threading.Lock()
+    calls = {}
+
+    def run(mode):
+        calls[mode] = set()
+
+        def main():
+            rt = hc.current_runtime()
+            locales = rt.graph.locales_of_type("L1")
+
+            def dist(ndim, flat, total):
+                loc = locales[flat % len(locales)]
+                with lock:
+                    calls[mode].add((flat, total, loc.name))
+                return loc
+
+            hc.forasync(lambda i, j: None, [8, 8], tile=[2, 2],
+                        mode=mode, dist_func=dist)
+
+        hc.launch(main, nworkers=2)
+
+    run(hc.FLAT)
+    run(hc.RECURSIVE)
+    assert calls[hc.FLAT] == calls[hc.RECURSIVE]
+    assert len(calls[hc.FLAT]) == 16  # every flat tile placed exactly once
+
+
+def test_recursive_dist_func_unaligned_consistent():
+    """When recursion does NOT land on the flat grid (non-pow2 counts),
+    leaves still key placement by the flat tile covering their low
+    corner: every flat index used is in range and the full iteration
+    space executes exactly once."""
+    import threading
+
+    lock = threading.Lock()
+    flats = []
+    fn, hits, _ = _concurrent_marker(None)
+
+    def main():
+        central = hc.current_runtime().graph.central_locale()
+
+        def dist(ndim, flat, total):
+            with lock:
+                flats.append((flat, total))
+            return central
+
+        hc.forasync(fn, [24], tile=8, mode=hc.RECURSIVE, dist_func=dist)
+
+    hc.launch(main, nworkers=2)
+    assert hits == set(range(24))
+    assert all(0 <= f < t and t == 3 for f, t in flats)
+
+
 def test_arrayadd_forasync():
     """Reference: test/forasync/arrayadd - c = a + b elementwise."""
     n = 1000

@@ -12,13 +12,34 @@ import hclib_tpu as hc
 from hclib_tpu.runtime.locality import load_locality_file
 
 CONFIG_DIR = os.path.join(os.path.dirname(__file__), "..", "locality_graphs")
-CONFIGS = sorted(glob.glob(os.path.join(CONFIG_DIR, "*.json")))
+_ALL = sorted(glob.glob(os.path.join(CONFIG_DIR, "*.json")))
+# Machine graphs vs mesh-placement descriptors (ISSUE 9) share the
+# directory; ".place_" in the name marks the descriptor schema.
+CONFIGS = [p for p in _ALL if ".place_" not in os.path.basename(p)]
+PLACEMENTS = [p for p in _ALL if ".place_" in os.path.basename(p)]
 
 
 def test_configs_present():
     names = {os.path.basename(p) for p in CONFIGS}
     assert {"v5e_1.json", "v5e_4.json", "v5e_8.json", "v4_8.json",
             "dcn_2host.json"} <= names
+    assert {os.path.basename(p) for p in PLACEMENTS} >= {
+        "v5e_4.place_block.json", "v5e_4.place_skew.json",
+    }
+
+
+@pytest.mark.parametrize("path", PLACEMENTS, ids=os.path.basename)
+def test_placement_descriptor_loads(path):
+    """Shipped placement descriptors resolve: the referenced graph loads,
+    the roster is dense, and the mapping covers a tile range exactly."""
+    from hclib_tpu.runtime.locality import MeshPlacement
+
+    p = MeshPlacement.from_file(path)
+    assert p.ndev >= 1
+    counts = p.counts(2 * p.ndev)
+    assert sum(counts) == 2 * p.ndev
+    if p.graph is not None:
+        assert p.hop_order(), "graph-backed descriptor must order hops"
 
 
 @pytest.mark.parametrize("path", CONFIGS, ids=os.path.basename)

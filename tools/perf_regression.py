@@ -20,7 +20,12 @@ observability layer must never silently tax the hot path. The
 way: tenancy-off streams compile zero new device words and stay
 bit-identical to seed, and the 1-tenant enabled path is bounded vs the
 plain streaming-inject baseline in the SAME run
-(``--ingress-tolerance``).
+(``--ingress-tolerance``). The **forasync-tile guard** holds the
+forasync device tier's floor: the same map loop through host forasync
+(scalar-spawn) and the batch-lane tile tier must stay bit-identical,
+the tile tier must beat the host arm by ``--forasync-floor`` (default
+2x) in the SAME run, and its batch-lane occupancy must not collapse
+(``--forasync-occupancy``).
 
 Usage:
   python tools/perf_regression.py               # full sizes, 3 trials
@@ -328,6 +333,90 @@ def _checkpoint_overhead(quick: bool, trials: int) -> dict:
     }
 
 
+def _forasync_tile(quick: bool, trials: int) -> dict:
+    """forasync-tile guard (ISSUE 9), same-run arms: the SAME map loop
+    through (a) host forasync - per-tile scalar-spawn through the host
+    scheduler, the reference's execution model - and (b) the device tile
+    tier (batch lanes + operand prefetch). Results must be bit-identical
+    and the tile tier must hold a tasks/s floor vs the scalar-spawn arm
+    (--forasync-floor, default 2x; measured 8-30x on CPU interpret). A
+    third arm - scalar DEVICE dispatch - is recorded informationally:
+    interpret-mode walls do not show the dispatch win (the interpreter
+    serializes the DMAs the lanes overlap on hardware), so the device-
+    internal ratio is reported, not bounded. The lane-occupancy bound
+    (--forasync-occupancy) fails if the static tile set stops filling
+    its batches - the tier silently degrading to near-scalar firing."""
+    import numpy as np
+
+    import hclib_tpu as hc
+    from hclib_tpu.device.forasync_tier import (
+        make_forasync_megakernel, run_forasync_device,
+    )
+    from hclib_tpu.device.workloads import (
+        map_body, map_data, map_loop, map_reference,
+    )
+
+    # Quick stays large enough that the host arm's per-index python cost
+    # dominates its scheduler noise: the ratio is ~4-8x unloaded and must
+    # clear the 2x floor even on a loaded CI box.
+    T = 32 if quick else 64
+    tk, bounds, tile = map_loop(T)
+    vin, vout = map_data(T)
+    ref = map_reference(vin)
+    mk_tier = make_forasync_megakernel(tk, width=8, interpret=True)
+    mk_scalar = make_forasync_megakernel(tk, width=0, interpret=True)
+
+    def run_host() -> np.ndarray:
+        vh = vout.copy()
+
+        def main():
+            hc.forasync(map_body(vin, vh), bounds, tile=tile)
+
+        hc.launch(main, nworkers=4)
+        return vh
+
+    def run_dev(mk, width) -> np.ndarray:
+        d, info = run_forasync_device(
+            tk, bounds, tile, {"vin": vin, "vout": vout.copy()},
+            width=width, mk=mk,
+        )
+        if width:
+            run_dev.tiers = info["tiers"]
+        return np.asarray(d["vout"])
+
+    results = {run_host().tobytes(), run_dev(mk_tier, 8).tobytes(),
+               run_dev(mk_scalar, 0).tobytes(), ref.tobytes()}  # + warm
+    if len(results) != 1:
+        raise AssertionError(
+            "forasync-tile: arms diverged (host/scalar/tile results not "
+            "bit-identical)"
+        )
+    n = max(2, trials)
+    host, tier, scalar = [], [], []
+    for _ in range(n):
+        t0 = time.perf_counter_ns()
+        run_host()
+        host.append(time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
+        run_dev(mk_tier, 8)
+        tier.append(time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
+        run_dev(mk_scalar, 0)
+        scalar.append(time.perf_counter_ns() - t0)
+    occ = run_dev.tiers["batch_occupancy"]
+    return {
+        "tiles": T,
+        "host_ns": min(host),
+        "tile_tier_ns": min(tier),
+        "device_scalar_ns": min(scalar),
+        "tier_vs_host": min(host) / min(tier),
+        "tier_vs_device_scalar": min(scalar) / min(tier),
+        "occupancy": occ,
+        "prefetch_hits": run_dev.tiers["prefetch_hits"],
+        "bit_identical": True,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -385,6 +474,16 @@ def main(argv=None) -> int:
                     "batch-slot occupancy (from tstats) on devices that "
                     "fired batch rounds - a collapse means the mesh "
                     "stopped exposing same-kind width to the tier")
+    ap.add_argument("--forasync-floor", type=float, default=2.0,
+                    help="forasync-tile guard: minimum tile-tier tasks/s "
+                    "as a multiple of the host scalar-spawn arm measured "
+                    "in the same run (measured 8-30x; 2x is the collapse "
+                    "floor)")
+    ap.add_argument("--forasync-occupancy", type=float, default=0.8,
+                    help="forasync-tile guard: minimum batch-lane "
+                    "occupancy of the static tile set (near 1.0 by "
+                    "construction; a drop means the tier stopped "
+                    "batching the loop)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -531,6 +630,39 @@ def main(argv=None) -> int:
                     f"{8 + co['stride'] - 1})"
                 )
                 line += "  STRIDE-LAG-REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "forasync-tile" in wanted:
+        try:
+            fa = _forasync_tile(args.quick, args.trials)
+        except Exception as e:
+            print(f"forasync-tile FAILED: {e}", file=sys.stderr)
+            failures.append(f"forasync-tile: failed ({e})")
+        else:
+            results["forasync-tile"] = fa
+            line = (
+                f"{'forasync-tile':15s} tier vs host "
+                f"{fa['tier_vs_host']:5.2f}x (vs device-scalar "
+                f"{fa['tier_vs_device_scalar']:5.2f}x, occupancy "
+                f"{fa['occupancy']:.2f}, {fa['tiles']} tiles, "
+                "bit-identical)"
+            )
+            if fa["tier_vs_host"] < args.forasync_floor:
+                failures.append(
+                    f"forasync-tile: tile tier is only "
+                    f"{fa['tier_vs_host']:.2f}x the host scalar-spawn arm "
+                    f"(floor {args.forasync_floor:.2f}x) - the device "
+                    "tier collapsed"
+                )
+                line += "  REGRESSED"
+            if fa["occupancy"] < args.forasync_occupancy:
+                failures.append(
+                    f"forasync-tile: batch-lane occupancy "
+                    f"{fa['occupancy']:.2f} under bound "
+                    f"{args.forasync_occupancy:.2f} - the tile loop "
+                    "stopped batching"
+                )
+                line += "  OCC-REGRESSED"
             print(line, flush=True)
 
     if args.device:
