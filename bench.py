@@ -1071,6 +1071,125 @@ def bench_forasync(quick: bool = False) -> None:
     log(f"forasync bench written: {path}")
 
 
+def bench_graph(quick: bool = False) -> None:
+    """Graph-analytics frontier tier cost of record (ISSUE 10): BFS,
+    delta-stepping-style SSSP, and push PageRank over a seeded
+    R-MAT-style graph through the batch-lane frontier tier (edge-slab
+    prefetch + the age-triggered firing policy). The headline JSON -
+    combined traversed-edges/s (TEPS) - prints (and flushes) FIRST,
+    rc=124-proofed like every other headline; per-kernel TEPS /
+    occupancy / lane_partial_age lines go to stderr budget-gated, and
+    the full detail lands in perf-logs/<ts>.graph.json."""
+    import jax
+    import numpy as np
+
+    from hclib_tpu.device.frontier import (
+        Graph, host_bfs, host_pagerank_push, host_sssp,
+        make_frontier_megakernel, run_frontier, _KINDS,
+    )
+    from hclib_tpu.device.workloads import rmat_edges
+
+    scale = 6 if quick else 9
+    n, src, dst, w = rmat_edges(scale, efactor=8, seed=7)
+    g = Graph(n, src, dst, w)
+    width = 8
+    # PageRank mass/threshold sized so the push's FIFO-lane breadth (the
+    # live descriptor set is the mass frontier, not a DFS spine) fits
+    # the table; interpret-mode capacity may exceed the ~800-row SMEM
+    # guidance real hardware wants.
+    m0, reps = 1 << 12, 64
+    capacity = 1024 if quick else 4096
+
+    def arm(kind):
+        fk = _KINDS[kind](reps=reps) if kind == "pagerank" else _KINDS[kind]()
+        mk = make_frontier_megakernel(
+            fk, g, width=width, capacity=capacity, interpret=True,
+        )
+        kw = dict(m0=m0, reps=reps, capacity=capacity, interpret=True, mk=mk)
+        res, info = run_frontier(kind, g, 0, **kw)  # warm the jit
+        t0 = time.perf_counter()
+        res, info = run_frontier(kind, g, 0, **kw)
+        wall = time.perf_counter() - t0
+        ref = {
+            "bfs": lambda: host_bfs(g, 0),
+            "sssp": lambda: host_sssp(g, 0),
+            "pagerank": lambda: host_pagerank_push(g, m0=m0, reps=reps)[0],
+        }[kind]()
+        assert np.array_equal(np.asarray(res, np.int64), ref), (
+            f"{kind}: device result diverged from the host reference"
+        )
+        return info, wall
+
+    arms = {}
+    edges_total = 0.0
+    wall_total = 0.0
+    for kind in ("bfs", "sssp", "pagerank"):
+        info, wall = arm(kind)
+        arms[kind] = (info, wall)
+        edges_total += info["edges"]
+        wall_total += wall
+    headline = {
+        "metric": f"graph frontier traversal throughput (BFS+SSSP+"
+        f"PageRank, R-MAT scale {scale}, {g.m} edges, batched "
+        f"frontier width {width})",
+        "value": round(edges_total / max(wall_total, 1e-9)),
+        "unit": "TEPS",
+        "bfs_teps": round(arms["bfs"][0]["edges"] / max(arms["bfs"][1], 1e-9)),
+        "sssp_teps": round(
+            arms["sssp"][0]["edges"] / max(arms["sssp"][1], 1e-9)
+        ),
+        "pagerank_teps": round(
+            arms["pagerank"][0]["edges"] / max(arms["pagerank"][1], 1e-9)
+        ),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(headline), flush=True)  # headline FIRST, always
+    detail = {"kernels": {}}
+    for kind, (info, wall) in arms.items():
+        t = info.get("tiers", {})
+        detail["kernels"][kind] = {
+            "edges": info["edges"],
+            "relaxations": info["relaxations"],
+            "tasks": info["executed"],
+            "elapsed_s": wall,
+            "occupancy": round(t.get("batch_occupancy", 0.0), 3),
+            "age_fires": t.get("age_fires", 0),
+            "max_starved_age": t.get("max_starved_age", 0),
+        }
+        log(f"graph {kind}: {info['edges']} edges in {wall:.3f}s "
+            f"({info['edges'] / max(wall, 1e-9):,.0f} TEPS), occupancy "
+            f"{t.get('batch_occupancy', 0.0):.2f}, {t.get('age_fires', 0)} "
+            f"age fires (max starved age {t.get('max_starved_age', 0)})")
+
+    # Traced BFS round (stderr, budget-gated): the lane_partial_age
+    # gauge - bounded by the age-triggered firing policy - plus per-lane
+    # occupancy off the flight recorder.
+    def traced():
+        _, info = run_frontier(
+            "bfs", g, 0, width=width, capacity=capacity, interpret=True,
+            trace=4096,
+        )
+        t = info["tiers"]
+        detail["traced_bfs"] = {
+            "lane_partial_age": t.get("lane_partial_age", 0),
+            "age_fires": t.get("age_fires", 0),
+            "max_starved_age": t.get("max_starved_age", 0),
+            "occupancy": round(t.get("batch_occupancy", 0.0), 3),
+        }
+        log(f"graph traced bfs: lane_partial_age "
+            f"{t.get('lane_partial_age', 0)}, max starved age "
+            f"{t.get('max_starved_age', 0)} (bounded by the "
+            "age-triggered firing policy)")
+
+    section("graph traced round", 90, traced)
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.graph.json")
+    with open(path, "w") as f:
+        json.dump({**headline, **detail}, f, indent=1)
+    log(f"graph bench written: {path}")
+
+
 def bench_multichip(quick: bool = False) -> None:
     """8-device forest-steal through the sharded steal runner, BATCHED
     arm first (ISSUE 7): the batched tasks/s headline JSON prints (and
@@ -1182,6 +1301,15 @@ def main(argv=None) -> None:
         "single-device suite for this run",
     )
     ap.add_argument(
+        "--graph", action="store_true",
+        help="graph-analytics mode: BFS/SSSP/PageRank traversed-edges/s "
+        "(TEPS) through the batched frontier tier on a seeded R-MAT "
+        "graph; the combined TEPS headline prints FIRST (stdout JSON), "
+        "per-kernel TEPS/occupancy/lane_partial_age to stderr and "
+        "perf-logs/<ts>.graph.json; replaces the single-device suite "
+        "for this run",
+    )
+    ap.add_argument(
         "--multichip", action="store_true",
         help="8-device mesh mode: the batched forest-steal tasks/s "
         "headline prints FIRST (stdout JSON), then per-device "
@@ -1200,6 +1328,9 @@ def main(argv=None) -> None:
         return
     if args.forasync:
         bench_forasync(quick=args.quick)
+        return
+    if args.graph:
+        bench_graph(quick=args.quick)
         return
     if args.multichip:
         # Must land before jax initializes: the mesh workloads need the

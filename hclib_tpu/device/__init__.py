@@ -30,6 +30,14 @@ from .forasync_tier import (
     run_forasync_device,
     seed_tiles,
 )
+from .frontier import (
+    Graph,
+    host_bfs,
+    host_pagerank,
+    host_sssp,
+    make_frontier_megakernel,
+    run_frontier,
+)
 from .megakernel import BatchContext, BatchSpec, KernelContext, Megakernel
 from .resident import ResidentKernel
 from .tenants import Admission, TenantSpec, TenantTable
@@ -37,6 +45,12 @@ from .tracebuf import TraceRing, decode_ring, trace_to_jsonable
 
 __all__ = [
     "Admission",
+    "Graph",
+    "host_bfs",
+    "host_pagerank",
+    "host_sssp",
+    "make_frontier_megakernel",
+    "run_frontier",
     "Slab",
     "TileKernel",
     "make_forasync_megakernel",

@@ -1,0 +1,936 @@
+"""Graph-analytics frontier tier: BFS / SSSP / PageRank on the batch lanes.
+
+ROADMAP direction 5: UTS and fib prove dynamic trees, but nothing in the
+bench family exercised skewed frontier expansion over a large in-HBM
+structure. This module is that workload family - an adjacency kept in
+HBM is traversed by EXPAND task descriptors, and because every EXPAND of
+one traversal is the same kind, each round's frontier dynamically groups
+onto ONE per-F_FN batch lane (the PR 3 tier) and fires ``width`` at a
+time through one tiled body, with the cross-round double-buffered
+prefetch streaming the next batch's edge slabs under the current batch's
+relax loop.
+
+**Blocked CSR.** The adjacency is CSR with every vertex's edge run
+padded out to ``EBLOCK``-edge blocks (``Graph``): ``indices`` (and
+``weights``) are ``(nblocks, EBLOCK)`` int32 arrays in HBM, and a
+vertex's edges occupy blocks ``[blk_start[v], blk_start[v] +
+blk_count[v])``. Block alignment is what makes the edge slab a STATIC
+DMA shape - each EXPAND names one block, so a hub vertex is simply many
+same-kind descriptors (the R-MAT skew becomes batch occupancy instead
+of a ragged-transfer problem), and the slab address is a legal dynamic
+offset on real hardware (Mosaic wants coarse alignment).
+
+**Descriptor ABI.** ``EXPAND(v, blk, carry, cnt)``: expand block ``blk``
+(``cnt`` live edges) of vertex ``v``, propagating ``carry`` - the
+tentative distance of ``v`` (BFS/SSSP) or the residual mass delivered to
+``v`` (PageRank). Everything a task needs rides its own descriptor plus
+per-vertex state in SMEM value slots, and EXPANDs are spawned link-free,
+so they are migratable on every multi-device runner by construction.
+
+**Relaxation model.** BFS and SSSP are label-correcting: an edge
+``v -> u`` relaxes ``dist[u] = min(dist[u], carry + w)`` and an
+IMPROVING relax re-spawns u's blocks with the new distance. The final
+distance array is the exact shortest-path fixpoint - independent of
+execution order, batch grouping, and migration - which is what makes
+"bit-identical across scalar dispatch, batched frontier, and the
+4-device mesh" hold without any ordering machinery: per-device distance
+arrays are local caches combined by elementwise min (a suppressed spawn
+on one device means an equal-or-better carry was already propagated
+there; propagation is transitive). Level-synchronous BFS order is the
+special case the lane LIFO/FIFO approximates; delta-stepping SSSP
+likewise degenerates to the lane order (re-expansions are the
+correction; the bucket discipline of true delta-stepping is future
+work noted in ROADMAP). PageRank is push-style with integer
+fixed-point mass: a delivery of ``q`` to ``u`` retains
+``q - deg(u) * q_child`` into rank[u] and forwards ``q_child =
+(alpha * q) / deg(u)`` along every out-edge, folding entirely into
+rank[u] once ``q`` drops under ``reps`` - mass is conserved exactly,
+every delivery's children depend only on its own descriptor, so the
+result is deterministic across schedules and mesh runs (per-device
+ranks combine by sum), and it approximates the float PageRank series
+``(1-alpha) * sum_k alpha^k P^k`` to the fixed-point tolerance.
+
+**Firing policy.** Frontier expansion is exactly the chained-spawner
+shape the lane-policy watch item predicted: every batch deposits a
+fan-out of same-kind children on the ready ring, so under pure
+ring-drain-first firing the lane sits starved for the whole routing
+drain. The frontier megakernels therefore default the ISSUE 10 age
+trigger ON (``lane_max_age = 4 * width``): a lane that has held entries
+for that many rounds jumps the ring and fires - full batches mid-drain
+once >= width entries accumulated - keeping ``lane_partial_age`` and the
+device-side ``max_starved_age`` gauge bounded (the frontier-batch perf
+guard pins both).
+
+**TEPS.** Every EXPAND counts its ``cnt`` live edges into value slot
+``V_EDGES``; traversed-edges/s = edges / wall over a run - the headline
+the graph bench reports beside UTS nodes/s. Improving relaxations (or
+PageRank deliveries) count into ``V_RELAX``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..runtime.locality import MeshPlacement, resolve_placement
+from .descriptor import TaskGraphBuilder
+from .megakernel import BatchSpec, Megakernel, _batch_stub
+
+__all__ = [
+    "EBLOCK",
+    "INF",
+    "FR_EXPAND",
+    "Graph",
+    "FrontierKernel",
+    "bfs_kernel",
+    "sssp_kernel",
+    "pagerank_kernel",
+    "make_frontier_megakernel",
+    "run_frontier",
+    "seed_frontier",
+    "host_bfs",
+    "host_sssp",
+    "host_pagerank_push",
+    "host_pagerank",
+    "PR_NUM",
+    "PR_DEN",
+]
+
+# Edge-block width: one VMEM lane row of int32, and the blocked-CSR
+# alignment unit (every vertex's edge run starts on a block boundary).
+EBLOCK = 128
+
+# Unreached distance sentinel (fits int32 with relax headroom: INF + any
+# edge weight stays positive and still compares greater than any real
+# path length).
+INF = 0x3FFFFFFF
+
+# The EXPAND kernel's table index: frontier megakernels are single-kind
+# (one traversal family per build), so the id is fixed - which is also
+# what puts every frontier descriptor on ONE batch lane.
+FR_EXPAND = 0
+
+# PageRank damping as an exact int32 rational: alpha = 13/16 = 0.8125
+# (exactly representable in the float host reference too, so the only
+# device-vs-float divergence is fixed-point truncation).
+PR_NUM = 13
+PR_DEN = 16
+
+# Value-slot layout: two counters, then the vertex table (3 words per
+# vertex: block start / block count / out-degree), then per-vertex state
+# (distance or rank). All host-preset, so the whole layout stages into
+# SMEM and the device reads it with plain dynamic indexing.
+V_EDGES = 0   # traversed edges (the TEPS numerator; combines by sum)
+V_RELAX = 1   # improving relaxations / PR deliveries (combines by sum)
+VT_BASE = 8
+
+
+class Graph:
+    """Host-side blocked-CSR adjacency (module docstring): dense int32
+    arrays shaped for the device tier plus python adjacency for the host
+    reference arms."""
+
+    def __init__(
+        self,
+        n: int,
+        src: np.ndarray,
+        dst: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+    ) -> None:
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        if src.shape != dst.shape:
+            raise ValueError("src/dst must be the same length")
+        if len(src) and (
+            src.min() < 0 or src.max() >= n or dst.min() < 0 or dst.max() >= n
+        ):
+            raise ValueError(f"edge endpoints out of range [0, {n})")
+        self.n = int(n)
+        self.m = int(len(src))
+        w = (
+            np.asarray(weights, np.int64)
+            if weights is not None
+            else np.ones(self.m, np.int64)
+        )
+        if w.shape != src.shape:
+            raise ValueError("weights must match the edge count")
+        if len(w) and w.min() < 0:
+            raise ValueError("weights must be >= 0")
+        order = np.argsort(src, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
+        self.deg = np.bincount(src, minlength=n).astype(np.int32)
+        self.blk_count = ((self.deg + EBLOCK - 1) // EBLOCK).astype(np.int32)
+        self.blk_start = np.zeros(n, np.int32)
+        if n > 1:
+            self.blk_start[1:] = np.cumsum(self.blk_count)[:-1].astype(
+                np.int32
+            )
+        self.nblocks = max(1, int(self.blk_count.sum()))
+        self.indices = np.full((self.nblocks, EBLOCK), -1, np.int32)
+        self.weights = np.zeros((self.nblocks, EBLOCK), np.int32)
+        # Per-vertex adjacency (python lists) for the host references.
+        splits = np.searchsorted(src, np.arange(n + 1))
+        self.adj: List[np.ndarray] = []
+        self.adj_w: List[np.ndarray] = []
+        for v in range(n):
+            lo, hi = int(splits[v]), int(splits[v + 1])
+            self.adj.append(dst[lo:hi].astype(np.int32))
+            self.adj_w.append(w[lo:hi].astype(np.int32))
+            d = hi - lo
+            b0 = int(self.blk_start[v])
+            flat = self.indices[
+                b0 : b0 + int(self.blk_count[v])
+            ].reshape(-1)
+            flat[:d] = dst[lo:hi]
+            wflat = self.weights[
+                b0 : b0 + int(self.blk_count[v])
+            ].reshape(-1)
+            wflat[:d] = w[lo:hi]
+
+    def block_cnt(self, v: int, i: int) -> int:
+        """Live edges in block ``i`` of vertex ``v`` (the descriptor's
+        ``cnt`` arg): full blocks then the ragged tail."""
+        return int(min(EBLOCK, int(self.deg[v]) - i * EBLOCK))
+
+    # -- value-slot layout --
+
+    @property
+    def st_base(self) -> int:
+        return VT_BASE + 3 * self.n
+
+    @property
+    def num_value_slots(self) -> int:
+        """Host-preset slots: counters + vertex table + per-vertex state."""
+        return self.st_base + self.n
+
+    def preset_values(self, num_values: int, state0: int) -> np.ndarray:
+        """The host ivalues row: vertex table filled, per-vertex state
+        initialized to ``state0`` (INF for distances, 0 for ranks)."""
+        if num_values < self.num_value_slots:
+            raise ValueError(
+                f"graph wants num_values >= {self.num_value_slots}, "
+                f"got {num_values}"
+            )
+        iv = np.zeros(num_values, np.int32)
+        vt = np.stack(
+            [self.blk_start, self.blk_count, self.deg], axis=1
+        ).reshape(-1)
+        iv[VT_BASE : VT_BASE + 3 * self.n] = vt
+        iv[self.st_base : self.st_base + self.n] = state0
+        return iv
+
+
+# ----------------------------------------------------------- device tier
+
+
+def _spawn_blocks(kctx, u, carry) -> None:
+    """Spawn one EXPAND per adjacency block of vertex ``u`` (the device
+    side of frontier growth; the host seeding mirrors it exactly)."""
+    vt = VT_BASE + 3 * u
+    bs = kctx.ivalues[vt]
+    bc = kctx.ivalues[vt + 1]
+    deg = kctx.ivalues[vt + 2]
+
+    def sp(i, _):
+        cnt = jnp.clip(deg - i * EBLOCK, 0, EBLOCK)
+        kctx.spawn(FR_EXPAND, [u, bs + i, carry, cnt], nargs=4)
+        return 0
+
+    jax.lax.fori_loop(0, bc, sp, 0)
+
+
+class FrontierKernel:
+    """One traversal family as an edge-slab pipeline: a per-edge scalar
+    ``relax(fk, kctx, u, w, carry)`` plus the slab declarations, from
+    which BOTH dispatch spellings derive (the TileKernel pattern): the
+    scalar-tier kernel (DMA one block in, relax its edges - the
+    bit-identity reference arm) and the batched body (all live slots'
+    slabs in flight before the first wait, the prospective next batch's
+    slabs prefetched into the other VMEM half during this round's relax
+    loop, the PR 3 double-buffer protocol) with its ``drain``. One relax
+    trace means scalar-vs-batched identity holds by construction - and
+    for these kernels the RESULT is additionally schedule-independent
+    (module docstring), which is what extends the identity across the
+    mesh.
+
+    ``relax`` receives the kernel itself first so it can read the
+    graph-layout base ``fk.st_base`` at TRACE time -
+    ``make_frontier_megakernel`` stamps it before the megakernel's lazy
+    first trace."""
+
+    def __init__(
+        self,
+        name: str,
+        relax: Callable,
+        weighted: bool,
+        state0: int,
+    ) -> None:
+        self.name = name
+        self._relax = relax
+        self.weighted = bool(weighted)
+        self.state0 = int(state0)
+        # Per-vertex state region base in the value slots; stamped by
+        # make_frontier_megakernel from the graph layout (trace-time
+        # read, so the kernel must be bound to ONE graph layout).
+        self.st_base: Optional[int] = None
+
+    def relax(self, kctx, u, w, carry) -> None:
+        if self.st_base is None:
+            raise ValueError(
+                "FrontierKernel has no graph layout bound: build it "
+                "through make_frontier_megakernel (which stamps st_base)"
+            )
+        self._relax(self, kctx, u, w, carry)
+
+    def data_specs(self, graph: Graph) -> Dict[str, jax.ShapeDtypeStruct]:
+        specs = {
+            "indices": jax.ShapeDtypeStruct(
+                (graph.nblocks, EBLOCK), jnp.int32
+            )
+        }
+        if self.weighted:
+            specs["weights"] = jax.ShapeDtypeStruct(
+                (graph.nblocks, EBLOCK), jnp.int32
+            )
+        return specs
+
+    def data(self, graph: Graph) -> Dict[str, np.ndarray]:
+        d = {"indices": graph.indices}
+        if self.weighted:
+            d["weights"] = graph.weights
+        return d
+
+    def _relax_block(self, kctx, eslab, wslab, carry, cnt) -> None:
+        """The shared relax loop over one loaded edge slab: the single
+        arithmetic trace both dispatch spellings run. ``eslab``/``wslab``
+        are zero-arg VMEM readers ``f(e) -> scalar``."""
+        kctx.ivalues[V_EDGES] = kctx.ivalues[V_EDGES] + cnt
+
+        def e_body(e, _):
+            u = eslab(e)
+            w = wslab(e) if self.weighted else jnp.int32(0)
+            self.relax(kctx, u, w, carry)
+            return 0
+
+        jax.lax.fori_loop(0, cnt, e_body, 0)
+
+    # -- scalar-tier spelling --
+
+    def scalar_scratch(self) -> Dict[str, Any]:
+        sc: Dict[str, Any] = {
+            "fr_idx": pltpu.VMEM((EBLOCK,), jnp.int32),
+            "fr_lsem": pltpu.SemaphoreType.DMA((1,)),
+        }
+        if self.weighted:
+            sc["fr_wgt"] = pltpu.VMEM((EBLOCK,), jnp.int32)
+        return sc
+
+    def scalar_kernel(self, ctx) -> None:
+        v, blk, carry, cnt = (ctx.arg(i) for i in range(4))
+        sem = ctx.scratch["fr_lsem"].at[0]
+        copies = [
+            pltpu.make_async_copy(
+                ctx.data["indices"].at[blk], ctx.scratch["fr_idx"], sem
+            )
+        ]
+        if self.weighted:
+            copies.append(
+                pltpu.make_async_copy(
+                    ctx.data["weights"].at[blk], ctx.scratch["fr_wgt"], sem
+                )
+            )
+        for cp in copies:
+            cp.start()
+        for cp in copies:
+            cp.wait()
+        self._relax_block(
+            ctx,
+            lambda e: ctx.scratch["fr_idx"][e],
+            (lambda e: ctx.scratch["fr_wgt"][e]) if self.weighted else None,
+            carry,
+            cnt,
+        )
+
+    # -- batch-tier spelling --
+
+    def batch_scratch(self, width: int) -> Dict[str, Any]:
+        sc: Dict[str, Any] = {
+            # Double-buffered (leading 2): one half relaxes while the
+            # tier's cross-round prefetch streams the next batch's edge
+            # slabs into the other.
+            "fr_idx": pltpu.VMEM((2, width, EBLOCK), jnp.int32),
+            "fr_lsem": pltpu.SemaphoreType.DMA((2, width)),
+        }
+        if self.weighted:
+            sc["fr_wgt"] = pltpu.VMEM((2, width, EBLOCK), jnp.int32)
+        return sc
+
+    def _slot_loads(self, ctx, buf, slot: int, blk, wait: bool) -> None:
+        """Start (or retire) the edge-slab copies of batch slot ``slot``
+        into half ``buf`` - one semaphore per (half, slot) counting every
+        stream, each start matched by exactly one wait."""
+        sem = ctx.scratch["fr_lsem"].at[buf, slot]
+        cp = pltpu.make_async_copy(
+            ctx.data["indices"].at[blk],
+            ctx.scratch["fr_idx"].at[buf, slot],
+            sem,
+        )
+        (cp.wait if wait else cp.start)()
+        if self.weighted:
+            cp = pltpu.make_async_copy(
+                ctx.data["weights"].at[blk],
+                ctx.scratch["fr_wgt"].at[buf, slot],
+                sem,
+            )
+            (cp.wait if wait else cp.start)()
+
+    def batch_body(self, ctx) -> None:
+        width = ctx.width
+        buf = ctx.buf
+
+        # Phase 1: start edge-slab copies for live slots the prefetch
+        # didn't already cover.
+        for b in range(width):
+            @pl.when(ctx.live(b) & (jnp.int32(b) >= ctx.prefetched))
+            def _(b=b):
+                self._slot_loads(ctx, buf, b, ctx.arg(b, 1), wait=False)
+
+        # Phase 2: the prospective NEXT batch's slabs start into the
+        # other half now, landing under this round's relax loops.
+        obuf = 1 - buf
+        for b in range(width):
+            @pl.when(jnp.int32(b) < ctx.prefetch_count)
+            def _(b=b):
+                self._slot_loads(ctx, obuf, b, ctx.next_arg(b, 1),
+                                 wait=False)
+
+        # Phase 3: retire this round's loads (prefetched slots wait the
+        # copies LAST round's phase 2 started into this half).
+        for b in range(width):
+            @pl.when(ctx.live(b))
+            def _(b=b):
+                self._slot_loads(ctx, buf, b, ctx.arg(b, 1), wait=True)
+
+        # Phase 4: per-slot relax loops, in slot order - each slot's
+        # relaxes see the SMEM state earlier slots of the same batch
+        # wrote, exactly as scalar dispatch of the same rows would.
+        for b in range(width):
+            @pl.when(ctx.live(b))
+            def _(b=b):
+                kctx = ctx.slot_ctx(b)
+                self._relax_block(
+                    kctx,
+                    lambda e, b=b: ctx.scratch["fr_idx"][buf, b, e],
+                    (lambda e, b=b: ctx.scratch["fr_wgt"][buf, b, e])
+                    if self.weighted
+                    else None,
+                    ctx.arg(b, 2),
+                    ctx.arg(b, 3),
+                )
+
+    def batch_drain(self, ctx) -> None:
+        """Retire an in-flight prefetch whose target entries will spill
+        instead of batching (scheduler exit: fuel, quiesce) - no DMA
+        outlives the round loop."""
+        for b in range(ctx.width):
+            @pl.when(jnp.int32(b) < ctx.prefetched)
+            def _(b=b):
+                self._slot_loads(ctx, ctx.buf, b, ctx.arg(b, 1), wait=True)
+
+
+# ----------------------------------------------------- the three kernels
+
+
+def bfs_kernel() -> FrontierKernel:
+    """Level-style BFS as monotone label correction: carry is dist[v] at
+    spawn; an improving hop re-spawns the target's blocks."""
+
+    def relax(fk, kctx, u, w, carry) -> None:
+        nd = carry + 1
+        st = fk.st_base + u
+        better = nd < kctx.ivalues[st]
+
+        @pl.when(better)
+        def _():
+            kctx.ivalues[st] = nd
+            kctx.ivalues[V_RELAX] = kctx.ivalues[V_RELAX] + 1
+            _spawn_blocks(kctx, u, nd)
+
+    return FrontierKernel("fr_bfs", relax, weighted=False, state0=INF)
+
+
+def sssp_kernel() -> FrontierKernel:
+    """Delta-stepping-style SSSP (nonnegative int weights): the same
+    monotone relaxation with ``carry + w``; re-expansions are the
+    delta-stepping corrections, with the lane's pop order standing in
+    for the bucket discipline (exactness does not depend on it)."""
+
+    def relax(fk, kctx, u, w, carry) -> None:
+        nd = carry + w
+        st = fk.st_base + u
+        better = nd < kctx.ivalues[st]
+
+        @pl.when(better)
+        def _():
+            kctx.ivalues[st] = nd
+            kctx.ivalues[V_RELAX] = kctx.ivalues[V_RELAX] + 1
+            _spawn_blocks(kctx, u, nd)
+
+    return FrontierKernel("fr_sssp", relax, weighted=True, state0=INF)
+
+
+def _pr_split(q, deg):
+    """Child mass of a PageRank delivery ``q`` at a vertex of out-degree
+    ``deg`` (int fixed point) - the ONE place the split arithmetic
+    lives, shared by the device relax (traced int32), host seeding, and
+    the exact host twin (python ints, so the twin is bit-exact)."""
+    if isinstance(q, (int, np.integer)):
+        return (int(q) * PR_NUM // PR_DEN) // max(int(deg), 1)
+    return (q * PR_NUM // PR_DEN) // jnp.maximum(deg, 1)
+
+
+def pagerank_kernel(reps: int = 64) -> FrontierKernel:
+    """Push-style PageRank on integer fixed-point mass: a delivery of
+    ``q`` retains ``q - deg*q_child`` into rank[u] and forwards
+    ``q_child`` per out-edge; ``q < reps`` (or a zero child, or a
+    dangling target) folds the whole delivery into rank[u]. Mass
+    conserves exactly, so the result is deterministic across schedules
+    and sums across mesh devices."""
+
+    reps = int(reps)
+    if reps < 1:
+        raise ValueError(f"pagerank reps must be >= 1, got {reps}")
+
+    def relax(fk, kctx, u, w, q) -> None:
+        vt = VT_BASE + 3 * u
+        deg = kctx.ivalues[vt + 2]
+        qc = _pr_split(q, deg)
+        expand = (q >= jnp.int32(reps)) & (qc > 0) & (deg > 0)
+        retained = jnp.where(expand, q - deg * qc, q)
+        st = fk.st_base + u
+        kctx.ivalues[st] = kctx.ivalues[st] + retained
+        kctx.ivalues[V_RELAX] = kctx.ivalues[V_RELAX] + 1
+
+        @pl.when(expand)
+        def _():
+            _spawn_blocks(kctx, u, qc)
+
+    fk = FrontierKernel("fr_pagerank", relax, weighted=False, state0=0)
+    fk.reps = reps
+    return fk
+
+
+# ------------------------------------------------------------ host side
+
+_KINDS: Dict[str, Callable[..., FrontierKernel]] = {
+    "bfs": bfs_kernel,
+    "sssp": sssp_kernel,
+    "pagerank": pagerank_kernel,
+}
+
+
+def seed_frontier(
+    builder: TaskGraphBuilder,
+    graph: Graph,
+    kind: str,
+    src: int = 0,
+    m0: int = 1 << 14,
+    reps: int = 64,
+) -> List[Tuple[int, ...]]:
+    """Host seeding (mirrors the device relax exactly). BFS/SSSP: dist
+    preset 0 at ``src`` (the caller's preset row carries it) and one
+    EXPAND per block of ``src``. PageRank: every vertex receives the
+    initial mass ``m0`` host-side - retained rank goes into the preset
+    row, survivors seed their blocks. Returns the seeded arg tuples (the
+    placement path deals them across devices)."""
+    seeds: List[Tuple[int, ...]] = []
+    if kind in ("bfs", "sssp"):
+        v = int(src)
+        if not 0 <= v < graph.n:
+            raise ValueError(f"source {v} out of range [0, {graph.n})")
+        for i in range(int(graph.blk_count[v])):
+            seeds.append(
+                (v, int(graph.blk_start[v]) + i, 0, graph.block_cnt(v, i))
+            )
+    elif kind == "pagerank":
+        for v in range(graph.n):
+            deg = int(graph.deg[v])
+            qc = _pr_split(m0, deg)
+            if m0 >= reps and qc > 0 and deg > 0:
+                for i in range(int(graph.blk_count[v])):
+                    seeds.append(
+                        (
+                            v,
+                            int(graph.blk_start[v]) + i,
+                            qc,
+                            graph.block_cnt(v, i),
+                        )
+                    )
+    else:
+        raise ValueError(f"unknown frontier kind {kind!r} (bfs|sssp|pagerank)")
+    if builder is not None:
+        for args in seeds:
+            builder.add(FR_EXPAND, args=list(args))
+    return seeds
+
+
+def _pr_seed_rank(graph: Graph, m0: int, reps: int) -> np.ndarray:
+    """Rank retained by the host-side seed deliveries (the preset the
+    device run starts from; mirrors seed_frontier's split decisions)."""
+    rank = np.zeros(graph.n, np.int64)
+    for v in range(graph.n):
+        deg = int(graph.deg[v])
+        qc = _pr_split(m0, deg)
+        if m0 >= reps and qc > 0 and deg > 0:
+            rank[v] = m0 - deg * qc
+        else:
+            rank[v] = m0
+    return rank
+
+
+def host_bfs(graph: Graph, src: int = 0) -> np.ndarray:
+    """Exact hop distances (frontier BFS; INF where unreached)."""
+    dist = np.full(graph.n, INF, np.int64)
+    dist[src] = 0
+    frontier = [int(src)]
+    while frontier:
+        nxt: List[int] = []
+        for v in frontier:
+            nd = dist[v] + 1
+            for u in graph.adj[v]:
+                if nd < dist[u]:
+                    dist[u] = nd
+                    nxt.append(int(u))
+        frontier = nxt
+    return dist.astype(np.int32)
+
+
+def host_sssp(graph: Graph, src: int = 0) -> np.ndarray:
+    """Exact shortest paths (Dijkstra; nonnegative int weights)."""
+    import heapq
+
+    dist = np.full(graph.n, INF, np.int64)
+    dist[src] = 0
+    heap: List[Tuple[int, int]] = [(0, int(src))]
+    while heap:
+        d, v = heapq.heappop(heap)
+        if d > dist[v]:
+            continue
+        for u, w in zip(graph.adj[v], graph.adj_w[v]):
+            nd = d + int(w)
+            if nd < dist[u]:
+                dist[u] = nd
+                heapq.heappush(heap, (nd, int(u)))
+    return dist.astype(np.int32)
+
+
+def host_pagerank_push(
+    graph: Graph, m0: int = 1 << 14, reps: int = 64
+) -> Tuple[np.ndarray, int]:
+    """Exact integer twin of the device push (same split, same fold
+    rule, any processing order - the bit-identity reference arm).
+    Returns (rank, deliveries)."""
+    rank = _pr_seed_rank(graph, m0, reps)
+    queue: List[Tuple[int, int]] = []
+    # Seed deliveries: every surviving seed vertex pushes qc along each
+    # out-edge (the queue order is irrelevant - the push is
+    # schedule-independent, which is the property under test).
+    for v in range(graph.n):
+        deg = int(graph.deg[v])
+        qc = _pr_split(m0, deg)
+        if m0 >= reps and qc > 0 and deg > 0:
+            for u in graph.adj[v]:
+                queue.append((int(u), qc))
+    deliveries = 0
+    while queue:
+        u, q = queue.pop()
+        deliveries += 1
+        deg = int(graph.deg[u])
+        qc = _pr_split(q, deg)
+        if q >= reps and qc > 0 and deg > 0:
+            rank[u] += q - deg * qc
+            for t in graph.adj[u]:
+                queue.append((int(t), qc))
+        else:
+            rank[u] += q
+    return rank.astype(np.int64), deliveries
+
+
+def host_pagerank(
+    graph: Graph,
+    alpha: float = PR_NUM / PR_DEN,
+    iters: int = 80,
+    m0: float = 1.0,
+) -> np.ndarray:
+    """Float PageRank series the push approximates: rank = sum_k of the
+    mass retained at step k, with dangling vertices absorbing fully
+    (the push's fold rule). Normalized to ``m0`` seed mass per vertex."""
+    m = np.full(graph.n, float(m0))
+    rank = np.zeros(graph.n)
+    deg = graph.deg.astype(np.float64)
+    for _ in range(iters):
+        keep = np.where(deg > 0, (1.0 - alpha) * m, m)
+        rank += keep
+        push = np.where(deg > 0, alpha * m / np.maximum(deg, 1), 0.0)
+        m2 = np.zeros(graph.n)
+        for v in range(graph.n):
+            if push[v] > 0:
+                np.add.at(m2, graph.adj[v], push[v])
+        m = m2
+    return rank + m  # fold the residual tail
+
+
+# ------------------------------------------------------------ megakernel
+
+
+def _default_lane_max_age(width: int) -> int:
+    """Frontier builds default the ISSUE 10 age trigger ON at 4x the
+    lane width (module docstring); HCLIB_TPU_LANE_MAX_AGE (handled by
+    Megakernel itself) still overrides process-wide."""
+    if os.environ.get("HCLIB_TPU_LANE_MAX_AGE", ""):
+        return None  # type: ignore[return-value]  # env wins
+    return 4 * width
+
+
+def make_frontier_megakernel(
+    fk: FrontierKernel,
+    graph: Graph,
+    *,
+    width: int = 8,
+    prefetch: bool = True,
+    capacity: int = 512,
+    num_values: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    trace=None,
+    checkpoint: Optional[bool] = None,
+    lane_max_age: Optional[int] = None,
+) -> Megakernel:
+    """Build a traversal's megakernel. ``width=0`` is the scalar-
+    dispatch arm (the bit-identity reference); ``width>0`` routes EXPAND
+    through the batch lanes with the double-buffered edge-slab prefetch,
+    and arms the age-triggered firing policy (``lane_max_age``; default
+    4*width, 0 disables)."""
+    if num_values is None:
+        num_values = graph.num_value_slots + 8
+    if width:
+        spec = BatchSpec(
+            fk.batch_body,
+            width=width,
+            prefetch=prefetch,
+            drain=fk.batch_drain if prefetch else None,
+        )
+        kernels = [(fk.name, _batch_stub)]
+        route = {fk.name: spec}
+        scratch = fk.batch_scratch(width)
+        if lane_max_age is None:
+            lane_max_age = _default_lane_max_age(width)
+    else:
+        kernels = [(fk.name, fk.scalar_kernel)]
+        route = None
+        scratch = fk.scalar_scratch()
+        lane_max_age = 0 if lane_max_age is None else lane_max_age
+    if fk.st_base is not None and fk.st_base != graph.st_base:
+        raise ValueError(
+            "FrontierKernel is already bound to a different graph layout "
+            f"(st_base {fk.st_base} vs {graph.st_base}): build a fresh "
+            "kernel per graph - megakernels trace lazily, so rebinding "
+            "would silently retarget an earlier build's state region"
+        )
+    fk.st_base = graph.st_base
+    mk = Megakernel(
+        kernels=kernels,
+        route=route,
+        data_specs=fk.data_specs(graph),
+        scratch_specs=scratch,
+        capacity=capacity,
+        num_values=num_values,
+        succ_capacity=8,
+        interpret=interpret,
+        trace=trace,
+        checkpoint=checkpoint,
+        lane_max_age=lane_max_age,
+    )
+    # Stamp the graph layout the traced kernel is bound to: the relax
+    # closures bake st_base (and the data specs bake nblocks) into the
+    # trace, so running this build over a DIFFERENT graph layout would
+    # silently read the wrong state region - run_frontier refuses it.
+    mk._frontier_layout = (fk.name, graph.n, graph.nblocks, graph.st_base)
+    return mk
+
+
+# ---------------------------------------------------------------- runner
+
+
+def run_frontier(
+    kind: str,
+    graph: Graph,
+    src: int = 0,
+    *,
+    width: int = 8,
+    prefetch: bool = True,
+    m0: int = 1 << 14,
+    reps: int = 64,
+    capacity: int = 512,
+    interpret: Optional[bool] = None,
+    trace=None,
+    fuel: Optional[int] = None,
+    lane_max_age: Optional[int] = None,
+    mk: Optional[Megakernel] = None,
+    placement=None,
+    mesh=None,
+    runner: str = "sharded",
+    quantum: int = 64,
+    window: int = 16,
+    hop_order=None,
+) -> Tuple[np.ndarray, Dict]:
+    """Run one traversal to completion; returns ``(result, info)`` where
+    ``result`` is the distance array (bfs/sssp, int32, INF = unreached)
+    or the fixed-point rank array (pagerank, int64, ``m0`` mass units
+    per vertex seeded). ``info`` gains ``edges`` (TEPS numerator) and
+    ``relaxations``.
+
+    Single device when ``placement`` is None. With a placement the seed
+    descriptors deal across the per-device ready rings through
+    ``runtime.locality.resolve_placement`` (the forasync placement
+    discipline - data, not code), EXPANDs migrate through the chosen
+    runner's steal exchange (``runner='sharded'`` fast-interpret, or
+    ``'resident'`` - Mosaic interpret - whose XOR-hop exchange takes the
+    graph-ordered ``hop_order``), per-device distance caches combine by
+    elementwise min and ranks/counters by sum."""
+    if kind not in _KINDS:
+        raise ValueError(f"unknown frontier kind {kind!r} (bfs|sssp|pagerank)")
+    fk = _KINDS[kind](reps=reps) if kind == "pagerank" else _KINDS[kind]()
+    if mk is None:
+        mk = make_frontier_megakernel(
+            fk, graph, width=width, prefetch=prefetch, capacity=capacity,
+            interpret=interpret, trace=trace, lane_max_age=lane_max_age,
+        )
+    else:
+        # A prebuilt megakernel owns its own (already-bound) kernel; it
+        # must have been built for THIS graph's layout (the trace bakes
+        # st_base and the slab shapes in - a mismatch would silently
+        # relax the wrong value slots). The local fk only supplies the
+        # layout helpers below.
+        expect = (fk.name, graph.n, graph.nblocks, graph.st_base)
+        bound = getattr(mk, "_frontier_layout", None)
+        if bound != expect:
+            raise ValueError(
+                f"prebuilt megakernel is bound to frontier layout "
+                f"{bound}, but this run wants {expect} "
+                "(kind, n, nblocks, st_base): build one megakernel per "
+                "(kind, graph) via make_frontier_megakernel"
+            )
+        fk.st_base = graph.st_base
+    st = graph.st_base
+    iv = graph.preset_values(mk.num_values, fk.state0)
+    if kind in ("bfs", "sssp"):
+        iv[st + int(src)] = 0
+    else:
+        iv[st : st + graph.n] = _pr_seed_rank(graph, m0, reps).astype(
+            np.int32
+        )
+    seeds = seed_frontier(None, graph, kind, src=src, m0=m0, reps=reps)
+    data = fk.data(graph)
+
+    def finish(iv_rows: np.ndarray, info: Dict) -> Tuple[np.ndarray, Dict]:
+        rows = np.asarray(iv_rows, np.int64)
+        if rows.ndim == 1:
+            rows = rows[None]
+        states = rows[:, st : st + graph.n]
+        if kind in ("bfs", "sssp"):
+            result = states.min(axis=0).astype(np.int32)
+        else:
+            result = states.sum(axis=0) - (
+                (rows.shape[0] - 1) * iv[st : st + graph.n].astype(np.int64)
+            )  # presets replicate per device; count the seed rank once
+        info["edges"] = int(rows[:, V_EDGES].sum())
+        info["relaxations"] = int(rows[:, V_RELAX].sum())
+        return result, info
+
+    if placement is None:
+        b = TaskGraphBuilder()
+        b.reserve_values(graph.num_value_slots)
+        for args in seeds:
+            b.add(FR_EXPAND, args=list(args))
+        iv_o, _, info = mk.run(
+            b, data=dict(data), ivalues=iv,
+            fuel=1 << 22 if fuel is None else fuel,
+        )
+        return finish(iv_o, info)
+
+    if fuel is not None:
+        # The mesh runners budget by quantum/rounds, not fuel; silently
+        # dropping a caller's bound would turn "bounded traversal" into
+        # "unbounded run".
+        raise ValueError(
+            "fuel= applies to the single-device path only; bound a mesh "
+            "run with quantum= (per-round budget) instead"
+        )
+    p = resolve_placement(placement)
+    from ..parallel.mesh import cpu_mesh
+
+    if mesh is None:
+        if not isinstance(p, MeshPlacement):
+            raise ValueError(
+                "a dist-func placement needs an explicit mesh= (a "
+                "MeshPlacement knows its own device count)"
+            )
+        mesh = cpu_mesh(p.ndev, axis_name="q")
+    ndev = int(np.prod(mesh.devices.shape))
+    builders = [TaskGraphBuilder() for _ in range(ndev)]
+    for d in range(ndev):
+        builders[d].reserve_values(graph.num_value_slots)
+    dev_of = p.device_of if isinstance(p, MeshPlacement) else (
+        lambda i, tot: p(1, i, tot)
+    )
+    pcounts = [0] * ndev
+    for i, args in enumerate(seeds):
+        d = int(dev_of(i, max(1, len(seeds))))
+        if not 0 <= d < ndev:
+            raise ValueError(
+                f"placement sent seed {i} to device {d} (mesh has {ndev})"
+            )
+        builders[d].add(FR_EXPAND, args=list(args))
+        pcounts[d] += 1
+    stacked_iv = np.broadcast_to(iv, (ndev,) + iv.shape).copy()
+    stacked = {
+        k: np.broadcast_to(v, (ndev,) + v.shape).copy()
+        for k, v in data.items()
+    }
+    if runner == "sharded":
+        from .sharded import ShardedMegakernel
+
+        if hop_order is None and isinstance(p, MeshPlacement):
+            hop_order = p.hop_order()
+        smk = ShardedMegakernel(mk, mesh, migratable_fns=[FR_EXPAND])
+        iv_o, _, info = smk.run(
+            builders, data=stacked, ivalues=stacked_iv, steal=True,
+            quantum=quantum, window=window, hop_order=hop_order,
+        )
+    elif runner == "resident":
+        from .resident import ResidentKernel
+
+        if hop_order is None and isinstance(p, MeshPlacement):
+            hop_order = p.xor_hop_order()
+        rk = ResidentKernel(
+            mk, mesh, migratable_fns=[FR_EXPAND], window=window,
+            homed=False,
+        )
+        iv_o, _, info = rk.run(
+            builders, data=stacked, ivalues=stacked_iv, quantum=quantum,
+            hop_order=hop_order,
+        )
+    else:
+        raise ValueError(
+            f"unknown frontier runner {runner!r} (sharded|resident)"
+        )
+    info["placement_counts"] = pcounts
+    info["hop_order"] = list(hop_order) if hop_order else None
+    result, info = finish(iv_o, info)
+    return result, info

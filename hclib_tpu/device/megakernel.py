@@ -50,6 +50,7 @@ from .descriptor import (
 from .tracebuf import (
     NullTracer,
     TR_CKPT,
+    TR_FIRE_AGE,
     TR_FIRE_BATCH,
     TR_FIRE_SCALAR,
     TR_PREFETCH_DRAIN,
@@ -147,7 +148,13 @@ TS_PREFETCH = 4       # descriptors whose operands came from a prefetch
 TS_FULL_ROUNDS = 5    # batch rounds at full width
 TS_SPILLED = 6        # lane entries spilled back to the ring at sched exit
 TS_OFFERED = 7        # batch slots offered (sum of widths over fired rounds)
-TS_WORDS = 8
+TS_AGE_FIRES = 8      # batch rounds fired by the age trigger (jumped the
+                      # ring-drain-first policy; zero when lane_max_age off)
+TS_MAX_AGE = 9        # max starved-round age any lane reached (rounds a
+                      # lane held entries without firing; written only
+                      # when lane_max_age is on - the device-side gauge
+                      # the age-trigger acceptance bounds)
+TS_WORDS = 10
 
 # Per-lane scheduler state words (SMEM (nbatch, LS_WORDS) scratch): the
 # lane's FIFO cursors plus the cross-round prefetch handshake.
@@ -156,6 +163,9 @@ LS_TAIL = 1     # push cursor
 LS_PF_BASE = 2  # head-at-issue + 1 of the outstanding prefetch (0 = none)
 LS_PF_N = 3     # descriptors the outstanding prefetch covers
 LS_PF_BUF = 4   # operand-buffer half the prefetch was written into
+LS_AGE = 5      # consecutive rounds the lane held entries without firing
+                # (the age-trigger clock; written only when lane_max_age
+                # is on - see the firing-policy site in sched())
 LS_WORDS = 8
 
 # Quiesce control words (the checkpoint/restore subsystem,
@@ -716,6 +726,7 @@ class Megakernel:
         trace: Optional[Any] = None,
         checkpoint: Optional[bool] = None,
         quiesce_stride: Optional[int] = None,
+        lane_max_age: Optional[int] = None,
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
@@ -774,6 +785,26 @@ class Megakernel:
                 except ValueError:
                     quiesce_stride = 1
         self.quiesce_stride = max(1, int(quiesce_stride or 1))
+        # Lane firing-policy age trigger (the ROADMAP lane-policy fix):
+        # ``lane_max_age=N`` lets a batch lane that has held entries for N
+        # consecutive scheduling rounds without firing JUMP the
+        # ring-drain-first policy and fire its (possibly partial) batch -
+        # see the firing-policy site in _make_core's sched(). 0/None = off:
+        # no age words are written and the round loop is the pre-knob
+        # ring-drain-first policy, byte-for-byte. HCLIB_TPU_LANE_MAX_AGE
+        # sets it process-wide; malformed or negative values RAISE (the
+        # PR 8 env convention - a typo must not silently change the
+        # firing policy).
+        if lane_max_age is None:
+            env = os.environ.get("HCLIB_TPU_LANE_MAX_AGE", "")
+            if env:
+                lane_max_age = int(env)
+        lane_max_age = int(lane_max_age or 0)
+        if lane_max_age < 0:
+            raise ValueError(
+                f"lane_max_age must be >= 0 (0 = off), got {lane_max_age}"
+            )
+        self.lane_max_age = lane_max_age
         # Dispatch-tier routing: ``route`` maps a kernel NAME to the spec
         # of a non-scalar dispatch tier for that task family. Two tiers:
         #
@@ -1257,34 +1288,87 @@ class Megakernel:
                 # their lane within a handful of rounds, so the added
                 # latency is noise against one kernel body. One dispatch
                 # per round; among eligible lanes the lowest F_FN wins.
-                # KNOWN TRADE (the ROADMAP lane-policy watch item): a
-                # dynamic spawner that keeps the ring hot - a forasync-
-                # style producer chained task-by-task - starves the lanes
-                # into long runs of width-1 partial fires. The DETECTOR
-                # is live: trace a run (trace=N) and read
-                # info['tiers']['lane_partial_age'] (longest consecutive
-                # partial-fire streak in rounds, tracebuf.lane_partial_age
-                # off the TR_FIRE_BATCH records; exported as a metrics
-                # gauge by MetricsRegistry.add_run_info). Knob trail if a
-                # workload trips it: (1) widen the spawner's spawn fan-out
-                # so each ring drain deposits >= width same-kind entries;
-                # (2) shrink the lane's BatchSpec width toward the
-                # workload's actual same-kind concurrency; (3) the policy
-                # fix itself - an age-triggered fire that lets a lane jump
-                # the ring after K starved rounds - is future work and
-                # belongs HERE, guarded by that gauge.
+                # KNOWN TRADE (the ROADMAP lane-policy watch item, FIXED
+                # here by ISSUE 10): a dynamic spawner that keeps the ring
+                # hot - a chained producer, or a graph frontier whose
+                # every batch deposits a fan-out of same-kind children on
+                # the ring - starves the lanes: under pure ring-drain-
+                # first a lane fires only at full drains, so entries sit
+                # for the whole routing run (latency unbounded; partial
+                # fires pile up once drains become momentary). The
+                # DETECTOR is the ``lane_partial_age`` gauge (trace a run
+                # and read info['tiers']; tracebuf.lane_partial_age off
+                # the TR_FIRE_BATCH records, exported by
+                # MetricsRegistry.add_run_info). The FIX is the age
+                # trigger below: ``Megakernel(lane_max_age=N)`` /
+                # HCLIB_TPU_LANE_MAX_AGE arms a per-lane starved-round
+                # clock (LS_AGE: rounds the lane held entries without
+                # firing); at age >= N the lane JUMPS ring-drain-first
+                # and fires whatever it holds - a full batch when >= width
+                # entries accumulated during routing (the frontier case:
+                # occupancy AND latency improve), a partial one otherwise
+                # (bounded latency is the point). Each jump emits a
+                # TR_FIRE_AGE reason record beside the round's
+                # TR_FIRE_BATCH and counts in tstats[TS_AGE_FIRES];
+                # tstats[TS_MAX_AGE] carries the worst age any lane
+                # reached. N=0/off compiles none of this - the pre-knob
+                # ring-drain-first policy, byte-for-byte. Knob trail for
+                # a starving workload: (1) set lane_max_age (>= the lane
+                # width keeps age-fires full under a steady spawner);
+                # (2) widen the spawner's fan-out so each drain deposits
+                # >= width same-kind entries; (3) shrink the BatchSpec
+                # width toward the workload's actual same-kind
+                # concurrency.
                 # (``fired`` starts at the quiesce flag: an observed
                 # quiesce suppresses both the batch fire and the scalar
                 # pop, so the exit below sees an untouched round.)
+                max_age = self.lane_max_age
                 fired = qz
-                for li, (fid, spec) in enumerate(self.batch_specs):
-                    eligible = (avails[li] > 0) & jnp.logical_not(ring_work)
+                lane_fires = [jnp.bool_(False)] * nbatch
+                # Two eligibility passes: STARVED lanes (age >= N) first,
+                # then the ordinary drained-ring scan - so a starved lane
+                # beats the lowest-F_FN drain priority and the age bound
+                # holds with several routed kinds (simultaneously starved
+                # lanes fire on consecutive rounds, so the worst observed
+                # age is N + nbatch - 1, not unbounded).
+                phases = (["starved"] if max_age else []) + ["drain"]
+                for phase in phases:
+                    for li, (fid, spec) in enumerate(self.batch_specs):
+                        if phase == "starved":
+                            eligible = (avails[li] > 0) & (
+                                lstate[li, LS_AGE] >= jnp.int32(max_age)
+                            )
+                        else:
+                            eligible = (avails[li] > 0) & jnp.logical_not(
+                                ring_work
+                            )
+                        fire_now = eligible & jnp.logical_not(fired)
+                        if phase == "starved":
+                            # Reason record + counter for a fire that
+                            # jumped the ring (emitted before batch_round
+                            # so LS_AGE still holds the pre-fire age;
+                            # take mirrors batch_round's min(avail,
+                            # width) exactly). A starved fire with the
+                            # ring already empty is an ordinary drain
+                            # fire - no jump, no record.
+                            @pl.when(fire_now & ring_work)
+                            def _(li=li, fid=fid, spec=spec):
+                                tr.emit(
+                                    TR_FIRE_AGE, rt,
+                                    (jnp.int32(fid) << 16)
+                                    | jnp.minimum(avails[li], spec.width),
+                                    lstate[li, LS_AGE],
+                                )
+                                tstats[TS_AGE_FIRES] = (
+                                    tstats[TS_AGE_FIRES] + 1
+                                )
 
-                    @pl.when(eligible & jnp.logical_not(fired))
-                    def _(li=li, spec=spec, e0=e0):
-                        batch_round(li, spec, e0, rt)
+                        @pl.when(fire_now)
+                        def _(li=li, spec=spec, e0=e0):
+                            batch_round(li, spec, e0, rt)
 
-                    fired = fired | eligible
+                        lane_fires[li] = lane_fires[li] | fire_now
+                        fired = fired | eligible
 
                 @pl.when(jnp.logical_not(fired) & ring_work)
                 def _():
@@ -1318,6 +1402,27 @@ class Megakernel:
                     @pl.when(routed)
                     def _():
                         tstats[TS_ROUTED] = tstats[TS_ROUTED] + 1
+
+                if max_age:
+                    # Advance the starved-round clocks AFTER dispatch: a
+                    # lane that holds entries now (including one a scalar
+                    # pop just routed into) and did not fire this round
+                    # ages by one; a fire or an empty lane resets. The
+                    # worst age any lane reaches rides out in tstats -
+                    # the bounded-age gauge the acceptance pins.
+                    for li in range(nbatch):
+                        has_now = (
+                            lstate[li, LS_TAIL] - lstate[li, LS_HEAD]
+                        ) > 0
+                        age = jnp.where(
+                            lane_fires[li] | jnp.logical_not(has_now),
+                            0,
+                            lstate[li, LS_AGE] + 1,
+                        )
+                        lstate[li, LS_AGE] = age
+                        tstats[TS_MAX_AGE] = jnp.maximum(
+                            tstats[TS_MAX_AGE], age
+                        )
 
                 return (
                     counts[C_PENDING],
@@ -1717,6 +1822,14 @@ class Megakernel:
             "routed": int(t[TS_ROUTED]),
             "prefetch_hits": int(t[TS_PREFETCH]),
             "spilled": int(t[TS_SPILLED]),
+            # Age-trigger firing policy (lane_max_age; zeros when off):
+            # rounds that jumped ring-drain-first, and the worst
+            # starved-round age any lane reached - the device-side gauge
+            # the bounded-age acceptance pins (lane_partial_age, the
+            # trace-derived partial-fire streak, rides separately on
+            # traced runs).
+            "age_fires": int(t[TS_AGE_FIRES]),
+            "max_starved_age": int(t[TS_MAX_AGE]),
         }
 
     def stats_dict(self) -> Dict[str, Any]:

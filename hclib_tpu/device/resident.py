@@ -92,10 +92,15 @@ its builders - ``device.forasync_tier.place_tiles`` maps a tile loop's
 flat tiles onto the roster through a JSON placement descriptor or dist
 func (runtime/locality.py), so data-driven placement works here exactly
 as on the sharded runner (tests/test_forasync_device.py's resident
-seeding test). The XOR-hop exchange order is fixed by bit position
-(minor axis first); a graph-derived reordering like the sharded runner's
-``hop_order`` is future work - the per-axis decomposition already makes
-each hop a single-coordinate ICI neighbor, so the win is smaller here.
+seeding test). The XOR-hop exchange partner sequence is graph-ordered
+too (the PR 9 residual, closed by ISSUE 10): ``run(hop_order=)`` takes
+a permutation of the XOR partner deltas - ``runtime.locality.
+xor_hop_order`` / ``MeshPlacement.xor_hop_order()`` derive it
+near-neighbors-first from the machine graph's ICI distances, like the
+sharded runner's ``steal_hop_order`` - validated, compile-cache-keyed,
+and graph-absent behavior (bit-position order, minor axis first)
+unchanged. Order is free because the fold's per-dimension exchanges
+commute; coverage is not, so partial hop lists are refused.
 """
 
 from __future__ import annotations
@@ -510,7 +515,30 @@ class ResidentKernel:
 
     # -- the kernel --
 
-    def _kernel(self, quantum: int, max_rounds: int, trace, *refs) -> None:
+    def _hop_bits(self, hop_order) -> Tuple[int, ...]:
+        """Normalize a ``hop_order`` (XOR partner deltas, e.g. from
+        ``runtime.locality.xor_hop_order`` / a placement descriptor's
+        ``xor_hop_order()``) into the bit-index sequence the exchange
+        loop iterates. None = the default bit-position order (minor axis
+        first) - graph-absent behavior unchanged. The fold needs every
+        hypercube dimension each round (recursive-doubling sums and the
+        XOR all-to-all are products of commuting per-dimension
+        exchanges, so ORDER is free but coverage is not): anything short
+        of a full permutation of the power-of-two deltas is refused."""
+        if hop_order is None:
+            return tuple(range(self.nh))
+        deltas = [int(d) for d in hop_order]
+        if sorted(deltas) != [1 << k for k in range(self.nh)]:
+            raise ValueError(
+                f"hop_order must be a permutation of the XOR deltas "
+                f"{[1 << k for k in range(self.nh)]} (every hypercube "
+                f"dimension exactly once), got {deltas}"
+            )
+        return tuple(d.bit_length() - 1 for d in deltas)
+
+    def _kernel(
+        self, quantum: int, max_rounds: int, trace, hop_bits, *refs
+    ) -> None:
         # ``trace`` is captured at _build time (pallas traces lazily;
         # reading mk.trace here could disagree with the built out tree).
         mk = self.mk
@@ -1353,7 +1381,13 @@ class ResidentKernel:
 
             jax.lax.fori_loop(0, ndev, f1, 0)
 
-            for k in range(nh):
+            # Exchange order: ``hop_bits`` (default 0..nh-1, minor axis
+            # first; a locality graph reorders it near-neighbors-first
+            # via run(hop_order=)). Per-hop state (semaphores, inboxes,
+            # credit balances, fault predicates) stays indexed by the
+            # PHYSICAL bit k, so both endpoints of a pair - and the
+            # seeded fault schedule - agree regardless of scan order.
+            for k in hop_bits:
                 partner = me ^ (1 << k)
                 pdev = self._did(partner)
 
@@ -1844,7 +1878,7 @@ class ResidentKernel:
 
     # -- host entry --
 
-    def _build(self, quantum: int, max_rounds: int):
+    def _build(self, quantum: int, max_rounds: int, hop_bits=None):
         mk = self.mk
         ndata = len(mk.data_specs)
         ndev, nchan, nh = self.ndev, self.nchan, self.nh
@@ -1955,8 +1989,12 @@ class ResidentKernel:
                 pltpu.SMEM((ndev,), jnp.int32),  # hb_round
                 pltpu.SMEM((ndev,), jnp.int32),  # deadmask
             ]
+        if hop_bits is None:
+            hop_bits = tuple(range(nh))
         kern = pl.pallas_call(
-            functools.partial(self._kernel, quantum, max_rounds, mk.trace),
+            functools.partial(
+                self._kernel, quantum, max_rounds, mk.trace, hop_bits
+            ),
             out_shape=tuple(out_shape),
             in_specs=in_specs,
             out_specs=tuple(out_specs),
@@ -2042,6 +2080,7 @@ class ResidentKernel:
         abort=None,
         quiesce=None,
         resume_state: Optional[Dict[str, Any]] = None,
+        hop_order: Optional[Sequence[int]] = None,
     ):
         """Execute all partitions fully on-device.
 
@@ -2228,9 +2267,15 @@ class ResidentKernel:
                 ring[d][: len(keep)] = keep
                 counts[d][C_TAIL] = len(keep)
 
-        key = (quantum, max_rounds)
+        # hop_order (locality.xor_hop_order / a placement descriptor's
+        # xor_hop_order()): reorders the paired XOR exchange scan
+        # near-neighbors-first - validated to a full delta permutation
+        # by _hop_bits, and part of the compile cache key (the loop is
+        # unrolled into the kernel).
+        hop_bits = self._hop_bits(hop_order)
+        key = (quantum, max_rounds, hop_bits)
         if key not in self._jitted:
-            self._jitted[key] = self._build(quantum, max_rounds)
+            self._jitted[key] = self._build(quantum, max_rounds, hop_bits)
         t0_ns = time.monotonic_ns()
         iv_o, data_o, info = execute_partitions(
             mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,

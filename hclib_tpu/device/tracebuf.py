@@ -71,6 +71,7 @@ __all__ = [
     "TR_CKPT",
     "TR_SCALE",
     "TR_TENANT",
+    "TR_FIRE_AGE",
     "SC_HOLD",
     "SC_OUT",
     "SC_IN",
@@ -112,6 +113,14 @@ TR_TENANT = 16         # a = (tenant_lane << 16) | rows installed this
                        # poll, b = rows dropped expired (the counted
                        # TenantExpired records) - emitted by the WRR
                        # tenant inject poll, device/inject.py
+TR_FIRE_AGE = 17       # a = (lane_fn << 16) | take, b = starved age at
+                       # fire - the FIRE REASON record: this batch round
+                       # jumped the ring-drain-first policy because the
+                       # lane's starved-round age reached lane_max_age
+                       # (megakernel.py firing site). Every TR_FIRE_AGE
+                       # is paired with the TR_FIRE_BATCH of the same
+                       # round; a ring-drained fire emits only the
+                       # latter, so the reason split is exact.
 
 # TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
 SC_HOLD = 0
@@ -150,6 +159,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_CKPT: "ckpt_export",
     TR_SCALE: "scale",
     TR_TENANT: "tenant",
+    TR_FIRE_AGE: "fire_age",
 }
 
 # TR_CREDIT delta codes (b word).

@@ -35,6 +35,7 @@ __all__ = [
     "device_uts_mk",
     "UTS_NODE",
     "batch_of",
+    "rmat_edges",
     "stencil_loop",
     "stencil_body",
     "stencil_reference",
@@ -449,6 +450,54 @@ def map_data(T: int, th: int = 8, tw: int = 128, seed: int = 0):
     rng = np.random.default_rng(seed)
     vin = rng.integers(0, 1 << 20, size=(T, th, tw), dtype=np.int32)
     return vin, np.zeros_like(vin)
+
+
+# ------------------------------------------------- R-MAT graph generator
+#
+# Seeded edge factory for the graph-analytics frontier tier
+# (device/frontier.py): the skewed, power-law-ish degree distribution of
+# the Graph500 R-MAT recursion is exactly the load shape ROADMAP
+# direction 5 wants - hub vertices whose expansion floods the ready ring
+# with same-kind EXPAND descriptors while the long tail trickles.
+
+
+def rmat_edges(
+    scale: int,
+    efactor: int = 8,
+    seed: int = 0,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    max_weight: int = 16,
+):
+    """Seeded R-MAT-style edge list over ``N = 2**scale`` vertices with
+    ``efactor * N`` samples (self-loops dropped, duplicates merged, so
+    the returned edge count is a bit lower). Returns ``(n, src, dst,
+    weights)`` int32 arrays - weights uniform in [1, max_weight], for
+    the SSSP arm. Pure function of the arguments (one seeded
+    Generator), so every bench/test arm rebuilds the identical graph."""
+    if scale < 1:
+        raise ValueError(f"rmat scale must be >= 1, got {scale}")
+    n = 1 << scale
+    ne = int(efactor) * n
+    rng = np.random.default_rng(seed)
+    src = np.zeros(ne, np.int64)
+    dst = np.zeros(ne, np.int64)
+    d = 1.0 - a - b - c
+    if d <= 0:
+        raise ValueError(f"rmat quadrants must leave d > 0, got {d}")
+    for _ in range(scale):
+        sb = rng.random(ne) >= (a + b)  # src bit: lower half vs upper
+        pd = np.where(sb, d / (c + d), b / (a + b))
+        db = rng.random(ne) < pd
+        src = (src << 1) | sb
+        dst = (dst << 1) | db
+    keep = src != dst
+    key = np.unique(src[keep] * n + dst[keep])
+    src = (key // n).astype(np.int32)
+    dst = (key % n).astype(np.int32)
+    w = rng.integers(1, max_weight + 1, size=len(src)).astype(np.int32)
+    return n, src, dst, w
 
 
 # --------------------------------------------------------------- arrayadd

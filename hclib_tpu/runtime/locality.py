@@ -37,6 +37,7 @@ __all__ = [
     "MeshPlacement",
     "resolve_placement",
     "steal_hop_order",
+    "xor_hop_order",
     "device_distance_matrix",
 ]
 
@@ -297,6 +298,44 @@ def steal_hop_order(
     return sorted(hops, key=lambda d: (mean[d], d))
 
 
+def xor_hop_order(
+    graph: Union[LocalityGraph, str], ndev: Optional[int] = None
+) -> List[int]:
+    """XOR-partner deltas for the resident mesh's paired hypercube
+    exchange (device/resident.py ``fold_and_steal``), ordered
+    NEAR-NEIGHBORS-FIRST by the machine graph: hop delta ``d`` pairs
+    device ``i`` with ``i ^ d``, so for each power-of-two delta the mean
+    ICI distance between every device and its XOR partner is computed
+    over the tpu reachability edges, and deltas sort ascending by that
+    mean (ties toward the smaller delta). Unlike ``steal_hop_order``
+    (the additive-ring scan, where any nonempty subset terminates), the
+    resident fold NEEDS every hypercube dimension each round - the
+    recursive-doubling sums and the XOR all-to-all are products of
+    commuting per-dimension exchanges - so the result is always a FULL
+    permutation of the deltas; only the order (which partner's steal
+    exchange runs while backlogs are freshest) changes."""
+    if isinstance(graph, str):
+        graph = load_locality_file(graph)
+    dist = device_distance_matrix(graph)
+    n = len(dist)
+    if ndev is None:
+        ndev = n
+    if ndev != n:
+        raise ValueError(
+            f"graph describes {n} tpu devices, mesh has {ndev}"
+        )
+    if ndev & (ndev - 1):
+        raise ValueError(
+            f"xor_hop_order wants a power-of-two roster (the resident "
+            f"mesh constraint), got {ndev} devices"
+        )
+    deltas = [1 << k for k in range(ndev.bit_length() - 1)]
+    mean = {
+        d: sum(dist[i][i ^ d] for i in range(ndev)) / ndev for d in deltas
+    }
+    return sorted(deltas, key=lambda d: (mean[d], d))
+
+
 class MeshPlacement:
     """Data-driven flat-tile -> device mapping for the forasync device
     tier: the device-side rendering of the reference's loop dist-funcs
@@ -469,6 +508,14 @@ class MeshPlacement:
         if self.graph is None:
             return None
         return steal_hop_order(self.graph, self.ndev) or None
+
+    def xor_hop_order(self) -> Optional[List[int]]:
+        """Graph-derived XOR-exchange order for the resident runner
+        (``ResidentKernel.run(hop_order=)``); None without a graph and
+        on a 1-device roster, like ``hop_order``."""
+        if self.graph is None:
+            return None
+        return xor_hop_order(self.graph, self.ndev) or None
 
 
 def resolve_placement(

@@ -163,6 +163,31 @@ class MetricsRegistry:
                 keep["lane_partial_age"] = [
                     float(a) for a in ages if a is not None
                 ]
+            # Device-side starved-age gauge (the ISSUE 10 age-triggered
+            # firing policy, tstats TS_MAX_AGE): worst consecutive
+            # starved-round count any lane reached, per device - the
+            # number the lane_max_age knob bounds. Exported beside the
+            # trace-derived lane_partial_age so a dashboard alert works
+            # on untraced runs too.
+            sages = [
+                t.get("max_starved_age")
+                for t in tiers
+                if isinstance(t, Mapping)
+            ]
+            if any(a is not None for a in sages):
+                keep["lane_max_starved_age"] = [
+                    float(a) for a in sages if a is not None
+                ]
+        # Edge-rate gauge (graph-analytics runs, device/frontier.py):
+        # a run info carrying traversed edges and a wall time exports
+        # traversed-edges/s directly - the TEPS headline as a metric.
+        if "edges" in keep and keep.get("elapsed_s"):
+            try:
+                keep["teps"] = float(keep["edges"]) / float(
+                    keep["elapsed_s"]
+                )
+            except (TypeError, ZeroDivisionError):
+                pass
         tenants = keep.get("tenants")
         if isinstance(tenants, Mapping):
             # Multi-tenant ingress: mirror the per-tenant admission

@@ -417,6 +417,102 @@ def _forasync_tile(quick: bool, trials: int) -> dict:
     }
 
 
+def _frontier_batch(quick: bool, trials: int) -> dict:
+    """frontier-batch guard (ISSUE 10), same-run arms: the SAME seeded
+    R-MAT BFS through (a) scalar dispatch - one EXPAND per lax.switch
+    round, the bit-identity reference - and (b) the batched frontier
+    tier (edge-slab prefetch + the age-triggered firing policy, on at
+    its frontier default lane_max_age = 4*width). Distances must be
+    bit-identical to each other AND the host reference, the batched arm
+    must hold a TEPS floor against the scalar arm measured in the same
+    run (--frontier-floor; interpret mode serializes the edge-slab DMAs
+    the lanes overlap on hardware, so the measured ratio is ~0.5x and
+    the floor prices 'never collapses'), and the batched arm's
+    lane_partial_age must stay under --frontier-age-ceiling with its
+    device-side max_starved_age bounded by the knob - the proof that
+    the new firing policy keeps the lanes from starving while the
+    frontier spawner keeps the ring hot."""
+    import numpy as np
+
+    from hclib_tpu.device.frontier import (
+        Graph, _KINDS, host_bfs, make_frontier_megakernel, run_frontier,
+    )
+    from hclib_tpu.device.workloads import rmat_edges
+
+    scale = 6 if quick else 8
+    width = 8
+    n, src, dst, w = rmat_edges(scale, efactor=8, seed=7)
+    g = Graph(n, src, dst, w)
+    cap = 768 if quick else 1024
+    # The TIMED batched arm is untraced (tracing taxes only the batched
+    # side: in-kernel TR_* emission + host ring decode - an unfair
+    # thumb on the ratio); one separate traced run below supplies the
+    # lane_partial_age / age-gauge readings.
+    mk_b = make_frontier_megakernel(
+        _KINDS["bfs"](), g, width=width, capacity=cap, interpret=True,
+    )
+    lane_max_age = mk_b.lane_max_age
+    mk_s = make_frontier_megakernel(
+        _KINDS["bfs"](), g, width=0, capacity=cap, interpret=True,
+    )
+    mk_tr = make_frontier_megakernel(
+        _KINDS["bfs"](), g, width=width, capacity=cap, interpret=True,
+        trace=4096,
+    )
+    ref = host_bfs(g, 0)
+
+    def run_arm(mk):
+        d, info = run_frontier("bfs", g, 0, mk=mk, interpret=True)
+        run_arm.info = info
+        return d
+
+    d_b = run_arm(mk_b)
+    d_tr = run_arm(mk_tr)
+    info_b = run_arm.info  # the traced run's gauges
+    d_s = run_arm(mk_s)
+    if not np.array_equal(d_tr, ref):
+        raise AssertionError(
+            "frontier-batch: traced arm diverged from the host reference"
+        )
+    if not (np.array_equal(d_b, ref) and np.array_equal(d_s, ref)):
+        raise AssertionError(
+            "frontier-batch: arms diverged (scalar/batched/host BFS "
+            "distances not bit-identical)"
+        )
+    n_tr = max(2, trials)
+    b_ns, s_ns = [], []
+    for _ in range(n_tr):
+        t0 = time.perf_counter_ns()
+        run_arm(mk_b)
+        b_ns.append(time.perf_counter_ns() - t0)
+        edges_b = run_arm.info["edges"]
+        t0 = time.perf_counter_ns()
+        run_arm(mk_s)
+        s_ns.append(time.perf_counter_ns() - t0)
+        edges_s = run_arm.info["edges"]
+    teps_b = edges_b / (min(b_ns) / 1e9)
+    teps_s = edges_s / (min(s_ns) / 1e9)
+    t = info_b["tiers"]
+    if t["max_starved_age"] > lane_max_age:
+        raise AssertionError(
+            f"frontier-batch: device starved age {t['max_starved_age']} "
+            f"exceeds lane_max_age {lane_max_age} - the age trigger "
+            "stopped bounding starvation"
+        )
+    return {
+        "edges": g.m,
+        "batched_teps": round(teps_b),
+        "scalar_teps": round(teps_s),
+        "batched_vs_scalar": teps_b / teps_s,
+        "occupancy": t["batch_occupancy"],
+        "age_fires": t["age_fires"],
+        "max_starved_age": t["max_starved_age"],
+        "lane_max_age": lane_max_age,
+        "lane_partial_age": t.get("lane_partial_age", 0),
+        "bit_identical": True,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -484,6 +580,21 @@ def main(argv=None) -> int:
                     "occupancy of the static tile set (near 1.0 by "
                     "construction; a drop means the tier stopped "
                     "batching the loop)")
+    ap.add_argument("--frontier-floor", type=float, default=0.25,
+                    help="frontier-batch guard: minimum batched-frontier "
+                    "TEPS as a fraction of the scalar-dispatch arm "
+                    "measured in the same run. Interpret mode SERIALIZES "
+                    "the edge-slab DMAs the lanes overlap on hardware "
+                    "(the PR 9 forasync finding), so the batched arm "
+                    "measures ~0.5x here while the dispatch win is a "
+                    "hardware number - the floor prices 'never "
+                    "collapses', not 'faster under the interpreter'")
+    ap.add_argument("--frontier-age-ceiling", type=float, default=8,
+                    help="frontier-batch guard: maximum lane_partial_age "
+                    "(consecutive-partial-fire streak, rounds) on the "
+                    "batched BFS arm - the age-triggered firing policy "
+                    "keeps it near zero; a climb means lanes are "
+                    "starving again")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -663,6 +774,41 @@ def main(argv=None) -> int:
                     "stopped batching"
                 )
                 line += "  OCC-REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "frontier-batch" in wanted:
+        try:
+            fb = _frontier_batch(args.quick, args.trials)
+        except Exception as e:
+            print(f"frontier-batch FAILED: {e}", file=sys.stderr)
+            failures.append(f"frontier-batch: failed ({e})")
+        else:
+            results["frontier-batch"] = fb
+            line = (
+                f"{'frontier-batch':15s} batched/scalar "
+                f"{fb['batched_vs_scalar']:5.2f}x "
+                f"({fb['batched_teps']:,} vs {fb['scalar_teps']:,} TEPS, "
+                f"occupancy {fb['occupancy']:.2f}, partial age "
+                f"{fb['lane_partial_age']}, {fb['age_fires']} age fires, "
+                f"starved age {fb['max_starved_age']}<="
+                f"{fb['lane_max_age']}, bit-identical)"
+            )
+            if fb["batched_vs_scalar"] < args.frontier_floor:
+                failures.append(
+                    f"frontier-batch: batched frontier is "
+                    f"{fb['batched_vs_scalar']:.2f}x the scalar arm "
+                    f"(floor {args.frontier_floor:.2f}x) - the frontier "
+                    "tier collapsed"
+                )
+                line += "  REGRESSED"
+            if fb["lane_partial_age"] > args.frontier_age_ceiling:
+                failures.append(
+                    f"frontier-batch: lane_partial_age "
+                    f"{fb['lane_partial_age']} over ceiling "
+                    f"{args.frontier_age_ceiling:.0f} - the firing "
+                    "policy stopped bounding lane starvation"
+                )
+                line += "  AGE-REGRESSED"
             print(line, flush=True)
 
     if args.device:

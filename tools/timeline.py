@@ -397,6 +397,13 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                 fid, take = a >> 16, a & 0xFFFF
                 span(_TID_LANES + fid, f"lane fn{fid}", t, 0.5,
                      f"batch x{take}", {"take": take, "prefetched": b})
+            elif tag == tb.TR_FIRE_AGE:
+                # Fire-reason record (lane_max_age): this round's batch
+                # jumped ring-drain-first; rendered on the lane's own
+                # track so starved-then-forced fires read at a glance.
+                fid, take = a >> 16, a & 0xFFFF
+                span(_TID_LANES + fid, f"lane fn{fid}", t, 0.25,
+                     f"age fire x{take}", {"take": take, "age": b})
             elif tag == tb.TR_PREFETCH_ISSUE:
                 span(_TID_LANES + a, f"lane fn{a}", t, 0.25,
                      "prefetch", {"count": b})
