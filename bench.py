@@ -50,7 +50,9 @@ _T0 = None
 
 
 def _budget_s() -> float:
-    return float(os.environ.get("HCLIB_TPU_BENCH_BUDGET_S", "780"))
+    from hclib_tpu.runtime.env import env_float
+
+    return env_float("HCLIB_TPU_BENCH_BUDGET_S", 780.0)
 
 
 def _remaining() -> float:

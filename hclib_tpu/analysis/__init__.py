@@ -1,0 +1,87 @@
+"""hclint: the build-time program verifier (host-only static analysis).
+
+The batch/prefetch/migration contracts this runtime leans on -
+"mutually independent by construction" batch slots, "output buffers
+disjointly written across tiles", "a prefetch body MUST issue exactly
+the starts the tier announces", "reshard moves link-free rows only" -
+live in docstrings and are otherwise discovered at runtime, or never
+(interpret mode can land the right bytes through a real slab race).
+This package checks them when a program is BUILT:
+
+- ``verify_megakernel(mk)`` - the construction-time entry
+  ``Megakernel(verify=True)`` / ``HCLIB_TPU_VERIFY`` (default-on under
+  pytest) runs: word-layout consistency, per-kind migratability
+  classification, and for every routed ``BatchSpec`` the slot-race and
+  prefetch-protocol conformance checks (recording-shim abstract
+  interpretation; see shim.py).
+- ``check_tile_windows(tk, bounds, tile)`` - whole-loop store-window
+  disjointness over a concrete tile space (``run_forasync_device``
+  calls it when verification is on).
+- ``check_migratable(mk, fns, runner)`` - the reshard-class rule the
+  multi-device runners apply to their ``migratable_fns`` claims.
+
+Everything is pure host composition over the already-built Python
+objects: no Mosaic, no Pallas trace, zero new device words - a build
+with ``verify=False`` (or unset, outside pytest) is byte-identical to a
+build that predates this package, and even with ``verify=True`` the
+compiled program is untouched (the verifier can only *raise*).
+
+Findings carry concrete witnesses (colliding tile coordinates, the
+unmatched DMA start, the disagreeing layout word, the mislabeled kernel
+id); error findings raise ``AnalysisError`` at construction unless
+suppressed (``verify_suppress=("rule",)`` or ``("rule:kernel",)``).
+``tools/hclint.py`` drives the same checks over the repo's program
+builders from the command line / CI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .classify import check_migratable, classify_megakernel, trace_class
+from .findings import (
+    AnalysisError, AnalysisFinding, AnalysisReport, verify_default,
+)
+from .layout import check_layout
+from .races import boxes_overlap, check_batch_spec, check_tile_windows
+from .shim import ShimUnsupported
+
+__all__ = [
+    "AnalysisError",
+    "AnalysisFinding",
+    "AnalysisReport",
+    "ShimUnsupported",
+    "boxes_overlap",
+    "check_batch_spec",
+    "check_layout",
+    "check_migratable",
+    "check_tile_windows",
+    "classify_megakernel",
+    "trace_class",
+    "verify_default",
+    "verify_megakernel",
+]
+
+
+def verify_megakernel(mk, suppress: Sequence[str] = (),
+                      raise_on_error: bool = True,
+                      report: Optional[AnalysisReport] = None
+                      ) -> AnalysisReport:
+    """Run every construction-time analysis over a built ``Megakernel``;
+    returns the report (and raises ``AnalysisError`` on unsuppressed
+    error findings unless ``raise_on_error=False``)."""
+    report = report or AnalysisReport(suppress)
+    report.extend(check_layout())
+    for fid, spec in mk.batch_specs:
+        name = mk.kernel_names[fid]
+        check_batch_spec(
+            name, fid, spec, mk.data_specs, mk.scratch_specs,
+            report=report,
+        )
+    # Kind classification is LAZY (classify_megakernel memoizes on the
+    # instance): its consumers are describe(), snapshot meta, and
+    # reshard's upfront diagnostics, none of which every construction
+    # pays for - the tier-1 budget is the binding constraint.
+    if raise_on_error:
+        report.raise_errors()
+    return report

@@ -1,0 +1,153 @@
+"""Reshard/migratability classification of kernel-table kinds.
+
+``checkpoint.reshard`` moves only *link-free* rows (no successor links,
+no home-link, no dynamic out slot), and ``ShardedMegakernel``'s
+``migratable_fns`` contract requires migratable kernels to only read
+their args and write accumulate-style slots. Whether a KIND can satisfy
+those contracts is decidable at build time: run the kernel body once
+through the recording shim and look at what it *does* -
+
+- ``link-free``: no dynamic spawns with links, no continuation
+  transfer; rows of this kind stay link-free unless the host built
+  links into them.
+- ``home-linked``: the body spawns successor-linked children or
+  transfers its continuation (the fib/UTS family) - live rows of this
+  kind carry links, so they migrate only through the resident
+  home-link protocol and are never reshard-eligible.
+- ``vector``: a subtree-tier routed kind (completes in place).
+- ``unknown``: the shim could not interpret the body; no claim.
+
+``classify_megakernel`` returns {kernel name: class} and is surfaced
+through ``Megakernel.describe()``; ``check_migratable`` is the
+``reshard-class`` rule - a kind claimed migratable by a runner whose
+classification says ``home-linked`` is a mislabel caught before any row
+ever migrates wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ..device.descriptor import NO_TASK
+from .findings import ERROR, WARN, AnalysisReport
+from .shim import ShimUnsupported, run_batch_body, run_scalar_kernel
+
+__all__ = [
+    "classify_megakernel",
+    "check_migratable",
+    "trace_class",
+]
+
+LINK_FREE = "link-free"
+HOME_LINKED = "home-linked"
+VECTOR = "vector"
+UNKNOWN = "unknown"
+
+# Scalar kernel fns are usually module-level functions shared across
+# every construction in a process (the suite builds the same families
+# hundreds of times) - classification depends only on what the body
+# DOES, so memoize per function object. Weak keys: a dynamically
+# created closure's entry dies with it.
+import weakref  # noqa: E402
+
+_scalar_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def trace_class(trace) -> str:
+    """Classification verdict of one recorded body trace."""
+    if trace.continuations:
+        return HOME_LINKED
+    for _slot, sp in trace.spawns:
+        if (
+            sp["succ0"] != NO_TASK or sp["succ1"] != NO_TASK
+            or sp["dep_count"] != 0
+        ):
+            return HOME_LINKED
+    return LINK_FREE
+
+
+def classify_megakernel(mk) -> Dict[str, str]:
+    """{kernel name: class} for every kernel-table entry of ``mk``
+    (memoized on the instance - construction and every later
+    describe()/snapshot call share one shim pass)."""
+    cached = getattr(mk, "_kind_classes", None)
+    if cached is not None:
+        return cached
+    from ..device.megakernel import _is_batch_spec, _is_vector_spec
+
+    out: Dict[str, str] = {}
+    batch_bodies = {name: spec for name, spec in mk.route.items()
+                    if _is_batch_spec(spec)}
+    for i, name in enumerate(mk.kernel_names):
+        if (name in mk.route and _is_vector_spec(mk.route[name])) or (
+            getattr(mk.kernel_fns[i], "_hclib_vector_wrapped", False)
+        ):
+            out[name] = VECTOR
+            continue
+        try:
+            if name in batch_bodies:
+                t = run_batch_body(
+                    batch_bodies[name], i, mk.data_specs,
+                    mk.scratch_specs, prefetch_count=0,
+                )
+                out[name] = trace_class(t)
+            else:
+                fn = mk.kernel_fns[i]
+                try:
+                    cached = _scalar_cache.get(fn)
+                except TypeError:
+                    cached = None
+                if cached is not None:
+                    out[name] = cached
+                else:
+                    t = run_scalar_kernel(
+                        fn, mk.data_specs, mk.scratch_specs,
+                    )
+                    out[name] = trace_class(t)
+                    try:
+                        _scalar_cache[fn] = out[name]
+                    except TypeError:
+                        pass
+        except ShimUnsupported:
+            out[name] = UNKNOWN
+    mk._kind_classes = out
+    return out
+
+
+def check_migratable(mk, migratable_fns, runner: str,
+                     report: Optional[AnalysisReport] = None,
+                     suppress: Sequence[str] = (),
+                     homed: bool = False) -> AnalysisReport:
+    """The ``reshard-class`` audit: report every kernel id a runner
+    claims migratable whose body classifies home-linked. NOT a runtime
+    refusal - the exchanges carry row-level link filters, so such a
+    claim legally moves just the kind's link-free rows - but it IS the
+    signal that ``checkpoint.reshard`` will refuse bundles holding this
+    kind's linked residue, which is what the warn spells out. hclint
+    runs this over every in-repo mesh program; ``homed=True`` runners
+    carry linked rows through the proxy protocol and are exempt."""
+    report = report or AnalysisReport(suppress)
+    if homed:
+        return report
+    classes = classify_megakernel(mk)
+    for f in sorted(int(f) for f in migratable_fns):
+        if not 0 <= f < len(mk.kernel_names):
+            report.add(
+                "reshard-class", ERROR, None,
+                f"{runner} lists migratable kernel id {f} but the "
+                f"kernel table has {len(mk.kernel_names)} entries",
+                fn_id=f,
+            )
+            continue
+        name = mk.kernel_names[f]
+        if classes.get(name) == HOME_LINKED:
+            report.add(
+                "reshard-class", WARN, name,
+                f"{runner} lists {name!r} (id {f}) as migratable, but "
+                "its body spawns successor-linked children "
+                "(home-linked): only its link-free rows will move "
+                "under the exchange's row filter, and reshard will "
+                "refuse checkpoints holding its linked residue",
+                fn_id=f, classification=HOME_LINKED,
+            )
+    return report

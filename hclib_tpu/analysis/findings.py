@@ -1,0 +1,153 @@
+"""Typed findings + report plumbing for the build-time verifier.
+
+A finding is a single rule violation with a concrete *witness* - the two
+colliding store windows, the unmatched DMA start, the disagreeing layout
+word - so a report reads like a failing assertion, not a style nag.
+
+Rule ids (stable; the suppression syntax and README table key on them):
+
+    batch-race        two batch slots write overlapping data (store
+                      windows or value slots) in one round
+    tile-race         two tiles of one forasync loop store overlapping
+                      windows of an output buffer
+    prefetch-protocol a prefetch body/drain pair violates the tier's
+                      DMA handshake (unmatched start or wait, overreach)
+    layout            a shared word-layout constant disagrees between
+                      modules
+    reshard-class     a kernel kind's migratability claim contradicts
+                      its classified behavior (home-linked mislabeled
+                      migratable)
+    shim-unsupported  a body could not be abstractly interpreted
+                      (info only: nothing verified, nothing refuted)
+
+Severities: ``error`` findings make construction raise
+``AnalysisError`` (unless suppressed); ``warn`` and ``info`` ride the
+report only. Suppression: ``"<rule>"`` silences a rule everywhere in
+that kernel's verification, ``"<rule>:<kernel-name>"`` only for the
+named kernel-table entry; suppressed findings stay in the report with
+``suppressed=True`` so hclint can still show them.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..runtime.env import env_raw
+
+__all__ = [
+    "AnalysisError",
+    "AnalysisFinding",
+    "AnalysisReport",
+    "verify_default",
+]
+
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+
+
+@dataclass
+class AnalysisFinding:
+    rule: str
+    severity: str
+    kernel: Optional[str]      # kernel-table entry name, when attributable
+    message: str
+    witness: Dict[str, Any] = field(default_factory=dict)
+    suppressed: bool = False
+
+    def __str__(self) -> str:
+        k = f" [{self.kernel}]" if self.kernel else ""
+        w = f" witness={self.witness}" if self.witness else ""
+        s = " (suppressed)" if self.suppressed else ""
+        return f"{self.severity}: {self.rule}{k}: {self.message}{w}{s}"
+
+
+class AnalysisError(ValueError):
+    """Raised at construction when unsuppressed error-severity findings
+    exist; carries the full report."""
+
+    def __init__(self, report: "AnalysisReport") -> None:
+        self.report = report
+        errs = report.errors()
+        lines = "\n  ".join(str(f) for f in errs)
+        super().__init__(
+            f"hclint: {len(errs)} build-time verification failure(s):\n"
+            f"  {lines}\n(suppress a deliberate violation with "
+            "verify_suppress=('<rule>' or '<rule>:<kernel>',); "
+            "disable verification with verify=False / HCLIB_TPU_VERIFY=0)"
+        )
+
+
+class AnalysisReport:
+    """Findings accumulator with suppression applied at add() time."""
+
+    def __init__(self, suppress: Sequence[str] = ()) -> None:
+        self.findings: List[AnalysisFinding] = []
+        self._suppress = tuple(suppress or ())
+        # Kind classification (classify.py fills this): name -> class.
+        self.kind_classes: Dict[str, str] = {}
+
+    def suppressed(self, rule: str, kernel: Optional[str]) -> bool:
+        for s in self._suppress:
+            if s == rule:
+                return True
+            if kernel is not None and s == f"{rule}:{kernel}":
+                return True
+        return False
+
+    def add(self, rule: str, severity: str, kernel: Optional[str],
+            message: str, **witness) -> AnalysisFinding:
+        f = AnalysisFinding(
+            rule, severity, kernel, message, witness,
+            suppressed=self.suppressed(rule, kernel),
+        )
+        self.findings.append(f)
+        return f
+
+    def extend(self, other: "AnalysisReport") -> None:
+        self.findings.extend(other.findings)
+        self.kind_classes.update(other.kind_classes)
+
+    def errors(self) -> List[AnalysisFinding]:
+        return [
+            f for f in self.findings
+            if f.severity == ERROR and not f.suppressed
+        ]
+
+    def actionable(self) -> List[AnalysisFinding]:
+        """What hclint's exit code counts: anything above info that was
+        not deliberately suppressed."""
+        return [
+            f for f in self.findings
+            if f.severity in (ERROR, WARN) and not f.suppressed
+        ]
+
+    def raise_errors(self) -> None:
+        if self.errors():
+            raise AnalysisError(self)
+
+    def to_jsonable(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "rule": f.rule, "severity": f.severity,
+                "kernel": f.kernel, "message": f.message,
+                "witness": {k: repr(v) for k, v in f.witness.items()},
+                "suppressed": f.suppressed,
+            }
+            for f in self.findings
+        ]
+
+
+def verify_default() -> bool:
+    """The ``verify=None`` resolution: HCLIB_TPU_VERIFY wins when set
+    ('0' forces off, anything else on); otherwise default ON under
+    pytest (the suite is where the contracts are exercised; production
+    builds opt in) and off everywhere else."""
+    v = env_raw("HCLIB_TPU_VERIFY")
+    if v is not None and v != "":
+        return v != "0"
+    import os
+
+    return "PYTEST_CURRENT_TEST" in os.environ or "pytest" in sys.modules

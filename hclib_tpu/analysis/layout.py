@@ -1,0 +1,127 @@
+"""Word-layout consistency: ONE table, cross-checked against every
+module that hard-codes part of the shared device ABI.
+
+The descriptor ABI (descriptor.py), the ring-row transport words
+(tenants.py / inject.py / resident.py), the batch-tier counter rows
+(megakernel.py), and the checkpoint export key set (checkpoint.py) all
+agree on word positions only by convention; this table is the
+convention, and ``check_layout`` is the build-time assertion that no
+module drifted. The witness of a violation is the word's name plus the
+two disagreeing values - the exact edit to make.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .findings import ERROR, AnalysisReport
+
+__all__ = ["LAYOUT", "check_layout"]
+
+# word name -> (expected value, module paths that must agree). A module
+# listed here must expose the attribute with exactly this value.
+LAYOUT = {
+    # descriptor ABI (device/descriptor.py)
+    "DESC_WORDS": (16, ("hclib_tpu.device.descriptor",
+                        "hclib_tpu.runtime.checkpoint")),
+    "NO_TASK": (-1, ("hclib_tpu.device.descriptor",)),
+    "F_FN": (0, ("hclib_tpu.device.descriptor",)),
+    "F_DEP": (1, ("hclib_tpu.device.descriptor",)),
+    "F_SUCC0": (2, ("hclib_tpu.device.descriptor",)),
+    "F_SUCC1": (3, ("hclib_tpu.device.descriptor",)),
+    "F_CSR_OFF": (4, ("hclib_tpu.device.descriptor",)),
+    "F_CSR_N": (5, ("hclib_tpu.device.descriptor",)),
+    "F_A0": (6, ("hclib_tpu.device.descriptor",)),
+    "F_OUT": (12, ("hclib_tpu.device.descriptor",)),
+    "F_HOME": (13, ("hclib_tpu.device.descriptor",)),
+    "F_HROW": (14, ("hclib_tpu.device.descriptor",)),
+    "F_VMASK": (15, ("hclib_tpu.device.descriptor",)),
+    # injection-ring transport words: every module that stamps or reads
+    # them must share the descriptor-side canonical home.
+    "RING_ROW": (256, ("hclib_tpu.device.descriptor",
+                       "hclib_tpu.device.inject",
+                       "hclib_tpu.device.resident")),
+    "TEN_ID": (16, ("hclib_tpu.device.descriptor",)),
+    "TEN_EXPIRED": (17, ("hclib_tpu.device.descriptor",)),
+    # batch-tier counter/state rows (device/megakernel.py)
+    "TS_WORDS": (10, ("hclib_tpu.device.megakernel",)),
+    "LS_WORDS": (8, ("hclib_tpu.device.megakernel",)),
+    "LS_AGE": (5, ("hclib_tpu.device.megakernel",)),
+    "QC_FLAG": (0, ("hclib_tpu.device.megakernel",)),
+    "QC_AFTER": (1, ("hclib_tpu.device.megakernel",)),
+    "C_EXECUTED": (5, ("hclib_tpu.device.megakernel",)),
+    "C_ROUNDS": (7, ("hclib_tpu.device.megakernel",)),
+}
+
+# checkpoint.py's export key sets: resharding and restore key on these
+# literal names riding the bundle npz.
+_CKPT_STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
+_CKPT_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats")
+
+_cache: Optional[AnalysisReport] = None
+
+
+def check_layout(report: Optional[AnalysisReport] = None,
+                 suppress: Sequence[str] = (),
+                 force: bool = False) -> AnalysisReport:
+    """Cross-check LAYOUT against the live modules (memoized: the
+    constants cannot change within a process, so every megakernel
+    construction after the first reuses the verdict)."""
+    global _cache
+    if _cache is not None and not force and report is None and not suppress:
+        return _cache
+    import importlib
+
+    report = report or AnalysisReport(suppress)
+    rows: List[Tuple[str, str, int, int]] = []
+    for word, (expected, modules) in LAYOUT.items():
+        for modname in modules:
+            mod = importlib.import_module(modname)
+            actual = getattr(mod, word, None)
+            if actual != expected:
+                rows.append((word, modname, expected, actual))
+    for word, modname, expected, actual in rows:
+        report.add(
+            "layout", ERROR, None,
+            f"layout word {word} disagrees: table says {expected}, "
+            f"{modname} has {actual}",
+            word=word, module=modname, expected=expected, actual=actual,
+        )
+    # Structural invariants that no single constant captures.
+    from ..device import descriptor as d
+    from ..device import megakernel as m
+
+    if not (d.DESC_WORDS <= d.TEN_ID < d.TEN_EXPIRED < d.RING_ROW):
+        report.add(
+            "layout", ERROR, None,
+            "ring-row transport words must sit beyond the descriptor "
+            f"ABI and inside the padded row: DESC_WORDS={d.DESC_WORDS} "
+            f"<= TEN_ID={d.TEN_ID} < TEN_EXPIRED={d.TEN_EXPIRED} < "
+            f"RING_ROW={d.RING_ROW} violated",
+            word="TEN_ID",
+        )
+    if not (m.LS_AGE < m.LS_WORDS and m.TS_MAX_AGE < m.TS_WORDS):
+        report.add(
+            "layout", ERROR, None,
+            "lane/tier state words exceed their declared row widths",
+            word="LS_WORDS",
+        )
+    from ..runtime import checkpoint as c
+
+    if tuple(c._STATE_KEYS) != _CKPT_STATE_KEYS:
+        report.add(
+            "layout", ERROR, None,
+            f"checkpoint state keys drifted: {c._STATE_KEYS} != "
+            f"{_CKPT_STATE_KEYS}",
+            word="_STATE_KEYS", actual=tuple(c._STATE_KEYS),
+        )
+    if tuple(c._OPT_KEYS) != _CKPT_OPT_KEYS:
+        report.add(
+            "layout", ERROR, None,
+            f"checkpoint optional keys drifted: {c._OPT_KEYS} != "
+            f"{_CKPT_OPT_KEYS}",
+            word="_OPT_KEYS", actual=tuple(c._OPT_KEYS),
+        )
+    if report.findings == [] and not suppress:
+        _cache = report
+    return report

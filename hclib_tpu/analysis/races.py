@@ -1,0 +1,362 @@
+"""Batch-slot race detection + prefetch-protocol conformance.
+
+Two spellings, matching how the kernels declare themselves:
+
+- **Slab-declared kernels** (``TileKernel``): the store windows are pure
+  Python ``index(args)`` callables, so ``check_tile_windows`` evaluates
+  them CONCRETELY over the whole tile space and proves pairwise
+  disjointness - the witness of a violation is the two colliding tile
+  coordinates and their windows. This is the strong, whole-loop result
+  (any two ready tiles can share a batch round).
+
+- **Raw batch bodies** (any ``BatchSpec``): ``check_batch_spec``
+  abstract-interprets the body once with the recording shim over a
+  slot-distinct synthetic batch and checks (a) per-slot DMA store
+  windows into data buffers are pairwise disjoint, (b) per-slot value
+  writes hit disjoint slots, (c) every DMA wait matches a start, (d)
+  with a prefetch announced, the residual (unwaited) starts are EXACTLY
+  what ``drain`` retires. A body the shim cannot run yields one
+  ``shim-unsupported`` info finding instead of false alarms.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .findings import ERROR, INFO, WARN, AnalysisReport
+from .shim import (
+    BodyTrace, ShimUnsupported, run_batch_body, run_drain,
+)
+
+__all__ = [
+    "boxes_overlap",
+    "check_batch_spec",
+    "check_tile_windows",
+]
+
+
+def boxes_overlap(a, b) -> bool:
+    """Axis-aligned boxes ((start, stop) per axis) intersect; shorter
+    box = full range on the missing trailing axes."""
+    n = max(len(a), len(b))
+    for i in range(n):
+        lo_a, hi_a = a[i] if i < len(a) else (0, 1 << 62)
+        lo_b, hi_b = b[i] if i < len(b) else (0, 1 << 62)
+        if hi_a <= lo_b or hi_b <= lo_a:
+            return False
+    return True
+
+
+# ------------------------------------------------------- tile windows
+
+
+import weakref
+
+# Clean verdicts memoized per (TileKernel instance, bounds, tile):
+# run_forasync_device re-proves on every call otherwise (repeated bench
+# / mesh runs over one kernel), and the proof is O(tiles x stores)
+# Python. Only CLEAN results cache - a violation raises at the caller
+# and re-deriving its witness is the cheap path.
+_tile_clean: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def check_tile_windows(tk, bounds, tile,
+                       report: Optional[AnalysisReport] = None,
+                       suppress: Sequence[str] = ()) -> AnalysisReport:
+    """Prove every pair of tiles of one forasync loop stores disjoint
+    windows (per store slab/buffer) by concrete evaluation over the
+    whole tile space. Witness: the two colliding tile coordinates."""
+    from ..device.forasync_tier import tile_args, tile_grid
+
+    report = report or AnalysisReport(suppress)
+    key = (repr(tuple(bounds)), repr(tuple(tile) if not isinstance(
+        tile, int) else (tile,)))
+    try:
+        if key in _tile_clean.get(tk, ()):
+            return report
+    except TypeError:
+        pass
+    dims, tile_dims, counts, total = tile_grid(bounds, tile)
+    # buffer -> list of (box, flat, los)
+    per_buffer: Dict[str, List[Tuple[Any, int, Tuple[int, ...]]]] = {}
+    from .shim import _norm_box
+
+    for flat in range(total):
+        args = tile_args(dims, tile_dims, counts, flat)
+        for s in tk.stores:
+            try:
+                idx = s.index(tuple(args))
+            except Exception as e:  # noqa: BLE001
+                report.add(
+                    "shim-unsupported", INFO, tk.name,
+                    f"store slab {s.name!r} index not concretely "
+                    f"evaluable: {e}",
+                )
+                return report
+            shape = tuple(tk.data_specs[s.data].shape)
+            box = _norm_box(shape, idx)
+            per_buffer.setdefault(s.data, []).append(
+                (box, flat, tuple(args[1:1 + len(dims)]))
+            )
+    for buf, wins in per_buffer.items():
+        # Sweep in first-axis order so disjoint layouts exit near-linearly.
+        wins.sort(key=lambda w: w[0][0] if w[0] else (0, 0))
+        active: List[Tuple[Any, int, Tuple[int, ...]]] = []
+        for box, flat, los in wins:
+            lo0 = box[0][0] if box else 0
+            active = [w for w in active if (w[0][0][1] if w[0] else 1 << 62)
+                      > lo0]
+            for obox, oflat, olos in active:
+                if boxes_overlap(box, obox):
+                    report.add(
+                        "tile-race", ERROR, tk.name,
+                        f"tiles {olos} and {los} store overlapping "
+                        f"windows of buffer {buf!r}",
+                        buffer=buf, tile_a=olos, tile_b=los,
+                        window_a=obox, window_b=box,
+                        flat_a=oflat, flat_b=flat,
+                    )
+                    return report  # one witness is enough
+            active.append((box, flat, los))
+    try:
+        _tile_clean.setdefault(tk, set()).add(key)
+    except TypeError:
+        pass
+    return report
+
+
+# -------------------------------------------------------- batch bodies
+
+
+def _slot_of_box(box, width: int) -> Optional[int]:
+    """Best-effort slot attribution of a window: which synthetic slot's
+    arg stride the first nonzero start coordinate falls under."""
+    from .shim import ARG_STRIDE
+
+    for lo, _hi in box:
+        if lo >= ARG_STRIDE:
+            s = lo // ARG_STRIDE - 1
+            return s if 0 <= s < width else None
+    return None
+
+
+def check_batch_spec(name: str, fid: int, spec, data_specs, scratch_specs,
+                     report: Optional[AnalysisReport] = None,
+                     suppress: Sequence[str] = (),
+                     ctx_hook=None) -> AnalysisReport:
+    """Run the four shim-based checks over one routed BatchSpec (see
+    module docstring). ``suppress`` composes with the spec's own
+    ``verify_suppress`` annotation (a per-rule opt-out the spec owner
+    writes next to the deliberate violation)."""
+    sup = tuple(suppress) + tuple(getattr(spec, "verify_suppress", ()))
+    if report is not None:
+        sup = sup + tuple(report._suppress)
+        sub = AnalysisReport(sup)
+    else:
+        report = sub = AnalysisReport(sup)
+    try:
+        t = run_batch_body(
+            spec, fid, data_specs, scratch_specs,
+            prefetch_count=0, ctx_hook=ctx_hook,
+        )
+    except ShimUnsupported as e:
+        sub.add(
+            "shim-unsupported", INFO, name,
+            f"batch body not abstractly interpretable ({e}); "
+            "slot-race and prefetch-protocol checks skipped",
+        )
+    else:
+        _check_round_trace(name, spec, t, sub)
+        if spec.prefetch:
+            _check_prefetch(name, fid, spec, data_specs, scratch_specs,
+                            sub)
+    if sub is not report:
+        report.extend(sub)
+    return report
+
+
+def _check_round_trace(name: str, spec, t: BodyTrace,
+                       report: AnalysisReport) -> None:
+    # (c) wait/start matching within a round with nothing announced.
+    # A trace with truncated / arg-bounded loops is an UNDER-
+    # approximation: an apparently unmatched start may be waited inside
+    # the iterations the shim skipped (the cholesky pipelined row
+    # stream), so mismatches demote to one info note instead of lying.
+    uw, us = t.unmatched_waits(), t.unmatched_starts()
+    if t.approx_loops and (uw or us):
+        report.add(
+            "shim-unsupported", INFO, name,
+            f"{t.approx_loops} loop(s) ran truncated (arg-dependent "
+            f"bounds); {len(us)} start(s)/{len(uw)} wait(s) left "
+            "unmatched in the partial trace - DMA protocol not "
+            "verifiable for this body",
+        )
+        uw, us = [], []
+    for w in uw:
+        report.add(
+            "prefetch-protocol", ERROR, name,
+            f"DMA wait with no matching start: {w.src[0]} -> "
+            f"{w.dst[0]}{list(w.dst[1])}",
+            dst=w.dst, sem=w.sem,
+        )
+    for s in us:
+        report.add(
+            "prefetch-protocol", ERROR, name,
+            "DMA start never waited in a round with no prefetch "
+            f"announced (it would outlive the batch's completions): "
+            f"{s.src[0]} -> {s.dst[0]}{list(s.dst[1])}",
+            dst=s.dst, sem=s.sem,
+        )
+    # (a) per-slot store windows into data buffers pairwise disjoint.
+    stores = [e for e in t.starts() if e.dst_kind == "data"]
+    for a, b in itertools.combinations(stores, 2):
+        if a.dst[0] != b.dst[0]:
+            continue
+        if boxes_overlap(a.dst[1], b.dst[1]):
+            sa = _slot_of_box(a.dst[1], spec.width)
+            sb = _slot_of_box(b.dst[1], spec.width)
+            if sa is not None and sa == sb:
+                continue  # one slot touching its own window twice
+            report.add(
+                "batch-race", ERROR, name,
+                f"two batch slots store overlapping windows of "
+                f"{a.dst[0]!r} "
+                f"(slots {sa} and {sb}: the slab index ignores the "
+                "slot's descriptor)",
+                buffer=a.dst[0], window_a=a.dst[1], window_b=b.dst[1],
+                slot_a=sa, slot_b=sb,
+            )
+            return
+    # (b) per-slot value-slot writes disjoint. A BLIND overwrite of a
+    # slot another batch slot already wrote is the copy-paste bug (the
+    # second writer's result is independent of the first, so one slot's
+    # output is silently lost); a read-modify-write chain (the slot
+    # READ the value after the earlier write, before its own) is the
+    # legitimate sequential-accumulator pattern - batch bodies run
+    # their slots in order, so in-SMEM accumulation is well-defined.
+    last_write: Dict[int, Tuple[int, int]] = {}  # vs -> (slot, seq)
+    for slot, vs, seq in sorted(t.value_writes, key=lambda w: w[2]):
+        if slot is None:
+            last_write[vs] = (-1, seq)
+            continue
+        prev = last_write.get(vs)
+        if prev is not None and prev[0] not in (slot, -1):
+            read_between = any(
+                rvs == vs and rslot in (slot, None)
+                and prev[1] < rseq < seq
+                for rslot, rvs, rseq in t.value_reads
+            )
+            if not read_between:
+                report.add(
+                    "batch-race", ERROR, name,
+                    f"batch slots {prev[0]} and {slot} both write value "
+                    f"slot {vs}, and slot {slot} never read it first "
+                    "(blind overwrite: one slot's output is lost)",
+                    value_slot=vs, slot_a=prev[0], slot_b=slot,
+                )
+                return
+        last_write[vs] = (slot, seq)
+    # Overreach: next-batch reads beyond the announced count (announced
+    # 0 here, so ANY next read is unguarded).
+    for s, pfc in t.next_reads:
+        report.add(
+            "prefetch-protocol", WARN, name,
+            f"reads prospective next-batch slot {s} with only {pfc} "
+            "announced (guard next_arg/next_idx with "
+            "pl.when(s < ctx.prefetch_count))",
+            slot=s, announced=pfc,
+        )
+        break
+
+
+def _check_prefetch(name: str, fid: int, spec, data_specs, scratch_specs,
+                    report: AnalysisReport) -> None:
+    """(d): announce a prefetch of k, collect the body's residual
+    starts, and require drain() to retire exactly those."""
+    k = min(2, spec.width)
+    try:
+        tb = run_batch_body(
+            spec, fid, data_specs, scratch_specs, prefetch_count=k,
+        )
+    except ShimUnsupported as e:
+        report.add(
+            "shim-unsupported", INFO, name,
+            f"prefetch pass not interpretable ({e})",
+        )
+        return
+    residual = tb.unmatched_starts()
+    if not residual:
+        if not tb.dma:
+            # A compute-only body that opted into prefetch pops (FIFO
+            # lane order) without any operand DMA: the protocol is
+            # vacuously satisfied - nothing to issue, nothing to drain.
+            pass
+        elif tb.approx_loops:
+            report.add(
+                "shim-unsupported", INFO, name,
+                "prefetch pass ran with truncated arg-dependent loops "
+                "and left no residual starts; start-count conformance "
+                "not verifiable",
+            )
+        else:
+            report.add(
+                "prefetch-protocol", ERROR, name,
+                f"the tier announced a prefetch of {k} next-batch "
+                "descriptors but the body issued no residual DMA starts "
+                "(a prefetch body MUST issue exactly the starts the tier "
+                "announces)",
+                announced=k,
+            )
+        return
+    # Which operand half did the prefetch target? The scheduler records
+    # LS_PF_BUF = 1 - buf; the shim ran the body with buf=0.
+    try:
+        td = run_drain(
+            spec, fid, data_specs, scratch_specs, prefetched=k, buf=1,
+        )
+    except ShimUnsupported as e:
+        report.add(
+            "shim-unsupported", INFO, name,
+            f"drain not interpretable ({e})",
+        )
+        return
+    approx = bool(tb.approx_loops or td.approx_loops)
+    open_ = [s.triple() for s in residual]
+    for w in td.dma:
+        if w.op != "wait":
+            continue
+        if w.triple() in open_:
+            open_.remove(w.triple())
+        elif approx:
+            report.add(
+                "shim-unsupported", INFO, name,
+                "drain/body DMA sets disagree under truncated "
+                "arg-dependent loops; conformance not verifiable",
+            )
+            return
+        else:
+            report.add(
+                "prefetch-protocol", ERROR, name,
+                "drain waits a copy the body never started "
+                f"(start-count mismatch): {w.src[0]} -> "
+                f"{w.dst[0]}{list(w.dst[1])}",
+                dst=w.dst, sem=w.sem, announced=k,
+            )
+            return
+    for s in open_:
+        if approx:
+            report.add(
+                "shim-unsupported", INFO, name,
+                "residual prefetch start not drained under truncated "
+                "arg-dependent loops; conformance not verifiable",
+            )
+            return
+        report.add(
+            "prefetch-protocol", ERROR, name,
+            "prefetch DMA start never drained (the scheduler's exit "
+            f"path would leave it in flight): {s[0][0]} -> "
+            f"{s[1][0]}{list(s[1][1])}",
+            src=s[0], dst=s[1], sem=s[2], announced=k,
+        )
+        return

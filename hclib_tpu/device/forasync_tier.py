@@ -53,7 +53,6 @@ Device-path constraints (both explicit ``ValueError``\\ s):
 from __future__ import annotations
 
 import math
-import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -62,6 +61,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime.env import env_int
 from ..runtime.locality import MeshPlacement, resolve_placement
 from .descriptor import TaskGraphBuilder
 from .megakernel import BatchSpec, Megakernel, _batch_stub
@@ -408,6 +408,7 @@ def make_forasync_megakernel(
     trace=None,
     checkpoint: Optional[bool] = None,
     quiesce_stride: Optional[int] = None,
+    verify: Optional[bool] = None,
 ) -> Megakernel:
     """Build the loop's megakernel. ``width=0`` is the scalar-dispatch
     arm (one tile per ``lax.switch`` round - the bit-identity reference);
@@ -439,20 +440,24 @@ def make_forasync_megakernel(
         trace=trace,
         checkpoint=checkpoint,
         quiesce_stride=quiesce_stride,
+        verify=verify,
     )
+
+
+def _verify_default() -> bool:
+    from ..analysis.findings import verify_default
+
+    return verify_default()
 
 
 def _default_width() -> int:
     """Batch width when the caller leaves it unset: 8, overridable
     process-wide with HCLIB_TPU_FORASYNC_WIDTH (>= 1; malformed values
     raise - a typo must not silently change the dispatch tier)."""
-    env = os.environ.get("HCLIB_TPU_FORASYNC_WIDTH", "")
-    if not env:
-        return 8
-    w = int(env)
+    w = env_int("HCLIB_TPU_FORASYNC_WIDTH", 8)
     if w < 1:
         raise ValueError(
-            f"HCLIB_TPU_FORASYNC_WIDTH must be >= 1, got {env!r}"
+            f"HCLIB_TPU_FORASYNC_WIDTH must be >= 1, got {w!r}"
         )
     return w
 
@@ -491,6 +496,20 @@ def run_forasync_device(
     w = _default_width() if width is None else int(width)
     dims, tile_dims, tcounts, total = tile_grid(bounds, tile)
     cap = capacity or max(64, total + 8)
+    if mk is not None and getattr(mk, "verify", False) or (
+        mk is None and _verify_default()
+    ):
+        # Whole-loop store-window race detection (hclib_tpu.analysis):
+        # the slab index callables are pure Python, so the bounds known
+        # HERE let the verifier prove pairwise disjointness over the
+        # CONCRETE tile space - the strong form of the construction-time
+        # synthetic check (witness: the two colliding tile coords).
+        from ..analysis import check_tile_windows
+
+        check_tile_windows(
+            tk, bounds, tile,
+            suppress=getattr(mk, "verify_suppress", ()) if mk else (),
+        ).raise_errors()
     if mk is not None:
         # A prebuilt kernel OWNS the dispatch configuration: verify the
         # caller's width agrees, so a benchmark arm can never believe it

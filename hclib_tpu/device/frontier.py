@@ -69,7 +69,6 @@ PageRank deliveries) count into ``V_RELAX``.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -693,7 +692,9 @@ def _default_lane_max_age(width: int) -> int:
     """Frontier builds default the ISSUE 10 age trigger ON at 4x the
     lane width (module docstring); HCLIB_TPU_LANE_MAX_AGE (handled by
     Megakernel itself) still overrides process-wide."""
-    if os.environ.get("HCLIB_TPU_LANE_MAX_AGE", ""):
+    from ..runtime.env import env_set
+
+    if env_set("HCLIB_TPU_LANE_MAX_AGE"):
         return None  # type: ignore[return-value]  # env wins
     return 4 * width
 

@@ -22,7 +22,6 @@ spawn new tasks dynamically (fib/UTS-style recursion) through
 from __future__ import annotations
 
 import functools
-import os
 import types
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -32,6 +31,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime.env import env_bool, env_int, env_raw
 from .descriptor import (
     DESC_WORDS,
     F_A0,
@@ -516,7 +516,7 @@ class BatchSpec:
     """
 
     def __init__(self, body, width: int = 8, prefetch: bool = False,
-                 drain=None) -> None:
+                 drain=None, verify_suppress: Sequence[str] = ()) -> None:
         if width < 1:
             raise ValueError(f"batch width must be >= 1, got {width}")
         if prefetch and drain is None:
@@ -529,6 +529,13 @@ class BatchSpec:
         self.width = int(width)
         self.prefetch = bool(prefetch)
         self.drain = drain
+        # Per-rule opt-outs for the build-time verifier (hclib_tpu.
+        # analysis): a spec whose body DELIBERATELY violates a checked
+        # contract (e.g. intentionally-shared value slots) annotates the
+        # rule here - the suppression rides the spec, next to the code
+        # it excuses, and the finding still appears (marked suppressed)
+        # in hclint reports.
+        self.verify_suppress = tuple(verify_suppress)
 
 
 class BatchContext:
@@ -697,6 +704,10 @@ def _wrap_vector_spec(spec, interpret: bool):
         ctx.add_executed(nodes)
         ctx.flag_overflow(over)
 
+    # The verifier's classification pass must not abstractly interpret
+    # the subtree runner (it embeds whole-engine sweeps); the marker
+    # routes this kind straight to the 'vector' class.
+    body._hclib_vector_wrapped = True
     return body
 
 
@@ -727,6 +738,8 @@ class Megakernel:
         checkpoint: Optional[bool] = None,
         quiesce_stride: Optional[int] = None,
         lane_max_age: Optional[int] = None,
+        verify: Optional[bool] = None,
+        verify_suppress: Sequence[str] = (),
     ) -> None:
         if interpret is None:
             interpret = jax.default_backend() == "cpu"
@@ -741,7 +754,7 @@ class Megakernel:
         # to untraced instead of failing a run the env owner never wrote.
         self.trace_from_env = False
         if trace is None:
-            env = os.environ.get("HCLIB_TPU_TRACE", "")
+            env = env_raw("HCLIB_TPU_TRACE", "")
             if env and env != "0":
                 try:
                     n = int(env)
@@ -764,8 +777,7 @@ class Megakernel:
         # failing a run the env owner never wrote.
         self.checkpoint_from_env = False
         if checkpoint is None:
-            env = os.environ.get("HCLIB_TPU_CHECKPOINT", "")
-            checkpoint = bool(env) and env != "0"
+            checkpoint = env_bool("HCLIB_TPU_CHECKPOINT")
             self.checkpoint_from_env = checkpoint
         self.checkpoint = bool(checkpoint)
         # Quiesce poll stride (checkpoint builds only): the scheduler
@@ -778,12 +790,9 @@ class Megakernel:
         # HCLIB_TPU_QUIESCE_STRIDE sets it process-wide; a malformed or
         # nonpositive value degrades to 1 (poll every round), never off.
         if quiesce_stride is None:
-            env = os.environ.get("HCLIB_TPU_QUIESCE_STRIDE", "")
-            if env:
-                try:
-                    quiesce_stride = int(env)
-                except ValueError:
-                    quiesce_stride = 1
+            quiesce_stride = env_int(
+                "HCLIB_TPU_QUIESCE_STRIDE", None, malformed=1
+            )
         self.quiesce_stride = max(1, int(quiesce_stride or 1))
         # Lane firing-policy age trigger (the ROADMAP lane-policy fix):
         # ``lane_max_age=N`` lets a batch lane that has held entries for N
@@ -796,9 +805,7 @@ class Megakernel:
         # PR 8 env convention - a typo must not silently change the
         # firing policy).
         if lane_max_age is None:
-            env = os.environ.get("HCLIB_TPU_LANE_MAX_AGE", "")
-            if env:
-                lane_max_age = int(env)
+            lane_max_age = env_int("HCLIB_TPU_LANE_MAX_AGE", None)
         lane_max_age = int(lane_max_age or 0)
         if lane_max_age < 0:
             raise ValueError(
@@ -892,6 +899,64 @@ class Megakernel:
         # through the axon tunnel; on a directly-attached TPU VM this
         # matters far less).
         self._packer = jax.jit(lambda *a: jnp.concatenate(a))
+        # Build-time static verifier (hclib_tpu.analysis - the hclint
+        # station): pure host analysis over the objects assembled above,
+        # so it cannot change the compiled program in ANY mode - it can
+        # only raise here with a witness. verify=None resolves through
+        # HCLIB_TPU_VERIFY, defaulting ON under pytest and off
+        # elsewhere; error findings raise AnalysisError unless listed in
+        # ``verify_suppress`` (see analysis.findings for the syntax).
+        self.verify_suppress = tuple(verify_suppress)
+        if verify is None:
+            from ..analysis.findings import verify_default
+
+            verify = verify_default()
+        self.verify = bool(verify)
+        self.analysis = None
+        if self.verify:
+            from ..analysis import verify_megakernel
+
+            self.analysis = verify_megakernel(
+                self, suppress=self.verify_suppress
+            )
+
+    def describe(self) -> Dict[str, Any]:
+        """Whole-program description of this megakernel's kernel table:
+        per-kind dispatch tier and migratability classification (the
+        reshard-class analysis), plus the build knobs - what hclint
+        prints and what checkpoint bundles carry for upfront reshard
+        diagnostics. Classification runs on demand (one recording-shim
+        pass, memoized) even when verification is off."""
+        from ..analysis import classify_megakernel
+
+        classes = classify_megakernel(self)
+        batched = {fid: spec for fid, spec in self.batch_specs}
+        kinds = {}
+        for i, name in enumerate(self.kernel_names):
+            spec = batched.get(i)
+            kinds[name] = {
+                "id": i,
+                "dispatch": (
+                    "batch" if spec is not None
+                    else ("vector" if classes.get(name) == "vector"
+                          else "scalar")
+                ),
+                "classification": classes.get(name, "unknown"),
+                **(
+                    {"width": spec.width, "prefetch": spec.prefetch}
+                    if spec is not None else {}
+                ),
+            }
+        return {
+            "kinds": kinds,
+            "capacity": self.capacity,
+            "num_values": self.num_values,
+            "checkpoint": self.checkpoint,
+            "verify": self.verify,
+            "findings": (
+                self.analysis.to_jsonable() if self.analysis else []
+            ),
+        }
 
     # -- the kernel body --
 

@@ -360,6 +360,16 @@ class ResidentKernel:
             # spawn/continuation transfer (plain megakernels skip these
             # scalar writes - see Megakernel.tracks_home).
             mk.tracks_home = True
+        # A claimed kernel id outside the table would silently never
+        # migrate (the whitelist is a per-kind mask) - refuse
+        # unconditionally, verifier on or off.
+        bad = [f for f in self.migratable
+               if not 0 <= f < len(mk.kernel_names)]
+        if bad:
+            raise ValueError(
+                f"migratable_fns {sorted(bad)} outside the kernel "
+                f"table (0..{len(mk.kernel_names) - 1})"
+            )
         for f, vargs in self.migratable.items():
             if len(vargs) > VBLOCK:
                 raise ValueError(

@@ -265,6 +265,23 @@ class ShardedMegakernel:
         # its args and write accumulate-style value slots (the host combines
         # per-device ivalues), like forasync tiles or UTS node counters.
         self.migratable_fns = frozenset(int(f) for f in migratable_fns)
+        # The claim itself must index the kernel table (the exchange
+        # whitelist is a per-kind mask): an out-of-range id would
+        # silently never migrate, so refuse unconditionally - the check
+        # is a cheap scan, no reason to gate it on the verifier flag.
+        # Kind-LEVEL classification is deliberately NOT enforced here:
+        # the exchange carries its own row-level link filter, so
+        # claiming a home-linked kind (fib forests) legally moves just
+        # its link-free rows; the classification rides
+        # Megakernel.describe() and the checkpoint bundles for
+        # reshard's upfront diagnostics.
+        bad = [f for f in self.migratable_fns
+               if not 0 <= f < len(mk.kernel_names)]
+        if bad:
+            raise ValueError(
+                f"migratable_fns {sorted(bad)} outside the kernel "
+                f"table (0..{len(mk.kernel_names) - 1})"
+            )
         self._jitted: Dict[Any, Any] = {}
 
     @contextlib.contextmanager

@@ -944,9 +944,9 @@ class TenantTable:
 # ------------------------------------------------------------- plumbing
 
 def _env_float(name: str) -> Optional[float]:
-    import os
+    from ..runtime.env import env_raw
 
-    v = os.environ.get(name)
+    v = env_raw(name)
     if not v:
         return None
     try:
@@ -964,10 +964,10 @@ def tenants_from_env() -> Optional[List[TenantSpec]]:
     overrides weights (when both are set their lane counts must agree);
     ``HCLIB_TPU_TENANT_RATE`` / ``_BURST`` / ``_INFLIGHT`` /
     ``_DEADLINE_S`` apply to every lane. Returns None when unset."""
-    import os
+    from ..runtime.env import env_raw
 
-    n_env = os.environ.get("HCLIB_TPU_TENANTS", "")
-    w_env = os.environ.get("HCLIB_TPU_TENANT_WEIGHTS", "")
+    n_env = env_raw("HCLIB_TPU_TENANTS", "")
+    w_env = env_raw("HCLIB_TPU_TENANT_WEIGHTS", "")
     weights: Optional[List[int]] = None
     if w_env:
         try:

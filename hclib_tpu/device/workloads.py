@@ -142,6 +142,12 @@ def make_fib_megakernel(
         trace=trace,
         route=route,
         checkpoint=checkpoint,
+        # hclint reshard-class: fib is DELIBERATELY claimed migratable
+        # on the mesh runners (forest seeds are link-free rows; the
+        # exchanges' row filter keeps the spawned continuation chains
+        # home) - annotate the intent so the audit shows the finding as
+        # suppressed instead of flagging every forest run.
+        verify_suppress=("reshard-class:fib",),
     )
 
 

@@ -269,13 +269,9 @@ class AutoscalerPolicy:
         self.max_devices = int(max_devices)
 
         def _envf(name: str, default: float) -> float:
-            v = os.environ.get(name)
-            if not v:
-                return default
-            try:
-                return float(v)
-            except ValueError:
-                return default
+            from .env import env_float
+
+            return env_float(name, default, malformed=default)
 
         self.scale_out_backlog = (
             _envf("HCLIB_TPU_AUTOSCALE_OUT", 32.0)

@@ -101,6 +101,7 @@ from ..device.descriptor import (  # noqa: E402
     DESC_WORDS,
     F_CSR_N,
     F_DEP,
+    F_FN,
     F_HOME,
     F_OUT,
     F_SUCC0,
@@ -125,6 +126,19 @@ def _kernel_meta(mk) -> Dict[str, Any]:
             for k, s in mk.data_specs.items()
         },
     }
+
+
+def _kind_classes(mk) -> Dict[str, str]:
+    """Build-time migratability classification for the bundle manifest
+    (hclib_tpu.analysis; memoized on the megakernel) - ``reshard``
+    reads it back for upfront whole-program diagnostics. Best-effort:
+    a kernel table the shim cannot interpret classes 'unknown'."""
+    try:
+        from ..analysis import classify_megakernel
+
+        return dict(classify_megakernel(mk))
+    except Exception:  # noqa: BLE001 - manifest enrichment only
+        return {}
 
 
 def _check_kernel_meta(mk, meta: Dict[str, Any]) -> None:
@@ -355,6 +369,16 @@ class CheckpointBundle:
             )
         V = ivalues.shape[1]
         va = int(counts[:, C_VALLOC].max())
+        # Whole-program eligibility scan (ISSUE 12): instead of refusing
+        # at the FIRST offending row, collect every violation, fold it
+        # per kernel kind, and - when the bundle carries the build-time
+        # ``kind_classes`` classification (Megakernel.describe() /
+        # hclib_tpu.analysis) - lead the diagnostic with the per-kind
+        # story, so one error names everything that must drain before a
+        # resize instead of a row-by-row whack-a-mole.
+        kind_names = list(self.meta.get("kernel_names") or [])
+        kind_classes = dict(self.meta.get("kind_classes") or {})
+        violations: List[Tuple[int, int, int, str]] = []
         live_rows: List[np.ndarray] = []
         for d in range(ndev):
             alloc = int(counts[d][C_ALLOC])
@@ -376,13 +400,34 @@ class CheckpointBundle:
                 elif int(row[F_OUT]) >= va:
                     bad = f"a dynamic out slot ({int(row[F_OUT])} >= {va})"
                 if bad is not None:
-                    raise CheckpointError(
-                        f"reshard: device {d} row {i} carries {bad}; only "
-                        "ready link-free rows re-home across mesh sizes "
-                        "(quiesce drains dependent subgraphs first, or "
-                        "restore onto the original mesh size)"
-                    )
+                    violations.append((d, i, int(row[F_FN]), bad))
+                    continue
                 live_rows.append(row.copy())
+        if violations:
+            by_kind: Dict[int, int] = {}
+            for _d, _i, fn, _bad in violations:
+                by_kind[fn] = by_kind.get(fn, 0) + 1
+            kinds = []
+            for fn, n in sorted(by_kind.items()):
+                name = (
+                    kind_names[fn]
+                    if 0 <= fn < len(kind_names) else f"id {fn}"
+                )
+                cls = kind_classes.get(str(name))
+                kinds.append(
+                    f"{name!r}"
+                    + (f" [{cls}]" if cls else "")
+                    + f": {n} row(s)"
+                )
+            d0, i0, _fn0, bad0 = violations[0]
+            raise CheckpointError(
+                f"reshard: {len(violations)} live row(s) across "
+                f"{ndev} device(s) are not link-free "
+                f"({'; '.join(kinds)}); e.g. device {d0} row {i0} "
+                f"carries {bad0}; only ready link-free rows re-home "
+                "across mesh sizes (quiesce drains dependent subgraphs "
+                "first, or restore onto the original mesh size)"
+            )
         pend_total = int(counts[:, C_PENDING].sum())
         if pend_total != len(live_rows):
             raise CheckpointError(
@@ -566,6 +611,7 @@ def snapshot_resident(rk, info: Dict[str, Any],
     """Bundle a quiesced ``ResidentKernel.run`` info dict."""
     state = _require_quiesced(info, "snapshot_resident")
     m = _kernel_meta(rk.mk)
+    m["kind_classes"] = _kind_classes(rk.mk)
     m["ndev"] = int(rk.ndev)
     m["dims"] = [int(d) for d in rk.dims]
     m["quiesce_round"] = max(

@@ -155,7 +155,9 @@ class EventLog:
         """Write ``hclib.<ts>.dump/<worker>`` binary files + manifest
         (layout parity: src/hclib-instrument.c:50-83). Lane ``nworkers``
         is the external lane (named in the manifest)."""
-        base = directory or os.environ.get("HCLIB_TPU_DUMP_DIR", ".")
+        from .env import env_raw
+
+        base = directory or env_raw("HCLIB_TPU_DUMP_DIR", ".")
         path = os.path.join(base, f"hclib.{int(time.time() * 1000)}.dump")
         os.makedirs(path, exist_ok=True)
         with _type_lock:

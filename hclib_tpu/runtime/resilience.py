@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import hashlib
 import logging
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
@@ -241,8 +240,9 @@ def preempt_requested() -> bool:
     spelling for wrapper scripts that cannot deliver a signal."""
     if _preempt_fired:
         return True
-    v = os.environ.get("HCLIB_TPU_PREEMPT", "")
-    return bool(v) and v != "0"
+    from .env import env_bool
+
+    return env_bool("HCLIB_TPU_PREEMPT")
 
 
 def install_preempt_handler(signals: Optional[Sequence[int]] = None):
@@ -489,13 +489,9 @@ class RetryPolicy:
 # ------------------------------------------------------------- device chaos
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    try:
-        return int(v)
-    except ValueError:
-        return default
+    from .env import env_int
+
+    return env_int(name, default, malformed=default)
 
 
 class DeviceFaultPlan:

@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from . import resilience
+from . import env, resilience
 from .deque import WSDeque
 from .finish import Finish
 from .locality import Locale, LocalityGraph, generate_default_graph, load_locality_file
@@ -195,12 +195,11 @@ class Runtime:
         metrics: Optional[bool] = None,
     ) -> None:
         if nworkers is None:
-            env = os.environ.get("HCLIB_TPU_WORKERS") or os.environ.get("HCLIB_WORKERS")
-            nworkers = int(env) if env else (os.cpu_count() or 1)
-        if locality_graph is None:
-            path = os.environ.get("HCLIB_TPU_LOCALITY_FILE") or os.environ.get(
-                "HCLIB_LOCALITY_FILE"
+            nworkers = env.env_int(
+                "HCLIB_TPU_WORKERS", os.cpu_count() or 1
             )
+        if locality_graph is None:
+            path = env.env_str("HCLIB_TPU_LOCALITY_FILE")
             locality_graph = (
                 load_locality_file(path, nworkers) if path else generate_default_graph(nworkers)
             )
@@ -209,7 +208,7 @@ class Runtime:
         self.nworkers = nworkers
         self.graph = locality_graph
         self.stats_enabled = (
-            stats if stats is not None else bool(os.environ.get("HCLIB_TPU_STATS"))
+            stats if stats is not None else env.env_flag("HCLIB_TPU_STATS")
         )
         # One deque per (locale, worker) - the core locality-graph invariant
         # (inc/hclib-locality-graph.h:9-50).
@@ -238,20 +237,14 @@ class Runtime:
         self._idle_fns: List[Callable[[int], bool]] = []
         # Observability (SURVEY §5): event log, state timer, stall watchdog.
         if instrument is None:
-            instrument = bool(
-                os.environ.get("HCLIB_TPU_INSTRUMENT")
-                or os.environ.get("HCLIB_INSTRUMENT")
-            )
+            instrument = env.env_flag("HCLIB_TPU_INSTRUMENT")
         if timer is None:
-            timer = bool(os.environ.get("HCLIB_TPU_TIMER"))
+            timer = env.env_flag("HCLIB_TPU_TIMER")
         if watchdog_s is None:
-            env = os.environ.get("HCLIB_TPU_WATCHDOG_S") or os.environ.get(
-                "HCLIB_TPU_WATCHDOG"
-            )
-            watchdog_s = float(env) if env else 0.0
+            watchdog_s = env.env_float("HCLIB_TPU_WATCHDOG_S", 0.0)
         if watchdog_escalate is None:
-            env = os.environ.get("HCLIB_TPU_WATCHDOG_ESCALATE")
-            watchdog_escalate = env != "0" if env is not None else True
+            e = env.env_raw("HCLIB_TPU_WATCHDOG_ESCALATE")
+            watchdog_escalate = e != "0" if e is not None else True
         self.event_log = None
         self._ev_task = None
         if instrument:
@@ -270,8 +263,7 @@ class Runtime:
         # stats-dump rung logs its snapshot.
         if metrics is None:
             # Same convention as HCLIB_TPU_TRACE: "0" (and empty) is OFF.
-            env = os.environ.get("HCLIB_TPU_METRICS", "")
-            metrics = env not in ("", "0")
+            metrics = env.env_bool("HCLIB_TPU_METRICS")
         self.metrics = None
         if metrics:
             from .metrics import MetricsRegistry
@@ -1088,9 +1080,7 @@ class Runtime:
                         # registry survive in the stall post-mortem.
                         dump += "\nmetrics: " + self.metrics.to_json()
                     log.error("%s\n%s", head, dump)
-                    if os.environ.get(
-                        "HCLIB_TPU_WATCHDOG_CHECKPOINT", ""
-                    ) not in ("", "0"):
+                    if env.env_bool("HCLIB_TPU_WATCHDOG_CHECKPOINT"):
                         # Optional checkpoint rung: before escalation can
                         # cancel (and abort device streams, losing their
                         # task graphs), fire the preemption hooks so any
@@ -1227,9 +1217,8 @@ class Runtime:
                 self.print_stats()
             if self.state_timer is not None:
                 self.state_timer.finalize()
-            if self.event_log is not None and (
-                os.environ.get("HCLIB_TPU_INSTRUMENT")
-                or os.environ.get("HCLIB_INSTRUMENT")
+            if self.event_log is not None and env.env_flag(
+                "HCLIB_TPU_INSTRUMENT"
             ):
                 # Env-driven runs flush at finalize like the reference
                 # (src/hclib-runtime.c:1465); programmatic users call

@@ -6,12 +6,11 @@ them (LINEAR / CYCLIC / EXPDEC) land on ONE padded (16, 100)-table,
 stack-10 engine and the suite pays a single ~1 min trace instead of one
 per tree - the compile-sharing knobs exist precisely for this."""
 
-import os
-
 import jax
 import pytest
 
 from hclib_tpu.device.uts_pallas import uts_pallas
+from hclib_tpu.runtime.env import env_flag
 from hclib_tpu.device.uts_vec import uts_vec
 from hclib_tpu.models.uts import FIXED, T3, UTSParams, count_seq
 
@@ -52,7 +51,7 @@ def test_uts_pallas_requires_128_lanes():
 
 
 @pytest.mark.skipif(
-    jax.default_backend() != "tpu" or not os.environ.get("HCLIB_TPU_BIG_TESTS"),
+    jax.default_backend() != "tpu" or not env_flag("HCLIB_TPU_BIG_TESTS"),
     reason="needs TPU + HCLIB_TPU_BIG_TESTS (fresh ~60s compile + ~20s run)",
 )
 def test_uts_pallas_t1xxl_exact_on_tpu():

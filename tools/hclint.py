@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""hclint: run the build-time program verifier over the repo's builders.
+
+The library half (``hclib_tpu.analysis``) runs automatically at
+``Megakernel`` construction when ``verify=True`` / ``HCLIB_TPU_VERIFY``
+(default-on under pytest) and RAISES on violations. This CLI is the
+audit spelling for CI and humans: it constructs every curated in-repo
+program builder (workloads, stress configurations, the kernels the
+benches and tutorials build), runs the full analysis suite over each -
+word-layout consistency, batch-slot race detection, prefetch-protocol
+conformance, tile store-window disjointness over concrete tile spaces,
+and the reshard/migratability classification audit - and prints every
+finding with its witness. Exit 1 when any unsuppressed error/warn
+finding exists (info notes and spec-annotated suppressions don't gate).
+
+Everything is host-only composition: kernels are CONSTRUCTED, never
+built or run - no Pallas lowering, no Mosaic, a few seconds total.
+
+Usage: ``python tools/hclint.py [--json] [--verbose]``
+CI runs this beside tools/lint.py, before the test suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Tuple
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The CLI drives verification EXPLICITLY (collecting findings instead of
+# raising at construction), so force the construction-time hook off for
+# the builders below no matter what the environment says.
+os.environ["HCLIB_TPU_VERIFY"] = "0"
+
+
+def _programs() -> List[Tuple[str, "callable"]]:
+    """(label, thunk) per curated builder; each thunk returns either a
+    Megakernel or a finished AnalysisReport."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from hclib_tpu.analysis import (
+        AnalysisReport, check_migratable, check_tile_windows,
+        verify_megakernel,
+    )
+    from hclib_tpu.device.cholesky import make_cholesky_megakernel
+    from hclib_tpu.device.forasync_tier import Slab, TileKernel, \
+        make_forasync_megakernel
+    from hclib_tpu.device.frontier import (
+        Graph, bfs_kernel, make_frontier_megakernel, pagerank_kernel,
+        sssp_kernel,
+    )
+    from hclib_tpu.device.smithwaterman import (
+        make_sw_batched_megakernel, make_sw_megakernel,
+        make_sw_wave_megakernel,
+    )
+    from hclib_tpu.device.workloads import (
+        FIB, make_fib_megakernel, make_uts_megakernel,
+        make_vfib_megakernel,
+    )
+
+    progs: List[Tuple[str, "callable"]] = []
+    progs.append(("fib(scalar)", lambda: make_fib_megakernel(
+        256, interpret=True)))
+    progs.append(("fib(batch=4)", lambda: make_fib_megakernel(
+        256, interpret=True, batch_width=4)))
+    progs.append(("uts", lambda: make_uts_megakernel(interpret=True)))
+    progs.append(("vfib", lambda: make_vfib_megakernel(interpret=True)))
+    progs.append(("cholesky(nt=4)", lambda: make_cholesky_megakernel(
+        4, interpret=True)))
+    progs.append(("sw", lambda: make_sw_megakernel(4, 4, interpret=True)))
+    progs.append(("sw-wave", lambda: make_sw_wave_megakernel(
+        4, 4, interpret=True)))
+    progs.append(("sw-batched", lambda: make_sw_batched_megakernel(
+        4, 4, interpret=True, width=4)))
+
+    rng = np.random.default_rng(7)
+    n, m = 32, 96
+    g = Graph(
+        n, rng.integers(0, n, m), rng.integers(0, n, m),
+        rng.integers(1, 9, m),
+    )
+    for kf in (bfs_kernel, sssp_kernel, pagerank_kernel):
+        progs.append((
+            f"frontier:{kf().name}",
+            lambda kf=kf: make_frontier_megakernel(
+                kf(), g, width=4, interpret=True
+            ),
+        ))
+
+    # The forasync tutorial's 2D Jacobi tile loop, with the whole-loop
+    # store-window proof over its concrete tile space.
+    N, TS = 32, 8
+
+    def jacobi() -> AnalysisReport:
+        specs = {
+            "grid": jax.ShapeDtypeStruct((N, N), jnp.int32),
+            "out": jax.ShapeDtypeStruct((N, N), jnp.int32),
+        }
+        tk = TileKernel(
+            loads=[Slab(
+                "win", "grid",
+                lambda a: (pl.ds(a[1], TS), pl.ds(a[2], TS)), (TS, TS),
+            )],
+            stores=[Slab(
+                "wout", "out",
+                lambda a: (pl.ds(a[1], TS), pl.ds(a[2], TS)), (TS, TS),
+            )],
+            compute=lambda ins: {"wout": ins["win"] * 2 + 1},
+            data_specs=specs,
+        )
+        mk = make_forasync_megakernel(tk, width=4, interpret=True)
+        rep = verify_megakernel(mk, raise_on_error=False)
+        check_tile_windows(tk, [N, N], [TS, TS], report=rep)
+        return rep
+
+    progs.append(("forasync:jacobi2d", jacobi))
+
+    # The mesh stress configuration's migratability claim (stress.
+    # forest_steal: fib on the sharded exchange) - audited, with the
+    # workload's own suppression annotation honored.
+    def forest_claim() -> AnalysisReport:
+        mk = make_fib_megakernel(256, interpret=True, batch_width=4)
+        return check_migratable(
+            mk, [FIB], "stress.forest_steal",
+            suppress=mk.verify_suppress,
+        )
+
+    progs.append(("stress:forest_steal", forest_claim))
+    return progs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print clean programs and info findings too")
+    args = ap.parse_args(argv)
+
+    from hclib_tpu.analysis import (
+        check_layout, classify_megakernel, verify_megakernel,
+    )
+    from hclib_tpu.analysis.findings import AnalysisReport
+
+    out = {}
+    bad = 0
+
+    lay = check_layout(force=True)
+    out["layout"] = {"findings": lay.to_jsonable(), "kind_classes": {}}
+    bad += len(lay.actionable())
+
+    for label, thunk in _programs():
+        try:
+            obj = thunk()
+        except Exception as e:  # noqa: BLE001 - report, keep auditing
+            out[label] = {"findings": [{
+                "rule": "builder-error", "severity": "error",
+                "kernel": None, "message": f"{type(e).__name__}: {e}",
+                "witness": {}, "suppressed": False,
+            }], "kind_classes": {}}
+            bad += 1
+            continue
+        if isinstance(obj, AnalysisReport):
+            rep = obj
+        else:
+            rep = verify_megakernel(
+                obj, suppress=getattr(obj, "verify_suppress", ()),
+                raise_on_error=False,
+            )
+            rep.kind_classes = classify_megakernel(obj)
+        out[label] = {
+            "findings": rep.to_jsonable(),
+            "kind_classes": dict(rep.kind_classes),
+        }
+        if rep.kind_classes and not args.json and args.verbose:
+            cls = ", ".join(
+                f"{k}={v}" for k, v in sorted(rep.kind_classes.items())
+            )
+            print(f"{label}: {cls}")
+        bad += len(rep.actionable())
+        for f in rep.findings:
+            if args.json:
+                continue
+            if f.severity == "info" and not args.verbose:
+                continue
+            print(f"{label}: {f}")
+
+    if args.json:
+        print(json.dumps(out, indent=2))
+    if bad:
+        print(f"hclint: {bad} actionable finding(s)", file=sys.stderr)
+        return 1
+    if not args.json:
+        n = len(out) - 1
+        print(f"hclint: {n} program(s) + layout table clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

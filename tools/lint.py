@@ -13,6 +13,12 @@ Python (ast-based, so no false positives from strings/comments):
   - no bare ``except:``
   - no mutable default arguments
   - no tabs, no trailing whitespace, lines <= 96 chars
+  - no raw ``os.environ`` READS of ``HCLIB_TPU_*`` names outside
+    ``runtime/env.py`` (the typed registry is the single parse point;
+    writes - tests seeding the environment - stay legal)
+  - every ``HCLIB_TPU_*`` name mentioned anywhere in the tree must have
+    a row in the ``runtime/env.py`` registry (the doc table cannot
+    silently lag the code)
 
 C++ (native/src):
   - no tabs, no trailing whitespace, lines <= 100 chars
@@ -27,11 +33,16 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Set, Tuple
 
 PY_MAX_LINE = 96
 CC_MAX_LINE = 100
+# The env-registry module: the ONLY file allowed to read HCLIB_TPU_*
+# names from os.environ, and the source of truth for the name table.
+ENV_MODULE = os.path.join("hclib_tpu", "runtime", "env.py")
+_ENV_NAME = re.compile(r"HCLIB_TPU_[A-Z][A-Z0-9_]*")
 SKIP_DIRS = {
     ".git", ".jax_cache", "__pycache__", ".pytest_cache", ".hypothesis",
     "perf-logs", ".claude", "build", "dist", ".eggs",
@@ -76,7 +87,95 @@ def _used_names(tree: ast.AST) -> set:
     return used
 
 
-def _check_python(path: str, src: str) -> List[Tuple[int, str]]:
+def _is_os_environ(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _hclib_names(node: ast.AST) -> Set[str]:
+    """HCLIB_TPU_* tokens inside any string constants under ``node``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out |= set(_ENV_NAME.findall(n.value))
+    return out
+
+
+def registry_names(repo: str) -> Set[str]:
+    """Registered names (canonical + legacy aliases) parsed from the
+    env module's AST - no import, so the linter stays stdlib-only and
+    works on a tree that doesn't import."""
+    with open(os.path.join(repo, ENV_MODULE)) as f:
+        tree = ast.parse(f.read())
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_v"
+        ):
+            for arg in [node.args[0]] + [
+                kw.value for kw in node.keywords if kw.arg == "legacy"
+            ] + (list(node.args[4:5])):
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Constant) and isinstance(
+                        n.value, str
+                    ):
+                        names.add(n.value)
+    return names
+
+
+def _check_env_usage(
+    path: str, tree: ast.AST, repo: str, registered: Set[str],
+    noqa,
+) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    rel = os.path.relpath(path, repo)
+    is_env_module = rel == ENV_MODULE
+    for node in ast.walk(tree):
+        # Rule 1: raw environ READS of HCLIB_TPU_* outside the registry.
+        hit: Optional[ast.AST] = None
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            # pop is the cleanup-write spelling tests use next to their
+            # seeding writes - only the read idioms are flagged.
+            and node.func.attr in ("get", "setdefault")
+            and _is_os_environ(node.func.value)
+            and any(_hclib_names(a) for a in node.args)
+        ):
+            hit = node
+        elif (
+            isinstance(node, ast.Subscript)
+            and _is_os_environ(node.value)
+            and isinstance(node.ctx, ast.Load)
+            and _hclib_names(node.slice)
+        ):
+            hit = node
+        if hit is not None and not is_env_module and not noqa(hit.lineno):
+            out.append((
+                hit.lineno,
+                "raw os.environ read of an HCLIB_TPU_* name: go "
+                "through hclib_tpu.runtime.env (typed registry)",
+            ))
+    # Rule 2: every mentioned name has a registry row.
+    for name in sorted(_hclib_names(tree) - registered):
+        out.append((
+            1,
+            f"env var {name} is not in the runtime/env.py registry: "
+            "add a row (name, type, default, doc)",
+        ))
+    return out
+
+
+def _check_python(
+    path: str, src: str, repo: Optional[str] = None,
+    registered: Optional[Set[str]] = None,
+) -> List[Tuple[int, str]]:
     out = _check_whitespace(path, src, PY_MAX_LINE)
     try:
         tree = ast.parse(src)
@@ -87,6 +186,9 @@ def _check_python(path: str, src: str) -> List[Tuple[int, str]]:
 
     def noqa(lineno: int) -> bool:
         return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
+
+    if repo is not None and registered is not None:
+        out.extend(_check_env_usage(path, tree, repo, registered, noqa))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
@@ -149,12 +251,23 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = argv or [repo]
+    try:
+        registered = registry_names(repo)
+    except OSError:
+        registered = None  # env module missing: skip the env rules
+    except SyntaxError:
+        # env.py's own syntax error surfaces as a normal finding in the
+        # per-file loop below; don't die with a traceback here.
+        registered = None
     bad = 0
     for path in _files(paths):
         with open(path, errors="replace") as f:
             src = f.read()
         if path.endswith(".py"):
-            problems = _check_python(path, src)
+            problems = _check_python(
+                path, src, repo if registered is not None else None,
+                registered,
+            )
         else:
             problems = _check_whitespace(path, src, CC_MAX_LINE)
         for lineno, msg in sorted(problems):

@@ -1,0 +1,120 @@
+"""Lesson 16: hclint - the build-time program verifier.
+
+Every earlier lesson leaned on contracts that live only in docstrings:
+batch slots "remain responsible for writing disjoint data" (lesson 7),
+forasync tiles must store disjoint output windows (lesson 14), a
+prefetch body "MUST issue exactly the starts the tier announces", and
+reshard moves link-free rows only (lesson 11). Violations surface at
+runtime as corrupt buffers - or NEVER: interpret mode serializes DMAs,
+so a real slab race can still land the right bytes on CPU and corrupt
+on hardware.
+
+``hclib_tpu.analysis`` checks those contracts when the program is
+BUILT. ``Megakernel(verify=True)`` (or ``HCLIB_TPU_VERIFY=1``;
+default-on under pytest) runs four host-only analyses over the
+assembled Python objects - no Pallas build, no Mosaic, byte-identical
+compiled programs either way:
+
+1. **Batch-slot race detection.** Kernel bodies are plain Python
+   emitting device code, so the verifier RUNS each routed batch body
+   once over a synthetic slot-distinct batch with recording fake
+   buffers, then proves the recorded store windows pairwise disjoint.
+   For forasync TileKernels with known bounds it goes further and
+   proves disjointness over the whole concrete tile space - the
+   witness is the two colliding tile coordinates.
+2. **Prefetch-protocol conformance.** The same recorded trace must
+   match every DMA start with a wait, and the residual (prefetch)
+   starts must be exactly what ``drain`` retires.
+3. **Word-layout consistency.** One table of shared ABI words
+   (descriptor fields, ring-row transport words, counter rows)
+   cross-checked against every module that hard-codes them.
+4. **Reshard classification.** Each kernel kind classes link-free vs
+   home-linked from what its body does (spawns with successors?
+   continuation transfer?); ``describe()`` surfaces it and checkpoint
+   bundles carry it so ``reshard`` can name every offending kind
+   upfront.
+
+``tools/hclint.py`` runs the same checks over every in-repo builder
+from the CLI (CI gates on it, next to tools/lint.py).
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+from hclib_tpu.analysis import (  # noqa: E402
+    AnalysisError, check_layout, check_tile_windows,
+)
+from hclib_tpu.device.forasync_tier import (  # noqa: E402
+    Slab, TileKernel, make_forasync_megakernel, run_forasync_device,
+)
+from hclib_tpu.device.workloads import make_fib_megakernel  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+N, TS = 64, 8
+SPECS = {
+    "x": jax.ShapeDtypeStruct((N,), jnp.int32),
+    "y": jax.ShapeDtypeStruct((N,), jnp.int32),
+}
+
+# ---- 1. a clean tile loop builds and runs, verified --------------------
+
+good = TileKernel(
+    loads=[Slab("xin", "x", lambda a: (pl.ds(a[1], TS),), (TS,))],
+    stores=[Slab("yout", "y", lambda a: (pl.ds(a[1], TS),), (TS,))],
+    compute=lambda ins: {"yout": ins["xin"] * 3 + 7},
+    data_specs=SPECS,
+)
+mk = make_forasync_megakernel(good, width=4, interpret=True, verify=True)
+assert mk.verify and mk.analysis is not None
+assert mk.analysis.errors() == []
+x = np.arange(N, dtype=np.int32)
+out, _ = run_forasync_device(
+    good, [N], [TS], {"x": x, "y": np.zeros(N, np.int32)},
+    width=4, mk=mk,
+)
+assert (out["y"] == x * 3 + 7).all()
+print("clean tile loop: verified at build, correct at run")
+
+# ---- 2. a planted batch-slot race is caught AT BUILD TIME --------------
+
+# The classic copy-paste bug: the store index ignores the tile's
+# descriptor args, so every tile writes window [0, TS).
+racy = TileKernel(
+    loads=[Slab("xin", "x", lambda a: (pl.ds(a[1], TS),), (TS,))],
+    stores=[Slab("yout", "y", lambda a: (pl.ds(0, TS),), (TS,))],
+    compute=lambda ins: {"yout": ins["xin"]},
+    data_specs=SPECS,
+)
+try:
+    make_forasync_megakernel(racy, width=4, interpret=True, verify=True)
+    raise SystemExit("the race went unnoticed!")
+except AnalysisError as e:
+    print("caught at construction:",
+          str(e).splitlines()[1].strip()[:72], "...")
+
+# The bounds-aware spelling gives the concrete colliding tiles:
+rep = check_tile_windows(racy, [N], [TS])
+w = rep.findings[0].witness
+print(f"colliding tiles: {w['tile_a']} vs {w['tile_b']} "
+      f"both store {w['window_a']} of 'y'")
+assert rep.findings[0].rule == "tile-race"
+
+# ---- 3. layout table + classification ----------------------------------
+
+assert check_layout(force=True).findings == []
+fib = make_fib_megakernel(128, interpret=True)
+kinds = fib.describe()["kinds"]
+assert kinds["fib"]["classification"] == "home-linked"
+assert kinds["sum"]["classification"] == "link-free"
+print("classification:",
+      {k: v["classification"] for k, v in kinds.items()})
+print("lesson 16 OK")
