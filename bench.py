@@ -891,10 +891,98 @@ def bench_autoscale():
     return out
 
 
+def _bench_tenants_mesh(weights: dict, per_tenant: int) -> dict:
+    """The MESH arm (ISSUE 13): the same 3-lane roster spanning a
+    4-device front door (MeshTenantTable routing + the numpy WRR
+    reference model - the executable spec of the in-kernel poll;
+    interpret mode serializes the DMAs, so the model is the honest
+    host-side price), riding ONE live reshard cut 4 -> 2 mid-stream
+    (the scale event). Reports aggregate tasks/s and per-tenant
+    p50/p99 admission-to-complete latency ACROSS the event, plus the
+    cut's own latency - the serving-latency seed direction 1 inherits."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import RING_ROW
+    from hclib_tpu.device.tenants import (
+        MeshTenantTable, TenantSpec, wrr_poll_reference,
+    )
+
+    # Region sized so each tenant's rows fit one lane region even at
+    # the 2-device trough (the lifetime budget resets at the cut).
+    region = -(-per_tenant // (2 * 8)) * 8 + 16
+    specs = [TenantSpec(t, weight=w, queue_capacity=4 * per_tenant)
+             for t, w in weights.items()]
+    table = MeshTenantTable(specs, 4, region)
+    rings = np.zeros((4, len(specs) * region, RING_ROW), np.int32)
+
+    def drive(tbl, rg, polls, start):
+        tctl = tbl.pump(rg)
+        for r in range(start, start + polls):
+            for d in range(tbl.ndev):
+                wrr_poll_reference(rg[d], tctl[d], region, r, 1 << 20)
+        tbl.absorb(tctl)
+
+    def raw_latencies(tbl):
+        out = {tid: [] for tid in weights}
+        for i, tid in enumerate(weights):
+            for t in tbl.tables:
+                out[tid].extend(t._lanes[i].latencies)
+        return out
+
+    t0 = time.perf_counter()
+    total = 0
+    for tid in weights:
+        for _ in range(per_tenant):
+            assert table.submit(tid, 0, args=[1])
+            total += 1
+    rnd = 0
+    drive(table, rings, 4, rnd)
+    rnd += 4
+    lat_pre = raw_latencies(table)
+    done_pre = {t: s["completed"] for t, s in table.stats().items()}
+    t_cut = time.perf_counter()
+    table, _ = table.reshard(rings, 2)
+    resize_s = time.perf_counter() - t_cut
+    rings = np.zeros((2, len(specs) * region, RING_ROW), np.int32)
+    for r in range(1024):
+        drive(table, rings, 2, rnd)
+        rnd += 2
+        if table.drained():
+            break
+    wall = time.perf_counter() - t0
+    assert table.drained(), "mesh tenant bench wedged"
+    snap = table.stats()
+    assert sum(s["completed"] for s in snap.values()) == total
+    lat_post = raw_latencies(table)
+    detail = {}
+    for tid in weights:
+        xs = sorted(lat_pre[tid] + lat_post[tid])
+        pct = (lambda p, xs=xs:
+               xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0)
+        detail[tid] = {
+            "weight": weights[tid],
+            "completed": int(snap[tid]["completed"]),
+            "completed_before_cut": int(done_pre[tid]),
+            "p50_latency_s": round(pct(0.50), 6),
+            "p99_latency_s": round(pct(0.99), 6),
+        }
+    return {
+        "ndev": "4->2",
+        "tasks": total,
+        "tasks_per_sec": round(total / max(wall, 1e-9), 1),
+        "wall_s": round(wall, 4),
+        "resize_latency_s": round(resize_s, 6),
+        "wrr_rounds": rnd,
+        "per_tenant": detail,
+    }
+
+
 def bench_tenants(quick: bool = False) -> None:
-    """Multi-tenant ingress cost of record (ISSUE 8): a 3-lane weighted
-    front door (4:2:1) over the interpret-mode streaming kernel. The
-    headline JSON - aggregate admitted tasks/s through the WRR poll -
+    """Multi-tenant ingress cost of record (ISSUE 8 + the ISSUE 13 mesh
+    arm): a 3-lane weighted front door (4:2:1) over the interpret-mode
+    streaming kernel, plus the same roster spanning a 4-device mesh
+    front door across a live reshard cut. The headline JSON - aggregate
+    admitted tasks/s through the WRR poll, single-device AND mesh -
     prints (and flushes) FIRST, rc=124-proofed like every other
     headline; per-tenant tasks/s and p50/p99 admission-to-complete
     latency go to stderr and perf-logs/<ts>.tenants.json."""
@@ -919,6 +1007,9 @@ def bench_tenants(quick: bool = False) -> None:
         mk, ring_capacity=3 * max(per_tenant, 64),
         tenants=[TenantSpec(t, weight=w) for t, w in weights.items()],
     )
+    # The mesh arm runs first (host-model, milliseconds) so its
+    # aggregate lands in the rc=124-proofed headline line.
+    mesh = _bench_tenants_mesh(weights, per_tenant)
     total = 0
     for tid in weights:
         for i in range(per_tenant):
@@ -939,6 +1030,8 @@ def bench_tenants(quick: bool = False) -> None:
         "tasks": total,
         "tasks_per_sec": round(rate, 1),
         "wall_s": round(wall, 4),
+        "mesh_tasks_per_sec": mesh["tasks_per_sec"],
+        "mesh_resize_latency_s": mesh["resize_latency_s"],
     }
     print(json.dumps(headline), flush=True)  # headline FIRST, always
     detail = {}
@@ -958,11 +1051,22 @@ def bench_tenants(quick: bool = False) -> None:
             f"admission-to-complete p50 "
             f"{detail[tid]['p50_latency_s'] * 1e3:.1f} ms / p99 "
             f"{detail[tid]['p99_latency_s'] * 1e3:.1f} ms")
+    for tid, row in mesh["per_tenant"].items():
+        log(f"mesh tenant [{tid}] w={row['weight']}: "
+            f"{row['completed']} tasks across the 4->2 cut "
+            f"({row['completed_before_cut']} pre-cut), "
+            f"admission-to-complete p50 "
+            f"{row['p50_latency_s'] * 1e3:.2f} ms / p99 "
+            f"{row['p99_latency_s'] * 1e3:.2f} ms")
+    log(f"mesh arm: {mesh['tasks']} tasks at "
+        f"{mesh['tasks_per_sec']:,} tasks/s across a 4->2 reshard "
+        f"({mesh['resize_latency_s'] * 1e3:.2f} ms cut)")
     logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
     os.makedirs(logdir, exist_ok=True)
     path = os.path.join(logdir, f"{int(time.time())}.tenants.json")
     with open(path, "w") as f:
-        json.dump({**headline, "per_tenant": detail}, f, indent=1)
+        json.dump({**headline, "per_tenant": detail, "mesh": mesh},
+                  f, indent=1)
     log(f"tenant ingress bench written: {path}")
 
 

@@ -43,6 +43,41 @@ LAYOUT = {
                        "hclib_tpu.device.resident")),
     "TEN_ID": (16, ("hclib_tpu.device.descriptor",)),
     "TEN_EXPIRED": (17, ("hclib_tpu.device.descriptor",)),
+    "TEN_DEADLINE_MS": (18, ("hclib_tpu.device.descriptor",)),
+    # tctl ABI (one 8-word control row per tenant lane, device/tenants):
+    # the host pump, the single-device stream poll, the resident-mesh
+    # WRR poll, and the numpy reference model all index these words -
+    # one drifted cursor slot would silently corrupt every lane.
+    "TC_TAIL": (0, ("hclib_tpu.device.tenants",
+                    "hclib_tpu.device.inject",
+                    "hclib_tpu.device.resident")),
+    "TC_CONSUMED": (1, ("hclib_tpu.device.tenants",
+                        "hclib_tpu.device.inject",
+                        "hclib_tpu.device.resident")),
+    "TC_WEIGHT": (2, ("hclib_tpu.device.tenants",
+                      "hclib_tpu.device.inject",
+                      "hclib_tpu.device.resident")),
+    "TC_PAUSE": (3, ("hclib_tpu.device.tenants",
+                     "hclib_tpu.device.inject",
+                     "hclib_tpu.device.resident")),
+    "TC_EXPIRED": (4, ("hclib_tpu.device.tenants",
+                       "hclib_tpu.device.inject",
+                       "hclib_tpu.device.resident")),
+    "TC_INSTALLED": (5, ("hclib_tpu.device.tenants",
+                         "hclib_tpu.device.inject",
+                         "hclib_tpu.device.resident")),
+    "TC_DROPPED": (6, ("hclib_tpu.device.tenants",
+                       "hclib_tpu.device.inject",
+                       "hclib_tpu.device.resident")),
+    # tstats ABI (host-side cumulative counters serialized per tenant
+    # into checkpoint bundles).
+    "TS_ACCEPTED": (0, ("hclib_tpu.device.tenants",)),
+    "TS_REJECTED": (1, ("hclib_tpu.device.tenants",)),
+    "TS_EXPIRED_HOST": (2, ("hclib_tpu.device.tenants",)),
+    "TS_POISONED": (3, ("hclib_tpu.device.tenants",)),
+    "TS_DROPPED": (4, ("hclib_tpu.device.tenants",)),
+    "TS_THROTTLED": (5, ("hclib_tpu.device.tenants",)),
+    "TS_QUARANTINED": (6, ("hclib_tpu.device.tenants",)),
     # batch-tier counter/state rows (device/megakernel.py)
     "TS_WORDS": (10, ("hclib_tpu.device.megakernel",)),
     "LS_WORDS": (8, ("hclib_tpu.device.megakernel",)),
@@ -91,12 +126,14 @@ def check_layout(report: Optional[AnalysisReport] = None,
     from ..device import descriptor as d
     from ..device import megakernel as m
 
-    if not (d.DESC_WORDS <= d.TEN_ID < d.TEN_EXPIRED < d.RING_ROW):
+    if not (d.DESC_WORDS <= d.TEN_ID < d.TEN_EXPIRED
+            < d.TEN_DEADLINE_MS < d.RING_ROW):
         report.add(
             "layout", ERROR, None,
             "ring-row transport words must sit beyond the descriptor "
             f"ABI and inside the padded row: DESC_WORDS={d.DESC_WORDS} "
             f"<= TEN_ID={d.TEN_ID} < TEN_EXPIRED={d.TEN_EXPIRED} < "
+            f"TEN_DEADLINE_MS={d.TEN_DEADLINE_MS} < "
             f"RING_ROW={d.RING_ROW} violated",
             word="TEN_ID",
         )

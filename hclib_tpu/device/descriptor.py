@@ -49,10 +49,17 @@ ABI carry *transport metadata* the scheduler never copies
                    sat on the ring; the in-kernel tenant poll drops it
                    (counted, a ``TenantExpired`` record) instead of
                    installing it
+    18 TEN_DEADLINE_MS  the row's REMAINING admission-deadline budget in
+                   milliseconds (0 = no deadline), stamped by the host at
+                   checkpoint export and re-armed against the resuming
+                   clock - deadlines survive a cut as remaining budget,
+                   never as stale wall-clock instants. Host-only: the
+                   device poll never reads it.
 
-Because the words ride the row itself, tenant identity survives every
-path a row can travel: checkpoint residue export, ``reshard``'s
-round-robin re-deal, and resume re-publication.
+Because the words ride the row itself, tenant identity - and a residue
+row's remaining deadline budget - survives every path a row can travel:
+checkpoint residue export, ``reshard``'s round-robin re-deal, and resume
+re-publication.
 """
 
 from __future__ import annotations
@@ -78,6 +85,7 @@ __all__ = [
     "RING_ROW",
     "TEN_ID",
     "TEN_EXPIRED",
+    "TEN_DEADLINE_MS",
     "TaskGraphBuilder",
 ]
 
@@ -108,6 +116,7 @@ RING_ROW = 256
 # rows are DESC_WORDS wide and never carry them.
 TEN_ID = 16
 TEN_EXPIRED = 17
+TEN_DEADLINE_MS = 18
 
 
 class TaskGraphBuilder:

@@ -464,7 +464,14 @@ class StreamingMegakernel:
             installing. Cursors and cumulative counters live in the
             tctl echo (host-seeded, so they survive entries). Returns
             rows installed this poll. The global ctl acquire DMA
-            (close/abort/quiesce words) stays with the caller."""
+            (close/abort/quiesce words) stays with the caller.
+
+            This scan IS the mesh-tenancy poll too: ``ResidentKernel``
+            (tenants=) compiles the same semantics per device against
+            its per-device tctl block (plus a quiesce freeze), and
+            ``tenants.wrr_poll_reference`` is the shared executable
+            spec both are tested against - change one, change all
+            three."""
             newly = jnp.int32(0)
             for k in range(T):
                 lane = jax.lax.rem(r + k, T)

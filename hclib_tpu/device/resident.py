@@ -139,7 +139,16 @@ from .descriptor import (
     TaskGraphBuilder,
 )
 from ..runtime.resilience import DeviceFaultPlan, StallError
-from .tenants import build_row
+from .tenants import (
+    TC_CONSUMED,
+    TC_DROPPED,
+    TC_EXPIRED,
+    TC_INSTALLED,
+    TC_PAUSE,
+    TC_TAIL,
+    TC_WEIGHT,
+    build_row,
+)
 from .megakernel import (
     fault_mix,
     interpret_mode,
@@ -291,6 +300,21 @@ class ResidentKernel:
     ``inject=True`` adds a per-device host injection ring (rows published
     before entry are discovered by the in-kernel poll).
 
+    ``tenants=`` (mesh-wide tenancy, device/tenants.py; needs
+    ``inject=True``): an int N, a sequence of TenantSpec/str/dict lane
+    specs, None for the ``HCLIB_TPU_MESH_TENANTS`` env spelling, False
+    to force off. With lanes enabled every device's injection ring is
+    partitioned into per-tenant regions with a per-device ``tctl[T, 8]``
+    control block (host-published per entry, echoed back), and the
+    in-kernel poll becomes the same weighted-round-robin lane scan the
+    single-device stream compiles - at most ``weight`` rows per lane
+    per poll, start lane rotating per round, installs bounded by live
+    scheduler headroom, host-marked-expired rows dropped counted.
+    Admission routes through a :class:`MeshTenantTable`
+    (``run(tenant_table=...)``). A ``tenants=None`` build (no env)
+    compiles ZERO new device words - no extra inputs, outputs, or
+    branches - bit-identical to the pre-tenancy mesh kernel.
+
     **Device resilience** (ISSUE 2): every run polls a host-writable abort
     word (HBM, one per device) inside the round loop and folds it into the
     termination collective, so ``run(abort=...)`` stops a running mesh
@@ -321,6 +345,7 @@ class ResidentKernel:
         ring_capacity: int = 256,
         proxy_cap: Optional[int] = None,
         fault_plan: Optional[DeviceFaultPlan] = None,
+        tenants=None,
     ) -> None:
         if len(mesh.axis_names) not in (1, 2, 3):
             raise ValueError(
@@ -400,6 +425,25 @@ class ResidentKernel:
         self.outbox = int(outbox)
         self.max_waits = int(max_waits)
         self.ring_capacity = -(-int(ring_capacity) // 8) * 8
+        # Mesh-wide tenancy (device/tenants.py): per-tenant ring regions
+        # + a per-device tctl WRR control block. Off (the default, and
+        # the env-less default) compiles ZERO new device words.
+        from .tenants import normalize_mesh_tenants
+
+        specs = normalize_mesh_tenants(tenants)
+        if specs is not None and not self.inject:
+            raise ValueError(
+                "tenants= partitions the injection ring into per-tenant "
+                "regions: needs inject=True"
+            )
+        self.tenant_specs = specs
+        self.T = 0 if specs is None else len(specs)
+        if self.T:
+            self.region_rows = -(-self.ring_capacity // (8 * self.T)) * 8
+            # The ring is exactly the concatenation of the lane regions.
+            self.ring_capacity = self.T * self.region_rows
+        else:
+            self.region_rows = 0
         # Outstanding-proxy budget: a homed export pins a proxy row until
         # the migrated SUBTREE completes remotely (its continuation chain
         # sends the completion), so unthrottled migration of dep-bearing
@@ -555,15 +599,20 @@ class ResidentKernel:
         ndata = len(mk.data_specs)
         nbatch = len(mk.batch_specs)
         ntrace = 1 if trace is not None else 0
-        n_in = 7 + ndata + (2 if self.inject else 0)  # + abort word (last)
+        nten = 1 if self.T else 0
+        # + abort word (last); tenant builds add the per-device tctl
+        # block between ictl and it.
+        n_in = 7 + ndata + (2 if self.inject else 0) + nten
         in_refs = refs[:n_in]
-        # + (batch-routed builds) the per-device tstats row, + fstats,
-        # then (checkpoint builds only) the exported wait table - the
-        # lifted scratch limit: quiesce with pending host-declared waits
-        # now exports them instead of refusing - then the optional
+        # + (tenant builds) the tctl echo after the ctl echo, + (batch-
+        # routed builds) the per-device tstats row, + fstats, then
+        # (checkpoint builds only) the exported wait table - the lifted
+        # scratch limit: quiesce with pending host-declared waits now
+        # exports them instead of refusing - then the optional
         # flight-recorder ring (always last).
         n_out = (
-            5 + ndata + (1 if self.inject else 0) + (1 if nbatch else 0)
+            5 + ndata + (1 if self.inject else 0) + nten
+            + (1 if nbatch else 0)
             + (1 if self.checkpoint else 0) + ntrace
         )
         out_refs = refs[n_in : n_in + n_out]
@@ -616,16 +665,22 @@ class ResidentKernel:
         waits_in = in_refs[5 + ndata]
         if self.inject:
             iring, ictl = in_refs[6 + ndata], in_refs[7 + ndata]
+        tctl_in = in_refs[8 + ndata] if nten else None
         abort_in = in_refs[n_in - 1]
         tasks, ready, counts, ivalues = out_refs[:4]
         data = dict(zip(mk.data_specs.keys(), out_refs[4 : 4 + ndata]))
         if self.inject:
             ctl_out = out_refs[4 + ndata]
-        # Per-device batched-tier counters (appended after the ctl echo):
-        # decoded host-side into info['tiers'][d], the mesh occupancy the
-        # perf guard and the lane-firing-policy detector watch.
+        # Tenant lane cursors + cumulative counters: host-seeded per
+        # entry, mutated in place by the WRR poll, echoed back at exit
+        # (right after the ctl echo).
+        tctl_out = out_refs[5 + ndata] if nten else None
+        # Per-device batched-tier counters (appended after the ctl/tctl
+        # echoes): decoded host-side into info['tiers'][d], the mesh
+        # occupancy the perf guard and the lane-firing-policy detector
+        # watch.
         tstats = (
-            out_refs[4 + ndata + (1 if self.inject else 0)]
+            out_refs[4 + ndata + (1 if self.inject else 0) + nten]
             if nbatch else None
         )
         fstats = out_refs[n_out - 1 - ntrace - nckpt]
@@ -1367,6 +1422,108 @@ class ResidentKernel:
 
                 return jax.lax.while_loop(lambda c: c < tl, chunk, consumed)
 
+        if self.inject and nten:
+            T, region = self.T, self.region_rows
+
+            def tpoll(r, quiescing):
+                """Mesh half of the tenant front door: the same WRR
+                lane scan the single-device stream compiles
+                (device/inject.py ``tpoll``), over THIS device's ring
+                regions. Per lane visit it installs at most ``weight``
+                rows, never more than the scheduler's live
+                ``headroom()`` (a full task table turns into ring
+                backpressure the host reads off the cursor echo), drops
+                rows the host marked expired (counted: FS_TEN_EXPIRED +
+                the tctl echo + a TR_TENANT record), and sweeps paused
+                lanes. Quiescing rounds freeze the scan entirely -
+                published rows stay put and export as the checkpoint's
+                per-lane residue."""
+                newly = jnp.int32(0)
+                for k in range(T):
+                    lane = jax.lax.rem(r + k, T)
+                    tail = tctl_out[lane, TC_TAIL]
+                    cons = tctl_out[lane, TC_CONSUMED]
+                    paused = tctl_out[lane, TC_PAUSE] != 0
+                    avail = tail - cons
+                    weight = tctl_out[lane, TC_WEIGHT]
+                    take = jnp.where(
+                        paused | quiescing,
+                        0,
+                        jnp.minimum(
+                            jnp.minimum(weight, avail), core.headroom()
+                        ),
+                    )
+                    target = cons + take
+
+                    def chunk(carry, lane=lane, target=target):
+                        c, inst, exp = carry
+                        base = (c // 8) * 8
+                        rp = pltpu.make_async_copy(
+                            iring.at[pl.ds(lane * region + base, 8)],
+                            rowbuf, isem.at[1],
+                        )
+                        rp.start()
+                        rp.wait()
+                        n = jnp.minimum(target - c, 8 - (c - base))
+
+                        def ins(i, ie, c=c, base=base):
+                            inst0, exp0 = ie
+                            slot = c - base + i
+                            expired = rowbuf[slot, TEN_EXPIRED] != 0
+
+                            @pl.when(jnp.logical_not(expired))
+                            def _():
+                                install_fixed(lambda w: rowbuf[slot, w])
+
+                            one = jnp.int32(1)
+                            return (
+                                inst0 + jnp.where(expired, 0, one),
+                                exp0 + jnp.where(expired, one, 0),
+                            )
+
+                        inst, exp = jax.lax.fori_loop(
+                            0, n, ins, (inst, exp)
+                        )
+                        return c + n, inst, exp
+
+                    c, inst, exp = jax.lax.while_loop(
+                        lambda cr, target=target: cr[0] < target,
+                        chunk,
+                        (cons, jnp.int32(0), jnp.int32(0)),
+                    )
+                    sweep = paused & jnp.logical_not(quiescing)
+                    tctl_out[lane, TC_CONSUMED] = jnp.where(
+                        sweep, tail, c
+                    )
+                    tctl_out[lane, TC_DROPPED] = (
+                        tctl_out[lane, TC_DROPPED]
+                        + jnp.where(sweep, avail, 0)
+                    )
+                    tctl_out[lane, TC_INSTALLED] = (
+                        tctl_out[lane, TC_INSTALLED] + inst
+                    )
+                    tctl_out[lane, TC_EXPIRED] = (
+                        tctl_out[lane, TC_EXPIRED] + exp
+                    )
+                    fstats[FS_TEN_EXPIRED] = fstats[FS_TEN_EXPIRED] + exp
+
+                    @pl.when((inst > 0) | (exp > 0))
+                    def _(lane=lane, inst=inst, exp=exp):
+                        tr.emit(
+                            TR_TENANT, tr.now(), (lane << 16) | inst, exp
+                        )
+
+                    newly = newly + inst
+                return newly
+
+            def lane_backlog():
+                b = jnp.int32(0)
+                for i in range(T):
+                    b = b + (
+                        tctl_out[i, TC_TAIL] - tctl_out[i, TC_CONSUMED]
+                    )
+                return b
+
         # ---- the fold + steal hops ----
 
         def fold_and_steal(r, inj_backlog, am_dead, local_abort,
@@ -1683,6 +1840,12 @@ class ResidentKernel:
 
         core.stage()
         stage_resident()
+        if nten:
+            # Lane cursors + cumulative counters: host-seeded per entry,
+            # mutated in place by the WRR poll, echoed back at exit.
+            for i in range(self.T):
+                for w in range(8):
+                    tctl_out[i, w] = tctl_in[i, w]
         if self.inject:
             cp0 = pltpu.make_async_copy(ictl, ctlbuf, isem.at[0])
             cp0.start()
@@ -1751,14 +1914,26 @@ class ResidentKernel:
                     )
                 else:
                     quiescing = jnp.bool_(False)
-                c_new = poll(consumed, quiescing)
+                if nten:
+                    # Tenant lanes: rows come off the per-lane regions
+                    # through the WRR poll; cursors live in the tctl
+                    # echo, not the loop carry.
+                    newly = tpoll(r, quiescing)
 
-                @pl.when(c_new > consumed)
-                def _():
-                    tr.emit(TR_INJECT, tr.now(), c_new - consumed)
+                    @pl.when(newly > 0)
+                    def _():
+                        tr.emit(TR_INJECT, tr.now(), newly)
 
-                consumed = c_new
-                inj_backlog = ctlbuf[0] - consumed
+                    inj_backlog = lane_backlog()
+                else:
+                    c_new = poll(consumed, quiescing)
+
+                    @pl.when(c_new > consumed)
+                    def _():
+                        tr.emit(TR_INJECT, tr.now(), c_new - consumed)
+
+                    consumed = c_new
+                    inj_backlog = ctlbuf[0] - consumed
             else:
                 inj_backlog = jnp.int32(0)
             drain_outbox()
@@ -1895,9 +2070,12 @@ class ResidentKernel:
         W = self.window
         smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
         anyspace = functools.partial(pl.BlockSpec, memory_space=pl.ANY)
+        nten = 1 if self.T else 0
         in_specs = [smem()] * 5 + [anyspace()] * ndata + [smem()]
         if self.inject:
             in_specs += [anyspace(), anyspace()]  # iring, ictl (HBM)
+        if nten:
+            in_specs += [smem()]  # per-device tctl block (tiny)
         in_specs += [anyspace()]  # abort word (HBM: re-read every round)
         out_specs = [smem()] * 4 + [anyspace()] * ndata
         data_shapes = [
@@ -1913,6 +2091,13 @@ class ResidentKernel:
         if self.inject:
             out_specs.append(smem())
             out_shape.append(jax.ShapeDtypeStruct((8,), jnp.int32))
+        if nten:
+            # The tctl echo (lane cursors + cumulative counters), right
+            # after the ctl echo.
+            out_specs.append(smem())
+            out_shape.append(
+                jax.ShapeDtypeStruct((self.T, 8), jnp.int32)
+            )
         if mk.batch_specs:
             # Batched-tier counters (TS_* words) per device, appended
             # after the ctl echo: decoded into info['tiers'][d].
@@ -2030,13 +2215,16 @@ class ResidentKernel:
             ntrace = 1 if self.mk.trace is not None else 0
             nckpt = 1 if ckpt else 0
             nbatch = 1 if self.mk.batch_specs else 0
-            # Per-device batched-tier counters (appended after the ctl
-            # echo, before fstats): surfaced so info['tiers'][d] reads
-            # mesh occupancy exactly like the single-device decode.
+            # Per-device batched-tier counters (appended after the ctl/
+            # tctl echoes, before fstats): surfaced so info['tiers'][d]
+            # reads mesh occupancy exactly like the single-device decode.
             tstats_o = (
-                [outs[4 + ndata + (1 if self.inject else 0)]]
+                [outs[4 + ndata + (1 if self.inject else 0) + nten]]
                 if nbatch else []
             )
+            # The tctl echo rides out beside fstats on every tenant run
+            # (the host table absorbs it after each entry).
+            tctl_o = [outs[5 + ndata]] if nten else []
             fstats_o = outs[-1 - ntrace - nckpt]
             tail_o = ([outs[-1]] if ntrace else [])
             # Checkpoint builds export the mutated task table + ready
@@ -2055,17 +2243,19 @@ class ResidentKernel:
                 gcounts[None],
                 *[d[None] for d in data_o],
                 *[t[None] for t in tstats_o],
+                *[t[None] for t in tctl_o],
                 fstats_o[None],
                 *[s[None] for s in state_o],
                 *[t[None] for t in tail_o],
             )
 
-        nin = 7 + ndata + (2 if self.inject else 0)
-        # fstats (and the tstats / trace ring / checkpoint state outputs,
-        # when built in) are per-device outputs too: out_specs must cover
-        # them or shard_map rejects the pytree at trace time.
+        nin = 7 + ndata + (2 if self.inject else 0) + nten
+        # fstats (and the tstats / tctl echo / trace ring / checkpoint
+        # state outputs, when built in) are per-device outputs too:
+        # out_specs must cover them or shard_map rejects the pytree at
+        # trace time.
         nout = (
-            4 + ndata + (1 if self.mk.batch_specs else 0)
+            4 + ndata + (1 if self.mk.batch_specs else 0) + nten
             + (1 if self.mk.trace is not None else 0)
             + ((3 + (1 if self.inject else 0)) if ckpt else 0)
         )
@@ -2091,6 +2281,7 @@ class ResidentKernel:
         quiesce=None,
         resume_state: Optional[Dict[str, Any]] = None,
         hop_order: Optional[Sequence[int]] = None,
+        tenant_table=None,
     ):
         """Execute all partitions fully on-device.
 
@@ -2131,6 +2322,18 @@ class ResidentKernel:
         parked wait rows re-arm exactly. An injecting mesh exports its
         ring residue + consumed cursor the same way (``state['ring_rows']``
         / ``state['ictl']``), so a mid-stream quiesce loses nothing.
+
+        Mesh tenancy (``tenants=`` at construction): ``tenant_table`` is
+        the :class:`~hclib_tpu.device.tenants.MeshTenantTable` fronting
+        this mesh - it pumps every device's lane regions + tctl block
+        before entry and absorbs the echo after; rows enter ONLY through
+        its ``submit`` routing (``inject_rows`` is refused). The echo
+        rides out as ``info['tenant_ctl']`` and aggregate stats as
+        ``info['tenants']``; a quiesced run's state carries the
+        per-device tenant-tagged residue + aggregate tctl/tstats blocks
+        (``tenant_table.export_state``), which a resume - on ANY mesh
+        size, through ``CheckpointBundle.reshard`` - feeds back via
+        ``run(resume_state=..., tenant_table=fresh_table)``.
         """
         from .sharded import execute_partitions
 
@@ -2191,12 +2394,83 @@ class ResidentKernel:
                             "range"
                         )
                     waits_arr[d, 1 + i] = (ch, need, row)
+        if tenant_table is not None and not self.T:
+            raise ValueError(
+                "tenant_table= needs a tenant-enabled mesh: build the "
+                "ResidentKernel with tenants= (or set "
+                "HCLIB_TPU_MESH_TENANTS)"
+            )
         extra: List[np.ndarray] = [waits_arr]
         if self.inject:
             R = self.ring_capacity
             iring = np.zeros((ndev, R, RING_ROW), np.int32)
             ictl = np.zeros((ndev, 8), np.int32)
-            if resume_state is not None:
+            if self.T:
+                # Mesh tenancy: rows enter through the MeshTenantTable's
+                # routed admission only - the table pumps each device's
+                # lane regions and builds the stacked tctl block this
+                # entry uploads; the plain linear tail is unused.
+                if inject_rows:
+                    raise ValueError(
+                        "a tenant-enabled mesh admits rows through its "
+                        "MeshTenantTable (run(tenant_table=...)), not "
+                        "inject_rows="
+                    )
+                if tenant_table is not None and (
+                    len(tenant_table) != self.T
+                    or tenant_table.ndev != ndev
+                    or tenant_table.region_rows != self.region_rows
+                ):
+                    raise ValueError(
+                        f"tenant_table shape mismatch: table has "
+                        f"{len(tenant_table)} lanes x "
+                        f"{tenant_table.ndev} devices x "
+                        f"{tenant_table.region_rows} region rows; this "
+                        f"mesh wants {self.T} x {ndev} x "
+                        f"{self.region_rows}"
+                    )
+                if resume_state is not None and "tctl" in resume_state:
+                    if tenant_table is None:
+                        raise ValueError(
+                            "resume state carries per-tenant lane "
+                            "blocks (tctl/tstats): pass a fresh "
+                            "tenant_table= so residue re-deals into "
+                            "its lanes instead of being dropped"
+                        )
+                    tenant_table.resume_from(resume_state)
+                elif resume_state is not None:
+                    rr = resume_state.get("ring_rows")
+                    rc = resume_state.get("ictl")
+                    if (
+                        rr is not None and rc is not None
+                        and int(np.asarray(rc)[:, 0].sum()) > 0
+                    ):
+                        # A tenancy-off snapshot's residue has no lane
+                        # identity: republishing it here would misfile
+                        # every row, silently dropping it would lose
+                        # tasks - refuse, like the mirror guard below.
+                        raise ValueError(
+                            "resume state carries untagged inject-ring "
+                            "residue but no per-tenant lane blocks: it "
+                            "was exported from a tenancy-off mesh and "
+                            "cannot resume on a tenant-enabled one"
+                        )
+                ictl[:, 1] = 1  # closed: single-entry run drains fully
+                if tenant_table is not None:
+                    tctl_np = tenant_table.pump(iring)
+                else:
+                    tctl_np = np.zeros((ndev, self.T, 8), np.int32)
+            elif resume_state is not None:
+                if "tctl" in resume_state:
+                    # Mirror of the tenant-resume guard: silently
+                    # stripping every row's tenant identity would break
+                    # the conservation contract.
+                    raise ValueError(
+                        "resume state carries per-tenant lane blocks "
+                        "(tctl/tstats): it was exported from a "
+                        "tenant-enabled mesh and cannot resume on a "
+                        "tenancy-off one"
+                    )
                 # Re-publish the inject-ring residue (rows that were on
                 # the ring but unconsumed at quiesce): packed from slot
                 # 0 with a reset consumed cursor, so the in-kernel poll
@@ -2228,6 +2502,8 @@ class ResidentKernel:
                     ictl[d, 0] = n
                     ictl[d, 1] = 1  # closed: single-entry run drains fully
             extra += [iring, ictl]
+            if self.T:
+                extra += [tctl_np]
         elif inject_rows:
             raise ValueError("inject_rows requires inject=True")
         from .sharded import abort_words
@@ -2316,11 +2592,21 @@ class ResidentKernel:
         fs = [decode_fault_stats(frows[d]) for d in range(ndev)]
         info["fault_stats"] = fs
         info["aborted"] = any(f["abort_round"] >= 0 for f in fs)
+        if self.T:
+            # The stacked tctl echo (lane cursors + cumulative install/
+            # expire/sweep counters): fold it back into the front door
+            # so consume-cursor advances free in-flight budget and the
+            # aggregate stats refresh.
+            tctl_echo = np.asarray(tail[-2]).reshape(ndev, self.T, 8)
+            info["tenant_ctl"] = tctl_echo
+            if tenant_table is not None:
+                tenant_table.absorb(tctl_echo)
+                info["tenants"] = tenant_table.stats()
         if mk.batch_specs:
             # Per-device batched-tier occupancy (counters accumulate over
             # the whole resident entry): the mesh lane-firing-policy
             # signal the perf guard and MetricsRegistry gauges watch.
-            trows = tail[-2]
+            trows = tail[-2 - (1 if self.T else 0)]
             info["tiers"] = [
                 mk.decode_tier_stats(trows[d]) for d in range(ndev)
             ]
@@ -2345,7 +2631,18 @@ class ResidentKernel:
                     "data": {k: np.asarray(v) for k, v in data_o.items()},
                     "waits": np.asarray(waits_rows),
                 }
-                if self.inject:
+                if self.inject and self.T:
+                    # Tenant mesh: the front door exports the per-lane
+                    # residue (deadline-stamped, tenant-tagged) plus the
+                    # aggregate tctl/tstats blocks. Without a table
+                    # nothing was ever published (inject_rows is
+                    # refused), so the state carries no tenant blocks
+                    # and resumes table-less.
+                    if tenant_table is not None:
+                        info["state"].update(
+                            tenant_table.export_state(iring)
+                        )
+                elif self.inject:
                     ic = np.asarray(ictl_rows)
                     rr = np.zeros(
                         (ndev, self.ring_capacity, RING_ROW), np.int32

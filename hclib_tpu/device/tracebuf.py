@@ -78,6 +78,8 @@ __all__ = [
     "SC_EVACUATE",
     "SC_CHECKPOINT",
     "SC_FINISH",
+    "SC_DEADLINE_OUT",
+    "SC_STRAND_HOLD",
     "SC_NAMES",
     "host_trace_info",
     "TAG_NAMES",
@@ -129,6 +131,10 @@ SC_IN = 2
 SC_EVACUATE = 3
 SC_CHECKPOINT = 4
 SC_FINISH = 5
+SC_DEADLINE_OUT = 6   # tenant deadline-pressure scale-out (no gates:
+                      # it must beat the watchdog's strike ladder)
+SC_STRAND_HOLD = 7    # scale-in refused: it would strand a tenant's
+                      # in-flight quota / ring residue
 
 # The ONE name table for SC_* codes: runtime/autoscaler.py derives its
 # kind->code map from it and tools/timeline.py labels TR_SCALE spans
@@ -140,6 +146,8 @@ SC_NAMES: Dict[int, str] = {
     SC_EVACUATE: "evacuate",
     SC_CHECKPOINT: "checkpoint",
     SC_FINISH: "finish",
+    SC_DEADLINE_OUT: "deadline out",
+    SC_STRAND_HOLD: "strand hold",
 }
 
 TAG_NAMES: Dict[int, str] = {

@@ -97,11 +97,18 @@ def _pof2_floor(n: int) -> int:
 class Observation:
     """One control slice's view of the mesh - everything the policy may
     read. Built from a quiesced run's ``info`` by the controller, or
-    constructed directly in policy unit tests."""
+    constructed directly in policy unit tests.
+
+    ``tenants`` (mesh-tenancy runs): the per-tenant pressure feed -
+    ``{tid: {backlog, in_flight, ring_residue, expired, budget, ...}}``
+    (``MeshTenantTable.pressure()`` is the canonical producer). The
+    policy reads deadline-budget DRAIN (expired deltas between
+    consecutive observations) and the strand set (tenants with
+    in-flight / ring-resident rows a scale-in would disturb) off it."""
 
     __slots__ = (
         "ndev", "backlog", "pending", "executed_delta", "inject_backlog",
-        "quarantined", "slice_s",
+        "quarantined", "slice_s", "tenants",
     )
 
     def __init__(
@@ -113,6 +120,7 @@ class Observation:
         inject_backlog: int = 0,
         quarantined: Sequence[int] = (),
         slice_s: float = 0.0,
+        tenants: Optional[Dict[str, Dict[str, float]]] = None,
     ) -> None:
         self.ndev = int(ndev)
         self.backlog = [int(b) for b in backlog]
@@ -121,6 +129,20 @@ class Observation:
         self.inject_backlog = int(inject_backlog)
         self.quarantined = tuple(sorted(set(int(q) for q in quarantined)))
         self.slice_s = float(slice_s)
+        self.tenants = tenants
+
+    @property
+    def stranded_tenants(self) -> List[str]:
+        """Tenants a scale-in would disturb mid-flight: nonzero
+        in-flight quota or ring residue (host backlog re-homes freely;
+        published-but-unconsumed rows are the strand risk)."""
+        if not self.tenants:
+            return []
+        return sorted(
+            tid for tid, s in self.tenants.items()
+            if float(s.get("in_flight", 0)) > 0
+            or float(s.get("ring_residue", 0)) > 0
+        )
 
     @property
     def backlog_per_device(self) -> float:
@@ -136,6 +158,7 @@ class Observation:
     def from_info(
         cls, ndev: int, info: Dict[str, Any], executed_before: int,
         slice_s: float,
+        tenants: Optional[Dict[str, Dict[str, float]]] = None,
     ) -> "Observation":
         from ..device.megakernel import C_HEAD, C_TAIL
 
@@ -153,6 +176,7 @@ class Observation:
             ndev=ndev, backlog=backlog, pending=int(info["pending"]),
             executed_delta=int(info["executed"]) - int(executed_before),
             inject_backlog=inj, quarantined=quarantined, slice_s=slice_s,
+            tenants=tenants,
         )
 
 
@@ -225,21 +249,37 @@ class AutoscalerPolicy:
     out. Hysteresis and cooldown are the no-flap machinery:
 
     - scale OUT when mean ready backlog per device stays >=
-      ``scale_out_backlog`` for ``hysteresis`` consecutive slices;
+      ``scale_out_backlog`` for ``hysteresis`` consecutive slices - OR
+      (the LIVE-DELTA signal, ISSUE 13) when it RISES by >=
+      ``scale_out_delta`` per slice while the executed rate is not
+      rising, for the same streak: a storm is caught while it builds,
+      not after it crosses the level threshold;
     - scale IN when it stays <= ``scale_in_backlog`` (and nothing is
-      queued on the inject rings) for ``hysteresis`` slices;
-    - after any resize, ``cooldown`` slices must pass before the next
-      one (streaks also reset), so out/in decisions can never ping-pong
-      faster than hysteresis + cooldown slices;
-    - EVACUATION bypasses both: a quarantined chip is resharded around
-      at the first observation that names it - fault recovery must not
-      wait out a flap guard. The target drops to the largest power of
-      two that fits the survivors (the hypercube hop schedule is
-      pof2-only).
+      queued on the inject rings) for ``hysteresis`` slices - but NEVER
+      while it would strand a tenant's in-flight quota or ring residue
+      (``obs.tenants``): the refusal is a typed ``strand_hold`` event,
+      and the streak stays armed so a drained mesh shrinks at the very
+      next slice;
+    - DEADLINE PRESSURE bypasses hysteresis AND cooldown: a tenant
+      whose deadline budget drains by >= ``tenant_pressure`` (fraction
+      of its budget) within one slice triggers an immediate
+      ``deadline_out`` scale-out - the controller must beat the
+      watchdog's strike ladder (budget exhaustion cancels the lane) to
+      the punch, so this path has no flap guard, only the post-resize
+      cooldown it sets;
+    - EVACUATION bypasses both too: a quarantined chip is resharded
+      around at the first observation that names it - fault recovery
+      must not wait out a flap guard. The target drops to the largest
+      power of two that fits the survivors (the hypercube hop schedule
+      is pof2-only).
 
     Thresholds default from ``HCLIB_TPU_AUTOSCALE_OUT`` /
-    ``HCLIB_TPU_AUTOSCALE_IN`` (tasks per device). The instance is
-    stateful (streak/cooldown counters): use one per controlled mesh.
+    ``HCLIB_TPU_AUTOSCALE_IN`` (tasks per device),
+    ``HCLIB_TPU_AUTOSCALE_OUT_DELTA`` (tasks per device per slice) and
+    ``HCLIB_TPU_AUTOSCALE_TENANT_PRESSURE`` (budget fraction per
+    slice; the new knobs raise on malformed text). The instance is
+    stateful (streak/cooldown counters + the previous slice's levels
+    the deltas difference against): use one per controlled mesh.
     """
 
     def __init__(
@@ -250,6 +290,8 @@ class AutoscalerPolicy:
         scale_in_backlog: Optional[float] = None,
         hysteresis: int = 2,
         cooldown: int = 2,
+        scale_out_delta: Optional[float] = None,
+        tenant_pressure: Optional[float] = None,
     ) -> None:
         if min_devices < 1 or _pof2_floor(min_devices) != min_devices:
             raise ValueError(
@@ -287,22 +329,98 @@ class AutoscalerPolicy:
                 f"scale_out_backlog ({self.scale_out_backlog}): an "
                 "overlapping band would oscillate by construction"
             )
+        # The live-delta knobs (new in ISSUE 13) parse with RAISE
+        # semantics: a typo'd threshold must not silently change the
+        # elasticity policy.
+        from .env import env_float
+
+        self.scale_out_delta = (
+            env_float("HCLIB_TPU_AUTOSCALE_OUT_DELTA", 8.0)
+            if scale_out_delta is None else float(scale_out_delta)
+        )
+        if self.scale_out_delta <= 0:
+            raise ValueError(
+                f"scale_out_delta must be > 0, got {self.scale_out_delta}"
+            )
+        self.tenant_pressure = (
+            env_float("HCLIB_TPU_AUTOSCALE_TENANT_PRESSURE", 0.25)
+            if tenant_pressure is None else float(tenant_pressure)
+        )
+        if not 0 < self.tenant_pressure <= 1:
+            raise ValueError(
+                f"tenant_pressure must be in (0, 1], got "
+                f"{self.tenant_pressure} (it is a fraction of the "
+                "tenant's deadline budget drained per slice)"
+            )
         self.hysteresis = int(hysteresis)
         self.cooldown = int(cooldown)
         self._out_streak = 0
         self._in_streak = 0
         self._cooling = 0
+        # Previous-slice levels the delta signals difference against
+        # (None until the first observation lands).
+        self._prev_per_dev: Optional[float] = None
+        self._prev_rate: Optional[float] = None
+        self._prev_expired: Optional[Dict[str, float]] = None
 
     def reset(self) -> None:
         self._out_streak = self._in_streak = self._cooling = 0
+        self._prev_per_dev = self._prev_rate = None
+        self._prev_expired = None
 
     def _resized(self) -> None:
         self._out_streak = self._in_streak = 0
         self._cooling = self.cooldown
 
+    def _roll_deltas(self, obs: Observation):
+        """Advance the previous-slice levels and return this slice's
+        delta signals: (backlog_delta, rate_delta, worst_drain,
+        worst_tenant). Every decide() path must pass through here
+        exactly once, or the deltas would stretch across skipped
+        slices."""
+        per_dev = obs.backlog_per_device
+        rate = (
+            obs.executed_delta / obs.slice_s if obs.slice_s > 0 else None
+        )
+        backlog_delta = (
+            None if self._prev_per_dev is None
+            else per_dev - self._prev_per_dev
+        )
+        rate_delta = (
+            None if rate is None or self._prev_rate is None
+            else rate - self._prev_rate
+        )
+        drain, worst = 0.0, None
+        if obs.tenants:
+            prev = self._prev_expired
+            for tid, s in obs.tenants.items():
+                budget = float(s.get("budget") or 0)
+                if budget <= 0:
+                    continue
+                if prev is None:
+                    # First observation: no baseline, no drain - a
+                    # resumed deployment's cumulative expiry count must
+                    # not read as a fresh storm.
+                    continue
+                d = (
+                    float(s.get("expired", 0)) - prev.get(tid, 0.0)
+                ) / budget
+                if d > drain:
+                    drain, worst = d, tid
+        self._prev_per_dev = per_dev
+        if rate is not None:
+            self._prev_rate = rate
+        if obs.tenants is not None:
+            self._prev_expired = {
+                tid: float(s.get("expired", 0))
+                for tid, s in obs.tenants.items()
+            }
+        return backlog_delta, rate_delta, drain, worst
+
     def decide(self, obs: Observation):
         """-> (target_ndev, kind, reason). ``target == obs.ndev`` means
         hold (kind names why)."""
+        backlog_delta, rate_delta, drain, worst = self._roll_deltas(obs)
         # Fault first: reshard around quarantined chips immediately.
         if obs.quarantined:
             survivors = obs.ndev - len(obs.quarantined)
@@ -319,26 +437,57 @@ class AutoscalerPolicy:
                 f"quarantined {list(obs.quarantined)} but already at "
                 f"min_devices={self.min_devices} (watchdog owns this)",
             )
+        # Deadline pressure next, BEFORE the cooldown gate: a tenant
+        # burning its budget must scale out before the watchdog's
+        # strike ladder (budget exhaustion -> lane cancel) fires, and a
+        # flap guard is exactly the latency that would lose that race.
+        if (
+            drain >= self.tenant_pressure
+            and obs.ndev < self.max_devices
+        ):
+            target = min(obs.ndev * 2, self.max_devices)
+            self._resized()
+            return (
+                target, "deadline_out",
+                f"tenant {worst!r} deadline budget draining "
+                f"({drain:.0%}/slice >= {self.tenant_pressure:.0%}): "
+                "scale out before the watchdog strikes",
+            )
         if self._cooling > 0:
             self._cooling -= 1
             return obs.ndev, "hold", f"cooldown ({self._cooling + 1} left)"
         per_dev = obs.backlog_per_device
-        if per_dev >= self.scale_out_backlog and obs.ndev < self.max_devices:
+        hot_level = per_dev >= self.scale_out_backlog
+        # The delta arm: backlog RISING while the executed rate is not -
+        # extra devices will absorb the rise; a rising rate means the
+        # mesh is still ramping and levels should decide.
+        hot_delta = (
+            backlog_delta is not None
+            and backlog_delta >= self.scale_out_delta
+            and (rate_delta is None or rate_delta <= 0)
+        )
+        if (hot_level or hot_delta) and obs.ndev < self.max_devices:
             self._out_streak += 1
             self._in_streak = 0
             if self._out_streak >= self.hysteresis:
                 target = min(obs.ndev * 2, self.max_devices)
                 self._resized()
+                why = (
+                    f"backlog {per_dev:.1f}/dev >= "
+                    f"{self.scale_out_backlog:g}"
+                    if hot_level else
+                    f"backlog rising {backlog_delta:+.1f}/dev/slice >= "
+                    f"{self.scale_out_delta:g} with rate flat"
+                )
                 return (
                     target, "scale_out",
-                    f"backlog {per_dev:.1f}/dev >= "
-                    f"{self.scale_out_backlog:g} for "
-                    f"{self.hysteresis} slices",
+                    f"{why} for {self.hysteresis} slices",
                 )
             return (
                 obs.ndev, "hold",
-                f"backlog high ({per_dev:.1f}/dev), streak "
-                f"{self._out_streak}/{self.hysteresis}",
+                f"backlog high ({per_dev:.1f}/dev"
+                + (f", {backlog_delta:+.1f}/slice" if hot_delta else "")
+                + f"), streak {self._out_streak}/{self.hysteresis}",
             )
         if (
             per_dev <= self.scale_in_backlog
@@ -348,6 +497,16 @@ class AutoscalerPolicy:
             self._in_streak += 1
             self._out_streak = 0
             if self._in_streak >= self.hysteresis:
+                stranded = obs.stranded_tenants
+                if stranded:
+                    # Typed refusal, streak left armed: the mesh shrinks
+                    # at the first slice whose residue has drained.
+                    self._in_streak = self.hysteresis
+                    return (
+                        obs.ndev, "strand_hold",
+                        f"scale-in refused: would strand in-flight "
+                        f"rows of tenant(s) {stranded}",
+                    )
                 target = max(obs.ndev // 2, self.min_devices)
                 self._resized()
                 return (
@@ -516,6 +675,7 @@ class Autoscaler:
         inject_rows: Optional[Sequence[Sequence]] = None,
         quantum: int = 8,
         max_rounds: int = 1 << 14,
+        tenant_table=None,
     ):
         """Serve ``builders`` (one per starting device) - or continue a
         saved ``resume_bundle`` (a resident CheckpointBundle or path) -
@@ -529,7 +689,17 @@ class Autoscaler:
         executed counters fold by sum at every reshard (the
         ``migratable_fns`` contract), so summed ivalues and executed
         totals are invariant - the storm soak asserts them bit-equal to
-        an uninterrupted run's."""
+        an uninterrupted run's.
+
+        ``tenant_table`` (mesh-tenancy runs, device/tenants.py): the
+        ``MeshTenantTable`` fronting the mesh. It is passed through to
+        every slice's ``rk.run`` (the table pumps/absorbs the per-device
+        rings + tctl blocks), its ``pressure()`` feed rides every
+        Observation (so the policy sees per-tenant backlog and
+        deadline-budget drain), and a resize swaps in a fresh
+        ``resized(M)`` table - lane state rides the resharded bundle,
+        never the table object, so per-tenant counts conserve across
+        every cut by the same mechanism the single-device stream uses."""
         if (builders is None) == (resume_bundle is None):
             raise ValueError(
                 "run() wants exactly one of builders= or resume_bundle="
@@ -569,6 +739,9 @@ class Autoscaler:
         rk = self._kernel_for(ndev)
         executed_before = 0
         iv = data_o = info = None
+        tkw = {} if tenant_table is None else {
+            "tenant_table": tenant_table
+        }
         for slice_idx in range(self.max_slices):
             t0 = time.monotonic()
             if state is None:
@@ -576,11 +749,13 @@ class Autoscaler:
                     builders, data=data, ivalues=ivalues, waits=waits,
                     inject_rows=inject_rows, quantum=quantum,
                     max_rounds=max_rounds, quiesce=self.slice_rounds,
+                    **tkw,
                 )
             else:
                 iv, data_o, info = rk.run(
                     resume_state=state, quantum=quantum,
                     max_rounds=max_rounds, quiesce=self.slice_rounds,
+                    **tkw,
                 )
             slice_s = time.monotonic() - t0
             if not info.get("quiesced"):
@@ -594,7 +769,11 @@ class Autoscaler:
                 ))
                 break
             obs = Observation.from_info(
-                rk.ndev, info, executed_before, slice_s
+                rk.ndev, info, executed_before, slice_s,
+                tenants=(
+                    None if tenant_table is None
+                    else tenant_table.pressure()
+                ),
             )
             executed_before = int(info["executed"])
             if self.metrics is not None:
@@ -668,6 +847,12 @@ class Autoscaler:
                     rk = self._kernel_for(target)
                     state = bundle.state()
                     self.ndev = target
+                    if tenant_table is not None:
+                        # Fresh table, same roster: residue + counters
+                        # ride the resharded bundle state, which the
+                        # next slice's run feeds to resume_from.
+                        tenant_table = tenant_table.resized(target)
+                        tkw = {"tenant_table": tenant_table}
                     self._event(ScaleEvent(
                         kind, slice_idx, obs.ndev, target, reason,
                         backlog=sum(obs.backlog), pending=obs.pending,

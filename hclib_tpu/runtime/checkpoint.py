@@ -517,6 +517,14 @@ class CheckpointBundle:
                 )
             arrays["ring_rows"] = rr_new
             arrays["ictl"] = ic_new
+        # Mesh-tenancy counter blocks (aggregate (T, 8) tctl/tstats,
+        # MeshTenantTable.export_state): device-count-free by
+        # construction, so a reshard passes them through untouched -
+        # per-tenant accepted/completed/expired totals are conserved
+        # across N -> M exactly like the tagged residue rows above.
+        for k in ("tctl", "tstats"):
+            if k in self.arrays:
+                arrays[k] = np.asarray(self.arrays[k]).copy()
         meta = dict(self.meta)
         meta["ndev"] = ndev_new
         meta["resharded_from"] = int(ndev)
@@ -618,6 +626,12 @@ def snapshot_resident(rk, info: Dict[str, Any],
         f["quiesce_round"] for f in info["fault_stats"]
     )
     m.update(meta or {})
+    # After the user meta (as snapshot_stream): the roster is what
+    # restore_resident's mismatch guard validates.
+    if getattr(rk, "tenant_specs", None):
+        m["tenants"] = [s.id for s in rk.tenant_specs]
+    else:
+        m.pop("tenants", None)
     return CheckpointBundle(
         "resident", m, CheckpointBundle._flatten_state(state, m)
     )
@@ -673,20 +687,37 @@ def restore_stream(bundle_or_path, sm, **run_stream_kw):
 
 
 def restore_resident(bundle_or_path, rk, quantum: int = 64,
-                     max_rounds: int = 1 << 14, quiesce=None):
+                     max_rounds: int = 1 << 14, quiesce=None,
+                     tenant_table=None):
     """Validate + relaunch a resident-mesh bundle on ``rk``. A mesh-size
     mismatch re-homes the queues automatically (``reshard`` - totals
-    conserved; see its docstring for the eligibility rules). Returns
-    (ivalues, data, info) of the continued run."""
+    conserved; see its docstring for the eligibility rules). A
+    tenant-enabled bundle needs a fresh ``tenant_table`` matching the
+    roster - residue re-deals into its lanes. Returns (ivalues, data,
+    info) of the continued run."""
     b = _as_bundle(bundle_or_path)
     if b.kind != "resident":
         raise CheckpointError(f"restore_resident got a {b.kind!r} bundle")
     _check_kernel_meta(rk.mk, b.meta)
+    # Tenant roster must match EXACTLY (ids AND order) - lane state is
+    # keyed by index, as on the stream restore path.
+    want = b.meta.get("tenants")
+    have = (
+        [s.id for s in rk.tenant_specs]
+        if getattr(rk, "tenant_specs", None) else None
+    )
+    if (want or None) != (have or None):
+        raise CheckpointError(
+            f"tenant roster mismatch: bundle carries {want!r}, the "
+            f"target mesh has {have!r} (ids and order must match - "
+            "lane state is keyed by index)"
+        )
     if int(b.meta.get("ndev", rk.ndev)) != rk.ndev:
         b = b.reshard(rk.ndev)
+    kw = {} if tenant_table is None else {"tenant_table": tenant_table}
     return rk.run(
         resume_state=b.state(), quantum=quantum, max_rounds=max_rounds,
-        quiesce=quiesce,
+        quiesce=quiesce, **kw,
     )
 
 

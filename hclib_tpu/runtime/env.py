@@ -99,6 +99,12 @@ REGISTRY = {
            "scale-out backlog threshold, tasks/device"),
         _v("HCLIB_TPU_AUTOSCALE_IN", "float", "2",
            "scale-in backlog threshold, tasks/device"),
+        _v("HCLIB_TPU_AUTOSCALE_OUT_DELTA", "float", "8",
+           "scale-out backlog RISE threshold, tasks/device/slice "
+           "(the live-delta signal; malformed text raises)"),
+        _v("HCLIB_TPU_AUTOSCALE_TENANT_PRESSURE", "float", "0.25",
+           "deadline-budget drain fraction per slice that triggers an "
+           "immediate deadline_out scale-out (malformed text raises)"),
         # -- device megakernel (device/megakernel.py) --
         _v("HCLIB_TPU_TRACE", "int", "0 (off)",
            "flight-recorder ring capacity (1 = default capacity)"),
@@ -117,6 +123,10 @@ REGISTRY = {
         # -- multi-tenant ingress (device/tenants.py) --
         _v("HCLIB_TPU_TENANTS", "int", "0 (off)",
            "enable N equal tenant lanes on streaming runs"),
+        _v("HCLIB_TPU_MESH_TENANTS", "int", "0 (off)",
+           "enable N equal tenant lanes on resident inject meshes "
+           "(shares the per-lane WEIGHTS/RATE/BURST/INFLIGHT/"
+           "DEADLINE_S knobs above; malformed text raises)"),
         _v("HCLIB_TPU_TENANT_WEIGHTS", "list", "unset",
            "per-lane WRR weights, e.g. 4,2,1 (implies lane count)"),
         _v("HCLIB_TPU_TENANT_RATE", "float", "unset",

@@ -343,3 +343,149 @@ def test_autoscale_preempt_checkpoints_and_resumes():
     assert info2["pending"] == 0
     assert int(np.asarray(iv2)[:, 0].sum()) == total
     assert info2["executed"] == info_f["executed"]
+
+
+# ------------------- tenant/deadline-aware policy (ISSUE 13), pure
+
+
+def _pressure(expired, budget=20.0, in_flight=0.0, backlog=0.0):
+    return {"expired": float(expired), "budget": float(budget),
+            "in_flight": float(in_flight), "ring_residue": in_flight,
+            "backlog": float(backlog),
+            "pressure": min(1.0, expired / budget) if budget else 0.0}
+
+
+def test_policy_deadline_pressure_beats_cooldown_and_watchdog():
+    """ACCEPTANCE: a tenant draining >= tenant_pressure of its deadline
+    budget in ONE slice triggers an immediate typed ``deadline_out``
+    scale-out - during cooldown, with zero streak (before the watchdog
+    rung: budget exhaustion would cancel the lane). The drain is a
+    DELTA: a resumed deployment's cumulative expiry count is not a
+    fresh storm, and a stable count never re-fires."""
+    p = _policy(hysteresis=3, cooldown=3, tenant_pressure=0.25)
+    p._cooling = 3  # mid-cooldown: the pressure path must not wait
+    # First observation: cumulative expired=10 is BASELINE, not drain.
+    base = hc.Observation(2, [1, 1], tenants={"t": _pressure(10)})
+    assert p.decide(base)[1] == "hold"
+    # 6 new expirations on a budget of 20 = 30% drained in one slice.
+    target, kind, reason = p.decide(
+        hc.Observation(2, [1, 1], tenants={"t": _pressure(16)})
+    )
+    assert (target, kind) == (4, "deadline_out"), (target, kind, reason)
+    assert "watchdog" in reason and "'t'" in reason
+    # Stable cumulative count after the resize: no re-fire (the resize
+    # set a cooldown; and with zero drain there is no pressure at all;
+    # backlog held in band so only the pressure path could resize).
+    for _ in range(6):
+        assert p.decide(
+            hc.Observation(4, [5] * 4, tenants={"t": _pressure(16)})
+        )[1] == "hold"
+    # At max_devices the pressure path cannot help: it falls through
+    # to the ordinary machinery (here: in-band hold), never a loop.
+    p2 = _policy(max_devices=2, tenant_pressure=0.25)
+    p2.decide(hc.Observation(2, [5, 5], tenants={"t": _pressure(0)}))
+    assert p2.decide(
+        hc.Observation(2, [5, 5], tenants={"t": _pressure(19)})
+    )[1] == "hold"
+
+
+def test_policy_delta_scale_out_below_level_threshold():
+    """The live-delta arm: a backlog RISING by >= scale_out_delta per
+    slice scales out after hysteresis even while the LEVEL is still
+    under scale_out_backlog - the storm is caught while it builds."""
+    p = _policy(hysteresis=2, cooldown=0, scale_out_delta=4.0)
+    # Levels 2 -> 8 -> 14 per device: always far below the 16 level
+    # threshold, but rising 6/slice with a flat executed rate.
+    assert p.decide(hc.Observation(2, [2, 2], executed_delta=80,
+                                   slice_s=1.0))[1] == "hold"
+    assert p.decide(hc.Observation(2, [8, 8], executed_delta=80,
+                                   slice_s=1.0))[1] == "hold"  # streak 1
+    target, kind, reason = p.decide(
+        hc.Observation(2, [14, 14], executed_delta=80, slice_s=1.0)
+    )
+    assert (target, kind) == (4, "scale_out"), (target, kind, reason)
+    assert "rising" in reason
+    # A rising backlog WITH a rising rate is ramp-up, not a storm.
+    p2 = _policy(hysteresis=1, cooldown=0, scale_out_delta=4.0)
+    p2.decide(hc.Observation(2, [2, 2], executed_delta=10, slice_s=1.0))
+    assert p2.decide(
+        hc.Observation(2, [8, 8], executed_delta=200, slice_s=1.0)
+    )[1] == "hold"
+
+
+def test_policy_strand_refusal_then_scale_in():
+    """ACCEPTANCE: scale-in NEVER strands a tenant's in-flight quota or
+    ring residue - the refusal is a typed ``strand_hold`` that keeps
+    the streak armed, so the mesh shrinks at the first drained slice."""
+    p = _policy(hysteresis=2, cooldown=0)
+    idle_busy = hc.Observation(
+        4, [0] * 4, tenants={"t": _pressure(0, in_flight=3)}
+    )
+    assert p.decide(idle_busy)[1] == "hold"          # streak 1/2
+    for _ in range(3):                               # typed, repeated
+        target, kind, reason = p.decide(idle_busy)
+        assert (target, kind) == (4, "strand_hold"), (kind, reason)
+        assert "'t'" in reason
+    drained = hc.Observation(
+        4, [0] * 4, tenants={"t": _pressure(0, in_flight=0)}
+    )
+    assert p.decide(drained)[:2] == (2, "scale_in")
+
+
+def test_policy_no_flap_two_competing_tenants():
+    """No-flap proof with two tenants trading small budget drains and
+    an oscillating backlog: neither the pressure path (drains below
+    threshold) nor the streak machinery (alternating hot/cold) ever
+    resizes."""
+    p = _policy(hysteresis=2, cooldown=2, tenant_pressure=0.5)
+    exp_a = exp_b = 0.0
+    for i in range(12):
+        # Each slice one tenant expires 2 rows (10% of its budget) and
+        # the backlog flips between busy and idle-with-residue.
+        if i % 2:
+            exp_a += 2
+            obs = hc.Observation(4, [40] * 4, tenants={
+                "a": _pressure(exp_a), "b": _pressure(exp_b),
+            })
+        else:
+            exp_b += 2
+            obs = hc.Observation(4, [0] * 4, tenants={
+                "a": _pressure(exp_a, in_flight=1),
+                "b": _pressure(exp_b),
+            })
+        target, kind, _ = p.decide(obs)
+        assert target == 4, (i, kind)
+        assert kind in ("hold", "strand_hold"), (i, kind)
+
+
+def test_scale_event_new_kinds_ride_trace_and_metrics():
+    """The new typed kinds (deadline_out / strand_hold) ride TR_SCALE,
+    the metrics registry, and the Perfetto exporter - one SC_NAMES
+    edit, no drifting copies."""
+    from hclib_tpu.device.tracebuf import (
+        SC_DEADLINE_OUT, SC_STRAND_HOLD,
+    )
+
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(lambda n: None, _policy(), metrics=reg)
+    asc._event(hc.ScaleEvent("deadline_out", 0, 2, 4, "pressure"))
+    asc._event(hc.ScaleEvent("strand_hold", 1, 4, 4, "residue"))
+    snap = reg.snapshot()["metrics"]
+    assert snap["autoscale.deadline_out.count"] == 1.0
+    assert snap["autoscale.strand_hold.count"] == 1.0
+    recs = records_of(asc.trace_info(), TR_SCALE)
+    assert [int(r[3]) for r in recs] == [SC_DEADLINE_OUT, SC_STRAND_HOLD]
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import timeline
+
+    doc = timeline.export_perfetto("", traces=[asc.trace_info()])
+    names = [e.get("name", "") for e in doc["traceEvents"]]
+    assert any(n.startswith("deadline out 2→4") for n in names), names
+    assert any(n.startswith("strand hold") for n in names), names
+    with pytest.raises(ValueError, match="kind"):
+        hc.ScaleEvent("strand", 0, 1, 1, "typo")

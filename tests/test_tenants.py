@@ -808,3 +808,314 @@ def test_resident_inject_rows_accept_tenant_tags():
     assert per_tenant_ring_counts(ring[None], ic) == {1: 1, 2: 1}
     with pytest.raises(ValueError, match="overflow"):
         pack_inject_rows([(BUMP, ())] * 5, R=4)
+
+
+# ----------------------- deadline survival + mesh-wide tenancy (ISSUE 13)
+
+
+def test_deadline_budget_survives_export_resume():
+    """SATELLITE: deadlines export as REMAINING budget (TEN_DEADLINE_MS
+    on the residue row, never a wall-clock instant) and re-arm against
+    the resuming clock - a deadline storm straddling a cut reconciles
+    exactly: rows with budget left complete, rows whose re-armed budget
+    lapses expire, and nothing resumes deadline-free."""
+    from hclib_tpu.device.descriptor import TEN_DEADLINE_MS
+
+    clock = FakeClock()
+    t = _table([TenantSpec("a", queue_capacity=64)], clock=clock)
+    ring = np.zeros((16, RING_ROW), np.int32)
+    # Three deadline classes: none, ample (60 s), tight (2 s).
+    assert t.admit("a", _row(0))
+    assert t.admit("a", _row(1), deadline_at=clock() + 60.0)
+    assert t.admit("a", _row(2), deadline_at=clock() + 2.0)
+    state = t.export_state(ring)  # nothing pumped: all three queued
+    ms = sorted(int(r[TEN_DEADLINE_MS]) for r in state["ring_rows"])
+    assert ms == [0, 2000, 60000]
+    # Resume on a MUCH later clock: a wall-clock instant would have
+    # doomed every row; remaining budget re-arms from now.
+    clock.advance(100.0)
+    t2 = _table([TenantSpec("a", queue_capacity=64)], clock=clock)
+    t2.resume_from(state)
+    clock.advance(5.0)  # only the tight row's re-armed 2 s lapses
+    ring2 = np.zeros((16, RING_ROW), np.int32)
+    _drive(t2, ring2, polls=4)
+    s = t2.stats()["a"]
+    assert s["accepted"] == 3
+    assert s["completed"] == 2 and s["expired"] == 1, s
+    assert s["accepted"] == s["completed"] + s["expired"]
+    # The republished rows carry a CLEAN deadline word (stamped only at
+    # export) - byte-parity with freshly admitted rows.
+    assert all(int(r[TEN_DEADLINE_MS]) == 0 for r in ring2[:2])
+    # A row already past its deadline AT export folds into the expired
+    # count right there (doomed either way), not into the residue.
+    t3 = _table([TenantSpec("b", queue_capacity=64)], clock=clock)
+    assert t3.admit("b", _row(), deadline_at=clock() + 1.0)
+    clock.advance(2.0)
+    st3 = t3.export_state(np.zeros((16, RING_ROW), np.int32))
+    assert st3["ring_rows"].shape[0] == 0
+    assert t3.stats()["b"]["expired"] == 1
+
+
+def test_mesh_table_routing_quota_and_isolation():
+    """Mesh front door (the tentpole's host half): least-backlogged
+    routing with explicit device override, the typed Admission ladder
+    verbatim per replica, a MESH-WIDE rate bucket, and the poison
+    ladder enforced on aggregate counts across devices."""
+    from hclib_tpu.device.tenants import MeshTenantTable
+
+    def boom(row):
+        raise RuntimeError("poison")
+
+    clock = FakeClock()
+    mt = MeshTenantTable(
+        [TenantSpec("a", weight=2, queue_capacity=64),
+         TenantSpec("rated", rate=1.0, burst=2.0, queue_capacity=64),
+         TenantSpec("poi", validator=boom, poison_throttle=1,
+                    poison_quarantine=2, queue_capacity=64)],
+        ndev=2, region_rows=16, clock=clock,
+    )
+    rings = np.zeros((2, 3 * 16, RING_ROW), np.int32)
+    # Least-backlog routing alternates devices (ties to the lowest id).
+    devs = [mt.submit("a", BUMP, args=[i]).device for i in range(4)]
+    assert devs == [0, 1, 0, 1]
+    # Explicit placement override.
+    assert mt.submit("a", BUMP, args=[9], device=1).device == 1
+    with pytest.raises(KeyError):
+        mt.submit("a", BUMP, device=7)
+    with pytest.raises(KeyError):
+        mt.submit("nobody", BUMP)
+    # The rate quota is MESH-WIDE: burst 2 admits two, the third
+    # rejects "rate" no matter which replica it would land on.
+    assert mt.submit("rated", BUMP, args=[1])
+    assert mt.submit("rated", BUMP, args=[2])
+    adm = mt.submit("rated", BUMP, args=[3])
+    assert adm.rejected and adm.reason == "rate"
+    # Aggregate poison: ONE terminal validator failure per device - no
+    # single replica reaches a threshold, the mesh-wide count does.
+    assert mt.submit("poi", BUMP, args=[1], device=0)
+    assert mt.submit("poi", BUMP, args=[2], device=1)
+    mt.pump(rings)   # validator poisons one row on each device
+    mt.pump(rings)   # aggregate (2 >= quarantine) applies everywhere
+    snap = mt.stats()["poi"]
+    assert snap["quarantined"] == 1 and snap["poisoned"] == 2
+    for d in range(2):
+        adm = mt.submit("poi", BUMP, args=[0], device=d)
+        assert adm.rejected and adm.reason == "quarantined"
+    # Per-tenant conservation on the aggregate identity.
+    for tid, s in mt.stats().items():
+        assert s["accepted"] == (
+            s["completed"] + s["expired"] + s["dropped"] + s["backlog"]
+        ), (tid, s)
+
+
+def test_mesh_export_reshard_resume_conserves_and_guards():
+    """The mesh survivability core, host half: export mid-flight,
+    resume on a DIFFERENT device count - per-tenant counts conserved
+    exactly, residue re-dealt round-robin, roster mismatches and
+    tenant-less states refused (never misfiled)."""
+    from hclib_tpu.device.tenants import MeshTenantTable
+
+    clock = FakeClock()
+    specs = lambda: [  # noqa: E731
+        TenantSpec("x", weight=2, queue_capacity=64),
+        TenantSpec("y", queue_capacity=64),
+        TenantSpec("z", queue_capacity=64),
+    ]
+    mt = MeshTenantTable(specs(), 4, 16, clock=clock)
+    rings = np.zeros((4, 3 * 16, RING_ROW), np.int32)
+    sub = {"x": 11, "y": 7, "z": 5}
+    for tid, n in sub.items():
+        for i in range(n):
+            assert mt.submit(tid, BUMP, args=[i])
+    # Partial consumption, then the cut.
+    tctl = mt.pump(rings)
+    for d in range(4):
+        wrr_poll_reference(rings[d], tctl[d], 16, 0, 1 << 20)
+    mt.absorb(tctl)
+    done_at_cut = {t: mt.stats()[t]["completed"] for t in sub}
+    mt2, state = mt.reshard(rings, 2)
+    res = per_tenant_ring_counts(state["ring_rows"], state["ictl"])
+    for i, (tid, n) in enumerate(sub.items()):
+        assert done_at_cut[tid] + res.get(i, 0) == n
+    # A submit racing the cut gets a clean "closed" verdict.
+    late = mt.submit("x", BUMP, args=[0])
+    assert late.rejected and late.reason == "closed"
+    # Drain on the 2-device successor: per-tenant totals exact.
+    rings2 = np.zeros((2, 3 * 16, RING_ROW), np.int32)
+    for r in range(64):
+        tctl = mt2.pump(rings2)
+        for d in range(2):
+            wrr_poll_reference(rings2[d], tctl[d], 16, r, 1 << 20)
+        mt2.absorb(tctl)
+        if mt2.drained():
+            break
+    assert mt2.drained()
+    for tid, n in sub.items():
+        s = mt2.stats()[tid]
+        assert s["accepted"] == n and s["completed"] == n, (tid, s)
+    # Roster mismatch / tenant-less state / lane-count guards.
+    bad = MeshTenantTable(
+        [TenantSpec("y"), TenantSpec("x"), TenantSpec("z")], 2, 16,
+        clock=clock,
+    )
+    with pytest.raises(ValueError, match="roster"):
+        bad.resume_from(state)
+    with pytest.raises(ValueError, match="tctl"):
+        MeshTenantTable(specs(), 2, 16, clock=clock).resume_from(
+            {"ring_rows": state["ring_rows"], "ictl": state["ictl"]}
+        )
+    with pytest.raises(ValueError, match="lanes"):
+        MeshTenantTable([TenantSpec("only")], 2, 16,
+                        clock=clock).resume_from(state)
+
+
+def test_mesh_tenants_env_and_normalize(monkeypatch):
+    """HCLIB_TPU_MESH_TENANTS spelling: lane count, shared per-lane
+    knobs, weight-count agreement, and RAISE-on-malformed semantics."""
+    from hclib_tpu.device.tenants import (
+        mesh_tenants_from_env,
+        normalize_mesh_tenants,
+    )
+
+    for var in ("HCLIB_TPU_MESH_TENANTS", "HCLIB_TPU_TENANT_WEIGHTS",
+                "HCLIB_TPU_TENANT_RATE"):
+        monkeypatch.delenv(var, raising=False)
+    assert mesh_tenants_from_env() is None
+    assert normalize_mesh_tenants(None) is None
+    assert normalize_mesh_tenants(False) is None
+    monkeypatch.setenv("HCLIB_TPU_MESH_TENANTS", "3")
+    specs = normalize_mesh_tenants(None)
+    assert [s.id for s in specs] == ["t0", "t1", "t2"]
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,2,1")
+    assert [s.weight for s in normalize_mesh_tenants(None)] == [4, 2, 1]
+    monkeypatch.setenv("HCLIB_TPU_TENANT_WEIGHTS", "4,2")
+    with pytest.raises(ValueError, match="lanes"):
+        mesh_tenants_from_env()
+    monkeypatch.delenv("HCLIB_TPU_TENANT_WEIGHTS")
+    monkeypatch.setenv("HCLIB_TPU_MESH_TENANTS", "nope")
+    with pytest.raises(ValueError, match="MESH_TENANTS"):
+        mesh_tenants_from_env()
+
+
+def test_resident_mesh_tenancy_construction_and_off_path():
+    """Tenancy-off mesh builds carry ZERO tenant state - no lane count,
+    no tctl inputs/outputs, no region partition (the structural half of
+    the bit-identity acceptance; the compiled-run half needs Mosaic and
+    rides the chaos job) - and the tenant-enabled construction
+    validates every shape up front, before any kernel builds."""
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.tenants import MeshTenantTable
+    from hclib_tpu.parallel.mesh import cpu_mesh
+
+    rk_off = ResidentKernel(
+        _bump_mk(checkpoint=True), cpu_mesh(2, axis_name="q"),
+        inject=True,
+    )
+    assert rk_off.T == 0 and rk_off.tenant_specs is None
+    assert rk_off.region_rows == 0
+    rk = ResidentKernel(
+        _bump_mk(checkpoint=True), cpu_mesh(2, axis_name="q"),
+        inject=True, tenants=["x", "y", "z"], ring_capacity=96,
+    )
+    assert rk.T == 3
+    assert rk.ring_capacity == rk.T * rk.region_rows
+    assert rk.region_rows % 8 == 0
+    with pytest.raises(ValueError, match="inject=True"):
+        ResidentKernel(_bump_mk(), cpu_mesh(2, axis_name="q"),
+                       tenants=2)
+    builders = [TaskGraphBuilder() for _ in range(2)]
+    # Rows enter only through the table on a tenant mesh.
+    with pytest.raises(ValueError, match="MeshTenantTable"):
+        rk.run(builders, inject_rows=[[(BUMP, (1,))]])
+    # Table shape must match the mesh exactly.
+    with pytest.raises(ValueError, match="mismatch"):
+        rk.run(builders,
+               tenant_table=MeshTenantTable([TenantSpec("x")], 2, 16))
+    # A tenancy-off mesh refuses a table outright.
+    with pytest.raises(ValueError, match="tenant-enabled"):
+        rk_off.run(builders,
+                   tenant_table=MeshTenantTable(
+                       [TenantSpec("x")], 2, 16))
+
+
+needs_mosaic = pytest.mark.skipif(
+    not __import__(
+        "hclib_tpu.jaxcompat", fromlist=["has_mosaic_interpret"]
+    ).has_mosaic_interpret(),
+    reason="needs the Mosaic TPU interpret mode (jax >= 0.5)",
+)
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_resident_mesh_tenant_wrr_and_quiesce_reshard():
+    """DEVICE ACCEPTANCE (mesh half): the in-kernel WRR tenant poll on
+    a 4-device mesh installs every routed admission exactly once (value
+    algebra proves it), a mid-stream quiesce exports deadline-stamped
+    tenant-tagged residue + aggregate counter blocks, and a reshard to
+    2 devices resumes with per-tenant totals conserved exactly."""
+    import numpy as np
+
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.tenants import MeshTenantTable
+    from hclib_tpu.parallel.mesh import cpu_mesh
+    from hclib_tpu.runtime.checkpoint import (
+        restore_resident, snapshot_resident,
+    )
+
+    specs = lambda: ["gold", "std", "bg"]  # noqa: E731
+
+    def make(ndev):
+        return ResidentKernel(
+            _bump_mk(checkpoint=True), cpu_mesh(ndev, axis_name="q"),
+            migratable_fns=[BUMP], homed=False, window=4, inject=True,
+            tenants=specs(), ring_capacity=96,
+        )
+
+    def table_for(rk):
+        return MeshTenantTable(
+            rk.tenant_specs, rk.ndev, rk.region_rows
+        )
+
+    def seed(ndev):
+        bs = [TaskGraphBuilder() for _ in range(ndev)]
+        for b in bs:
+            b.add(BUMP, args=[0])
+        return bs
+
+    sub = {"gold": 10, "std": 6, "bg": 4}
+    # Full run: every admitted row installs + executes exactly once.
+    rk = make(4)
+    table = table_for(rk)
+    expect = 0
+    for i, (tid, n) in enumerate(sub.items()):
+        for _ in range(n):
+            assert table.submit(tid, BUMP, args=[i + 1])
+            expect += i + 1
+    iv, _, info = rk.run(seed(4), quantum=2, max_rounds=4096,
+                         tenant_table=table)
+    assert info["pending"] == 0
+    assert int(np.asarray(iv)[:, 0].sum()) == expect
+    ten = info["tenants"]
+    for tid, n in sub.items():
+        assert ten[tid]["accepted"] == n and ten[tid]["completed"] == n
+    # Quiesce mid-stream, reshard 4 -> 2, resume: totals conserved.
+    rk2 = make(4)
+    t2 = table_for(rk2)
+    for i, (tid, n) in enumerate(sub.items()):
+        for _ in range(n):
+            assert t2.submit(tid, BUMP, args=[i + 1])
+    _, _, info_q = rk2.run(seed(4), quantum=1, max_rounds=4096,
+                           quiesce=1, tenant_table=t2)
+    assert info_q["quiesced"], info_q
+    assert "tctl" in info_q["state"]
+    bundle = snapshot_resident(rk2, info_q)
+    assert bundle.meta["tenants"] == specs()
+    rk3 = make(2)
+    iv3, _, info3 = restore_resident(
+        bundle, rk3, quantum=4, max_rounds=4096,
+        tenant_table=table_for(rk3),
+    )
+    assert info3["pending"] == 0
+    total3 = int(np.asarray(iv3)[:, 0].sum())
+    assert total3 == expect, (total3, expect)

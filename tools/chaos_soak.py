@@ -982,6 +982,322 @@ def scenario_tenant_preempt_stream(seed: int, scale: str) -> dict:
             **{f"tasks_{t}": c for t, c in subs.items()}}
 
 
+# ------------------------- mesh-wide tenancy + tenant storms (ISSUE 13)
+
+def _mesh_conservation(table) -> None:
+    """The per-cut identity: submitted == completed + expired + dropped
+    (+ still-queued backlog) reconciles EXACTLY per tenant, at every
+    mesh size."""
+    for tid, s in table.stats().items():
+        assert s["accepted"] == (
+            s["completed"] + s["expired"] + s["dropped"] + s["backlog"]
+        ), (tid, s)
+
+
+def _mesh_drive(table, rings, polls=2, start=0, clock=None, dt=0.0):
+    from hclib_tpu.device.tenants import wrr_poll_reference
+
+    tctl = table.pump(rings)
+    for r in range(start, start + polls):
+        for d in range(table.ndev):
+            wrr_poll_reference(
+                rings[d], tctl[d], table.region_rows, r, 1 << 20
+            )
+    table.absorb(tctl)
+    if clock is not None and dt:
+        clock[0] += dt
+
+
+def scenario_tenant_mesh_storm_reshard(seed: int, scale: str) -> dict:
+    """THE ACCEPTANCE STORM (ISSUE 13): greedy tenant + deadline storm +
+    poison quarantine hitting a mesh-wide front door across THREE live
+    reshard cuts (4 -> 2 -> 4 -> 2), per-tenant
+    submitted == completed + expired + dropped reconciled exactly at
+    every mesh size (one cut routed through CheckpointBundle.reshard's
+    tctl/tstats pass-through), and WRR fairness probed after every cut
+    in exact weight proportion - the single-device bounds. Runs on the
+    numpy WRR reference model (the executable spec of the in-kernel
+    poll), so no Mosaic is needed."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import RING_ROW
+    from hclib_tpu.device.tenants import MeshTenantTable, TenantSpec
+
+    rng = np.random.default_rng(5000 + seed)
+    t_now = [100.0]
+    clock = lambda: t_now[0]  # noqa: E731
+    # Region sized so a phase's storm + probe + carry + re-dealt
+    # residue fits one lane region even at the 2-device trough (the
+    # lifetime budget is per table incarnation: it resets at each cut).
+    region = 32
+
+    def boom(row):
+        raise RuntimeError(f"poison row (seed {seed})")
+
+    def specs():
+        return [
+            TenantSpec("steady", weight=2, queue_capacity=512),
+            TenantSpec("greedy", weight=1, max_in_flight=4,
+                       queue_capacity=6),
+            TenantSpec("stormy", weight=1, queue_capacity=512,
+                       deadline_budget=1_000_000),
+            TenantSpec("poison", weight=1, validator=boom,
+                       poison_throttle=2, poison_quarantine=4,
+                       queue_capacity=512),
+        ]
+
+    def fresh_rings(ndev):
+        return np.zeros((ndev, 4 * region, RING_ROW), np.int32)
+
+    sizes = [4, 2, 4, 2]
+    table = MeshTenantTable(specs(), sizes[0], region, clock=clock)
+    rings = fresh_rings(sizes[0])
+    greedy_rejects = 0
+    expired_doomed = 0
+    poisoned_subs = 6
+    fairness_probes = []
+    cuts = 0
+    rnd = 0
+    for phase, ndev in enumerate(sizes):
+        # Storm traffic: steady flow, a greedy burst far past its
+        # quota, a deadline storm (seeded doomed fraction), and - in
+        # phase 0 only - the poison tenant walking into quarantine.
+        for k in range(12):
+            assert table.submit("steady", 0, args=[k + 1]), "steady"
+        for _ in range(40):
+            adm = table.submit("greedy", 0, args=[1])
+            if not adm:
+                greedy_rejects += 1
+                assert adm.reason in ("backlog", "ring"), adm.reason
+        for i in range(16):
+            doomed = rng.random() < 0.4
+            if doomed:
+                expired_doomed += 1
+            adm = table.submit(
+                "stormy", 0, args=[i],
+                deadline_s=(0.01 if doomed else 1e6),
+            )
+            assert adm, adm.reason
+        if phase == 0:
+            for _ in range(poisoned_subs):
+                table.submit("poison", 0, args=[999])
+        _mesh_drive(table, rings, polls=2, start=rnd, clock=t_now,
+                    dt=0.05)
+        rnd += 2
+        _mesh_drive(table, rings, polls=2, start=rnd, clock=t_now,
+                    dt=0.05)
+        rnd += 2
+        _mesh_conservation(table)
+        # Drain this phase's storm (doomed rows expire, live rows
+        # complete) so the fairness probe below measures CLEAN lanes -
+        # expired rows legitimately consume WRR slots without
+        # installing, which is throughput shaping, not unfairness.
+        for r in range(128):
+            _mesh_drive(table, rings, polls=2, start=rnd, clock=t_now,
+                        dt=0.02)
+            rnd += 2
+            if table.drained():
+                break
+        assert table.drained(), f"phase {phase} storm wedged the drain"
+        _mesh_conservation(table)
+        # WRR fairness probe at THIS size (the single-device bounds):
+        # with both lanes saturated, installs per whole WRR cycle are
+        # exactly weight-proportional (steady w=2 : stormy w=1).
+        before = {t: table.stats()[t]["completed"]
+                  for t in ("steady", "stormy")}
+        for d in range(table.ndev):
+            for k in range(8):
+                assert table.submit("steady", 0, args=[1], device=d)
+            for k in range(4):
+                assert table.submit("stormy", 0, args=[1],
+                                    deadline_s=1e6, device=d)
+        _mesh_drive(table, rings, polls=4, start=rnd, clock=t_now)
+        rnd += 4
+        after = {t: table.stats()[t]["completed"]
+                 for t in ("steady", "stormy")}
+        ds = after["steady"] - before["steady"]
+        dm = after["stormy"] - before["stormy"]
+        assert ds == 2 * dm > 0, (phase, ds, dm)
+        fairness_probes.append((ds, dm))
+        if phase == len(sizes) - 1:
+            break
+        # Carry residue INTO the cut: a fresh batch pinned on device 0
+        # (so one weight-bounded poll cannot drain it), only partially
+        # consumed - the reshard must re-deal live tenant-tagged rows
+        # (the conservation identity must reconcile across the cut
+        # with work genuinely in flight).
+        for k in range(6):
+            assert table.submit("steady", 0, args=[k + 1], device=0)
+        for k in range(4):
+            assert table.submit("stormy", 0, args=[k], deadline_s=1e6,
+                                device=0)
+        _mesh_drive(table, rings, polls=1, start=rnd)
+        rnd += 1
+        assert not table.drained(), "carry batch already drained"
+        _mesh_conservation(table)
+        # LIVE RESHARD CUT to the next size. Cut 1 rides the
+        # CheckpointBundle path end-to-end (ring_rows re-deal + the
+        # aggregate tctl/tstats pass-through); the others use the
+        # table's own export/resume.
+        ndev_next = sizes[phase + 1]
+        if phase == 1:
+            from hclib_tpu.device.descriptor import (
+                DESC_WORDS, F_HOME, NO_TASK,
+            )
+            from hclib_tpu.runtime.checkpoint import CheckpointBundle
+
+            st = table.export_state(rings)
+            cap = 8
+            tasks = np.zeros((table.ndev, cap, DESC_WORDS), np.int32)
+            tasks[:, :, 2:4] = NO_TASK
+            tasks[:, :, F_HOME] = NO_TASK
+            counts = np.zeros((table.ndev, 8), np.int32)
+            counts[:, 4] = 2
+            b = CheckpointBundle("resident", {"ndev": table.ndev}, {
+                "tasks": tasks,
+                "succ": np.full((table.ndev, 8), -1, np.int32),
+                "ready": np.zeros((table.ndev, cap), np.int32),
+                "counts": counts,
+                "ivalues": np.zeros((table.ndev, 16), np.int32),
+                "ring_rows": st["ring_rows"], "ictl": st["ictl"],
+                "tctl": st["tctl"], "tstats": st["tstats"],
+            })
+            out = b.reshard(ndev_next)
+            assert np.array_equal(out.arrays["tctl"], st["tctl"])
+            assert np.array_equal(out.arrays["tstats"], st["tstats"])
+            nxt = table.resized(ndev_next)
+            nxt.resume_from({
+                "ring_rows": out.arrays["ring_rows"],
+                "ictl": out.arrays["ictl"],
+                "tctl": out.arrays["tctl"],
+                "tstats": out.arrays["tstats"],
+                "tenant_ids": st["tenant_ids"],
+            })
+            table = nxt
+        else:
+            table, _ = table.reshard(rings, ndev_next)
+        rings = fresh_rings(ndev_next)
+        cuts += 1
+        _mesh_conservation(table)
+    # Drain to empty: doomed rows expire, live rows complete.
+    for r in range(256):
+        _mesh_drive(table, rings, polls=2, start=rnd + r, clock=t_now,
+                    dt=0.02)
+        if table.drained():
+            break
+    assert table.drained(), "tenant mesh storm wedged the drain"
+    _mesh_conservation(table)
+    snap = table.stats()
+    assert snap["poison"]["quarantined"] == 1, snap["poison"]
+    assert snap["poison"]["completed"] == 0, snap["poison"]
+    assert snap["stormy"]["expired"] > 0, snap["stormy"]
+    assert greedy_rejects > 0, "greedy quota never pushed back"
+    assert all(s["backlog"] == 0 for s in snap.values()), snap
+    return {
+        "faults": greedy_rejects + snap["stormy"]["expired"]
+        + snap["poison"]["poisoned"],
+        "recoveries": cuts, "cuts": cuts,
+        "greedy_rejected": greedy_rejects,
+        "stormy_expired": int(snap["stormy"]["expired"]),
+        "fairness": fairness_probes,
+    }
+
+
+def scenario_tenant_mesh_autoscale_pressure(seed: int, scale: str) -> dict:
+    """Tenant/deadline-aware autoscaling (ISSUE 13 policy half): a
+    tenant burning its deadline budget triggers a typed ``deadline_out``
+    scale-out BEFORE the watchdog rung (budget exhaustion -> lane
+    cancel) - during cooldown, with zero streak - and scale-in is
+    refused with a typed ``strand_hold`` while any tenant has in-flight
+    ring residue, then fires once drained."""
+    import numpy as np
+
+    import hclib_tpu as hc
+    from hclib_tpu.device.descriptor import RING_ROW
+    from hclib_tpu.device.tenants import MeshTenantTable, TenantSpec
+
+    t_now = [100.0]
+    clock = lambda: t_now[0]  # noqa: E731
+    region = 16
+    budget = 40
+    table = MeshTenantTable(
+        [TenantSpec("latency", weight=2, deadline_budget=budget,
+                    queue_capacity=512),
+         TenantSpec("bulk", queue_capacity=512)],
+        2, region, clock=clock,
+    )
+    rings = np.zeros((2, 2 * region, RING_ROW), np.int32)
+    policy = hc.AutoscalerPolicy(
+        min_devices=1, max_devices=8, scale_out_backlog=1e9,
+        scale_in_backlog=4.0, hysteresis=2, cooldown=3,
+        tenant_pressure=0.25,
+    )
+    # Prime the cooldown gate (prove the pressure path bypasses it).
+    policy._cooling = 3
+    ndev, events, rnd = 2, [], 0
+
+    def observe(backlog_rows):
+        return hc.Observation(
+            ndev, [backlog_rows] * ndev, executed_delta=8, slice_s=1.0,
+            tenants=table.pressure(),
+        )
+
+    # Slice 0: baseline (no drain yet - deltas need a previous slice).
+    events.append(policy.decide(observe(8))[1])
+    # Slice 1: the deadline storm - a burst of doomed rows expires
+    # within one slice, draining >= 25% of the budget.
+    for i in range(16):
+        assert table.submit("latency", 0, args=[i], deadline_s=0.01)
+    t_now[0] += 1.0  # every deadline lapses before the pump
+    tctl = table.pump(rings)
+    table.absorb(tctl)
+    target, kind, reason = policy.decide(observe(8))
+    events.append(kind)
+    assert kind == "deadline_out", (kind, reason, table.pressure())
+    assert target == 2 * ndev
+    snap = table.stats()["latency"]
+    # BEFORE the watchdog rung: the budget is not exhausted, the lane
+    # is NOT cancelled - the controller beat the strike ladder.
+    assert snap["expired"] < budget, snap
+    assert table.submit("latency", 0, args=[0], deadline_s=1e6), (
+        "lane already cancelled: scale-out lost the race"
+    )
+    ndev = target
+    # The typed event rides TR_SCALE + the metrics registry.
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(lambda n: None, policy, metrics=reg)
+    asc._event(hc.ScaleEvent("deadline_out", 1, 2, 4, reason))
+    from hclib_tpu.device.tracebuf import TR_SCALE, records_of
+
+    recs = records_of(asc.trace_info(), TR_SCALE)
+    assert len(recs) == 1 and int(recs[0][2]) == (2 << 8) | 4
+    assert reg.snapshot()["metrics"]["autoscale.deadline_out.count"] == 1
+    # Strand refusal: idle backlog + in-flight ring residue (published,
+    # unconsumed - the submit above) -> typed strand_hold, repeatedly.
+    tctl = table.pump(rings)  # publish; nothing consumed yet
+    table.absorb(tctl)
+    assert table.stats()["latency"]["in_flight"] > 0
+    policy._cooling = 0
+    kinds = [policy.decide(observe(0))[1] for _ in range(3)]
+    events += kinds
+    assert kinds[0] == "hold"  # streak 1/2
+    assert kinds[1] == "strand_hold" and kinds[2] == "strand_hold", kinds
+    asc._event(hc.ScaleEvent("strand_hold", 2, ndev, ndev, "refused"))
+    # Drain the residue: the very next slice scales in.
+    from hclib_tpu.device.tenants import wrr_poll_reference
+
+    tctl = table.pump(rings)
+    for d in range(2):
+        wrr_poll_reference(rings[d], tctl[d], region, rnd, 1 << 20)
+    table.absorb(tctl)
+    assert table.stats()["latency"]["in_flight"] == 0
+    target, kind, reason = policy.decide(observe(0))
+    events.append(kind)
+    assert kind == "scale_in" and target == ndev // 2, (kind, reason)
+    return {"faults": int(table.stats()["latency"]["expired"]),
+            "recoveries": 1, "events": events}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -1012,6 +1328,11 @@ TENANT_SCENARIOS = [
     ("tenant_poison_quarantine", scenario_tenant_poison_quarantine),
     ("tenant_deadline_storm", scenario_tenant_deadline_storm),
     ("tenant_preempt_stream", scenario_tenant_preempt_stream),
+    # Mesh-wide tenancy (ISSUE 13): the reshard storm + the
+    # tenant/deadline-aware policy, both host-model (no Mosaic needed).
+    ("tenant_mesh_storm_reshard", scenario_tenant_mesh_storm_reshard),
+    ("tenant_mesh_autoscale_pressure",
+     scenario_tenant_mesh_autoscale_pressure),
 ]
 
 

@@ -1,0 +1,175 @@
+"""Lesson 17: mesh-wide tenancy + tenant/deadline-aware autoscaling.
+
+Lesson 13 gave ONE device a multi-tenant front door; lesson 12 gave the
+mesh an autoscaler that watched raw backlog LEVELS. This lesson closes
+both residuals as one elasticity story (ISSUE 13):
+
+- **Mesh-wide TenantTable** (``MeshTenantTable``, device/tenants.py):
+  the same tenant roster spans every device of a resident mesh - each
+  device's injection ring is partitioned into the same per-tenant
+  regions (one tctl echo block per device; the in-kernel WRR poll is
+  lesson 13's, unchanged, per device), and ``submit()`` ROUTES each
+  admission to a device by placement/backlog while the typed Admission
+  ladder stays verbatim. Rate quotas are mesh-wide; the poison ladder
+  and deadline budget are enforced on AGGREGATE counts, so a tenant
+  cannot evade isolation by spreading failures across devices.
+
+- **Deadline survival**: a checkpoint cut exports each residue row's
+  REMAINING deadline budget (``TEN_DEADLINE_MS``, a transport word on
+  the row itself) and resume re-arms it - the old "residue resumes
+  deadline-free" caveat is gone.
+
+- **Tenant/deadline-aware autoscaling**: the policy now reads live
+  per-slice rate DELTAS (a backlog rising while the executed rate is
+  flat scales out before the level threshold trips) and per-tenant
+  deadline-budget drain: a tenant burning >= ``tenant_pressure`` of
+  its budget in one slice triggers an immediate typed ``deadline_out``
+  scale-out - no hysteresis, no cooldown - so the controller beats the
+  watchdog's strike ladder (budget exhaustion cancels the lane).
+  Scale-in NEVER strands a tenant: while any lane has in-flight ring
+  residue the decision is a typed ``strand_hold``.
+
+Everything below runs on the numpy WRR reference model (the executable
+spec of the in-kernel poll), so the lesson is exact and fast with no
+TPU and no Mosaic interpret; ``ResidentKernel(tenants=...)`` +
+``run(tenant_table=...)`` is the compiled spelling of the same
+machinery.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from hclib_tpu.device.descriptor import RING_ROW  # noqa: E402
+from hclib_tpu.device.tenants import (  # noqa: E402
+    MeshTenantTable,
+    TenantSpec,
+    wrr_poll_reference,
+)
+from hclib_tpu.runtime.autoscaler import (  # noqa: E402
+    AutoscalerPolicy,
+    Observation,
+)
+
+BUMP = 0
+REGION = 16
+
+# A deterministic clock: every admission/deadline decision becomes a
+# pure function of the script.
+t_now = [100.0]
+clock = lambda: t_now[0]  # noqa: E731
+
+
+def drive(table, rings, polls=2, start=0):
+    """One mesh entry: pump every device's lanes, run ``polls`` WRR
+    reference rounds per device, absorb the echo."""
+    tctl = table.pump(rings)
+    for r in range(start, start + polls):
+        for d in range(table.ndev):
+            wrr_poll_reference(rings[d], tctl[d], REGION, r, 1 << 20)
+    table.absorb(tctl)
+
+
+# ---------------------------------------------------------------- 1
+# Routing: the least-backlogged replica of a tenant's lane wins.
+print("== mesh-wide admission routing ==")
+specs = [
+    TenantSpec("gold", weight=2, queue_capacity=64,
+               deadline_budget=20),
+    TenantSpec("std", queue_capacity=64),
+]
+table = MeshTenantTable(specs, ndev=4, region_rows=REGION, clock=clock)
+rings = np.zeros((4, 2 * REGION, RING_ROW), np.int32)
+routed = [table.submit("gold", BUMP, args=[i]).device for i in range(8)]
+print("gold admissions routed to devices:", routed)
+assert routed == [0, 1, 2, 3, 0, 1, 2, 3]  # backlog-balanced
+pinned = table.submit("gold", BUMP, args=[9], device=2)
+assert pinned and pinned.device == 2
+
+# ---------------------------------------------------------------- 2
+# The WRR poll per device is lesson 13's poll, unchanged: weight
+# proportion holds on every device of the mesh.
+for d in range(4):
+    for i in range(8):
+        assert table.submit("gold", BUMP, args=[i], device=d)
+        if i < 4:
+            assert table.submit("std", BUMP, args=[i], device=d)
+drive(table, rings, polls=4)
+snap = table.stats()
+print("completed after 4 WRR rounds:",
+      {t: s["completed"] for t, s in snap.items()})
+# gold (w=2) installs exactly twice std's rows per cycle, mesh-wide.
+assert snap["gold"]["completed"] == 2 * snap["std"]["completed"] > 0
+
+# ---------------------------------------------------------------- 3
+# A live reshard cut 4 -> 2: export (deadline-stamped, tenant-tagged
+# residue + aggregate counter blocks), resume on the smaller mesh -
+# per-tenant counts conserved exactly.
+print("== live reshard cut 4 -> 2 ==")
+for i in range(6):
+    assert table.submit("std", BUMP, args=[i], deadline_s=30.0)
+accepted_before = {t: s["accepted"] for t, s in table.stats().items()}
+table2, state = table.reshard(rings, 2)
+rings2 = np.zeros((2, 2 * REGION, RING_ROW), np.int32)
+t_now[0] += 1.0  # the 30 s budgets re-arm with ~29 s left
+for r in range(32):
+    drive(table2, rings2, polls=2, start=r)
+    if table2.drained():
+        break
+assert table2.drained()
+for tid, s in table2.stats().items():
+    assert s["accepted"] == accepted_before[tid]
+    assert s["accepted"] == s["completed"] + s["expired"] + s["dropped"]
+    print(f"  {tid}: accepted {s['accepted']} == completed "
+          f"{s['completed']} + expired {s['expired']} + dropped "
+          f"{s['dropped']}  (conserved across the cut)")
+
+# ---------------------------------------------------------------- 4
+# Deadline-pressure autoscaling: a storm drains the gold budget; the
+# policy fires a typed deadline_out BEFORE the lane's budget exhausts
+# (the watchdog rung), even mid-cooldown.
+print("== tenant/deadline-aware policy ==")
+policy = AutoscalerPolicy(min_devices=1, max_devices=8,
+                          scale_out_backlog=1e9, scale_in_backlog=4.0,
+                          hysteresis=2, cooldown=3, tenant_pressure=0.25)
+policy._cooling = 3  # prove the pressure path does not wait it out
+ndev = 2
+obs0 = Observation(ndev, [4] * ndev, executed_delta=8, slice_s=1.0,
+                   tenants=table2.pressure())
+print("slice 0:", policy.decide(obs0)[1:])
+# The storm: 8 doomed gold rows expire inside one slice (8/20 = 40%).
+for i in range(8):
+    assert table2.submit("gold", BUMP, args=[i], deadline_s=0.01)
+t_now[0] += 1.0
+table2.absorb(table2.pump(rings2))
+obs1 = Observation(ndev, [4] * ndev, executed_delta=8, slice_s=1.0,
+                   tenants=table2.pressure())
+target, kind, reason = policy.decide(obs1)
+print(f"slice 1: {kind} -> {target} devices ({reason})")
+assert kind == "deadline_out" and target == 2 * ndev
+assert table2.stats()["gold"]["expired"] < 20  # budget NOT exhausted:
+# the controller beat the watchdog's strike ladder to the punch.
+
+# ---------------------------------------------------------------- 5
+# Strand refusal: idle backlog but in-flight ring residue -> the
+# scale-in decision is a typed strand_hold until the residue drains.
+ndev = target
+policy._cooling = 0
+assert table2.submit("gold", BUMP, args=[0], deadline_s=1e6)
+table2.absorb(table2.pump(rings2))  # published, not yet consumed
+busy = Observation(ndev, [0] * ndev, tenants=table2.pressure())
+kinds = [policy.decide(busy)[1] for _ in range(2)]
+print("idle-with-residue decisions:", kinds)
+assert kinds == ["hold", "strand_hold"]
+drive(table2, rings2, polls=2, start=100)  # drain the straggler
+done = Observation(ndev, [0] * ndev, tenants=table2.pressure())
+target, kind, _ = policy.decide(done)
+print(f"drained decision: {kind} -> {target} devices")
+assert kind == "scale_in" and target == ndev // 2
+
+print("lesson 17 OK: mesh-wide tenancy + tenant-aware elasticity")
