@@ -38,28 +38,51 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from .classify import check_migratable, classify_megakernel, trace_class
+from .classify import (
+    KindSummary, check_migratable, classify_megakernel, kind_summaries,
+    trace_class,
+)
+from .explore import (
+    CreditExchangeModel, ExploreResult, InjectQuiesceModel,
+    check_protocols, explore,
+)
 from .findings import (
     AnalysisError, AnalysisFinding, AnalysisReport, verify_default,
 )
 from .layout import check_layout
+from .model import (
+    certify_claim, certify_frontier_schedule, certify_tile_schedule,
+)
 from .races import boxes_overlap, check_batch_spec, check_tile_windows
 from .shim import ShimUnsupported
+from .waits import check_wait_graph, wait_graph
 
 __all__ = [
     "AnalysisError",
     "AnalysisFinding",
     "AnalysisReport",
+    "CreditExchangeModel",
+    "ExploreResult",
+    "InjectQuiesceModel",
+    "KindSummary",
     "ShimUnsupported",
     "boxes_overlap",
+    "certify_claim",
+    "certify_frontier_schedule",
+    "certify_tile_schedule",
     "check_batch_spec",
     "check_layout",
     "check_migratable",
+    "check_protocols",
     "check_tile_windows",
+    "check_wait_graph",
     "classify_megakernel",
+    "explore",
+    "kind_summaries",
     "trace_class",
     "verify_default",
     "verify_megakernel",
+    "wait_graph",
 ]
 
 
@@ -78,10 +101,20 @@ def verify_megakernel(mk, suppress: Sequence[str] = (),
             name, fid, spec, mk.data_specs, mk.scratch_specs,
             report=report,
         )
+    # Wait-graph deadlock detection (waits.py): the construction gate
+    # for any kind performing an on-device promise wait. A tree with no
+    # wait ops pays a cheap code-object scan and zero shim passes; a
+    # waiting tree shares the memoized kind_summaries pass with the
+    # reshard classification.
+    check_wait_graph(mk, report=report)
     # Kind classification is LAZY (classify_megakernel memoizes on the
     # instance): its consumers are describe(), snapshot meta, and
     # reshard's upfront diagnostics, none of which every construction
-    # pays for - the tier-1 budget is the binding constraint.
+    # pays for - the tier-1 budget is the binding constraint. The
+    # bounded-interleaving explorer and schedule-independence
+    # certification (explore.py / model.py) are likewise lazy/budgeted:
+    # they run from tools/hclint.py, describe(), and the CI step, never
+    # per construction.
     if raise_on_error:
         report.raise_errors()
     return report

@@ -26,15 +26,18 @@ ever migrates wrong.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..device.descriptor import NO_TASK
 from .findings import ERROR, WARN, AnalysisReport
 from .shim import ShimUnsupported, run_batch_body, run_scalar_kernel
 
 __all__ = [
+    "KindSummary",
     "classify_megakernel",
     "check_migratable",
+    "kind_summaries",
     "trace_class",
 ]
 
@@ -45,12 +48,25 @@ UNKNOWN = "unknown"
 
 # Scalar kernel fns are usually module-level functions shared across
 # every construction in a process (the suite builds the same families
-# hundreds of times) - classification depends only on what the body
-# DOES, so memoize per function object. Weak keys: a dynamically
-# created closure's entry dies with it.
+# hundreds of times) - the summary depends only on what the body DOES,
+# so memoize per function object. Weak keys: a dynamically created
+# closure's entry dies with it.
 import weakref  # noqa: E402
 
 _scalar_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+@dataclass
+class KindSummary:
+    """Everything one recording-shim pass teaches about a kernel kind:
+    the reshard classification plus the promise-op events the wait-graph
+    analysis consumes - so classification and deadlock detection share
+    ONE pass per function object."""
+
+    cls: str
+    waits: List[Tuple[int, int]] = field(default_factory=list)
+    satisfies: List[Tuple[int, int]] = field(default_factory=list)
+    spawn_fns: List[int] = field(default_factory=list)
 
 
 def trace_class(trace) -> str:
@@ -66,23 +82,36 @@ def trace_class(trace) -> str:
     return LINK_FREE
 
 
-def classify_megakernel(mk) -> Dict[str, str]:
-    """{kernel name: class} for every kernel-table entry of ``mk``
-    (memoized on the instance - construction and every later
-    describe()/snapshot call share one shim pass)."""
-    cached = getattr(mk, "_kind_classes", None)
+def _summarize(trace) -> KindSummary:
+    return KindSummary(
+        cls=trace_class(trace),
+        waits=[(vs, seq) for _s, vs, seq in trace.waits],
+        satisfies=[(vs, seq) for _s, vs, seq in trace.satisfies],
+        spawn_fns=sorted({sp["fn"] for _s, sp in trace.spawns}),
+    )
+
+
+def kind_summaries(mk) -> Dict[str, KindSummary]:
+    """{kernel name: KindSummary} for every kernel-table entry of ``mk``
+    (memoized on the instance AND per scalar function object, so
+    construction-time wait-graph checks, describe(), and reshard
+    diagnostics all share one shim pass per body)."""
+    cached = getattr(mk, "_kind_summaries", None)
     if cached is not None:
         return cached
     from ..device.megakernel import _is_batch_spec, _is_vector_spec
 
-    out: Dict[str, str] = {}
+    out: Dict[str, KindSummary] = {}
     batch_bodies = {name: spec for name, spec in mk.route.items()
                     if _is_batch_spec(spec)}
     for i, name in enumerate(mk.kernel_names):
         if (name in mk.route and _is_vector_spec(mk.route[name])) or (
             getattr(mk.kernel_fns[i], "_hclib_vector_wrapped", False)
         ):
-            out[name] = VECTOR
+            # Never abstract-interpret a subtree engine (it embeds
+            # whole-engine sweeps); vector kinds complete in place and
+            # expose no promise ops.
+            out[name] = KindSummary(cls=VECTOR)
             continue
         try:
             if name in batch_bodies:
@@ -90,26 +119,53 @@ def classify_megakernel(mk) -> Dict[str, str]:
                     batch_bodies[name], i, mk.data_specs,
                     mk.scratch_specs, prefetch_count=0,
                 )
-                out[name] = trace_class(t)
+                out[name] = _summarize(t)
             else:
                 fn = mk.kernel_fns[i]
                 try:
-                    cached = _scalar_cache.get(fn)
+                    hit = _scalar_cache.get(fn)
                 except TypeError:
-                    cached = None
-                if cached is not None:
-                    out[name] = cached
+                    hit = None
+                if hit is not None:
+                    out[name] = hit
                 else:
                     t = run_scalar_kernel(
                         fn, mk.data_specs, mk.scratch_specs,
                     )
-                    out[name] = trace_class(t)
+                    out[name] = _summarize(t)
                     try:
                         _scalar_cache[fn] = out[name]
                     except TypeError:
                         pass
-        except ShimUnsupported:
-            out[name] = UNKNOWN
+        except ShimUnsupported as e:
+            # Keep the promise-op events recorded BEFORE the unmodelled
+            # construct (the partial trace rides the exception): a body
+            # whose tail the shim cannot run must still feed its waits
+            # to the deadlock gate - UNKNOWN classification, known
+            # waits.
+            partial = getattr(e, "trace", None)
+            out[name] = (
+                _summarize_partial(partial) if partial is not None
+                else KindSummary(cls=UNKNOWN)
+            )
+    mk._kind_summaries = out
+    return out
+
+
+def _summarize_partial(trace) -> KindSummary:
+    s = _summarize(trace)
+    s.cls = UNKNOWN
+    return s
+
+
+def classify_megakernel(mk) -> Dict[str, str]:
+    """{kernel name: class} for every kernel-table entry of ``mk``
+    (memoized on the instance - construction and every later
+    describe()/snapshot call share one shim pass)."""
+    cached = getattr(mk, "_kind_classes", None)
+    if cached is not None:
+        return cached
+    out = {name: s.cls for name, s in kind_summaries(mk).items()}
     mk._kind_classes = out
     return out
 

@@ -1,0 +1,481 @@
+"""Bounded-interleaving protocol exploration (the ``interleaving`` rule).
+
+The repo's concurrency protocols - the WRR tenant inject poll, the
+steal-credit exchange, the quiesce-settle condition - already have
+host-side executable specs (``tenants.wrr_poll_reference``, the credit
+discipline documented in device/resident.py, the freeze contract the
+checkpoint export promises). Runtime tests exercise ONE schedule per
+seed; this module explores EVERY schedule of a small seeded
+configuration, depth-bounded, and checks the properties the specs
+promise:
+
+- **termination / no wedge**: every maximal interleaving reaches a
+  terminal state with no work pending (a terminal state with pending
+  work is a deadlock - the credit-wedge shape ``credit_timeout=0``
+  produces at runtime, found here as a concrete action prefix);
+- **conservation**: installed == executed + dropped + residue at every
+  terminal state (nothing lost, nothing double-counted);
+- **quiesce freeze**: once quiesce is observed, the words the
+  checkpoint would export are exactly the words still live at exit - a
+  poll that keeps consuming after the freeze diverges and is refused.
+
+The explorer is a stateful DFS with full state deduplication: states
+are small tuples, so the REACHABLE SPACE - not the path space - bounds
+the work, which is the reduction that matters at these sizes. A
+footprint-based persistent-set reduction was tried and REJECTED as
+unsound here: disjointness against the currently-enabled set is not
+enough, because an action can disable a FUTURE dependency (exec
+consuming the victim's surplus disables the steal request whose
+interleaving holds the wedge) - pruning on it silently dropped the
+credit-wedge witness. ``Model.footprint`` remains part of the model
+interface (it documents each action's resource set and feeds the
+independence diagnostics in witnesses), but no schedule is ever
+skipped. Depth and wall budget are knobs (``HCLIB_TPU_MODEL_DEPTH`` /
+``HCLIB_TPU_MODEL_BUDGET_S``, runtime/env.py); an exhausted budget
+flags the result incomplete instead of silently passing.
+
+Everything is host-only numpy/python - no Pallas, no Mosaic - and the
+poll model calls ``wrr_poll_reference`` itself, so the explored
+semantics can never drift from the executable spec the fairness tests
+and chaos scenarios run.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.env import env_float, env_int
+from .findings import ERROR, AnalysisReport
+
+__all__ = [
+    "Action",
+    "CreditExchangeModel",
+    "ExploreResult",
+    "InjectQuiesceModel",
+    "check_protocols",
+    "default_depth",
+    "default_budget_s",
+    "explore",
+]
+
+Action = Tuple  # ("name", arg, ...) - hashable, printable
+
+
+def default_depth() -> int:
+    return env_int("HCLIB_TPU_MODEL_DEPTH", 64)
+
+
+def default_budget_s() -> float:
+    return env_float("HCLIB_TPU_MODEL_BUDGET_S", 20.0)
+
+
+@dataclass
+class Violation:
+    message: str
+    witness: Tuple[Action, ...]
+    state: Tuple
+
+
+@dataclass
+class ExploreResult:
+    """What one bounded exploration established."""
+
+    states: int = 0
+    terminals: int = 0
+    transitions: int = 0
+    complete: bool = True    # False: depth/budget bound cut the search
+    violations: List[Violation] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+
+def explore(model, depth: Optional[int] = None,
+            budget_s: Optional[float] = None,
+            max_states: int = 200_000) -> ExploreResult:
+    """Explore every interleaving of ``model`` from its initial state
+    (up to dedup + reduction), checking terminal states. Stops early -
+    flagged incomplete - on the depth bound, the wall budget, or the
+    state cap."""
+    depth = default_depth() if depth is None else int(depth)
+    budget = default_budget_s() if budget_s is None else float(budget_s)
+    t_end = time.monotonic() + budget
+    res = ExploreResult()
+    seen: Dict[Tuple, int] = {}
+    # DFS stack of (state, prefix tuple).
+    stack: List[Tuple[Tuple, Tuple[Action, ...]]] = [(model.initial(), ())]
+    while stack:
+        if time.monotonic() > t_end or len(seen) > max_states:
+            res.complete = False
+            break
+        state, prefix = stack.pop()
+        if state in seen:
+            continue
+        seen[state] = len(prefix)
+        res.states += 1
+        enabled = model.enabled(state)
+        if not enabled:
+            res.terminals += 1
+            for msg in model.check_final(state):
+                res.violations.append(Violation(msg, prefix, state))
+            continue
+        if len(prefix) >= depth:
+            res.complete = False
+            continue
+        # EVERY enabled action branches - no schedule is skipped (see
+        # the module docstring for why footprint-based pruning against
+        # the enabled set alone is unsound: it can hide an interleaving
+        # whose key action only becomes enabled later). The state dedup
+        # above is the whole reduction.
+        for a in enabled:
+            res.transitions += 1
+            stack.append((model.apply(state, a), prefix + (a,)))
+    return res
+
+
+# ----------------------------------------------- inject poll + quiesce
+
+
+class InjectQuiesceModel:
+    """The streaming-inject front door as a model: per-tenant ring
+    regions consumed by the WRR poll (``wrr_poll_reference`` - the
+    executable spec itself, called per transition), an install queue the
+    scheduler drains, and the quiesce freeze.
+
+    Config: ``lanes`` is a sequence of (rows, weight) or (rows, weight,
+    expired_mask, paused); ``capacity`` bounds the scheduler headroom
+    (install queue depth); ``quiesce=True`` adds the quiesce action;
+    ``freeze_poll=False`` plants the protocol bug where the poll keeps
+    consuming after the freeze - the seeded quiesce-divergence fixture.
+
+    State: (consumed per lane, dropped per lane, expired per lane,
+    queue, executed, polls, quiescing, exported-residue-or-None).
+    """
+
+    def __init__(self, lanes: Sequence[Tuple], capacity: int = 4,
+                 quiesce: bool = False, freeze_poll: bool = True,
+                 region_rows: int = 8) -> None:
+        norm = []
+        for lane in lanes:
+            rows, weight = lane[0], lane[1]
+            expired = tuple(lane[2]) if len(lane) > 2 else ()
+            paused = bool(lane[3]) if len(lane) > 3 else False
+            if rows > region_rows:
+                raise ValueError(
+                    f"lane rows {rows} exceed region_rows {region_rows}"
+                )
+            norm.append((int(rows), int(weight), expired, paused))
+        self.lanes = norm
+        self.capacity = int(capacity)
+        self.quiesce = bool(quiesce)
+        self.freeze_poll = bool(freeze_poll)
+        self.region_rows = int(region_rows)
+        self.total_rows = sum(r for r, _w, _e, _p in norm)
+
+    def initial(self) -> Tuple:
+        T = len(self.lanes)
+        return ((0,) * T, (0,) * T, (0,) * T, 0, 0, 0, 0, None)
+
+    def _residue(self, state) -> Tuple[int, ...]:
+        cons = state[0]
+        return tuple(
+            rows - c for (rows, _w, _e, _p), c in zip(self.lanes, cons)
+        )
+
+    def enabled(self, state) -> List[Action]:
+        cons, _drop, _exp, queue, _ex, _polls, quiescing, _snap = state
+        out: List[Action] = []
+        poll_frozen = quiescing and self.freeze_poll
+        if not poll_frozen and queue < self.capacity:
+            if any(
+                rows - c > 0 and w > 0 and not p
+                for (rows, w, _e, p), c in zip(self.lanes, cons)
+            ) or any(
+                rows - c > 0 and p
+                for (rows, _w, _e, p), c in zip(self.lanes, cons)
+            ):
+                out.append(("poll",))
+        if queue > 0:
+            out.append(("exec",))
+        if self.quiesce and not quiescing:
+            out.append(("quiesce",))
+        return out
+
+    def apply(self, state, action) -> Tuple:
+        cons, drop, exp, queue, executed, polls, quiescing, snap = state
+        if action[0] == "exec":
+            return (cons, drop, exp, queue - 1, executed + 1, polls,
+                    quiescing, snap)
+        if action[0] == "quiesce":
+            return (cons, drop, exp, queue, executed, polls, 1,
+                    self._residue(state))
+        # poll: rebuild the numpy tctl/ring and run the executable spec.
+        from ..device.descriptor import RING_ROW, TEN_EXPIRED
+        from ..device.tenants import (
+            TC_CONSUMED, TC_DROPPED, TC_EXPIRED, TC_PAUSE, TC_TAIL,
+            TC_WEIGHT, wrr_poll_reference,
+        )
+
+        T = len(self.lanes)
+        tctl = np.zeros((T, 8), np.int64)
+        ring = np.zeros((T * self.region_rows, RING_ROW), np.int32)
+        for li, (rows, w, expired, paused) in enumerate(self.lanes):
+            tctl[li, TC_TAIL] = rows
+            tctl[li, TC_CONSUMED] = cons[li]
+            tctl[li, TC_WEIGHT] = w
+            tctl[li, TC_PAUSE] = 1 if paused else 0
+            for r in expired:
+                ring[li * self.region_rows + int(r), TEN_EXPIRED] = 1
+        installed = wrr_poll_reference(
+            ring, tctl, self.region_rows, polls, self.capacity - queue
+        )
+        return (
+            tuple(int(tctl[li, TC_CONSUMED]) for li in range(T)),
+            tuple(
+                drop[li] + int(tctl[li, TC_DROPPED]) for li in range(T)
+            ),
+            tuple(
+                exp[li] + int(tctl[li, TC_EXPIRED]) for li in range(T)
+            ),
+            queue + len(installed),
+            executed,
+            # Only the WRR start-lane rotation reads the round index, so
+            # the state keeps it mod T - the state space stays finite.
+            (polls + 1) % T,
+            quiescing,
+            snap,
+        )
+
+    def footprint(self, action) -> FrozenSet[str]:
+        return {
+            "poll": frozenset({"ring", "queue"}),
+            "exec": frozenset({"queue"}),
+            "quiesce": frozenset({"ring", "quiesce"}),
+        }[action[0]]
+
+    def check_final(self, state) -> List[str]:
+        cons, drop, exp, queue, executed, _polls, quiescing, snap = state
+        out: List[str] = []
+        residue = self._residue(state)
+        consumed = sum(cons)
+        if consumed != executed + sum(drop) + sum(exp) + queue:
+            out.append(
+                "conservation: consumed "
+                f"{consumed} != executed {executed} + dropped "
+                f"{sum(drop)} + expired {sum(exp)} + queued {queue}"
+            )
+        # Cursor sanity per lane (residue = rows - consumed is an
+        # identity, so "seeded == consumed + residue" would be a
+        # tautology; the checkable property is the cursor staying
+        # inside its region - a poll that walked past tail or backward
+        # would double-count or resurrect rows).
+        for li, ((rows, _w, _e, _p), c) in enumerate(
+            zip(self.lanes, cons)
+        ):
+            if not 0 <= c <= rows:
+                out.append(
+                    f"conservation: lane {li} consumed cursor {c} "
+                    f"outside its region [0, {rows}]"
+                )
+        if quiescing and snap is not None and tuple(snap) != residue:
+            out.append(
+                "quiesce-freeze: the residue exported at observation "
+                f"{tuple(snap)} != the residue at exit {residue} (the "
+                "poll consumed rows the checkpoint already exported)"
+            )
+        return out
+
+
+# --------------------------------------------------- credit exchange
+
+
+class CreditExchangeModel:
+    """The steal-credit exchange as a model (the device/resident.py
+    discipline): a thief requests, the victim grants a row over the
+    wire WITH a credit, the thief's owed wait consumes the credit and
+    installs the row. A dropped credit (``drop_credit=k`` drops the
+    k-th grant's credit - the seeded DeviceFaultPlan fault) leaves the
+    row in flight and the thief's wait never enabled: without
+    regeneration (``regen=False``, the ``credit_timeout=0`` lockstep
+    wedge) the exploration finds the terminal-with-work-pending
+    interleaving and returns it as the witness; ``regen=True`` (the
+    shipped recovery: a starved waiter skips the owed wait and recovers
+    the row) restores termination + conservation on every schedule.
+
+    State: (tasks per dev, executed, request-or-None, wire row count,
+    credits per dev, grants, dropped credits).
+    """
+
+    def __init__(self, tasks: Sequence[int],
+                 drop_credit: Optional[int] = None,
+                 regen: bool = False, max_steals: int = 4) -> None:
+        self.tasks0 = tuple(int(t) for t in tasks)
+        self.ndev = len(self.tasks0)
+        self.drop_credit = drop_credit
+        self.regen = bool(regen)
+        self.max_steals = int(max_steals)
+        self.total = sum(self.tasks0)
+
+    def initial(self) -> Tuple:
+        return (self.tasks0, 0, None, 0, (0,) * self.ndev, 0, 0)
+
+    def enabled(self, state) -> List[Action]:
+        tasks, _ex, req, wire, credits, grants, _drops = state
+        out: List[Action] = []
+        for d in range(self.ndev):
+            if tasks[d] > 0:
+                out.append(("exec", d))
+        if req is None and grants < self.max_steals:
+            for t in range(self.ndev):
+                if tasks[t] == 0 and credits[t] == 0:
+                    for v in range(self.ndev):
+                        if v != t and tasks[v] > 1:
+                            out.append(("request", t, v))
+        if req is not None:
+            # A victim drained between request and response answers
+            # EMPTY (deny) - it cannot grant a row it no longer holds.
+            if tasks[req[1]] > 0:
+                out.append(("grant", req[0], req[1]))
+            else:
+                out.append(("deny", req[0], req[1]))
+        orphaned = wire - sum(credits)
+        for t in range(self.ndev):
+            if credits[t] > 0 and wire > 0:
+                out.append(("recv", t))
+            elif self.regen and orphaned > 0 and credits[t] == 0:
+                # Starved-channel credit regeneration (the shipped
+                # recovery): a waiter whose owed credit never arrived -
+                # an ORPHANED in-flight row exists - skips the owed
+                # wait and recovers the row.
+                out.append(("regen", t))
+        return out
+
+    def apply(self, state, action) -> Tuple:
+        tasks, ex, req, wire, credits, grants, drops = state
+        tasks = list(tasks)
+        credits = list(credits)
+        kind = action[0]
+        if kind == "exec":
+            tasks[action[1]] -= 1
+            ex += 1
+        elif kind == "request":
+            req = (action[1], action[2])
+        elif kind == "deny":
+            req = None
+        elif kind == "grant":
+            t, v = action[1], action[2]
+            tasks[v] -= 1
+            wire += 1
+            if self.drop_credit is not None and grants == self.drop_credit:
+                drops += 1  # the credit is lost in flight
+            else:
+                credits[t] += 1
+            grants += 1
+            req = None
+        elif kind == "recv":
+            t = action[1]
+            credits[t] -= 1
+            wire -= 1
+            tasks[t] += 1
+        elif kind == "regen":
+            t = action[1]
+            wire -= 1
+            tasks[t] += 1
+        return (tuple(tasks), ex, req, wire, tuple(credits), grants,
+                drops)
+
+    def footprint(self, action) -> FrozenSet:
+        kind = action[0]
+        if kind == "exec":
+            return frozenset({("dev", action[1])})
+        if kind == "recv" or kind == "regen":
+            return frozenset({("dev", action[1]), "wire"})
+        # request/grant touch both endpoints and the wire.
+        return frozenset(
+            {("dev", action[1]), ("dev", action[2]), "wire"}
+        )
+
+    def check_final(self, state) -> List[str]:
+        tasks, ex, _req, wire, _credits, _grants, drops = state
+        out: List[str] = []
+        if ex + sum(tasks) + wire != self.total:
+            out.append(
+                f"conservation: executed {ex} + queued {sum(tasks)} + "
+                f"in-flight {wire} != seeded {self.total}"
+            )
+        if ex < self.total:
+            why = (
+                f"credit wedge: {wire} stolen row(s) in flight with "
+                f"{drops} dropped credit(s) and no regeneration - the "
+                "thief's owed wait never fires, so the mesh exits with "
+                f"{self.total - ex} task(s) unrun"
+                if drops
+                else f"deadlock: {self.total - ex} task(s) unrun with "
+                "no enabled action"
+            )
+            out.append(why)
+        return out
+
+
+# ------------------------------------------------------------ curated
+
+
+def check_protocols(report: Optional[AnalysisReport] = None,
+                    depth: Optional[int] = None,
+                    budget_s: Optional[float] = None,
+                    configs: Optional[Sequence[Tuple[str, Any]]] = None
+                    ) -> AnalysisReport:
+    """Run the explorer over the curated protocol configurations (the
+    hclint/CI audit): the WRR poll with skewed weights + expired rows +
+    backpressure, the poll under a mid-stream quiesce, and the credit
+    exchange with the shipped regeneration recovery. All must explore
+    clean; violations land as ``interleaving`` error findings with the
+    action-prefix witness."""
+    report = report or AnalysisReport()
+    if configs is None:
+        configs = [
+            (
+                "inject-wrr(2:1, expired, backpressure)",
+                InjectQuiesceModel(
+                    [(3, 2, (1,)), (2, 1), (2, 1, (), True)],
+                    capacity=2,
+                ),
+            ),
+            (
+                "inject-quiesce(freeze)",
+                InjectQuiesceModel(
+                    [(2, 1), (2, 2)], capacity=2, quiesce=True,
+                ),
+            ),
+            (
+                "steal-credit(regen)",
+                CreditExchangeModel(
+                    (3, 0), drop_credit=0, regen=True, max_steals=2,
+                ),
+            ),
+            (
+                "steal-credit(clean)",
+                CreditExchangeModel((2, 1), max_steals=2),
+            ),
+        ]
+    for label, model in configs:
+        res = explore(model, depth=depth, budget_s=budget_s)
+        for v in res.violations:
+            report.add(
+                "interleaving", ERROR, None,
+                f"protocol model {label}: {v.message}",
+                interleaving=v.witness, config=label, state=v.state,
+            )
+        if not res.complete:
+            report.add(
+                "shim-unsupported", "info", None,
+                f"protocol model {label}: exploration hit its "
+                f"depth/budget bound after {res.states} states - "
+                "verdicts cover the explored prefix only",
+            )
+    return report

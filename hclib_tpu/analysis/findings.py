@@ -17,6 +17,18 @@ Rule ids (stable; the suppression syntax and README table key on them):
     reshard-class     a kernel kind's migratability claim contradicts
                       its classified behavior (home-linked mislabeled
                       migratable)
+    wait-cycle        the per-kind spawn/wait/satisfy graph holds a
+                      cycle (or an unsatisfiable wait): an on-device
+                      promise wait that can deadlock under every
+                      schedule (analysis/waits.py)
+    interleaving      the bounded-interleaving explorer found a
+                      protocol violation - deadlock/wedge, conservation
+                      break, or quiesce-freeze divergence - with the
+                      action-prefix interleaving as the witness
+                      (analysis/explore.py)
+    schedule-independence  a kernel claiming schedule-independence
+                      diverged across permuted pop orders; the witness
+                      is the two divergent schedules (analysis/model.py)
     shim-unsupported  a body could not be abstractly interpreted
                       (info only: nothing verified, nothing refuted)
 
@@ -62,6 +74,17 @@ class AnalysisFinding:
         w = f" witness={self.witness}" if self.witness else ""
         s = " (suppressed)" if self.suppressed else ""
         return f"{self.severity}: {self.rule}{k}: {self.message}{w}{s}"
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        """The one serialization of a finding (the --json-out artifact
+        schema): reports and certificate-embedded findings must agree
+        field-for-field so the CI diff never splits."""
+        return {
+            "rule": self.rule, "severity": self.severity,
+            "kernel": self.kernel, "message": self.message,
+            "witness": {k: repr(v) for k, v in self.witness.items()},
+            "suppressed": self.suppressed,
+        }
 
 
 class AnalysisError(ValueError):
@@ -129,15 +152,7 @@ class AnalysisReport:
             raise AnalysisError(self)
 
     def to_jsonable(self) -> List[Dict[str, Any]]:
-        return [
-            {
-                "rule": f.rule, "severity": f.severity,
-                "kernel": f.kernel, "message": f.message,
-                "witness": {k: repr(v) for k, v in f.witness.items()},
-                "suppressed": f.suppressed,
-            }
-            for f in self.findings
-        ]
+        return [f.to_jsonable() for f in self.findings]
 
 
 def verify_default() -> bool:

@@ -1,0 +1,347 @@
+"""Schedule-independence certification (the ``schedule-independence``
+rule) - the third model-checker analysis.
+
+The frontier traversals (BFS/SSSP as monotone label correction,
+PageRank as conserved integer mass) and the forasync tile loops claim
+their results are independent of execution order - that claim is what
+lets "bit-identical across scalar dispatch, batched tier, and the
+mesh" hold with no ordering machinery, and what makes their rows
+migratable/reshardable without replay. This module CHECKS the claim
+instead of trusting the docstring: run the kernel's abstract body
+(the same relax/compute trace the device executes, host-side over
+concrete numpy state) to the fixpoint under K permuted pop orders and
+prove the final state identical. Identical -> a certificate surfaced in
+``Megakernel.describe()`` beside the reshard classification; divergent
+-> certification is REFUSED with both schedules in the diagnostic (an
+``AnalysisError`` whose witness carries the two pop orders and the
+first differing word).
+
+Like every hclint analysis this is host-only composition - no Pallas
+build, no Mosaic - and lazy: builders stamp ``mk.si_claim`` at
+construction for free, and the certification runs on demand
+(describe(), tools/hclint.py, the CI step), memoized per claim.
+
+A certificate is evidence over K orders of a seeded configuration, not
+a proof over all schedules - which is exactly the exactness contract
+the runtime leans on (the acceptance suites then pin bit-identity on
+the real schedules). K rides ``HCLIB_TPU_MODEL_PERMS``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.env import env_int
+from .findings import ERROR, AnalysisReport
+from .shim import BodyTrace, FakeRef, _norm_box, _patched
+
+__all__ = [
+    "certify_claim",
+    "certify_frontier_schedule",
+    "certify_tile_schedule",
+]
+
+RULE = "schedule-independence"
+
+# Tile spaces above this are not concretely simulated K times at
+# describe() time (the certificate would cost more than the build);
+# hclint's curated spaces sit far below it.
+TILE_SPACE_CAP = 4096
+# Fixpoint step cap: a (buggy) diverging claim terminates the
+# certification instead of the process.
+STEP_CAP = 200_000
+
+_frontier_cache: Dict[Tuple, Dict[str, Any]] = {}
+
+import weakref  # noqa: E402
+
+_tile_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _perms() -> int:
+    return max(2, env_int("HCLIB_TPU_MODEL_PERMS", 3))
+
+
+def _np_index(box) -> Tuple:
+    return tuple(slice(lo, hi) for lo, hi in box)
+
+
+def _fill(shape, dtype, salt: int) -> np.ndarray:
+    """Deterministic synthetic buffer contents (iota + salt, wrapped
+    small so int dtypes never overflow under arithmetic bodies)."""
+    n = int(np.prod(shape)) if shape else 1
+    base = (np.arange(n, dtype=np.int64) * 7 + salt * 13) % 97
+    return base.reshape(shape).astype(dtype)
+
+
+def _finding_jsonable(f) -> List[Dict[str, Any]]:
+    return [f.to_jsonable()]
+
+
+def _schedule_witness(order: Sequence, cap: int = 16) -> List:
+    out = [list(map(int, np.atleast_1d(o))) if not np.isscalar(o)
+           else int(o) for o in list(order)[:cap]]
+    if len(order) > cap:
+        out.append(f"... {len(order) - cap} more")
+    return out
+
+
+# ------------------------------------------------------------ tiles
+
+
+def certify_tile_schedule(tk, bounds, tile, *,
+                          perms: Optional[int] = None, seed: int = 0,
+                          report: Optional[AnalysisReport] = None,
+                          raise_on_error: bool = True) -> Dict[str, Any]:
+    """Certify one forasync tile loop: execute every tile's
+    load->compute->store pipeline concretely over synthetic buffers in
+    K permuted orders; identical final buffers = certified. A tile
+    whose LOADS overlap another tile's STORES is order-dependent (the
+    in-place-stencil bug class) and diverges concretely - refused with
+    the two schedules."""
+    from ..device.forasync_tier import tile_args, tile_grid
+
+    perms = _perms() if perms is None else int(perms)
+    key = (repr(tuple(bounds)),
+           repr(tuple(tile) if not isinstance(tile, int) else (tile,)),
+           perms, seed)
+    cached = _tile_cache.get(tk)
+    if cached is not None and key in cached:
+        return cached[key]
+    dims, tile_dims, counts, total = tile_grid(bounds, tile)
+    cert: Dict[str, Any] = {
+        "claim": "forasync-tiles", "kernel": tk.name, "tiles": total,
+        "orders": perms,
+    }
+    if total > TILE_SPACE_CAP:
+        cert["status"] = f"unverified (tile space {total} > cap)"
+        return cert
+
+    def run_order(order) -> Dict[str, np.ndarray]:
+        bufs = {
+            name: _fill(tuple(spec.shape), np.dtype(spec.dtype), si)
+            for si, (name, spec) in enumerate(sorted(
+                tk.data_specs.items()
+            ))
+        }
+        for flat in order:
+            args = tuple(tile_args(dims, tile_dims, counts, int(flat)))
+            ins = {}
+            for s in tk.loads:
+                box = _norm_box(bufs[s.data].shape, s.index(args))
+                ins[s.name] = bufs[s.data][_np_index(box)].copy()
+            outs = tk.compute(ins)
+            for s in tk.stores:
+                box = _norm_box(bufs[s.data].shape, s.index(args))
+                bufs[s.data][_np_index(box)] = np.asarray(outs[s.name])
+        return bufs
+
+    rng = np.random.default_rng(seed)
+    orders = [list(range(total))]
+    for _ in range(perms - 1):
+        orders.append(list(rng.permutation(total)))
+    ref = run_order(orders[0])
+    for k in range(1, perms):
+        got = run_order(orders[k])
+        for name in sorted(ref):
+            if not np.array_equal(ref[name], got[name]):
+                diff = np.argwhere(
+                    np.asarray(ref[name]) != np.asarray(got[name])
+                )[0]
+                report = report or AnalysisReport()
+                f = report.add(
+                    RULE, ERROR, tk.name,
+                    f"tile loop {tk.name!r} is order-DEPENDENT: buffer "
+                    f"{name!r} diverges at {tuple(int(i) for i in diff)} "
+                    "between two pop orders (a tile reads a window "
+                    "another tile stores); certification refused",
+                    buffer=name, index=tuple(int(i) for i in diff),
+                    schedule_a=_schedule_witness(orders[0]),
+                    schedule_b=_schedule_witness(orders[k]),
+                    value_a=ref[name][tuple(diff)],
+                    value_b=got[name][tuple(diff)],
+                )
+                cert["status"] = "refused (order-dependent)"
+                # Only THIS refusal rides the certificate (the caller's
+                # report may hold unrelated program findings).
+                cert["findings"] = _finding_jsonable(f)
+                if raise_on_error:
+                    report.raise_errors()
+                return cert
+    cert["status"] = "certified"
+    if cached is None:
+        try:
+            _tile_cache[tk] = {key: cert}
+        except TypeError:
+            pass
+    else:
+        cached[key] = cert
+    return cert
+
+
+# --------------------------------------------------------- frontier
+
+
+class _AbsFrontierCtx:
+    """The concrete-interpretation context one frontier task body runs
+    against: real numpy ivalues behind a FakeRef (so ``pl.when`` /
+    ``fori_loop`` patched by the shim evaluate concretely) and a spawn
+    sink feeding the worklist."""
+
+    def __init__(self, iv: np.ndarray, sink: List[Tuple[int, ...]]):
+        self.ivalues = FakeRef("abs:ivalues", "smem", backing=iv)
+        self._sink = sink
+
+    def spawn(self, fn, args=(), nargs=None, **kw) -> int:
+        self._sink.append(
+            tuple(int(np.asarray(a)) for a in args)
+        )
+        return 0
+
+
+def _small_graph(seed: int):
+    from ..device.frontier import Graph
+    from ..device.workloads import rmat_edges
+
+    n, src, dst, w = rmat_edges(4, efactor=4, seed=seed + 11)
+    return Graph(n, src, dst, w)
+
+
+def certify_frontier_schedule(kind: str, *, reps: int = 64,
+                              perms: Optional[int] = None, seed: int = 0,
+                              report: Optional[AnalysisReport] = None,
+                              raise_on_error: bool = True,
+                              fk=None, graph=None) -> Dict[str, Any]:
+    """Certify one frontier traversal kind: run its relax body (the
+    SAME ``_relax_block`` loop both dispatch spellings trace) to the
+    fixpoint over a small seeded R-MAT graph under K permuted worklist
+    pop orders, and prove the per-vertex state identical. ``fk``/
+    ``graph`` override the defaults (the order-dependent-refusal tests
+    pass a planted kernel)."""
+    from ..device.frontier import _KINDS, seed_frontier
+
+    perms = _perms() if perms is None else int(perms)
+    custom = fk is not None or graph is not None
+    key = ("frontier", kind, reps, perms, seed)
+    if not custom and key in _frontier_cache:
+        return _frontier_cache[key]
+    g = graph if graph is not None else _small_graph(seed)
+    if fk is None:
+        if kind not in _KINDS:
+            raise ValueError(f"unknown frontier kind {kind!r}")
+        fk = _KINDS[kind](reps=reps) if kind == "pagerank" else (
+            _KINDS[kind]()
+        )
+    fk.st_base = g.st_base
+    m0 = 1 << 12
+    seeds = seed_frontier(None, g, kind, src=0, m0=m0, reps=reps)
+    cert: Dict[str, Any] = {
+        "claim": "frontier", "kind": kind, "orders": perms,
+        "vertices": g.n, "seeds": len(seeds),
+    }
+
+    def run_order(perm_seed: int):
+        from ..device.frontier import _pr_seed_rank
+
+        iv = g.preset_values(g.num_value_slots, fk.state0).astype(
+            np.int64
+        )
+        if kind in ("bfs", "sssp"):
+            iv[g.st_base] = 0
+        elif kind == "pagerank":
+            iv[g.st_base : g.st_base + g.n] = _pr_seed_rank(g, m0, reps)
+        wl: List[Tuple[int, ...]] = list(seeds)
+        rng = np.random.default_rng(seed * 1000 + perm_seed)
+        schedule: List[Tuple[int, ...]] = []
+        steps = 0
+        trace = BodyTrace()
+        with _patched(trace):
+            while wl:
+                steps += 1
+                if steps > STEP_CAP:
+                    return None, schedule, steps
+                i = 0 if perm_seed == 0 else int(
+                    rng.integers(len(wl))
+                )
+                v, blk, carry, cnt = wl.pop(i)
+                schedule.append((v, blk, carry, cnt))
+                ctx = _AbsFrontierCtx(iv, wl)
+                fk._relax_block(
+                    ctx,
+                    lambda e, blk=blk: int(g.indices[blk][int(e)]),
+                    (lambda e, blk=blk: int(g.weights[blk][int(e)]))
+                    if fk.weighted else None,
+                    carry,
+                    cnt,
+                )
+        return iv[g.st_base : g.st_base + g.n].copy(), schedule, steps
+
+    ref, sched0, steps0 = run_order(0)
+    if ref is None:
+        cert["status"] = f"unverified (fixpoint > {STEP_CAP} steps)"
+        return cert
+    cert["tasks"] = steps0
+    for k in range(1, perms):
+        got, schedk, _ = run_order(k)
+        if got is None:
+            cert["status"] = f"unverified (fixpoint > {STEP_CAP} steps)"
+            return cert
+        if not np.array_equal(ref, got):
+            v = int(np.argwhere(ref != got)[0][0])
+            report = report or AnalysisReport()
+            f = report.add(
+                RULE, ERROR, fk.name,
+                f"frontier kind {fk.name!r} is order-DEPENDENT: vertex "
+                f"{v} fixpoint diverges ({int(ref[v])} vs {int(got[v])})"
+                " between two pop orders; certification refused - the "
+                "two divergent schedules ride the witness",
+                vertex=v, value_a=int(ref[v]), value_b=int(got[v]),
+                schedule_a=_schedule_witness(sched0),
+                schedule_b=_schedule_witness(schedk),
+            )
+            cert["status"] = "refused (order-dependent)"
+            cert["findings"] = _finding_jsonable(f)
+            if raise_on_error:
+                report.raise_errors()
+            return cert
+    cert["status"] = "certified"
+    if not custom:
+        _frontier_cache[key] = cert
+    return cert
+
+
+# ------------------------------------------------------------ claims
+
+
+def certify_claim(mk, *, raise_on_error: bool = True,
+                  report: Optional[AnalysisReport] = None
+                  ) -> Optional[Dict[str, Any]]:
+    """Resolve and certify ``mk.si_claim`` (stamped by
+    make_frontier_megakernel / run_forasync_device). Returns the
+    certificate dict, or None when the builder made no claim. With
+    ``raise_on_error`` a refused certification raises ``AnalysisError``
+    carrying both divergent schedules."""
+    claim = getattr(mk, "si_claim", None)
+    if claim is None:
+        return None
+    if claim[0] == "frontier":
+        _tag, kind, reps = claim
+        return certify_frontier_schedule(
+            kind, reps=int(reps or 64), report=report,
+            raise_on_error=raise_on_error,
+        )
+    if claim[0] == "tile":
+        _tag, tk, bounds, tile = claim
+        if bounds is None:
+            return {
+                "claim": "forasync-tiles", "kernel": tk.name,
+                "status": "unbound (no tile space run yet: "
+                          "run_forasync_device stamps it)",
+            }
+        return certify_tile_schedule(
+            tk, bounds, tile, report=report,
+            raise_on_error=raise_on_error,
+        )
+    raise ValueError(f"unknown schedule-independence claim {claim[0]!r}")

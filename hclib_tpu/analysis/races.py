@@ -180,19 +180,30 @@ def _check_round_trace(name: str, spec, t: BodyTrace,
                        report: AnalysisReport) -> None:
     # (c) wait/start matching within a round with nothing announced.
     # A trace with truncated / arg-bounded loops is an UNDER-
-    # approximation: an apparently unmatched start may be waited inside
-    # the iterations the shim skipped (the cholesky pipelined row
-    # stream), so mismatches demote to one info note instead of lying.
+    # approximation, but only around the truncation points (the seq
+    # marks where skipped iterations would have emitted): an unmatched
+    # START demotes only when a truncated window sits AFTER it (the
+    # missing wait could be in the skipped iterations - the cholesky
+    # pipelined row stream), an unmatched WAIT only when one sits
+    # BEFORE it (the missing start could). Findings whose whole
+    # matching window was observed exactly stay errors - a blanket
+    # demotion would let an exact-window protocol bug ride along with
+    # one unrelated arg-dependent loop.
     uw, us = t.unmatched_waits(), t.unmatched_starts()
-    if t.approx_loops and (uw or us):
+    marks = t.approx_marks
+    dem_w = [w for w in uw if any(m < w.seq for m in marks)]
+    dem_s = [s for s in us if any(m > s.seq for m in marks)]
+    if dem_w or dem_s:
         report.add(
             "shim-unsupported", INFO, name,
             f"{t.approx_loops} loop(s) ran truncated (arg-dependent "
-            f"bounds); {len(us)} start(s)/{len(uw)} wait(s) left "
-            "unmatched in the partial trace - DMA protocol not "
-            "verifiable for this body",
+            f"bounds); {len(dem_s)} start(s)/{len(dem_w)} wait(s) "
+            "left unmatched inside the truncated windows - DMA "
+            "protocol not verifiable for those events (exact-window "
+            "events still check)",
         )
-        uw, us = [], []
+        uw = [w for w in uw if w not in dem_w]
+        us = [s for s in us if s not in dem_s]
     for w in uw:
         report.add(
             "prefetch-protocol", ERROR, name,

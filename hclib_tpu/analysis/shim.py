@@ -217,13 +217,28 @@ class BodyTrace:
     spawns: List[Tuple[Optional[int], Dict[str, int]]] = field(
         default_factory=list
     )
+    # On-device promise ops (the direction-1 serving surface): every
+    # ``ctx.wait_value(slot)`` / ``ctx.satisfy(slot)`` a body performs,
+    # as (slot-or-None, value-slot index, seq). The wait-graph analysis
+    # (analysis/waits.py) matches waiters to satisfiers across kinds.
+    waits: List[Tuple[Optional[int], int, int]] = field(
+        default_factory=list
+    )
+    satisfies: List[Tuple[Optional[int], int, int]] = field(
+        default_factory=list
+    )
     continuations: int = 0
     next_reads: List[Tuple[int, int]] = field(default_factory=list)
     # Loops whose bounds were truncated at LOOP_CAP or derived from the
     # synthetic descriptor args (>= ARG_STRIDE): the trace is then an
-    # UNDER-approximation - DMA start/wait matching findings demote to
-    # info (a skipped iteration could hold the matching half).
+    # UNDER-approximation. ``approx_marks`` holds the seq position of
+    # each truncation - the point where the skipped iterations WOULD
+    # have emitted their events - so protocol findings demote only when
+    # their witness's missing half could sit inside a skipped window
+    # (an unmatched wait before every mark, or an unmatched start after
+    # every mark, is an EXACT-window finding and stays an error).
     approx_loops: int = 0
+    approx_marks: List[int] = field(default_factory=list)
     seq: int = 0
 
     def tick(self) -> int:
@@ -350,11 +365,16 @@ def _patched(trace: BodyTrace):
         # cap) is taken as arg-dependent and marks the trace
         # approximate - the synthetic descriptor args make such bounds
         # meaningless (cholesky's nj = i - k goes negative).
-        if not (0 <= lo <= hi <= lo + LOOP_CAP):
+        approx = not (0 <= lo <= hi <= lo + LOOP_CAP)
+        if approx:
             trace.approx_loops += 1
         for i in range(lo, min(hi, lo + LOOP_CAP)):
             _tick()
             val = body(i, val)
+        if approx:
+            # Skipped iterations run (conceptually) HERE, after the
+            # executed prefix - the mark the demotion window keys on.
+            trace.approx_marks.append(trace.tick())
         return val
 
     def _while(cond, body, init):
@@ -366,6 +386,7 @@ def _patched(trace: BodyTrace):
                 break
             if i == LOOP_CAP:
                 trace.approx_loops += 1
+                trace.approx_marks.append(trace.tick())
                 break
             _tick()
             val = body(val)
@@ -450,6 +471,23 @@ def _make_recording_contexts():
             )
             super().set_out(v)
 
+        def wait_value(self, slot, spin_cap=None):
+            # Record the promise-wait; never spin (the synthetic flag is
+            # unset, and the wait-graph analysis - not execution order -
+            # decides whether a satisfier exists). Return the flag word
+            # like the real op so bodies that COMPUTE with the waited
+            # value keep interpreting past the wait.
+            self._shim_trace.waits.append(
+                (self._shim_slot, _as_int(slot), self._shim_trace.tick())
+            )
+            return self.ivalues[slot]
+
+        def satisfy(self, slot, v=1) -> None:
+            self._shim_trace.satisfies.append(
+                (self._shim_slot, _as_int(slot), self._shim_trace.tick())
+            )
+            super().satisfy(slot, v)
+
         def spawn(self, fn, args=(), dep_count=0, succ0=NO_TASK,
                   succ1=NO_TASK, out=0, nargs=None):
             row = super().spawn(
@@ -487,6 +525,18 @@ def _make_recording_contexts():
                 (int(s), _as_int(self.out_slot(s)), self._shim_trace.tick())
             )
             super().set_out(s, v)
+
+        def wait_value(self, slot, spin_cap=None):
+            self._shim_trace.waits.append(
+                (None, _as_int(slot), self._shim_trace.tick())
+            )
+            return self.k.ivalues[slot]
+
+        def satisfy(self, slot, v=1) -> None:
+            self._shim_trace.satisfies.append(
+                (None, _as_int(slot), self._shim_trace.tick())
+            )
+            super().satisfy(slot, v)
 
         def next_idx(self, s):
             self._shim_trace.next_reads.append(
@@ -598,10 +648,17 @@ def _run(fn, trace: BodyTrace):
     try:
         with _patched(trace):
             fn()
-    except ShimUnsupported:
+    except ShimUnsupported as e:
+        # The partial trace rides the exception: events recorded BEFORE
+        # the unmodelled construct (a promise wait, say) are real, and
+        # the wait-graph gate must still see them - otherwise any
+        # unmodelled tail would silently evade the deadlock analysis.
+        e.trace = trace
         raise
     except Exception as e:  # noqa: BLE001 - any body failure = unmodelled
-        raise ShimUnsupported(f"{type(e).__name__}: {e}") from e
+        exc = ShimUnsupported(f"{type(e).__name__}: {e}")
+        exc.trace = trace
+        raise exc from e
     return trace
 
 
@@ -671,7 +728,10 @@ def run_drain(spec, fid: int, data_specs, scratch_specs, *,
 def run_scalar_kernel(fn, data_specs, scratch_specs,
                       args=None) -> BodyTrace:
     """Evaluate a scalar kernel-table entry once over one synthetic
-    descriptor (row 0, moderate args so arg-bounded loops stay small);
+    descriptor (row 0, the same ``synth_arg`` scheme batch bodies get:
+    arg-derived values land ``>= ARG_STRIDE``, which is how the
+    wait-graph analysis tells an arg-carried promise slot from a static
+    one; arg-bounded loops truncate and mark the trace approximate);
     the trace's spawns/continuations drive classification."""
     RecordingKernelContext, _ = _make_recording_contexts()
     trace = BodyTrace()
@@ -680,7 +740,8 @@ def run_scalar_kernel(fn, data_specs, scratch_specs,
     )
     for i in range(6):
         tasks.backing[0, F_A0 + i] = (
-            args[i] if args is not None and i < len(args) else 40 + 7 * i
+            args[i] if args is not None and i < len(args)
+            else synth_arg(0, i)
         )
     data, scratch = _fake_env(data_specs, scratch_specs)
     ctx = RecordingKernelContext(

@@ -1,0 +1,223 @@
+"""Wait-graph deadlock detection over the per-kind spawn/wait/satisfy
+graph (the ``wait-cycle`` rule).
+
+Dependency edges cannot deadlock this runtime: a task with outstanding
+deps simply isn't ready, so the scheduler never blocks on one. The
+construct that CAN deadlock it is the on-device promise wait
+(``KernelContext.wait_value`` - the direction-1 serving-loop surface):
+an in-body spin occupies the core, so a kind that waits a flag only
+satisfied by a kind that (transitively) waits on the first can wedge
+under EVERY schedule. That property is decidable at build time from the
+recording shim: one pass per kind records every ``wait_value`` /
+``satisfy`` slot (concrete ints under the synthetic descriptors) and
+every spawn, and the analysis proves the waits-on graph cycle-free - or
+emits the concrete cycle (the kind chain) as the witness.
+
+Edges and verdicts:
+
+- kind A *waits-on* kind B when A waits a slot B satisfies (and A did
+  not satisfy it itself EARLIER in its own body - the local-handshake
+  pattern). Waiting kinds with NO satisfier anywhere are a guaranteed
+  stall (``wait-cycle`` error, witness = the orphan slot); a cycle in
+  the waits-on graph (including a self-loop: a kind that waits a slot
+  only later instances of itself satisfy) is the deadlock witness.
+- The analysis is deliberately conservative: a cycle is refused even
+  when an alternate acyclic satisfier exists for some edge (annotate a
+  deliberate topology with ``verify_suppress=("wait-cycle",)``).
+
+Cost discipline: ``check_wait_graph`` first scans each kernel's code
+objects (nested closures included) for the wait-op names - a tree with
+no on-device waits (today's entire tree) pays a few dict lookups and
+ZERO shim passes. When waits exist, the shim pass is the same memoized
+``kind_summaries`` pass the reshard classification shares. Known gap:
+a body that waits only through an out-of-module helper whose NAME
+never appears in the body's code objects evades the pre-filter - keep
+the promise ops spelled ``ctx.wait_value`` / ``ctx.satisfy`` at the
+call site (the repo convention everywhere else, e.g. ``pl.when``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .classify import kind_summaries
+from .findings import ERROR, INFO, AnalysisReport
+from .shim import ARG_STRIDE
+
+__all__ = ["check_wait_graph", "wait_graph"]
+
+_WAIT_OPS = ("wait_value", "satisfy")
+
+
+def _code_mentions(obj, names, depth: int = 0) -> bool:
+    """True when a function's code object (or any nested one) names one
+    of ``names`` - the cheap static pre-filter that keeps wait-free
+    trees at zero shim passes."""
+    code = getattr(obj, "__code__", None)
+    if code is None or depth > 4:
+        return False
+
+    def scan(c, d):
+        if any(n in c.co_names for n in names):
+            return True
+        if d > 4:
+            return False
+        for const in c.co_consts:
+            if type(const).__name__ == "code" and scan(const, d + 1):
+                return True
+        return False
+
+    return scan(code, depth)
+
+
+def _any_wait_mentions(mk) -> bool:
+    from ..device.megakernel import _is_batch_spec
+
+    for fn in mk.kernel_fns:
+        if _code_mentions(fn, _WAIT_OPS):
+            return True
+    for _name, spec in mk.route.items():
+        if _is_batch_spec(spec) and _code_mentions(spec.body, _WAIT_OPS):
+            return True
+    return False
+
+
+def wait_graph(mk) -> Dict[str, Dict[str, object]]:
+    """The static spawn/wait/satisfy graph per kind: {kind: {waits:
+    {slot: first_seq}, satisfies: {slot: first_seq}, spawns: [kind]}}.
+    Built from the shared (memoized) shim summaries."""
+    summaries = kind_summaries(mk)
+    graph: Dict[str, Dict[str, object]] = {}
+    for name, s in summaries.items():
+        waits: Dict[int, int] = {}
+        for slot, seq in s.waits:
+            waits.setdefault(slot, seq)
+        sats: Dict[int, int] = {}
+        for slot, seq in s.satisfies:
+            sats.setdefault(slot, seq)
+        graph[name] = {
+            "waits": waits,
+            "satisfies": sats,
+            "spawns": [
+                mk.kernel_names[f]
+                for f in s.spawn_fns
+                if 0 <= f < len(mk.kernel_names)
+            ],
+        }
+    return graph
+
+
+def _find_cycle(edges: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """First cycle in the waits-on graph as the kind chain
+    ``[a, b, ..., a]`` (DFS with an explicit path stack)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {k: WHITE for k in edges}
+    path: List[str] = []
+
+    def dfs(k: str) -> Optional[List[str]]:
+        color[k] = GRAY
+        path.append(k)
+        for nxt in sorted(edges.get(k, ())):
+            if color.get(nxt, WHITE) == GRAY:
+                i = path.index(nxt)
+                return path[i:] + [nxt]
+            if color.get(nxt, WHITE) == WHITE:
+                c = dfs(nxt)
+                if c is not None:
+                    return c
+        path.pop()
+        color[k] = BLACK
+        return None
+
+    for k in sorted(edges):
+        if color[k] == WHITE:
+            c = dfs(k)
+            if c is not None:
+                return c
+    return None
+
+def check_wait_graph(mk, report: Optional[AnalysisReport] = None,
+                     suppress: Sequence[str] = ()) -> AnalysisReport:
+    """Prove ``mk``'s wait graph deadlock-free; findings otherwise (rule
+    ``wait-cycle``, error severity - the construction gate for any
+    on-device-wait kind). Near-zero cost when no kind waits.
+
+    Slots computed from DESCRIPTOR ARGS (the dynamic per-request
+    plumbing a serving loop uses) evaluate under the shim's synthetic
+    args to values ``>= ARG_STRIDE``: those are statically unmatchable -
+    the graph neither claims an orphan nor builds edges for them, and
+    an info note records that the runtime spin budget (``OVF_PROMISE``)
+    is the backstop for that kind. Only STATIC slots (literals /
+    host-preset layout constants, the repo convention) participate in
+    the orphan and cycle verdicts."""
+    report = report or AnalysisReport(suppress)
+    if not _any_wait_mentions(mk):
+        return report
+    g = wait_graph(mk)
+    # Satisfier index over STATIC slots: slot -> kinds (with first seq).
+    satisfiers: Dict[int, List[Tuple[str, int]]] = {}
+    any_dynamic_sat = False
+    for name, node in g.items():
+        for slot, seq in node["satisfies"].items():
+            if slot >= ARG_STRIDE:
+                any_dynamic_sat = True
+                continue
+            satisfiers.setdefault(slot, []).append((name, seq))
+    edges: Dict[str, Set[str]] = {name: set() for name in g}
+    for name, node in g.items():
+        dynamic_waits = sorted(
+            s for s in node["waits"] if s >= ARG_STRIDE
+        )
+        if dynamic_waits:
+            report.add(
+                "wait-cycle", INFO, name,
+                f"kind {name!r} waits {len(dynamic_waits)} arg-carried "
+                "slot(s) (computed from descriptor args): the static "
+                "wait graph cannot match them - the bounded spin "
+                "budget (OVF_PROMISE) is the runtime backstop",
+                kind=name, slots=tuple(dynamic_waits),
+            )
+        for slot, wseq in node["waits"].items():
+            if slot >= ARG_STRIDE:
+                continue
+            own = node["satisfies"].get(slot)
+            if own is not None and own < wseq:
+                continue  # locally satisfied before the wait: no edge
+            sats = [s for s, _seq in satisfiers.get(slot, ())
+                    if s != name or node["satisfies"].get(slot, 1 << 60)
+                    > wseq]
+            if not sats:
+                if any_dynamic_sat:
+                    # An arg-carried satisfy exists somewhere: it COULD
+                    # target this slot at runtime, so an orphan claim
+                    # would be unsound - note it instead of refusing.
+                    report.add(
+                        "wait-cycle", INFO, name,
+                        f"kind {name!r} waits value slot {slot} with no "
+                        "static satisfier; an arg-carried satisfy "
+                        "elsewhere may cover it at runtime (not "
+                        "statically provable)",
+                        slot=slot, kind=name,
+                    )
+                else:
+                    report.add(
+                        "wait-cycle", ERROR, name,
+                        f"kind {name!r} waits value slot {slot} that no "
+                        "kind ever satisfies (guaranteed stall: the "
+                        "wait spins out its budget under every "
+                        "schedule)",
+                        slot=slot, kind=name,
+                    )
+                continue
+            edges[name].update(sats)
+    cycle = _find_cycle(edges)
+    if cycle is not None:
+        chain = " -> ".join(cycle)
+        report.add(
+            "wait-cycle", ERROR, cycle[0],
+            f"wait cycle: {chain} (each kind's promise wait can only "
+            "be satisfied by the next, so no schedule can order the "
+            "satisfactions; break the cycle or satisfy before waiting)",
+            cycle=tuple(cycle),
+        )
+    return report

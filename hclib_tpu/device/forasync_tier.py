@@ -428,7 +428,7 @@ def make_forasync_megakernel(
         kernels = [(tk.name, tk.scalar_kernel)]
         route = None
         scratch = tk.scalar_scratch()
-    return Megakernel(
+    mk = Megakernel(
         kernels=kernels,
         route=route,
         data_specs=tk.data_specs,
@@ -442,6 +442,13 @@ def make_forasync_megakernel(
         quiesce_stride=quiesce_stride,
         verify=verify,
     )
+    # Schedule-independence claim: tiles write disjoint slabs, so any
+    # pop order yields one output state. The tile SPACE isn't known
+    # until a run names (bounds, tile) - run_forasync_device completes
+    # the claim then; analysis/model.py certifies it lazily (K permuted
+    # orders over the concrete space) for describe()/hclint.
+    mk.si_claim = ("tile", tk, None, None)
+    return mk
 
 
 def _verify_default() -> bool:
@@ -531,6 +538,20 @@ def run_forasync_device(
             tk, width=w, prefetch=prefetch, capacity=cap,
             interpret=interpret, trace=trace,
         )
+    # Complete the schedule-independence claim with the concrete tile
+    # space this run names (make_forasync_megakernel stamps it
+    # unbound). Re-stamped on EVERY run: a later call over a different
+    # (bounds, tile) space must invalidate the previous certificate -
+    # an index fn can alias at one size and not another - and the
+    # model.py cache keys on the space, so describe() re-certifies.
+    claim = getattr(kernel, "si_claim", None)
+    if claim is not None and claim[0] == "tile":
+        nb = tuple(
+            tuple(b) if not isinstance(b, int) else b for b in bounds
+        )
+        nt = tuple(tile) if not isinstance(tile, int) else (tile,)
+        if (claim[2], claim[3]) != (nb, nt):
+            kernel.si_claim = ("tile", claim[1], nb, nt)
     if placement is None:
         b = TaskGraphBuilder()
         seed_tiles(b, bounds, tile)

@@ -762,6 +762,14 @@ def make_frontier_megakernel(
     # trace, so running this build over a DIFFERENT graph layout would
     # silently read the wrong state region - run_frontier refuses it.
     mk._frontier_layout = (fk.name, graph.n, graph.nblocks, graph.st_base)
+    # Schedule-independence claim (the exactness model this module's
+    # docstring promises): certified lazily by analysis/model.py - K
+    # permuted pop orders to the fixpoint - and surfaced in describe()
+    # beside the reshard classification.
+    kind = {"fr_bfs": "bfs", "fr_sssp": "sssp",
+            "fr_pagerank": "pagerank"}.get(fk.name)
+    if kind is not None:
+        mk.si_claim = ("frontier", kind, getattr(fk, "reps", None))
     return mk
 
 

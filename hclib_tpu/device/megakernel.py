@@ -115,6 +115,7 @@ def decode_overflow(mask: int) -> str:
         (OVF_OUTBOX, "AM outbox"),
         (OVF_WAITS, "wait table"),
         (OVF_LOCKQ, "lock FIFO"),
+        (OVF_PROMISE, "promise-wait spin budget"),
     ]
     hit = [n for bit, n in names if mask & bit]
     return " + ".join(hit) if hit else f"unknown (mask {mask})"
@@ -134,6 +135,7 @@ OVF_ENGINE = 4   # vector-tier per-lane stacks / step budget
 OVF_OUTBOX = 8   # resident AM outbox
 OVF_WAITS = 16   # resident wait table
 OVF_LOCKQ = 32   # resident lock FIFO
+OVF_PROMISE = 64  # on-device promise wait spun out its bounded budget
 
 # Batched-dispatch tier statistics (the 8-word tstats output a batch-routed
 # megakernel appends after its data outputs; surfaced as info['tiers'] /
@@ -257,6 +259,50 @@ class KernelContext:
 
     def set_out(self, v) -> None:
         self.ivalues[self.out_slot] = v
+
+    # -- on-device promises (the serving-loop wait surface) --
+
+    def satisfy(self, slot, v=1) -> None:
+        """Satisfy the promise flag at value slot ``slot``: one scalar
+        SMEM write of a NONZERO word (``v``) - the SURVEY north star's
+        "promise satisfaction becomes on-device flag writes". The
+        matching ``wait_value`` observes it; the wait-graph analysis
+        (hclib_tpu.analysis.waits) proves at construction that every
+        waiter has a satisfier that can run first."""
+        self.ivalues[slot] = v
+
+    def wait_value(self, slot, spin_cap: int = 4096):
+        """Block this task in place until the promise flag at value slot
+        ``slot`` is nonzero (bounded spin; returns the observed value).
+
+        This is an IN-BODY wait - unlike dependency edges (a task with
+        deps simply isn't ready; the scheduler never blocks), a spinning
+        wait occupies the core, so on a single scheduler it can only
+        succeed if the satisfier already ran. That is exactly why kinds
+        using it are GATED at construction: ``Megakernel(verify=True)``
+        runs the wait-graph deadlock analysis over every kind's recorded
+        wait/satisfy/spawn ops and refuses cycles (analysis/waits.py,
+        rule ``wait-cycle``) - the safety floor under the completion-
+        promise serving loop. ``spin_cap`` bounds the spin (static);
+        exhaustion sets ``OVF_PROMISE`` so the host raises a diagnostic
+        instead of the kernel wedging the core."""
+
+        def cond(c):
+            i, seen = c
+            return (i < jnp.int32(spin_cap)) & jnp.logical_not(seen)
+
+        def body(c):
+            i, _ = c
+            return (i + 1, self.ivalues[slot] != 0)
+
+        _, seen = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), self.ivalues[slot] != 0)
+        )
+        self._counts[C_OVERFLOW] = jnp.where(
+            seen, self._counts[C_OVERFLOW],
+            self._counts[C_OVERFLOW] | OVF_PROMISE,
+        )
+        return self.ivalues[slot]
 
     # -- dynamic task creation --
 
@@ -650,6 +696,12 @@ class BatchContext:
     def set_value(self, slot, v) -> None:
         self.k.set_value(slot, v)
 
+    def satisfy(self, slot, v=1) -> None:
+        self.k.satisfy(slot, v)
+
+    def wait_value(self, slot, spin_cap: int = 4096):
+        return self.k.wait_value(slot, spin_cap)
+
     def add_executed(self, n) -> None:
         self.k.add_executed(n)
 
@@ -907,6 +959,11 @@ class Megakernel:
         # elsewhere; error findings raise AnalysisError unless listed in
         # ``verify_suppress`` (see analysis.findings for the syntax).
         self.verify_suppress = tuple(verify_suppress)
+        # Schedule-independence claim (analysis/model.py): builders whose
+        # exactness story IS schedule-independence (frontier traversals,
+        # forasync tile loops) stamp their claim here; describe() and
+        # hclint surface the certificate (or the refusal) lazily.
+        self.si_claim = None
         if verify is None:
             from ..analysis.findings import verify_default
 
@@ -947,12 +1004,23 @@ class Megakernel:
                     if spec is not None else {}
                 ),
             }
+        cert = None
+        if self.si_claim is not None:
+            from ..analysis.model import certify_claim
+
+            cert = certify_claim(self, raise_on_error=False)
         return {
             "kinds": kinds,
             "capacity": self.capacity,
             "num_values": self.num_values,
             "checkpoint": self.checkpoint,
             "verify": self.verify,
+            # The schedule-independence certificate (analysis/model.py),
+            # beside the reshard classification: None when the builder
+            # made no claim; a dict with status "certified" (K permuted
+            # pop orders, identical fixpoint) or "refused" (with the two
+            # divergent schedules) otherwise.
+            "schedule_independence": cert,
             "findings": (
                 self.analysis.to_jsonable() if self.analysis else []
             ),

@@ -475,6 +475,25 @@ class TenantTable:
     def specs(self) -> List[TenantSpec]:
         return [lane.spec for lane in self._lanes]
 
+    def protocol_model(self, rows_per_lane: int = 2,
+                       capacity: int = 3, quiesce: bool = True):
+        """Seed the bounded-interleaving explorer with THIS table's
+        lane roster (analysis/explore.py): ``rows_per_lane`` published
+        rows per lane at the table's real WRR weights, scheduler
+        headroom ``capacity``, and (by default) a mid-stream quiesce
+        action - so hclint explores every schedule of the poll this
+        roster will actually run, against the same executable spec
+        (``wrr_poll_reference``) the fairness tests pin."""
+        from ..analysis.explore import InjectQuiesceModel
+
+        return InjectQuiesceModel(
+            [(int(rows_per_lane), lane.spec.weight)
+             for lane in self._lanes],
+            capacity=int(capacity),
+            quiesce=bool(quiesce),
+            region_rows=max(int(rows_per_lane), 8),
+        )
+
     def _lane(self, tenant: Union[str, int]) -> _Lane:
         if isinstance(tenant, int):
             if not 0 <= tenant < len(self._lanes):

@@ -180,6 +180,20 @@ FLT_DEAD_QUARANTINE = 1  # b = peer quarantined by heartbeat timeout
 FLT_WEDGE = 2            # b = starved-channel encoding ((hop<<8)|granter)+1
 FLT_DELAY = 3            # b = hop whose export quota was zeroed
 
+# Name tables for the payload codes above - the SAME one-table-edit
+# discipline as SC_NAMES: tools/timeline.py labels TR_CREDIT/TR_FAULT
+# payloads from these, so a new code is one edit here.
+CR_NAMES: Dict[int, str] = {
+    CR_DROPPED: "dropped",
+    CR_DUPED: "duplicated",
+    CR_REGENERATED: "regenerated",
+}
+FLT_NAMES: Dict[int, str] = {
+    FLT_DEAD_QUARANTINE: "dead-chip quarantine",
+    FLT_WEDGE: "wedge",
+    FLT_DELAY: "delay",
+}
+
 
 class TraceRing:
     """Host-side spec of a device trace ring (capacity in RECORDS).

@@ -117,6 +117,17 @@ REGISTRY = {
         _v("HCLIB_TPU_VERIFY", "bool", "off; on under pytest",
            "build-time static verifier (hclib_tpu.analysis; 0 forces "
            "off, nonzero forces on)"),
+        # -- model checker (hclib_tpu/analysis: explore.py / model.py) --
+        _v("HCLIB_TPU_MODEL_DEPTH", "int", "64",
+           "bounded-interleaving explorer depth bound, actions per "
+           "path (malformed text raises)"),
+        _v("HCLIB_TPU_MODEL_BUDGET_S", "float", "20",
+           "bounded-interleaving explorer wall budget, seconds; an "
+           "exhausted budget flags the result incomplete (malformed "
+           "text raises)"),
+        _v("HCLIB_TPU_MODEL_PERMS", "int", "3",
+           "schedule-independence certification: permuted pop orders "
+           "checked per claim (malformed text raises)"),
         # -- dispatch tiers --
         _v("HCLIB_TPU_FORASYNC_WIDTH", "int", "8",
            "default forasync device-tier batch width"),

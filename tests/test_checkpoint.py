@@ -58,16 +58,37 @@ def _uts_builder():
     return b
 
 
+@pytest.fixture(scope="session")
+def uts_ckpt_mk():
+    """ONE checkpoint-enabled UTS megakernel shared by every round-trip
+    test in this file (the heaviest repeated build of the suite: seven
+    tests previously compiled the identical program). A Megakernel is
+    re-entrant by construction - every run() stages fresh state from
+    its builder and the jitted executables are cached per (fuel,
+    stage_all_values) - so sharing the build changes nothing but the
+    wall clock. Tests that NEED a fresh build (restore onto a new
+    instance, program-mismatch rejection) still construct their own."""
+    return make_uts_megakernel(checkpoint=True, **UTS_KW)
+
+
+@pytest.fixture(scope="session")
+def uts_ref():
+    """(nodes, info) of the uninterrupted seeded traversal - the
+    deterministic reference every round trip compares against, run
+    once per session."""
+    return device_uts_mk(**UTS_KW)
+
+
 # ------------------------------------------------ megakernel round trips
 
 
-def test_uts_checkpoint_then_restore_bit_identical():
+def test_uts_checkpoint_then_restore_bit_identical(uts_ckpt_mk, uts_ref):
     """ACCEPTANCE (dynamic tree): quiesce the seeded UTS traversal at
     round k, resume from the exported state, and the final node count +
     executed totals are bit-identical to the uninterrupted run."""
-    nodes, info_full = device_uts_mk(**UTS_KW)
+    nodes, info_full = uts_ref
     assert nodes > 100  # the tree is a real traversal, not a stub
-    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    mk = uts_ckpt_mk
     iv_q, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 3)
     assert info_q["quiesced"] is True
     assert info_q["pending"] > 0  # genuinely mid-tree
@@ -78,12 +99,14 @@ def test_uts_checkpoint_then_restore_bit_identical():
     assert info_r["pending"] == 0
 
 
-def test_checkpoint_chains_and_quiesce_past_end_is_clean():
+def test_checkpoint_chains_and_quiesce_past_end_is_clean(
+    uts_ckpt_mk, uts_ref,
+):
     """A resumed run can be quiesced AGAIN (chained checkpoints); a
     quiesce threshold past the workload size never fires and the run
     completes normally."""
-    nodes, _ = device_uts_mk(**UTS_KW)
-    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    nodes, _ = uts_ref
+    mk = uts_ckpt_mk
     _, _, q1 = mk.run(_uts_builder(), quiesce=nodes // 4)
     _, _, q2 = mk.resume(q1["state"], quiesce=nodes // 2)
     assert q2["quiesced"] and q2["pending"] > 0
@@ -95,13 +118,15 @@ def test_checkpoint_chains_and_quiesce_past_end_is_clean():
     assert info2["quiesced"] is False and "state" not in info2
 
 
-def test_checkpoint_off_path_bit_identical_and_guarded():
+def test_checkpoint_off_path_bit_identical_and_guarded(
+    uts_ckpt_mk, uts_ref,
+):
     """DeviceFaultPlan discipline: a checkpoint-enabled build that never
     quiesces produces bit-identical outputs to a plain build, and a plain
     build refuses quiesce= with a clear error instead of silently
     ignoring it."""
-    n0, info0 = device_uts_mk(**UTS_KW)
-    mk_on = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    n0, info0 = uts_ref
+    mk_on = uts_ckpt_mk
     iv_on, _, info_on = mk_on.run(_uts_builder())
     assert int(iv_on[0]) == n0
     assert info_on["executed"] == info0["executed"]
@@ -198,12 +223,14 @@ def test_sw_wave_prefetch_checkpoint_bit_identical():
 # -------------------------------------------------------- bundle on disk
 
 
-def test_bundle_save_load_restore_and_metrics(tmp_path):
+def test_bundle_save_load_restore_and_metrics(
+    tmp_path, uts_ckpt_mk, uts_ref,
+):
     """Versioned on-disk artifact: quiesce -> snapshot -> save (npz +
     manifest, sha256) -> load -> restore onto a FRESHLY built megakernel;
     checkpoint size/duration land in the MetricsRegistry."""
-    nodes, _ = device_uts_mk(**UTS_KW)
-    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    nodes, _ = uts_ref
+    mk = uts_ckpt_mk
     _, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 2)
     bundle = snapshot_megakernel(mk, info_q)
     reg = hc.MetricsRegistry()
@@ -221,11 +248,13 @@ def test_bundle_save_load_restore_and_metrics(tmp_path):
     assert int(iv[0]) == nodes and info["pending"] == 0
 
 
-def test_bundle_corruption_and_version_rejected(tmp_path):
+def test_bundle_corruption_and_version_rejected(
+    tmp_path, uts_ckpt_mk, uts_ref,
+):
     import json
 
-    nodes, _ = device_uts_mk(**UTS_KW)
-    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    nodes, _ = uts_ref
+    mk = uts_ckpt_mk
     _, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 2)
     path = str(tmp_path / "ckpt")
     snapshot_megakernel(mk, info_q).save(path)
@@ -250,12 +279,12 @@ def test_bundle_corruption_and_version_rejected(tmp_path):
         CheckpointBundle.load(path)
 
 
-def test_restore_rejects_mismatched_program():
+def test_restore_rejects_mismatched_program(uts_ckpt_mk, uts_ref):
     """A bundle only restores onto the SAME program shape: F_FN words
     index the kernel table positionally, so a different table must be
     refused, not silently misdispatched."""
-    nodes, _ = device_uts_mk(**UTS_KW)
-    mk = make_uts_megakernel(checkpoint=True, **UTS_KW)
+    nodes, _ = uts_ref
+    mk = uts_ckpt_mk
     _, _, info_q = mk.run(_uts_builder(), quiesce=nodes // 2)
     bundle = snapshot_megakernel(mk, info_q)
     other = Megakernel(

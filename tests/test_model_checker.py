@@ -1,0 +1,431 @@
+"""hclint v2 - the whole-program concurrency model checker (ISSUE 14):
+wait-graph deadlock detection, bounded protocol interleaving, and
+schedule-independence certification. Every seeded-violation fixture
+must raise/report with a CONCRETE witness (the cycle's kind chain, the
+interleaving prefix, the two divergent schedules), the clean
+configurations must audit clean, and the verify-off path must stay
+bit-identical (the analyses are host-only composition - no Pallas
+build, no Mosaic)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from hclib_tpu.analysis import (
+    AnalysisError,
+    CreditExchangeModel,
+    InjectQuiesceModel,
+    certify_claim,
+    certify_frontier_schedule,
+    certify_tile_schedule,
+    check_protocols,
+    check_wait_graph,
+    explore,
+    wait_graph,
+)
+from hclib_tpu.analysis.waits import _any_wait_mentions
+from hclib_tpu.device.descriptor import TaskGraphBuilder
+from hclib_tpu.device.forasync_tier import (
+    Slab, TileKernel, make_forasync_megakernel, run_forasync_device,
+)
+from hclib_tpu.device.frontier import (
+    INF, FrontierKernel, Graph, _spawn_blocks, bfs_kernel,
+    make_frontier_megakernel,
+)
+from hclib_tpu.device.megakernel import Megakernel
+from hclib_tpu.device.tenants import TenantSpec, TenantTable
+
+N, TS = 32, 8
+
+
+def _mk(kernels, **kw):
+    kw.setdefault("capacity", 32)
+    kw.setdefault("num_values", 16)
+    kw.setdefault("succ_capacity", 8)
+    kw.setdefault("interpret", True)
+    kw.setdefault("verify", True)
+    return Megakernel(kernels=kernels, **kw)
+
+
+# ------------------------------------------------ wait-graph deadlock
+
+
+def test_two_kind_wait_cycle_caught_at_construction():
+    """SEEDED VIOLATION: kind a waits the flag only b satisfies and
+    vice versa - no schedule can order the satisfactions. Construction
+    refuses with the cycle's kind chain as the witness."""
+
+    def ka(ctx):
+        ctx.wait_value(5)
+        ctx.satisfy(6)
+
+    def kb(ctx):
+        ctx.wait_value(6)
+        ctx.satisfy(5)
+
+    with pytest.raises(AnalysisError, match="wait cycle") as ei:
+        _mk([("a", ka), ("b", kb)])
+    f = [x for x in ei.value.report.findings if x.rule == "wait-cycle"]
+    assert f and tuple(f[0].witness["cycle"]) in (
+        ("a", "b", "a"), ("b", "a", "b"),
+    )
+
+
+def test_unsatisfied_wait_is_a_guaranteed_stall():
+    def w(ctx):
+        ctx.wait_value(7)
+
+    with pytest.raises(AnalysisError, match="no kind ever satisfies"):
+        _mk([("w", w)])
+
+
+def test_wait_gate_survives_computing_bodies_and_unmodelled_tails():
+    """REGRESSION: the recording wait returns the flag word (like the
+    real op), so a body that COMPUTES with the waited value still
+    records its wait; and a body whose TAIL the shim cannot model
+    keeps the waits recorded before it (the partial trace rides the
+    ShimUnsupported) - neither spelling evades the deadlock gate."""
+
+    def compute_with_wait(ctx):
+        ctx.set_value(0, ctx.wait_value(7) + 1)
+
+    with pytest.raises(AnalysisError, match="no kind ever satisfies"):
+        _mk([("w", compute_with_wait)])
+
+    def wait_then_unmodelled(ctx):
+        ctx.wait_value(7)
+        raise RuntimeError("tail the shim cannot run")
+
+    with pytest.raises(AnalysisError, match="no kind ever satisfies"):
+        _mk([("w", wait_then_unmodelled)])
+
+
+def test_acyclic_wait_constructs_runs_and_satisfies():
+    """A satisfier/waiter pair with an order (a before b) passes the
+    gate AND runs: the promise flag write satisfies the bounded spin,
+    end to end on the device path."""
+
+    def sa(ctx):
+        ctx.satisfy(5, v=7)
+
+    def wb(ctx):
+        ctx.set_value(0, ctx.wait_value(5))
+
+    mk = _mk([("sat", sa), ("wait", wb)])
+    assert mk.analysis.errors() == []
+    g = wait_graph(mk)
+    assert g["wait"]["waits"] and g["sat"]["satisfies"]
+    b = TaskGraphBuilder()
+    b.add(1)  # waiter queued first ...
+    b.add(0)  # ... satisfier added last pops FIRST (LIFO owner side)
+    iv, _, info = mk.run(b)
+    assert int(iv[0]) == 7 and info["executed"] == 2
+
+
+def test_spin_budget_exhaustion_is_diagnosed_not_wedged():
+    """An unsatisfiable wait (gate suppressed to get it built) spins
+    out its bounded budget and the host raises naming the promise
+    budget - never a wedged core."""
+
+    def w(ctx):
+        ctx.wait_value(6, spin_cap=8)
+
+    mk = _mk([("w", w)], verify_suppress=("wait-cycle",))
+    b = TaskGraphBuilder()
+    b.add(0)
+    with pytest.raises(RuntimeError, match="promise-wait spin budget"):
+        mk.run(b)
+
+
+def test_arg_carried_promise_slots_note_not_refuse():
+    """A serving-loop-shaped program plumbs its promise slot through
+    DESCRIPTOR ARGS (per-request dynamic slots). The static graph
+    cannot match those - it must NOTE them (the spin budget is the
+    runtime backstop), never refuse a correct program as an orphan."""
+
+    def producer(ctx):
+        ctx.satisfy(ctx.arg(0))
+
+    def consumer(ctx):
+        ctx.wait_value(ctx.arg(0))
+
+    mk = _mk([("produce", producer), ("consume", consumer)])
+    assert mk.analysis.errors() == []
+    notes = [f for f in mk.analysis.findings
+             if f.rule == "wait-cycle" and f.severity == "info"]
+    assert any("arg-carried" in f.message for f in notes)
+
+
+def test_wait_free_tree_pays_no_shim_pass():
+    """The cost gate: a megakernel with no wait ops is detected by the
+    cheap code-object scan - no wait findings, no summaries forced at
+    construction."""
+
+    def plain(ctx):
+        ctx.set_value(0, ctx.value(0) + 1)
+
+    mk = _mk([("plain", plain)])
+    assert not _any_wait_mentions(mk)
+    assert getattr(mk, "_kind_summaries", None) is None
+    assert all(f.rule != "wait-cycle" for f in mk.analysis.findings)
+
+
+# ------------------------------------------- bounded interleaving
+
+
+def test_credit_wedge_interleaving_found_with_witness():
+    """SEEDED VIOLATION: the dropped-credit fault with no regeneration
+    (the credit_timeout=0 lockstep wedge). The explorer finds the
+    wedging interleaving and returns the action prefix as witness."""
+    res = explore(CreditExchangeModel(
+        (3, 0), drop_credit=0, regen=False, max_steals=2,
+    ))
+    assert res.violations, "the wedge was not found"
+    v = res.violations[0]
+    assert "credit wedge" in v.message
+    assert any(a[0] == "grant" for a in v.witness)  # a real interleaving
+    # The same fault WITH the shipped regeneration recovery explores
+    # clean on every schedule - termination and conservation restored.
+    res2 = explore(CreditExchangeModel(
+        (3, 0), drop_credit=0, regen=True, max_steals=2,
+    ))
+    assert res2.clean and res2.complete and res2.terminals > 0
+    # Through the report path the violation RAISES AnalysisError with
+    # the interleaving as its witness (the hclint/CI gate).
+    with pytest.raises(AnalysisError, match="credit wedge") as ei:
+        check_protocols(configs=[(
+            "seeded-wedge",
+            CreditExchangeModel((3, 0), drop_credit=0, max_steals=2),
+        )]).raise_errors()
+    f = ei.value.report.errors()[0]
+    assert f.rule == "interleaving" and f.witness["interleaving"]
+
+
+def test_inject_poll_conservation_and_quiesce_freeze():
+    """The WRR poll model (built on wrr_poll_reference itself): skewed
+    weights + expired rows + a paused lane + backpressure conserve on
+    every schedule; a poll that keeps consuming after the quiesce
+    freeze diverges from the exported words and is refused."""
+    res = explore(InjectQuiesceModel(
+        [(3, 2, (1,)), (2, 1), (2, 1, (), True)], capacity=2,
+    ))
+    assert res.clean and res.complete and res.terminals > 0
+    res_q = explore(InjectQuiesceModel(
+        [(2, 1), (2, 2)], capacity=2, quiesce=True,
+    ))
+    assert res_q.clean, [v.message for v in res_q.violations]
+    bad = explore(InjectQuiesceModel(
+        [(2, 1), (2, 2)], capacity=2, quiesce=True, freeze_poll=False,
+    ))
+    assert bad.violations
+    v = next(x for x in bad.violations if "quiesce-freeze" in x.message)
+    assert any(a[0] == "quiesce" for a in v.witness)
+
+
+def test_explorer_dedup_bounds_and_no_unsound_pruning():
+    """The explorer is stateful (dedup bounds the work by reachable
+    states) and its depth bound flags incompleteness instead of
+    silently passing. REGRESSION: the footprint-vs-enabled-set pruning
+    once shipped here was unsound - exec actions look independent at
+    the root, but executing the victim's surplus DISABLES the steal
+    request whose interleaving holds the wedge. This configuration is
+    the counterexample: the wedge must be found."""
+    model = CreditExchangeModel((2, 1), max_steals=2)
+    full = explore(model)
+    assert full.complete and full.states > 0
+    assert full.transitions >= full.states - 1
+    bounded = explore(model, depth=1)
+    assert not bounded.complete
+    hidden = explore(CreditExchangeModel(
+        (2, 1), drop_credit=0, regen=False, max_steals=2,
+    ))
+    assert hidden.complete
+    assert any("credit wedge" in v.message for v in hidden.violations)
+    # REGRESSION: a victim drained between request and grant answers
+    # EMPTY (deny) - no schedule may steal a row that no longer exists
+    # (negative task counts once masked wedges as conservation-clean).
+    assert all(
+        min(v.state[0]) >= 0 for v in hidden.violations
+    )
+
+
+def test_tenant_roster_protocol_model_and_curated_clean():
+    """TenantTable.protocol_model seeds the explorer from a real lane
+    roster; the curated protocol set (hclint's) audits clean."""
+    tb = TenantTable(
+        [TenantSpec("gold", weight=2), TenantSpec("std")],
+        16, clock=lambda: 0.0,
+    )
+    res = explore(tb.protocol_model(rows_per_lane=2, capacity=2))
+    assert res.clean and res.terminals > 0
+    rep = check_protocols()
+    assert rep.actionable() == []
+
+
+# ------------------------------------- schedule-independence certs
+
+
+def _specs():
+    return {
+        "x": jax.ShapeDtypeStruct((N,), jnp.int32),
+        "y": jax.ShapeDtypeStruct((N,), jnp.int32),
+    }
+
+
+def test_tile_certificate_and_order_dependent_refusal():
+    good = TileKernel(
+        loads=[Slab("xin", "x", lambda a: (pl.ds(a[1], TS),), (TS,))],
+        stores=[Slab("yout", "y", lambda a: (pl.ds(a[1], TS),), (TS,))],
+        compute=lambda ins: {"yout": ins["xin"] * 3 + 7},
+        data_specs=_specs(),
+    )
+    cert = certify_tile_schedule(good, [N], [TS])
+    assert cert["status"] == "certified" and cert["tiles"] == N // TS
+    # SEEDED VIOLATION: an in-place loop - each tile LOADS the window
+    # its neighbor STORES, so pop order changes what it reads.
+    inplace = TileKernel(
+        loads=[Slab("win", "y",
+                    lambda a: (pl.ds((a[1] + TS) % N, TS),), (TS,))],
+        stores=[Slab("wout", "y", lambda a: (pl.ds(a[1], TS),), (TS,))],
+        compute=lambda ins: {"wout": ins["win"] + 1},
+        data_specs=_specs(),
+    )
+    with pytest.raises(AnalysisError, match="order-DEPENDENT") as ei:
+        certify_tile_schedule(inplace, [N], [TS])
+    w = ei.value.report.findings[0].witness
+    assert "schedule_a" in w and "schedule_b" in w
+    assert w["schedule_a"] != w["schedule_b"]
+
+
+def test_frontier_kinds_certified_and_visit_order_refused():
+    for kind in ("bfs", "sssp", "pagerank"):
+        cert = certify_frontier_schedule(kind)
+        assert cert["status"] == "certified", cert
+
+    # SEEDED VIOLATION: visit-order labeling (DFS-vs-BFS numbering) -
+    # the classic order-dependent traversal. Refused with the two
+    # divergent schedules in the diagnostic.
+    def visit_order_relax(fk, kctx, u, w, carry):
+        st = fk.st_base + u
+        first = kctx.ivalues[st] == INF
+
+        @pl.when(first)
+        def _():
+            n = kctx.ivalues[1] + 1
+            kctx.ivalues[1] = n
+            kctx.ivalues[st] = n
+            _spawn_blocks(kctx, u, 0)
+
+    fk = FrontierKernel(
+        "fr_visit", visit_order_relax, weighted=False, state0=INF,
+    )
+    with pytest.raises(AnalysisError, match="order-DEPENDENT") as ei:
+        certify_frontier_schedule("bfs", fk=fk)
+    msg = str(ei.value)
+    assert "schedule_a" in msg and "schedule_b" in msg
+
+
+def test_certificates_surface_in_describe():
+    """ACCEPTANCE: frontier and forasync builders carry the certificate
+    in Megakernel.describe(), beside the reshard classification."""
+    rng = np.random.default_rng(3)
+    m = 40
+    g = Graph(16, rng.integers(0, 16, m), rng.integers(0, 16, m))
+    mk = make_frontier_megakernel(bfs_kernel(), g, width=4,
+                                  interpret=True)
+    d = mk.describe()
+    assert d["schedule_independence"]["status"] == "certified"
+    assert d["kinds"]["fr_bfs"]["classification"] == "link-free"
+
+    tk = TileKernel(
+        loads=[Slab("xin", "x", lambda a: (pl.ds(a[1], TS),), (TS,))],
+        stores=[Slab("yout", "y", lambda a: (pl.ds(a[1], TS),), (TS,))],
+        compute=lambda ins: {"yout": ins["xin"] * 3 + 7},
+        data_specs=_specs(),
+    )
+    fmk = make_forasync_megakernel(tk, width=4, interpret=True)
+    # Unbound until a run names the tile space ...
+    assert "unbound" in fmk.describe()["schedule_independence"]["status"]
+    out, _ = run_forasync_device(
+        tk, [N], [TS],
+        {"x": np.arange(N, dtype=np.int32), "y": np.zeros(N, np.int32)},
+        width=4, mk=fmk,
+    )
+    assert (out["y"] == np.arange(N) * 3 + 7).all()
+    cert = fmk.describe()["schedule_independence"]
+    assert cert["status"] == "certified" and cert["tiles"] == N // TS
+    assert certify_claim(fmk)["status"] == "certified"
+
+
+# ------------------------------------------------ off-path guarantees
+
+
+def test_verify_off_bit_identical_with_wait_kinds():
+    """The model checker can only RAISE: a wait/satisfy program lowers
+    to identical text (and identical results) verify-on vs verify-off."""
+
+    def sa(ctx):
+        ctx.satisfy(5, v=9)
+
+    def wb(ctx):
+        ctx.set_value(0, ctx.wait_value(5))
+
+    outs, texts = {}, {}
+    for v in (False, True):
+        mk = _mk([("sat", sa), ("wait", wb)], verify=v)
+        b = TaskGraphBuilder()
+        b.add(1)
+        b.add(0)
+        iv, _, _ = mk.run(b)
+        outs[v] = int(iv[0])
+        b2 = TaskGraphBuilder()
+        b2.add(1)
+        b2.add(0)
+        tasks, succ, ring, counts = b2.finalize(
+            capacity=32, succ_capacity=8
+        )
+        texts[v] = str(
+            jax.jit(mk._build_raw(16)).lower(
+                jnp.asarray(tasks), jnp.asarray(succ), jnp.asarray(ring),
+                jnp.asarray(counts), jnp.zeros(16, jnp.int32),
+            ).as_text()
+        )
+    assert outs[False] == outs[True] == 9
+    assert texts[False] == texts[True]
+
+
+def test_model_checker_stays_host_only():
+    """waits/explore/model never build kernels nor import Mosaic - the
+    same off-path guarantee the PR 11 analyses carry."""
+    import os as _os
+
+    import hclib_tpu.analysis as pkg
+
+    d = _os.path.dirname(pkg.__file__)
+    for fname in ("waits.py", "explore.py", "model.py"):
+        with open(_os.path.join(d, fname)) as f:
+            src = f.read()
+        assert "pallas_call" not in src, fname
+        assert "InterpretParams" not in src, fname
+
+
+def test_explicit_check_wait_graph_entry():
+    """The library entry composes with an existing report/suppression
+    like every other check_* (the hclint CLI path)."""
+
+    def ka(ctx):
+        ctx.wait_value(5)
+        ctx.satisfy(6)
+
+    def kb(ctx):
+        ctx.wait_value(6)
+        ctx.satisfy(5)
+
+    mk = _mk([("a", ka), ("b", kb)], verify=False)
+    rep = check_wait_graph(mk)
+    assert [f.rule for f in rep.errors()] == ["wait-cycle"]
+    rep2 = check_wait_graph(mk, suppress=("wait-cycle",))
+    assert rep2.errors() == [] and rep2.findings[0].suppressed

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""hclint: run the build-time program verifier over the repo's builders.
+"""hclint: run the build-time program verifier + whole-program
+concurrency model checker over the repo's builders.
 
 The library half (``hclib_tpu.analysis``) runs automatically at
 ``Megakernel`` construction when ``verify=True`` / ``HCLIB_TPU_VERIFY``
@@ -9,15 +10,28 @@ program builder (workloads, stress configurations, the kernels the
 benches and tutorials build), runs the full analysis suite over each -
 word-layout consistency, batch-slot race detection, prefetch-protocol
 conformance, tile store-window disjointness over concrete tile spaces,
-and the reshard/migratability classification audit - and prints every
-finding with its witness. Exit 1 when any unsuppressed error/warn
-finding exists (info notes and spec-annotated suppressions don't gate).
+the reshard/migratability classification audit, and (v2, ISSUE 14) the
+whole-program model checker: wait-graph deadlock detection over every
+kind's spawn/wait/satisfy ops, bounded-interleaving exploration of the
+inject-poll / steal-credit / quiesce protocols (every schedule of a
+small seeded configuration, checked for termination, conservation, and
+the quiesce freeze - wall-budgeted by ``HCLIB_TPU_MODEL_BUDGET_S`` and
+depth-bounded by ``HCLIB_TPU_MODEL_DEPTH``), and schedule-independence
+certification for the kernels that claim it (frontier BFS/SSSP/
+PageRank, forasync tiles - K permuted pop orders to the fixpoint).
+Every finding prints with its concrete witness (the colliding windows,
+the wait cycle's kind chain, the interleaving prefix, the two divergent
+schedules). Exit 1 when any unsuppressed error/warn finding exists
+(info notes and spec-annotated suppressions don't gate).
 
 Everything is host-only composition: kernels are CONSTRUCTED, never
 built or run - no Pallas lowering, no Mosaic, a few seconds total.
 
-Usage: ``python tools/hclint.py [--json] [--verbose]``
-CI runs this beside tools/lint.py, before the test suite.
+Usage: ``python tools/hclint.py [--json] [--json-out FILE] [--verbose]
+[--no-explore]``; ``--json-out`` writes the machine-readable findings
+(rule, kernel, witness, severity per program) for the CI artifact so
+regressions diff across PRs. CI runs this beside tools/lint.py, before
+the test suite.
 """
 
 from __future__ import annotations
@@ -101,6 +115,8 @@ def _programs() -> List[Tuple[str, "callable"]]:
     N, TS = 32, 8
 
     def jacobi() -> AnalysisReport:
+        from hclib_tpu.analysis import certify_tile_schedule
+
         specs = {
             "grid": jax.ShapeDtypeStruct((N, N), jnp.int32),
             "out": jax.ShapeDtypeStruct((N, N), jnp.int32),
@@ -120,6 +136,11 @@ def _programs() -> List[Tuple[str, "callable"]]:
         mk = make_forasync_megakernel(tk, width=4, interpret=True)
         rep = verify_megakernel(mk, raise_on_error=False)
         check_tile_windows(tk, [N, N], [TS, TS], report=rep)
+        # The schedule-independence certificate over the concrete tile
+        # space (refusals would land in rep as findings).
+        rep.certificates = {tk.name: certify_tile_schedule(
+            tk, [N, N], [TS, TS], report=rep, raise_on_error=False,
+        )}
         return rep
 
     progs.append(("forasync:jacobi2d", jacobi))
@@ -135,19 +156,46 @@ def _programs() -> List[Tuple[str, "callable"]]:
         )
 
     progs.append(("stress:forest_steal", forest_claim))
+
+    # Tenant front-door roster (the PR 8/13 ingress configuration the
+    # CI smokes run): its WRR poll explored over EVERY schedule via the
+    # roster-seeded protocol model (TenantTable.protocol_model wraps
+    # wrr_poll_reference - the same executable spec the fairness tests
+    # pin), plus the inner megakernel's standard verification.
+    def tenant_front_door() -> AnalysisReport:
+        from hclib_tpu.analysis import check_protocols
+        from hclib_tpu.device.tenants import TenantSpec, TenantTable
+
+        tb = TenantTable(
+            [TenantSpec("gold", weight=2), TenantSpec("std"),
+             TenantSpec("best-effort")],
+            16, clock=lambda: 0.0,
+        )
+        return check_protocols(configs=[
+            ("tenants:wrr(2:1:1)", tb.protocol_model()),
+        ])
+
+    progs.append(("tenants:front_door", tenant_front_door))
     return progs
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings")
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--json-out", metavar="FILE",
+                    help="also write the machine-readable findings to "
+                         "FILE (the CI artifact - diffable across PRs)")
+    ap.add_argument("--no-explore", action="store_true",
+                    help="skip the bounded-interleaving protocol "
+                         "exploration (the model-checker half)")
     ap.add_argument("--verbose", action="store_true",
                     help="print clean programs and info findings too")
     args = ap.parse_args(argv)
 
     from hclib_tpu.analysis import (
-        check_layout, classify_megakernel, verify_megakernel,
+        check_layout, check_protocols, classify_megakernel,
+        verify_megakernel,
     )
     from hclib_tpu.analysis.findings import AnalysisReport
 
@@ -158,34 +206,22 @@ def main(argv=None) -> int:
     out["layout"] = {"findings": lay.to_jsonable(), "kind_classes": {}}
     bad += len(lay.actionable())
 
-    for label, thunk in _programs():
-        try:
-            obj = thunk()
-        except Exception as e:  # noqa: BLE001 - report, keep auditing
-            out[label] = {"findings": [{
-                "rule": "builder-error", "severity": "error",
-                "kernel": None, "message": f"{type(e).__name__}: {e}",
-                "witness": {}, "suppressed": False,
-            }], "kind_classes": {}}
-            bad += 1
-            continue
-        if isinstance(obj, AnalysisReport):
-            rep = obj
-        else:
-            rep = verify_megakernel(
-                obj, suppress=getattr(obj, "verify_suppress", ()),
-                raise_on_error=False,
-            )
-            rep.kind_classes = classify_megakernel(obj)
+    def emit(label, rep, certs=None):
+        nonlocal bad
         out[label] = {
             "findings": rep.to_jsonable(),
             "kind_classes": dict(rep.kind_classes),
+            "certificates": dict(certs or {}),
         }
         if rep.kind_classes and not args.json and args.verbose:
             cls = ", ".join(
                 f"{k}={v}" for k, v in sorted(rep.kind_classes.items())
             )
             print(f"{label}: {cls}")
+        if certs and not args.json and args.verbose:
+            for k, c in sorted(certs.items()):
+                print(f"{label}: schedule-independence[{k}]: "
+                      f"{c.get('status')}")
         bad += len(rep.actionable())
         for f in rep.findings:
             if args.json:
@@ -194,8 +230,55 @@ def main(argv=None) -> int:
                 continue
             print(f"{label}: {f}")
 
+    for label, thunk in _programs():
+        try:
+            obj = thunk()
+        except Exception as e:  # noqa: BLE001 - report, keep auditing
+            out[label] = {"findings": [{
+                "rule": "builder-error", "severity": "error",
+                "kernel": None, "message": f"{type(e).__name__}: {e}",
+                "witness": {}, "suppressed": False,
+            }], "kind_classes": {}, "certificates": {}}
+            bad += 1
+            continue
+        certs = {}
+        if isinstance(obj, AnalysisReport):
+            rep = obj
+            certs = dict(getattr(obj, "certificates", {}) or {})
+        else:
+            rep = verify_megakernel(
+                obj, suppress=getattr(obj, "verify_suppress", ()),
+                raise_on_error=False,
+            )
+            rep.kind_classes = classify_megakernel(obj)
+            if getattr(obj, "si_claim", None) is not None:
+                from hclib_tpu.analysis import certify_claim
+
+                cert = certify_claim(
+                    obj, raise_on_error=False, report=rep,
+                )
+                if cert is not None:
+                    certs[cert.get("kind", cert.get("kernel", "?"))] = (
+                        cert
+                    )
+        emit(label, rep, certs)
+
+    # The bounded-interleaving model checker over the curated protocol
+    # configurations (inject WRR + quiesce freeze + credit exchange):
+    # every schedule of each small seeded config, wall-budgeted
+    # (HCLIB_TPU_MODEL_BUDGET_S) and depth-bounded
+    # (HCLIB_TPU_MODEL_DEPTH) - CI's hard budget is the step timeout.
+    if not args.no_explore:
+        prot = check_protocols()
+        prot.kind_classes = {}
+        emit("protocols", prot)
+
+    doc = json.dumps(out, indent=2)
     if args.json:
-        print(json.dumps(out, indent=2))
+        print(doc)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(doc + "\n")
     if bad:
         print(f"hclint: {bad} actionable finding(s)", file=sys.stderr)
         return 1

@@ -19,6 +19,14 @@ Python (ast-based, so no false positives from strings/comments):
   - every ``HCLIB_TPU_*`` name mentioned anywhere in the tree must have
     a row in the ``runtime/env.py`` registry (the doc table cannot
     silently lag the code)
+  - every ``TR_*``/``SC_*``/``CR_*``/``FLT_*``/``FS_*`` tag or
+    payload-code constant defined in ``device/tracebuf.py`` must have
+    a name row in its family's decode table (``TAG_NAMES`` /
+    ``SC_NAMES`` / ``CR_NAMES`` / ``FLT_NAMES`` / ``FS_NAMES`` - what
+    the metrics summarizer and the Perfetto exporter label with) AND a
+    decode mention in ``tools/timeline.py`` - the one-table-edit
+    invariant the TR_SCALE/SC_* plumbing relies on, enforced instead
+    of remembered (both files parsed as ASTs, stdlib-only)
 
 C++ (native/src):
   - no tabs, no trailing whitespace, lines <= 100 chars
@@ -172,6 +180,101 @@ def _check_env_usage(
     return out
 
 
+TRACEBUF = os.path.join("hclib_tpu", "device", "tracebuf.py")
+TIMELINE = os.path.join("tools", "timeline.py")
+# Structural constants sharing the tag prefixes but not record tags.
+_TAG_EXEMPT = {"TR_WORDS"}
+# Tag/code families and the name table each must key into (TR_* record
+# tags; SC_* scale kinds; CR_* credit deltas; FLT_* fault codes; FS_*
+# reserved for fault-stats words if they ever move tracebuf-side).
+_TAG_TABLES = {
+    "TR_": "TAG_NAMES",
+    "SC_": "SC_NAMES",
+    "CR_": "CR_NAMES",
+    "FLT_": "FLT_NAMES",
+    "FS_": "FS_NAMES",
+}
+_TAG_RE = re.compile(r"^(TR|SC|CR|FLT|FS)_[A-Z][A-Z0-9_]*$")
+
+
+def check_trace_tables(repo: str) -> List[Tuple[str, int, str]]:
+    """The trace-tag coverage rule: every TR_*/SC_*/CR_*/FLT_*/FS_*
+    constant assigned at tracebuf.py module level (by literal OR
+    expression - ``TR_NEW = TR_OLD + 1`` counts) must (a) be a key of
+    its family's name table (``_TAG_TABLES``) - the single table
+    metrics and Perfetto label from - and (b) be mentioned by
+    tools/timeline.py (its decode rows reference record tags as
+    ``tb.<TAG>``; payload-code families decode through their name
+    table, so the table reference counts). Violations: (path, line,
+    message)."""
+    with open(os.path.join(repo, TRACEBUF)) as f:
+        tree = ast.parse(f.read())
+    tags: List[Tuple[str, int]] = []
+    tables: dict = {}
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if (
+                    _TAG_RE.match(t.id)
+                    and t.id not in _TAG_EXEMPT
+                    and not t.id.endswith("_NAMES")
+                    # Any value expression counts (TR_NEW = TR_OLD + 1
+                    # is the natural way to append a tag); only dict/
+                    # sequence containers are structural, not tags.
+                    and not isinstance(
+                        node.value,
+                        (ast.Dict, ast.List, ast.Tuple, ast.Set),
+                    )
+                ):
+                    tags.append((t.id, node.lineno))
+                if t.id in set(_TAG_TABLES.values()):
+                    keys = set()
+                    for n in ast.walk(node.value):
+                        if isinstance(n, ast.Name):
+                            keys.add(n.id)
+                    tables[t.id] = keys
+    with open(os.path.join(repo, TIMELINE)) as f:
+        tl_tree = ast.parse(f.read())
+    tl_names: Set[str] = set()
+    for n in ast.walk(tl_tree):
+        if isinstance(n, ast.Attribute):
+            tl_names.add(n.attr)
+        elif isinstance(n, ast.Name):
+            tl_names.add(n.id)
+    out: List[Tuple[str, int, str]] = []
+    for tag, lineno in tags:
+        table = next(
+            t for p, t in _TAG_TABLES.items() if tag.startswith(p)
+        )
+        named = tag in tables.get(table, set())
+        if not named:
+            out.append((
+                TRACEBUF, lineno,
+                f"trace tag {tag} has no {table} row (the metrics/"
+                "Perfetto name tables must cover every tag - one table "
+                "edit, not three drifting copies)",
+            ))
+        # TR_* tags decode individually; SC_*/FS_* decode through their
+        # name table, so the table being consulted by timeline.py
+        # satisfies the decode-row half for them.
+        needed = tag if tag.startswith("TR_") else table
+        if needed not in tl_names:
+            out.append((
+                TRACEBUF, lineno,
+                f"trace tag {tag} has no decode row in tools/"
+                f"timeline.py ({needed} never referenced): add a "
+                "branch (or name-table rendering) so the tag is "
+                "legible in Perfetto",
+            ))
+    return out
+
+
 def _check_python(
     path: str, src: str, repo: Optional[str] = None,
     registered: Optional[Set[str]] = None,
@@ -273,6 +376,13 @@ def main(argv=None) -> int:
         for lineno, msg in sorted(problems):
             print(f"{os.path.relpath(path, repo)}:{lineno}: {msg}")
             bad += 1
+    try:
+        table_problems = check_trace_tables(repo)
+    except (OSError, SyntaxError):
+        table_problems = []  # missing/broken file surfaces above
+    for rel, lineno, msg in table_problems:
+        print(f"{rel}:{lineno}: {msg}")
+        bad += 1
     if bad:
         print(f"lint: {bad} violation(s)", file=sys.stderr)
         return 1

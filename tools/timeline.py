@@ -427,6 +427,27 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                      "checkpoint (quiesce→export)",
                      {"pending": a, "ready_backlog": b})
                 quiesce_at = None
+            elif tag == tb.TR_CREDIT:
+                # Steal-credit traffic: channel ((hop << 8) | peer) and
+                # the CR_* delta code - dropped/duplicated/regenerated
+                # credits read directly off the events track.
+                hop, peer = a >> 8, a & 0xFF
+                delta = tb.CR_NAMES.get(b, f"delta<{b}>")
+                span(_TID_EVENTS, "events", t, 0.25,
+                     f"credit {delta}", {"hop": hop, "peer": peer})
+            elif tag == tb.TR_XFER:
+                span(_TID_EVENTS, "events", t, 0.5,
+                     f"xfer x{b}", {"partner": a, "rows": b})
+            elif tag == tb.TR_ABORT:
+                span(_TID_EVENTS, "events", t, 0.5, "abort",
+                     {"observed_round": a})
+            elif tag == tb.TR_FAULT:
+                kind = tb.FLT_NAMES.get(a, f"fault<{a}>")
+                span(_TID_EVENTS, "events", t, 0.5, kind,
+                     {"code": a, "detail": b})
+            elif tag == tb.TR_INJECT:
+                span(_TID_EVENTS, "events", t, 0.5,
+                     f"inject +{a}", {"installed": a})
             elif tag == tb.TR_TENANT:
                 # One WRR tenant-poll visit: installs and lazy expired
                 # drops per lane, on a dedicated track so per-tenant

@@ -1,0 +1,137 @@
+"""Lesson 18: the whole-program concurrency model checker (hclint v2).
+
+Lesson 16's verifier proves PER-BODY properties (slot disjointness,
+prefetch pairing, the layout table). Nothing there speaks about
+LIVENESS: a wait cycle, a credit wedge, or a quiesce that exports one
+thing while the poll consumes another all still fail only at runtime -
+as a ``StallError``, or by wedging a mesh. Before the completion-promise
+serving loop lands (ROADMAP direction 1: ``TenantTable.submit()``
+returning a ``Future`` satisfied by an on-device flag write), the
+analysis package grows three whole-program analyses - all host-only,
+zero Pallas builds, compiled programs byte-identical verify-on-vs-off:
+
+1. **Wait-graph deadlock detection** (``analysis/waits.py``). The new
+   on-device promise ops - ``ctx.satisfy(slot)`` (one flag write) and
+   ``ctx.wait_value(slot)`` (a bounded in-body spin) - are recorded by
+   the same shim pass that classifies kinds, and construction proves
+   the per-kind waits-on graph cycle-free or refuses with the cycle's
+   kind chain.
+2. **Bounded interleaving exploration** (``analysis/explore.py``). The
+   WRR inject poll (via ``wrr_poll_reference`` - the executable spec
+   itself), the steal-credit exchange, and the quiesce freeze explored
+   over EVERY schedule of a small seeded configuration: termination,
+   conservation, and freeze-exactness checked at each terminal state,
+   with the violating action prefix as witness.
+3. **Schedule-independence certification** (``analysis/model.py``).
+   Kernels that CLAIM order-independence (frontier BFS/SSSP/PageRank,
+   forasync tiles) run their abstract body to the fixpoint under K
+   permuted pop orders; identical states certify (surfaced in
+   ``Megakernel.describe()``), divergent ones are refused with the two
+   schedules shown.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jax.experimental import pallas as pl  # noqa: E402
+
+from hclib_tpu.analysis import (  # noqa: E402
+    AnalysisError, CreditExchangeModel, certify_frontier_schedule,
+    explore,
+)
+from hclib_tpu.device.descriptor import TaskGraphBuilder  # noqa: E402
+from hclib_tpu.device.frontier import (  # noqa: E402
+    INF, FrontierKernel, _spawn_blocks,
+)
+from hclib_tpu.device.megakernel import Megakernel  # noqa: E402
+
+# ---- 1. a wait cycle is caught AT CONSTRUCTION -------------------------
+
+
+def kind_a(ctx):
+    ctx.wait_value(5)   # spin on the flag only kind_b writes ...
+    ctx.satisfy(6)
+
+
+def kind_b(ctx):
+    ctx.wait_value(6)   # ... which spins on the flag only kind_a writes
+    ctx.satisfy(5)
+
+
+try:
+    Megakernel(kernels=[("a", kind_a), ("b", kind_b)], capacity=32,
+               num_values=16, succ_capacity=8, interpret=True,
+               verify=True)
+    raise SystemExit("the deadlock went unnoticed!")
+except AnalysisError as e:
+    print("wait cycle refused:", str(e).splitlines()[1].strip()[:72])
+
+# The acyclic handshake builds AND runs: the satisfier fires first
+# (LIFO owner-side pops), the waiter's bounded spin observes the flag.
+mk = Megakernel(
+    kernels=[("sat", lambda ctx: ctx.satisfy(5, v=7)),
+             ("wait", lambda ctx: ctx.set_value(0, ctx.wait_value(5)))],
+    capacity=32, num_values=16, succ_capacity=8, interpret=True,
+    verify=True,
+)
+b = TaskGraphBuilder()
+b.add(1)
+b.add(0)
+iv, _, _ = mk.run(b)
+assert int(iv[0]) == 7
+print("acyclic promise handshake: built, gated, ran ->", int(iv[0]))
+
+# ---- 2. the explorer finds the credit wedge ----------------------------
+
+# Seeded fault: the victim's first grant DROPS its credit (the
+# DeviceFaultPlan fault) and regeneration is off - the thief's owed
+# wait can never fire. Some interleaving wedges; the explorer finds it
+# and hands back the exact action prefix.
+res = explore(CreditExchangeModel((3, 0), drop_credit=0, regen=False,
+                                  max_steals=2))
+assert res.violations
+print("credit wedge found:", res.violations[0].message[:60], "...")
+print("  interleaving:", list(res.violations[0].witness)[:4], "...")
+
+# The shipped recovery (credit regeneration) explores clean on EVERY
+# schedule - that is the difference between a test and a proof-shaped
+# sweep of the bounded configuration.
+assert explore(CreditExchangeModel((3, 0), drop_credit=0, regen=True,
+                                   max_steals=2)).clean
+print("with regeneration: every schedule terminates + conserves")
+
+# ---- 3. schedule-independence certificates -----------------------------
+
+cert = certify_frontier_schedule("bfs")
+print("bfs certificate:", cert["status"],
+      f"({cert['orders']} permuted orders, {cert['tasks']} tasks)")
+assert cert["status"] == "certified"
+
+
+# A visit-order labeling (DFS-vs-BFS numbering) is genuinely order-
+# dependent - certification is REFUSED with both schedules shown.
+def visit_order_relax(fk, kctx, u, w, carry):
+    st = fk.st_base + u
+    first = kctx.ivalues[st] == INF
+
+    @pl.when(first)
+    def _():
+        n = kctx.ivalues[1] + 1
+        kctx.ivalues[1] = n
+        kctx.ivalues[st] = n
+        _spawn_blocks(kctx, u, 0)
+
+
+try:
+    certify_frontier_schedule("bfs", fk=FrontierKernel(
+        "fr_visit", visit_order_relax, weighted=False, state0=INF))
+    raise SystemExit("order dependence went unnoticed!")
+except AnalysisError as e:
+    print("visit-order labeling refused:",
+          "two schedules in the witness:", "schedule_a" in str(e))
+
+print("lesson 18 OK")
