@@ -1185,7 +1185,16 @@ def bench_graph(quick: bool = False) -> None:
     combined traversed-edges/s (TEPS) - prints (and flushes) FIRST,
     rc=124-proofed like every other headline; per-kernel TEPS /
     occupancy / lane_partial_age lines go to stderr budget-gated, and
-    the full detail lands in perf-logs/<ts>.graph.json."""
+    the full detail lands in perf-logs/<ts>.graph.json.
+
+    perf-logs schema (<ts>.graph.json): the headline fields (metric/
+    value/unit, per-kernel ``*_teps``, ``sssp_delta_teps`` +
+    ``sssp_delta_expand_ratio`` - the ISSUE 15 ordered-work dividend,
+    executed EXPANDs of the bucketed arm over the unordered arm's)
+    merged with ``kernels.<kind>`` rows: edges / relaxations / tasks /
+    elapsed_s / occupancy / age_fires / max_starved_age /
+    bucket_fires / bucket_inversions (the last two zero on unbucketed
+    arms), plus ``traced_bfs`` gauges."""
     import jax
     import numpy as np
 
@@ -1234,6 +1243,30 @@ def bench_graph(quick: bool = False) -> None:
         arms[kind] = (info, wall)
         edges_total += info["edges"]
         wall_total += wall
+
+    # Delta-stepping arm (ISSUE 15): the SAME seeded SSSP through the
+    # priority-bucket tier - the headline addition is the executed-
+    # EXPAND ratio vs the unordered arm just measured (ordered
+    # retirement = asymptotically less work; distances asserted
+    # bit-identical) plus its own TEPS.
+    def delta_arm():
+        fk = _KINDS["sssp"]()
+        mk = make_frontier_megakernel(
+            fk, g, width=width, capacity=capacity, interpret=True,
+            priority_buckets=8,
+        )
+        kw = dict(capacity=capacity, interpret=True, mk=mk)
+        run_frontier("sssp", g, 0, **kw)  # warm the jit
+        t0 = time.perf_counter()
+        res, info = run_frontier("sssp", g, 0, **kw)
+        wall = time.perf_counter() - t0
+        assert np.array_equal(np.asarray(res), host_sssp(g, 0)), (
+            "sssp-delta: bucketed distances diverged from Dijkstra"
+        )
+        return info, wall
+
+    dinfo, dwall = delta_arm()
+    expand_ratio = dinfo["executed"] / max(arms["sssp"][0]["executed"], 1)
     headline = {
         "metric": f"graph frontier traversal throughput (BFS+SSSP+"
         f"PageRank, R-MAT scale {scale}, {g.m} edges, batched "
@@ -1247,10 +1280,16 @@ def bench_graph(quick: bool = False) -> None:
         "pagerank_teps": round(
             arms["pagerank"][0]["edges"] / max(arms["pagerank"][1], 1e-9)
         ),
+        # Priority tier (delta-stepping SSSP, priority_buckets=8):
+        # the work-count dividend is the schedule-proof number
+        # (interpret walls are weather; the EXPAND ratio is exact).
+        "sssp_delta_teps": round(dinfo["edges"] / max(dwall, 1e-9)),
+        "sssp_delta_expand_ratio": round(expand_ratio, 4),
         "backend": jax.default_backend(),
     }
     print(json.dumps(headline), flush=True)  # headline FIRST, always
     detail = {"kernels": {}}
+    arms["sssp_delta"] = (dinfo, dwall)
     for kind, (info, wall) in arms.items():
         t = info.get("tiers", {})
         detail["kernels"][kind] = {
@@ -1261,11 +1300,17 @@ def bench_graph(quick: bool = False) -> None:
             "occupancy": round(t.get("batch_occupancy", 0.0), 3),
             "age_fires": t.get("age_fires", 0),
             "max_starved_age": t.get("max_starved_age", 0),
+            # Priority-tier counters (zeros on unbucketed arms).
+            "bucket_fires": t.get("bucket_fires", 0),
+            "bucket_inversions": t.get("bucket_inversions", 0),
         }
         log(f"graph {kind}: {info['edges']} edges in {wall:.3f}s "
             f"({info['edges'] / max(wall, 1e-9):,.0f} TEPS), occupancy "
             f"{t.get('batch_occupancy', 0.0):.2f}, {t.get('age_fires', 0)} "
             f"age fires (max starved age {t.get('max_starved_age', 0)})")
+    log(f"graph sssp-delta: {dinfo['executed']} EXPANDs vs "
+        f"{arms['sssp'][0]['executed']} unordered "
+        f"({expand_ratio:.2f}x), {dinfo['edges']} edges in {dwall:.3f}s")
 
     # Traced BFS round (stderr, budget-gated): the lane_partial_age
     # gauge - bounded by the age-triggered firing policy - plus per-lane
@@ -1294,6 +1339,84 @@ def bench_graph(quick: bool = False) -> None:
     with open(path, "w") as f:
         json.dump({**headline, **detail}, f, indent=1)
     log(f"graph bench written: {path}")
+
+
+def bench_bnb(quick: bool = False) -> None:
+    """Branch-and-bound cost of record (ISSUE 15): best-first 0/1
+    knapsack on the priority-bucket tier vs the unordered batched arm,
+    same seeded instance, optimum asserted equal to the independent
+    host DP in both. The headline JSON - best-first expanded nodes/s
+    plus the expanded-node ratio (priority IS the speedup here) -
+    prints (and flushes) FIRST, rc=124-proofed like every other
+    headline; per-arm node/prune lines go to stderr budget-gated and
+    the full detail lands in perf-logs/<ts>.bnb.json.
+
+    perf-logs schema (<ts>.bnb.json): the headline fields (metric/
+    value/unit, ``expand_ratio`` = best-first executed nodes over
+    unordered, ``optimum``) merged with ``arms.<name>`` rows:
+    executed / pruned / leaves / elapsed_s / occupancy /
+    bucket_fires / bucket_inversions."""
+    import jax
+
+    from hclib_tpu.device.bnb import (
+        host_knapsack_opt, make_bnb_megakernel, make_knapsack, run_bnb,
+    )
+
+    n_items = 12 if quick else 16
+    kp = make_knapsack(n_items, seed=5)
+    opt = host_knapsack_opt(kp)
+    width = 4
+    arms = {}
+    for name, buckets in (("unordered", 0), ("best_first", 8)):
+        mk = make_bnb_megakernel(
+            kp, width=width, priority_buckets=buckets, interpret=True,
+            capacity=2048,
+        )
+        run_bnb(kp, mk=mk, interpret=True)  # warm the jit
+        t0 = time.perf_counter()
+        best, info = run_bnb(kp, mk=mk, interpret=True)
+        wall = time.perf_counter() - t0
+        assert best == opt, (
+            f"bnb {name}: incumbent {best} != DP optimum {opt}"
+        )
+        arms[name] = (info, wall)
+    bi, bw = arms["best_first"]
+    ui, _uw = arms["unordered"]
+    ratio = bi["executed"] / max(ui["executed"], 1)
+    headline = {
+        "metric": f"branch-and-bound best-first search ({n_items}-item "
+        f"knapsack, priority buckets over the batch lanes)",
+        "value": round(bi["executed"] / max(bw, 1e-9)),
+        "unit": "nodes/sec",
+        "optimum": opt,
+        "expand_ratio": round(ratio, 4),
+        "pruned_best_first": bi["pruned"],
+        "pruned_unordered": ui["pruned"],
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(headline), flush=True)  # headline FIRST, always
+    detail = {"arms": {}}
+    for name, (info, wall) in arms.items():
+        t = info.get("tiers", {})
+        detail["arms"][name] = {
+            "executed": info["executed"],
+            "pruned": info["pruned"],
+            "leaves": info["leaves"],
+            "elapsed_s": wall,
+            "occupancy": round(t.get("batch_occupancy", 0.0), 3),
+            "bucket_fires": t.get("bucket_fires", 0),
+            "bucket_inversions": t.get("bucket_inversions", 0),
+        }
+        log(f"bnb {name}: {info['executed']} nodes ({info['pruned']} "
+            f"pruned, {info['leaves']} leaves) in {wall:.3f}s")
+    log(f"bnb best-first expanded {ratio:.2f}x the unordered node "
+        f"count (optimum {opt} proven by both)")
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.bnb.json")
+    with open(path, "w") as f:
+        json.dump({**headline, **detail}, f, indent=1)
+    log(f"bnb bench written: {path}")
 
 
 def bench_multichip(quick: bool = False) -> None:
@@ -1416,6 +1539,15 @@ def main(argv=None) -> None:
         "for this run",
     )
     ap.add_argument(
+        "--bnb", action="store_true",
+        help="branch-and-bound mode: best-first knapsack search on the "
+        "priority-bucket tier; the expanded-nodes/s headline (plus the "
+        "expanded-node ratio vs the unordered arm) prints FIRST "
+        "(stdout JSON), per-arm node/prune lines to stderr and "
+        "perf-logs/<ts>.bnb.json; replaces the single-device suite for "
+        "this run",
+    )
+    ap.add_argument(
         "--multichip", action="store_true",
         help="8-device mesh mode: the batched forest-steal tasks/s "
         "headline prints FIRST (stdout JSON), then per-device "
@@ -1437,6 +1569,9 @@ def main(argv=None) -> None:
         return
     if args.graph:
         bench_graph(quick=args.quick)
+        return
+    if args.bnb:
+        bench_bnb(quick=args.quick)
         return
     if args.multichip:
         # Must land before jax initializes: the mesh workloads need the

@@ -51,7 +51,8 @@ from .findings import (
 )
 from .layout import check_layout
 from .model import (
-    certify_claim, certify_frontier_schedule, certify_tile_schedule,
+    certify_bnb_schedule, certify_claim, certify_frontier_schedule,
+    certify_tile_schedule,
 )
 from .races import boxes_overlap, check_batch_spec, check_tile_windows
 from .shim import ShimUnsupported
@@ -67,6 +68,7 @@ __all__ = [
     "KindSummary",
     "ShimUnsupported",
     "boxes_overlap",
+    "certify_bnb_schedule",
     "certify_claim",
     "certify_frontier_schedule",
     "certify_tile_schedule",

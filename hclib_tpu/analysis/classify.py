@@ -22,6 +22,15 @@ through ``Megakernel.describe()``; ``check_migratable`` is the
 ``reshard-class`` rule - a kind claimed migratable by a runner whose
 classification says ``home-linked`` is a mislabel caught before any row
 ever migrates wrong.
+
+Priority-bucketed kinds (ISSUE 15) keep their reshard class by
+construction: ``BatchSpec.priority`` is pop-time ROUTING state - a
+pure function of descriptor arg words evaluated by the scheduler, not
+body code - so the recording-shim pass (which runs only the body)
+classifies a bucketed kind exactly as its unbucketed twin, and
+reshard/steal row filters need no bucket awareness (the bucket id
+re-derives from the row's own words wherever it lands). Asserted in
+tests/test_priority.py.
 """
 
 from __future__ import annotations

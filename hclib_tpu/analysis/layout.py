@@ -79,9 +79,18 @@ LAYOUT = {
     "TS_THROTTLED": (5, ("hclib_tpu.device.tenants",)),
     "TS_QUARANTINED": (6, ("hclib_tpu.device.tenants",)),
     # batch-tier counter/state rows (device/megakernel.py)
-    "TS_WORDS": (10, ("hclib_tpu.device.megakernel",)),
+    "TS_WORDS": (12, ("hclib_tpu.device.megakernel",)),
     "LS_WORDS": (8, ("hclib_tpu.device.megakernel",)),
     "LS_AGE": (5, ("hclib_tpu.device.megakernel",)),
+    # priority-bucket tier words (ISSUE 15): the static bucket-ring
+    # cap and the two tstats counters the bucketed scheduler writes.
+    # The bucket id itself rides the DESCRIPTOR's own arg words
+    # (BatchSpec.priority is a pure function of them - see the routing
+    # site in megakernel.py), so there is no bucket transport word to
+    # pin: residue re-buckets on resume/reshard by construction.
+    "BK_MAX": (8, ("hclib_tpu.device.megakernel",)),
+    "TS_BUCKET_FIRES": (10, ("hclib_tpu.device.megakernel",)),
+    "TS_INVERSIONS": (11, ("hclib_tpu.device.megakernel",)),
     "QC_FLAG": (0, ("hclib_tpu.device.megakernel",)),
     "QC_AFTER": (1, ("hclib_tpu.device.megakernel",)),
     "C_EXECUTED": (5, ("hclib_tpu.device.megakernel",)),
@@ -137,10 +146,13 @@ def check_layout(report: Optional[AnalysisReport] = None,
             f"RING_ROW={d.RING_ROW} violated",
             word="TEN_ID",
         )
-    if not (m.LS_AGE < m.LS_WORDS and m.TS_MAX_AGE < m.TS_WORDS):
+    if not (m.LS_AGE < m.LS_WORDS
+            and m.TS_MAX_AGE < m.TS_BUCKET_FIRES
+            < m.TS_INVERSIONS < m.TS_WORDS):
         report.add(
             "layout", ERROR, None,
-            "lane/tier state words exceed their declared row widths",
+            "lane/tier state words exceed their declared row widths "
+            "(or the bucket-tier counters overlap the age words)",
             word="LS_WORDS",
         )
     from ..runtime import checkpoint as c

@@ -39,6 +39,7 @@ from .shim import BodyTrace, FakeRef, _norm_box, _patched
 
 __all__ = [
     "certify_claim",
+    "certify_bnb_schedule",
     "certify_frontier_schedule",
     "certify_tile_schedule",
 ]
@@ -211,20 +212,26 @@ def _small_graph(seed: int):
 
 def certify_frontier_schedule(kind: str, *, reps: int = 64,
                               perms: Optional[int] = None, seed: int = 0,
+                              buckets: int = 0, delta: int = 1,
                               report: Optional[AnalysisReport] = None,
                               raise_on_error: bool = True,
                               fk=None, graph=None) -> Dict[str, Any]:
     """Certify one frontier traversal kind: run its relax body (the
     SAME ``_relax_block`` loop both dispatch spellings trace) to the
     fixpoint over a small seeded R-MAT graph under K permuted worklist
-    pop orders, and prove the per-vertex state identical. ``fk``/
-    ``graph`` override the defaults (the order-dependent-refusal tests
-    pass a planted kernel)."""
-    from ..device.frontier import _KINDS, seed_frontier
+    pop orders, and prove the per-vertex state identical. With
+    ``buckets`` (a priority-bucketed build's claim, ISSUE 15) one extra
+    order is the BUCKETED pop - always take a lowest-bucket entry, via
+    the host spelling of the device priority function
+    (frontier.priority_bucket) - so the priority tier's pop order is
+    certified against the same fixpoint as the random permutations.
+    ``fk``/``graph`` override the defaults (the order-dependent-refusal
+    tests pass a planted kernel)."""
+    from ..device.frontier import _KINDS, priority_bucket, seed_frontier
 
     perms = _perms() if perms is None else int(perms)
     custom = fk is not None or graph is not None
-    key = ("frontier", kind, reps, perms, seed)
+    key = ("frontier", kind, reps, perms, seed, buckets, delta)
     if not custom and key in _frontier_cache:
         return _frontier_cache[key]
     g = graph if graph is not None else _small_graph(seed)
@@ -238,8 +245,11 @@ def certify_frontier_schedule(kind: str, *, reps: int = 64,
     m0 = 1 << 12
     seeds = seed_frontier(None, g, kind, src=0, m0=m0, reps=reps)
     cert: Dict[str, Any] = {
-        "claim": "frontier", "kind": kind, "orders": perms,
+        "claim": "frontier", "kind": kind,
+        "orders": perms + (1 if buckets else 0),
         "vertices": g.n, "seeds": len(seeds),
+        **({"buckets": int(buckets), "delta": int(delta)}
+           if buckets else {}),
     }
 
     def run_order(perm_seed: int):
@@ -253,7 +263,7 @@ def certify_frontier_schedule(kind: str, *, reps: int = 64,
         elif kind == "pagerank":
             iv[g.st_base : g.st_base + g.n] = _pr_seed_rank(g, m0, reps)
         wl: List[Tuple[int, ...]] = list(seeds)
-        rng = np.random.default_rng(seed * 1000 + perm_seed)
+        rng = np.random.default_rng(seed * 1000 + max(perm_seed, 0))
         schedule: List[Tuple[int, ...]] = []
         steps = 0
         trace = BodyTrace()
@@ -262,9 +272,19 @@ def certify_frontier_schedule(kind: str, *, reps: int = 64,
                 steps += 1
                 if steps > STEP_CAP:
                     return None, schedule, steps
-                i = 0 if perm_seed == 0 else int(
-                    rng.integers(len(wl))
-                )
+                if perm_seed == 0:
+                    i = 0
+                elif perm_seed == -1:
+                    # The bucketed pop order: lowest clipped bucket
+                    # first (FIFO within a bucket) - exactly what the
+                    # device's bucket-major drain retires.
+                    i = int(np.argmin([
+                        min(priority_bucket(kind, c, delta=delta,
+                                            reps=reps), buckets - 1)
+                        for _v, _b, c, _c in wl
+                    ]))
+                else:
+                    i = int(rng.integers(len(wl)))
                 v, blk, carry, cnt = wl.pop(i)
                 schedule.append((v, blk, carry, cnt))
                 ctx = _AbsFrontierCtx(iv, wl)
@@ -283,7 +303,8 @@ def certify_frontier_schedule(kind: str, *, reps: int = 64,
         cert["status"] = f"unverified (fixpoint > {STEP_CAP} steps)"
         return cert
     cert["tasks"] = steps0
-    for k in range(1, perms):
+    order_ids = list(range(1, perms)) + ([-1] if buckets else [])
+    for k in order_ids:
         got, schedk, _ = run_order(k)
         if got is None:
             cert["status"] = f"unverified (fixpoint > {STEP_CAP} steps)"
@@ -312,6 +333,108 @@ def certify_frontier_schedule(kind: str, *, reps: int = 64,
     return cert
 
 
+# -------------------------------------------------------------- bnb
+
+_bnb_cache: Dict[Tuple, Dict[str, Any]] = {}
+
+
+def certify_bnb_schedule(values, weights, cap: int, *,
+                         buckets: int = 0,
+                         perms: Optional[int] = None, seed: int = 0,
+                         report: Optional[AnalysisReport] = None,
+                         raise_on_error: bool = True) -> Dict[str, Any]:
+    """Certify a branch-and-bound claim (device/bnb.py): the OPTIMUM a
+    run proves is independent of the pop order. Runs the host worklist
+    model (same bound test, same branch rule as the device body) under
+    K permuted orders plus - when the claim is bucketed - the
+    best-first order itself, and proves the final incumbent identical.
+    Pruned/executed counts legitimately differ per schedule (that IS
+    the priority speedup) and are deliberately not compared."""
+    from ..device.bnb import Knapsack, bnb_bucket
+
+    perms = _perms() if perms is None else int(perms)
+    key = ("bnb", tuple(values), tuple(weights), int(cap), int(buckets),
+           perms, seed)
+    if key in _bnb_cache:
+        return _bnb_cache[key]
+    kp = Knapsack(values, weights, cap)
+    cert: Dict[str, Any] = {
+        "claim": "bnb", "kind": "bnb", "items": kp.n, "cap": kp.cap,
+        "orders": perms + (1 if buckets else 0),
+        **({"buckets": int(buckets)} if buckets else {}),
+    }
+
+    def run_order(perm_seed: int):
+        rng = np.random.default_rng(seed * 1000 + max(perm_seed, 0))
+        best, steps = 0, 0
+        wl: List[Tuple[int, int, int, int]] = [(0, 0, 0, kp.total)]
+        schedule: List[Tuple[int, ...]] = []
+        while wl:
+            steps += 1
+            if steps > STEP_CAP:
+                return None, schedule, steps
+            if perm_seed == 0:
+                i = 0
+            elif perm_seed == -1:
+                # The bucketed (best-first) pop: lowest bucket id =
+                # highest bound, via the host spelling of the device
+                # priority function.
+                i = int(np.argmin([
+                    min(bnb_bucket(kp, b, buckets), buckets - 1)
+                    for _l, _v, _w, b in wl
+                ]))
+            else:
+                i = int(rng.integers(len(wl)))
+            level, value, weight, bound = wl.pop(i)
+            schedule.append((level, value, weight, bound))
+            if bound <= best:
+                continue
+            if level == kp.n:
+                best = max(best, value)
+                continue
+            sfx = int(kp.suffix[level + 1])
+            wl.append((level + 1, value, weight, value + sfx))
+            v_i, w_i = int(kp.values[level]), int(kp.weights[level])
+            if weight + w_i <= kp.cap:
+                wl.append(
+                    (level + 1, value + v_i, weight + w_i,
+                     value + v_i + sfx)
+                )
+        return best, schedule, steps
+
+    ref, sched0, steps0 = run_order(0)
+    if ref is None:
+        cert["status"] = f"unverified (search > {STEP_CAP} steps)"
+        return cert
+    cert["tasks"] = steps0
+    cert["optimum"] = int(ref)
+    for k in list(range(1, perms)) + ([-1] if buckets else []):
+        got, schedk, _ = run_order(k)
+        if got is None:
+            cert["status"] = f"unverified (search > {STEP_CAP} steps)"
+            return cert
+        if got != ref:
+            report = report or AnalysisReport()
+            f = report.add(
+                RULE, ERROR, "bnb_node",
+                f"branch-and-bound incumbent is order-DEPENDENT: "
+                f"optimum {ref} vs {got} between two pop orders; "
+                "certification refused - the two divergent schedules "
+                "ride the witness",
+                value_a=int(ref), value_b=int(got),
+                schedule_a=_schedule_witness(sched0),
+                schedule_b=_schedule_witness(schedk),
+            )
+            cert["status"] = "refused (order-dependent)"
+            cert["findings"] = _finding_jsonable(f)
+            if raise_on_error:
+                report.raise_errors()
+            return cert
+    cert["status"] = "certified"
+    _bnb_cache[key] = cert
+    return cert
+
+
 # ------------------------------------------------------------ claims
 
 
@@ -327,10 +450,22 @@ def certify_claim(mk, *, raise_on_error: bool = True,
     if claim is None:
         return None
     if claim[0] == "frontier":
-        _tag, kind, reps = claim
+        # 3-tuple: (tag, kind, reps) - the unbucketed spelling. The
+        # priority-bucketed builders (ISSUE 15) stamp the 5-tuple
+        # (tag, kind, reps, buckets, delta) so the bucketed pop order
+        # itself is one of the certified schedules.
+        _tag, kind, reps = claim[:3]
+        buckets = int(claim[3]) if len(claim) > 3 and claim[3] else 0
+        delta = int(claim[4]) if len(claim) > 4 and claim[4] else 1
         return certify_frontier_schedule(
-            kind, reps=int(reps or 64), report=report,
-            raise_on_error=raise_on_error,
+            kind, reps=int(reps or 64), buckets=buckets, delta=delta,
+            report=report, raise_on_error=raise_on_error,
+        )
+    if claim[0] == "bnb":
+        _tag, values, weights, cap, buckets = claim
+        return certify_bnb_schedule(
+            values, weights, int(cap), buckets=int(buckets or 0),
+            report=report, raise_on_error=raise_on_error,
         )
     if claim[0] == "tile":
         _tag, tk, bounds, tile = claim

@@ -37,10 +37,13 @@ execution order, batch grouping, and migration - which is what makes
 arrays are local caches combined by elementwise min (a suppressed spawn
 on one device means an equal-or-better carry was already propagated
 there; propagation is transitive). Level-synchronous BFS order is the
-special case the lane LIFO/FIFO approximates; delta-stepping SSSP
-likewise degenerates to the lane order (re-expansions are the
-correction; the bucket discipline of true delta-stepping is future
-work noted in ROADMAP). PageRank is push-style with integer
+special case the lane LIFO/FIFO approximates; with
+``priority_buckets=B`` (ISSUE 15) SSSP runs TRUE delta-stepping -
+EXPANDs route into bucket ring ``dist // delta`` and the scheduler
+retires the lowest non-empty bucket first, so most relaxations happen
+at final distances and the re-relaxation work of label correction
+largely disappears (executed-EXPAND count and TEPS are the headline;
+the fixpoint is the same either way). PageRank is push-style with integer
 fixed-point mass: a delivery of ``q`` to ``u`` retains
 ``q - deg(u) * q_child`` into rank[u] and forwards ``q_child =
 (alpha * q) / deg(u)`` along every out-edge, folding entirely into
@@ -79,7 +82,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..runtime.locality import MeshPlacement, resolve_placement
 from .descriptor import TaskGraphBuilder
-from .megakernel import BatchSpec, Megakernel, _batch_stub
+from .megakernel import BK_MAX, BatchSpec, Megakernel, _batch_stub
 
 __all__ = [
     "EBLOCK",
@@ -97,6 +100,8 @@ __all__ = [
     "host_sssp",
     "host_pagerank_push",
     "host_pagerank",
+    "priority_bucket",
+    "default_delta",
     "PR_NUM",
     "PR_DEN",
 ]
@@ -465,10 +470,12 @@ def bfs_kernel() -> FrontierKernel:
 
 
 def sssp_kernel() -> FrontierKernel:
-    """Delta-stepping-style SSSP (nonnegative int weights): the same
-    monotone relaxation with ``carry + w``; re-expansions are the
-    delta-stepping corrections, with the lane's pop order standing in
-    for the bucket discipline (exactness does not depend on it)."""
+    """SSSP (nonnegative int weights): the same monotone relaxation
+    with ``carry + w``. Unordered, the lane's pop order stands in for
+    the bucket discipline and re-expansions are the correction; with
+    ``priority_buckets`` the build runs TRUE delta-stepping (bucket =
+    dist // delta, lowest first) and the re-expansions mostly vanish -
+    exactness depends on neither (the relax body is identical)."""
 
     def relax(fk, kctx, u, w, carry) -> None:
         nd = carry + w
@@ -482,6 +489,72 @@ def sssp_kernel() -> FrontierKernel:
             _spawn_blocks(kctx, u, nd)
 
     return FrontierKernel("fr_sssp", relax, weighted=True, state0=INF)
+
+
+# PageRank residual-magnitude bands grow by this factor per bucket:
+# bucket k holds deliveries with q in [reps*2^k, reps*2^(k+1)) - small
+# residuals (which FOLD, freeing rows) land in bucket 0 and fire first,
+# so the push collapses each subtree before the next large delivery
+# splits (depth-first by magnitude = the bounded-frontier fix). Factor
+# 2 resolves one alpha-split step (a delivery's children are ~q/deg:
+# always a lower band), which the live-set model showed is what holds
+# the peak flat as m0 grows; coarser bands leak whole generations into
+# one bucket and the breadth returns.
+PR_BAND = 2
+
+
+def priority_bucket(kind: str, carry: int, *, delta: int = 1,
+                    reps: int = 64) -> int:
+    """HOST-int spelling of the priority-bucket functions the device
+    routing runs (``_bucket_fn`` below is the traced twin - keep the two
+    in lockstep; analysis/model.py certifies the bucketed pop order
+    through THIS spelling). ``carry`` is the descriptor's carry word:
+    the tentative distance (bfs/sssp - bucket = dist // delta, the
+    delta-stepping discipline) or the delivered residual mass
+    (pagerank - ascending magnitude bands). The scheduler clips into
+    [0, priority_buckets)."""
+    if kind in ("bfs", "sssp", "fr_bfs", "fr_sssp"):
+        return int(carry) // max(1, int(delta))
+    b = 0
+    for k in range(1, BK_MAX):
+        b += int(carry) >= int(reps) * (PR_BAND ** k)
+    return b
+
+
+def _bucket_fn(name: str, delta: int, reps: int):
+    """Device (traced int32) twin of ``priority_bucket`` - the
+    ``BatchSpec.priority`` callable for one frontier kind. Reads ONLY
+    the descriptor's own arg words (carry is arg 2), which is what
+    makes spilled/stolen/resharded residue re-bucket on its next
+    routing pop."""
+    if name in ("fr_bfs", "fr_sssp"):
+        d = max(1, int(delta))
+        return lambda arg: arg(2) // jnp.int32(d)
+
+    def pr(arg):
+        q = arg(2)
+        b = jnp.int32(0)
+        for k in range(1, BK_MAX):
+            b = b + (q >= jnp.int32(int(reps) * (PR_BAND ** k))).astype(
+                jnp.int32
+            )
+        return b
+
+    return pr
+
+
+def default_delta(graph: Graph) -> int:
+    """Default delta-stepping bucket width for a graph: max edge weight
+    over the bucket-ring count (>= 1), so the static ring set resolves
+    roughly one relaxation step where the frontier lives. Measured on
+    seeded weighted R-MAT this FINE delta beats the classic coarse
+    ~max-weight choice even though far distances clip into the top
+    ring (executed-EXPAND 0.68-0.77x FIFO at delta = w_max/8 vs
+    0.85-0.87x at w_max/2): the early frontier is where re-relaxation
+    happens, so that is where resolution pays. Override via
+    ``make_frontier_megakernel(delta=)``."""
+    w = int(graph.weights.max()) if graph.m else 1
+    return max(1, w // BK_MAX)
 
 
 def _pr_split(q, deg):
@@ -711,26 +784,87 @@ def make_frontier_megakernel(
     trace=None,
     checkpoint: Optional[bool] = None,
     lane_max_age: Optional[int] = None,
+    priority_buckets: Optional[int] = None,
+    delta: Optional[int] = None,
 ) -> Megakernel:
     """Build a traversal's megakernel. ``width=0`` is the scalar-
     dispatch arm (the bit-identity reference); ``width>0`` routes EXPAND
     through the batch lanes with the double-buffered edge-slab prefetch,
     and arms the age-triggered firing policy (``lane_max_age``; default
-    4*width, 0 disables)."""
+    4*width, 0 disables).
+
+    ``priority_buckets=B`` (batched builds only) arms the ISSUE 15
+    priority tier: EXPANDs route into B bucket rings popped lowest-
+    nonempty-first - bucket = dist // ``delta`` for BFS/SSSP (TRUE
+    delta-stepping: ordered relaxation replaces label-correction
+    re-relaxation, so the executed-EXPAND count drops - the raw-speed
+    story) or the residual-magnitude band for PageRank (small deliveries
+    fold first, bounding the live frontier). Exactness never depends on
+    it: the result is schedule-independent (certified via ``si_claim``)
+    and bit-identical to the unordered arm."""
     if num_values is None:
         num_values = graph.num_value_slots + 8
+    if priority_buckets is None:
+        # The process-wide spelling reaches the builder too (the
+        # builder must know: it disables the cross-round prefetch and
+        # rescales the age default for bucketed builds).
+        from ..runtime.env import env_int
+
+        priority_buckets = env_int("HCLIB_TPU_PRIORITY_BUCKETS", None)
+    priority_buckets = int(priority_buckets or 0)
+    if priority_buckets and not width:
+        raise ValueError(
+            "priority_buckets needs the batched arm (width > 0): the "
+            "bucket rings layer over the per-kind batch lanes"
+        )
+    if delta is None:
+        delta = default_delta(graph)
     if width:
+        # Bucketed builds genuinely run WITHOUT the cross-round
+        # prefetch (the next firing ring is chosen at fire time, so
+        # there is no prospective next batch; the scheduler would never
+        # announce one anyway) - the spec says so too, so describe()
+        # and the prefetch-protocol analysis see the build that
+        # actually runs. Bucket rings still pop FIFO (the scheduler's
+        # bucket-ring discipline, independent of spec.prefetch).
+        prefetch = bool(prefetch) and not priority_buckets
         spec = BatchSpec(
             fk.batch_body,
             width=width,
             prefetch=prefetch,
             drain=fk.batch_drain if prefetch else None,
+            # The priority callable is carried unconditionally (it is
+            # only consulted when priority_buckets arms the tier, so an
+            # unbucketed build stays byte-identical - asserted in
+            # tests/test_priority.py).
+            priority=_bucket_fn(fk.name, delta, getattr(fk, "reps", 64)),
         )
         kernels = [(fk.name, _batch_stub)]
         route = {fk.name: spec}
         scratch = fk.batch_scratch(width)
         if lane_max_age is None:
-            lane_max_age = _default_lane_max_age(width)
+            if priority_buckets:
+                # Bucketed builds arm the SAME age-fire guard but at
+                # the DRAIN-PERIOD scale (2x capacity - a routing
+                # drain can hold a ring unfired for at most ~capacity
+                # rounds, the ring size), not PR 10's 4*width latency
+                # tune: at 4*width the guard fires constantly during
+                # long routing drains and every forced fire jumps the
+                # bucket order (measured: executed-EXPAND ratio decays
+                # 0.63x -> 0.86x and the PageRank live-set fix washes
+                # out 0.26x -> 0.92x). At 2x capacity it is a pure
+                # starvation backstop: zero fires in steady state,
+                # high buckets still provably bounded against a
+                # pathological low-bucket refill.
+                from ..runtime.env import env_set
+
+                lane_max_age = (
+                    None  # env wins, Megakernel resolves it
+                    if env_set("HCLIB_TPU_LANE_MAX_AGE")
+                    else 2 * capacity
+                )
+            else:
+                lane_max_age = _default_lane_max_age(width)
     else:
         kernels = [(fk.name, fk.scalar_kernel)]
         route = None
@@ -756,6 +890,7 @@ def make_frontier_megakernel(
         trace=trace,
         checkpoint=checkpoint,
         lane_max_age=lane_max_age,
+        priority_buckets=priority_buckets,
     )
     # Stamp the graph layout the traced kernel is bound to: the relax
     # closures bake st_base (and the data specs bake nblocks) into the
@@ -769,7 +904,17 @@ def make_frontier_megakernel(
     kind = {"fr_bfs": "bfs", "fr_sssp": "sssp",
             "fr_pagerank": "pagerank"}.get(fk.name)
     if kind is not None:
-        mk.si_claim = ("frontier", kind, getattr(fk, "reps", None))
+        # Bucketed builds extend the claim with (buckets, delta) so
+        # certify_claim includes the BUCKETED pop order among the K
+        # permutations it proves reach the same fixpoint - the priority
+        # tier's exactness gate (the 3-tuple spelling stays for
+        # unbucketed builds; certify_claim parses both).
+        mk.si_claim = (
+            ("frontier", kind, getattr(fk, "reps", None),
+             priority_buckets, delta)
+            if priority_buckets
+            else ("frontier", kind, getattr(fk, "reps", None))
+        )
     return mk
 
 
@@ -790,6 +935,8 @@ def run_frontier(
     trace=None,
     fuel: Optional[int] = None,
     lane_max_age: Optional[int] = None,
+    priority_buckets: Optional[int] = None,
+    delta: Optional[int] = None,
     mk: Optional[Megakernel] = None,
     placement=None,
     mesh=None,
@@ -819,6 +966,7 @@ def run_frontier(
         mk = make_frontier_megakernel(
             fk, graph, width=width, prefetch=prefetch, capacity=capacity,
             interpret=interpret, trace=trace, lane_max_age=lane_max_age,
+            priority_buckets=priority_buckets, delta=delta,
         )
     else:
         # A prebuilt megakernel owns its own (already-bound) kernel; it

@@ -754,7 +754,7 @@ class ICIStealMegakernel:
             # Batched dispatch tier lane scratch (both bodies unpack it
             # last): re-entrant across sched() entries via the spill
             # discipline, so the steal exchange never sees a lane entry.
-            nb = len(mk.batch_specs)
+            nb = mk.lane_scratch_rows  # kinds x priority buckets
             from .megakernel import LS_WORDS
 
             scratch += [

@@ -52,6 +52,7 @@ from .tracebuf import (
     TR_CKPT,
     TR_FIRE_AGE,
     TR_FIRE_BATCH,
+    TR_FIRE_BUCKET,
     TR_FIRE_SCALAR,
     TR_PREFETCH_DRAIN,
     TR_PREFETCH_ISSUE,
@@ -156,7 +157,27 @@ TS_MAX_AGE = 9        # max starved-round age any lane reached (rounds a
                       # lane held entries without firing; written only
                       # when lane_max_age is on - the device-side gauge
                       # the age-trigger acceptance bounds)
-TS_WORDS = 10
+TS_BUCKET_FIRES = 10  # batch rounds fired from a NONZERO priority bucket
+                      # (priority_buckets builds only; zero otherwise) -
+                      # how much of the dispatch actually used the
+                      # ordered-retirement structure
+TS_INVERSIONS = 11    # bucket-order inversions: age-guard fires that
+                      # jumped a LOWER non-empty bucket (the only legal
+                      # way a higher bucket fires first; bounded noise
+                      # is healthy, a large count means the age knob is
+                      # fighting the priority order)
+TS_WORDS = 12
+
+# Priority-bucket dispatch tier (ISSUE 15): ``priority_buckets=B`` layers
+# B bucket rings over every per-kind batch lane - pop lowest-nonempty-
+# bucket-first at ring-drain time. The bucket id is a pure function of
+# the descriptor's OWN arg words (BatchSpec.priority reads them at
+# routing time), so a bucket id always rides the descriptor: residue
+# spilled to the ready ring, stolen rows, and checkpoint/reshard exports
+# re-bucket on the next routing pop by construction - no extra transport
+# word, no re-bucketing pass. BK_MAX bounds the static set (SMEM lane
+# scratch scales linearly with B).
+BK_MAX = 8
 
 # Per-lane scheduler state words (SMEM (nbatch, LS_WORDS) scratch): the
 # lane's FIFO cursors plus the cross-round prefetch handshake.
@@ -546,6 +567,20 @@ class BatchSpec:
     pipeline requires (see below); the static tile DAGs that use prefetch
     are order-insensitive.
 
+    ``priority`` opts the kind into the priority-bucket tier (armed only
+    when the megakernel is built with ``priority_buckets=B``): a callable
+    ``priority(arg) -> traced int32`` where ``arg(i)`` reads the popped
+    descriptor's arg word ``i`` - the bucket id is a pure function of the
+    descriptor's own words, clipped into ``[0, B)`` by the scheduler.
+    Routing diverts the descriptor into its kind's bucket ring; at
+    ring-drain time the LOWEST non-empty bucket fires first, so ordered-
+    retirement workloads (delta-stepping relaxation, best-first search)
+    retire cheap/urgent work before speculative work. Priorities are a
+    performance hint ONLY: results must be schedule-independent (the
+    ``si_claim`` certification gate), and with ``priority_buckets``
+    off/unset the callable is never consulted - the build is
+    byte-identical to one without it.
+
     ``prefetch=True`` opts into the cross-round double-buffer protocol:
     the tier tells the body how many descriptors of the NEXT prospective
     batch to prefetch (``ctx.prefetch_count``) and, the round after, how
@@ -562,7 +597,8 @@ class BatchSpec:
     """
 
     def __init__(self, body, width: int = 8, prefetch: bool = False,
-                 drain=None, verify_suppress: Sequence[str] = ()) -> None:
+                 drain=None, priority=None,
+                 verify_suppress: Sequence[str] = ()) -> None:
         if width < 1:
             raise ValueError(f"batch width must be >= 1, got {width}")
         if prefetch and drain is None:
@@ -571,10 +607,16 @@ class BatchSpec:
                 "scheduler must be able to retire in-flight prefetch DMAs "
                 "when it exits with lane entries unrun"
             )
+        if priority is not None and not callable(priority):
+            raise ValueError(
+                "priority must be a callable priority(arg) -> bucket "
+                "(arg(i) reads the descriptor's arg word i)"
+            )
         self.body = body
         self.width = int(width)
         self.prefetch = bool(prefetch)
         self.drain = drain
+        self.priority = priority
         # Per-rule opt-outs for the build-time verifier (hclib_tpu.
         # analysis): a spec whose body DELIBERATELY violates a checked
         # contract (e.g. intentionally-shared value slots) annotates the
@@ -790,6 +832,7 @@ class Megakernel:
         checkpoint: Optional[bool] = None,
         quiesce_stride: Optional[int] = None,
         lane_max_age: Optional[int] = None,
+        priority_buckets: Optional[int] = None,
         verify: Optional[bool] = None,
         verify_suppress: Sequence[str] = (),
     ) -> None:
@@ -864,6 +907,26 @@ class Megakernel:
                 f"lane_max_age must be >= 0 (0 = off), got {lane_max_age}"
             )
         self.lane_max_age = lane_max_age
+        # Priority-bucket dispatch tier (ISSUE 15): ``priority_buckets=B``
+        # layers B bucket rings over every per-kind batch lane and makes
+        # ring-drain firing pop the LOWEST non-empty bucket first (see
+        # the firing-policy site in sched()). The bucket id is computed
+        # at routing time by the kind's BatchSpec.priority callable - a
+        # pure function of the popped descriptor's arg words, so residue
+        # re-buckets on resume/reshard by construction. 0/None = off: no
+        # bucket rings, priorities never consulted - byte-identical to a
+        # build whose specs carry no priority at all (asserted).
+        # HCLIB_TPU_PRIORITY_BUCKETS sets it process-wide; malformed or
+        # out-of-range values RAISE (the PR 8 env convention).
+        if priority_buckets is None:
+            priority_buckets = env_int("HCLIB_TPU_PRIORITY_BUCKETS", None)
+        priority_buckets = int(priority_buckets or 0)
+        if priority_buckets and not 2 <= priority_buckets <= BK_MAX:
+            raise ValueError(
+                f"priority_buckets must be 0 (off) or 2..{BK_MAX} "
+                f"(the static bucket-ring set), got {priority_buckets}"
+            )
+        self.priority_buckets = priority_buckets
         # Dispatch-tier routing: ``route`` maps a kernel NAME to the spec
         # of a non-scalar dispatch tier for that task family. Two tiers:
         #
@@ -977,6 +1040,16 @@ class Megakernel:
                 self, suppress=self.verify_suppress
             )
 
+    @property
+    def lane_scratch_rows(self) -> int:
+        """Rows of the batched-tier lane/lstate SMEM scratch: one ring
+        per routed kind, times ``priority_buckets`` bucket rings per
+        kind when the priority tier is armed. Every embedder that
+        allocates the scratch (this class's _build_raw, the sharded/
+        resident/ici/pgas runners) sizes it from here so the bucket
+        layout cannot drift per runner."""
+        return len(self.batch_specs) * (self.priority_buckets or 1)
+
     def describe(self) -> Dict[str, Any]:
         """Whole-program description of this megakernel's kernel table:
         per-kind dispatch tier and migratability classification (the
@@ -1000,7 +1073,8 @@ class Megakernel:
                 ),
                 "classification": classes.get(name, "unknown"),
                 **(
-                    {"width": spec.width, "prefetch": spec.prefetch}
+                    {"width": spec.width, "prefetch": spec.prefetch,
+                     "priority": spec.priority is not None}
                     if spec is not None else {}
                 ),
             }
@@ -1014,6 +1088,7 @@ class Megakernel:
             "capacity": self.capacity,
             "num_values": self.num_values,
             "checkpoint": self.checkpoint,
+            "priority_buckets": self.priority_buckets,
             "verify": self.verify,
             # The schedule-independence certificate (analysis/model.py),
             # beside the reshard classification: None when the builder
@@ -1102,6 +1177,26 @@ class Megakernel:
             )
         use_batch = lanes is not None and len(self.batch_specs) > 0
         nbatch = len(self.batch_specs) if use_batch else 0
+        # Priority-bucket tier: each kind's lane becomes ``nbk`` bucket
+        # rings (rows ``li*nbk .. li*nbk+nbk-1`` of the lanes/lstate
+        # scratch; bucket 0 pops first at drain time). nbk == 1 is the
+        # bucket-free tier - every row mapping below degenerates to the
+        # pre-knob lane indexing, so the off path compiles byte-for-byte
+        # identically.
+        nbk = self.priority_buckets if (
+            use_batch and self.priority_buckets
+        ) else 1
+        nrows = nbatch * nbk
+        # Static (row, fid, spec) enumeration of every lane-state row,
+        # in row order - the spill/stage iteration set. (Drain PRIORITY
+        # is not encoded here: the firing policy below derives the
+        # lowest-nonempty-bucket choice dynamically via kind_lowb/
+        # best_b so each kind keeps one batch-body instantiation.)
+        lane_rows = [
+            (li * nbk + bk, fid, spec)
+            for li, (fid, spec) in enumerate(self.batch_specs)
+            for bk in range(nbk)
+        ]
         # Flight recorder: a NullTracer's methods are no-ops, so every
         # emit site below compiles to nothing when tracing is off (the
         # DeviceFaultPlan zero-cost-when-disabled pattern).
@@ -1126,7 +1221,7 @@ class Megakernel:
                 # nothing lives in a lane across entries); tstats is the
                 # tier's output window - zeroed here so reps report the
                 # last rep's per-graph counters.
-                for li in range(nbatch):
+                for li in range(nrows):
                     for w in range(LS_WORDS):
                         lstate[li, w] = 0
                 for w in range(TS_WORDS):
@@ -1280,9 +1375,11 @@ class Megakernel:
             or one scalar descriptor; a batch round may overshoot ``fuel``
             by width-1 tasks."""
 
-            def batch_round(li, spec, e0, rt) -> None:
+            def batch_round(li, fid, spec, e0, rt) -> None:
+                """Fire one batch off lane-state row ``li`` (a (kind,
+                bucket) ring under the priority tier; the kind's only
+                ring otherwise)."""
                 B = spec.width
-                fid = self.batch_specs[li][0]
                 head = lstate[li, LS_HEAD]
                 tail = lstate[li, LS_TAIL]
                 avail = tail - head
@@ -1290,16 +1387,23 @@ class Megakernel:
                 # Pop side of the lane. Prefetch specs pop FIFO (oldest
                 # first): the cross-round operand pipeline targets "the
                 # entries behind the current batch", which is only stable
-                # when pops and pushes use opposite ends. Non-prefetch
-                # specs pop LIFO (the NEWEST `take` as one contiguous
-                # block): that is the scalar tier's owner-side discipline
-                # - newest-first keeps recursive families depth-first
-                # (live set ~ width * depth, not a breadth frontier; a
-                # FIFO fib lane measured ~40% of the WHOLE tree live) and
-                # leaves the oldest entries cold in the lane, which is
-                # exactly what the multi-device steal exchanges expect to
-                # find spilled at the ring's cold end.
-                base = head if spec.prefetch else tail - take
+                # when pops and pushes use opposite ends. Bucket rings
+                # (nbk > 1) pop FIFO too - stable oldest-first within a
+                # bucket is the order the schedule-independence
+                # certification's bucketed schedule models, and the
+                # depth-first rationale below doesn't apply (the bucket
+                # structure, not the pop end, bounds the live set).
+                # Remaining non-prefetch specs pop LIFO (the NEWEST
+                # `take` as one contiguous block): that is the scalar
+                # tier's owner-side discipline - newest-first keeps
+                # recursive families depth-first (live set ~ width *
+                # depth, not a breadth frontier; a FIFO fib lane
+                # measured ~40% of the WHOLE tree live) and leaves the
+                # oldest entries cold in the lane, which is exactly what
+                # the multi-device steal exchanges expect to find
+                # spilled at the ring's cold end.
+                fifo = spec.prefetch or nbk > 1
+                base = head if fifo else tail - take
                 # Cross-round prefetch handshake: an outstanding prefetch
                 # is ours iff it was issued for exactly this head (a spill
                 # or lane restage invalidates by clearing LS_PF_BASE).
@@ -1308,7 +1412,7 @@ class Megakernel:
                     pf_ok, jnp.minimum(lstate[li, LS_PF_N], take), 0
                 )
                 buf = lstate[li, LS_PF_BUF]
-                if spec.prefetch:
+                if spec.prefetch and nbk == 1:
                     # Announce next-batch prefetch only when the lane keeps
                     # entries AND fuel admits another round - the round
                     # that consumes (or drains) the prefetch is then
@@ -1318,6 +1422,16 @@ class Megakernel:
                     )
                     nxt = jnp.where(may, jnp.minimum(avail - take, B), 0)
                 else:
+                    # Priority-bucketed builds never announce: the NEXT
+                    # firing ring is chosen at fire time (lowest
+                    # non-empty bucket then), so "the entries behind
+                    # this batch" are not the next batch, and the VMEM
+                    # operand halves are shared across a kind's bucket
+                    # rings - a cross-round prefetch from ring A would
+                    # be overwritten (and its semaphores consumed) by
+                    # ring B's on-demand loads. Ordered retirement
+                    # trades the prefetch away; the asymptotic EXPAND
+                    # reduction is the workload's whole point.
                     nxt = jnp.int32(0)
                 # Flight-recorder: one record per batch round, lane id and
                 # occupancy packed ((fid << 16) | take), prefetched count
@@ -1336,16 +1450,18 @@ class Megakernel:
                     @pl.when(jnp.int32(s) < take)
                     def _(s=s):
                         complete(lanes[li, (base + s) % capacity])
-                if spec.prefetch:
+                if fifo:
                     lstate[li, LS_HEAD] = head + take
-                    lstate[li, LS_PF_BASE] = jnp.where(
-                        nxt > 0, head + take + 1, 0
-                    )
-                    lstate[li, LS_PF_N] = nxt
-                    # The half a prefetch targets is always 1 - buf; the
-                    # next round consumes (or on-demand-fills) that half,
-                    # so the parity alternates every round.
-                    lstate[li, LS_PF_BUF] = 1 - buf
+                    if spec.prefetch:
+                        lstate[li, LS_PF_BASE] = jnp.where(
+                            nxt > 0, head + take + 1, 0
+                        )
+                        lstate[li, LS_PF_N] = nxt
+                        # The half a prefetch targets is always 1 - buf;
+                        # the next round consumes (or on-demand-fills)
+                        # that half, so the parity alternates every
+                        # round.
+                        lstate[li, LS_PF_BUF] = 1 - buf
                 else:
                     # LIFO pop: the block came off the tail; head (and the
                     # dormant prefetch words) stay put.
@@ -1407,7 +1523,7 @@ class Megakernel:
                     )
                 avails = [
                     lstate[li, LS_TAIL] - lstate[li, LS_HEAD]
-                    for li in range(nbatch)
+                    for li in range(nrows)
                 ]
                 lane_work = functools.reduce(
                     jnp.logical_or, [a > 0 for a in avails]
@@ -1457,25 +1573,103 @@ class Megakernel:
                 # pop, so the exit below sees an untouched round.)
                 max_age = self.lane_max_age
                 fired = qz
-                lane_fires = [jnp.bool_(False)] * nbatch
-                # Two eligibility passes: STARVED lanes (age >= N) first,
-                # then the ordinary drained-ring scan - so a starved lane
-                # beats the lowest-F_FN drain priority and the age bound
-                # holds with several routed kinds (simultaneously starved
-                # lanes fire on consecutive rounds, so the worst observed
-                # age is N + nbatch - 1, not unbounded).
+                lane_fires = [jnp.bool_(False)] * nrows
+                # Two eligibility passes: STARVED rows (age >= N) first,
+                # then the ordinary drained-ring scan - so a starved row
+                # beats the drain priority and the age bound holds with
+                # several routed kinds/buckets (simultaneously starved
+                # rows fire on consecutive rounds, so the worst observed
+                # age is N + nrows - 1, not unbounded). Under the
+                # priority tier the SAME guard is what keeps high
+                # buckets live: drain pops retire the LOWEST non-empty
+                # bucket first (globally - a kind is drain-eligible only
+                # when its lowest non-empty bucket ties the mesh-wide
+                # minimum), so a high bucket behind a continuously
+                # refilled low bucket would starve without it; its
+                # age-guard fire is the one legal bucket-order
+                # inversion, counted in tstats[TS_INVERSIONS].
+                #
+                # The bucket CHOICE within a kind is a traced row index
+                # (a where-fold over the kind's nbk cursor pairs), NOT a
+                # per-bucket unroll: batch bodies are the largest code
+                # objects in the program (a frontier body carries
+                # width x EBLOCK relax loops), so each kind must keep
+                # exactly ONE instantiation per phase - the pre-bucket
+                # program size - with only the handful of scalar
+                # selection ops scaling in nbk.
+                if nbk > 1:
+                    # Per kind: lowest non-empty bucket (nbk = empty),
+                    # then the global minimum across kinds.
+                    kind_lowb = []
+                    kind_work = []
+                    for li in range(nbatch):
+                        has = [
+                            avails[li * nbk + b] > 0 for b in range(nbk)
+                        ]
+                        lb = jnp.int32(nbk)
+                        for b in reversed(range(nbk)):
+                            lb = jnp.where(has[b], jnp.int32(b), lb)
+                        kind_lowb.append(lb)
+                        kind_work.append(
+                            functools.reduce(jnp.logical_or, has)
+                        )
+                    best_b = functools.reduce(jnp.minimum, kind_lowb)
                 phases = (["starved"] if max_age else []) + ["drain"]
                 for phase in phases:
                     for li, (fid, spec) in enumerate(self.batch_specs):
-                        if phase == "starved":
-                            eligible = (avails[li] > 0) & (
-                                lstate[li, LS_AGE] >= jnp.int32(max_age)
+                        base = li * nbk
+                        if nbk == 1:
+                            row = base
+                            bk_sel = jnp.int32(0)
+                            if phase == "starved":
+                                eligible = (avails[base] > 0) & (
+                                    lstate[base, LS_AGE]
+                                    >= jnp.int32(max_age)
+                                )
+                            else:
+                                eligible = (
+                                    avails[base] > 0
+                                ) & jnp.logical_not(ring_work)
+                        elif phase == "starved":
+                            # Lowest-bucket starved ring of this kind
+                            # (deterministic; any starved ring fires
+                            # within nrows rounds either way).
+                            sflags = [
+                                (avails[base + b] > 0)
+                                & (lstate[base + b, LS_AGE]
+                                   >= jnp.int32(max_age))
+                                for b in range(nbk)
+                            ]
+                            bk_sel = jnp.int32(nbk - 1)
+                            for b in reversed(range(nbk)):
+                                bk_sel = jnp.where(
+                                    sflags[b], jnp.int32(b), bk_sel
+                                )
+                            eligible = functools.reduce(
+                                jnp.logical_or, sflags
                             )
+                            row = base + bk_sel
                         else:
-                            eligible = (avails[li] > 0) & jnp.logical_not(
-                                ring_work
+                            # Drain: this kind offers its lowest
+                            # non-empty bucket, and fires only when
+                            # that bucket ties the global minimum
+                            # (lowest-nonempty-bucket-first across
+                            # kinds; ties break to the lower F_FN via
+                            # the fired latch below).
+                            bk_sel = jnp.minimum(
+                                kind_lowb[li], jnp.int32(nbk - 1)
                             )
+                            eligible = (
+                                kind_work[li]
+                                & (kind_lowb[li] == best_b)
+                                & jnp.logical_not(ring_work)
+                            )
+                            row = base + bk_sel
                         fire_now = eligible & jnp.logical_not(fired)
+                        avail_sel = (
+                            lstate[row, LS_TAIL] - lstate[row, LS_HEAD]
+                        )
+                        take = jnp.minimum(avail_sel, spec.width)
                         if phase == "starved":
                             # Reason record + counter for a fire that
                             # jumped the ring (emitted before batch_round
@@ -1485,22 +1679,66 @@ class Megakernel:
                             # ring already empty is an ordinary drain
                             # fire - no jump, no record.
                             @pl.when(fire_now & ring_work)
-                            def _(li=li, fid=fid, spec=spec):
+                            def _(row=row, fid=fid, take=take):
                                 tr.emit(
                                     TR_FIRE_AGE, rt,
-                                    (jnp.int32(fid) << 16)
-                                    | jnp.minimum(avails[li], spec.width),
-                                    lstate[li, LS_AGE],
+                                    (jnp.int32(fid) << 16) | take,
+                                    lstate[row, LS_AGE],
                                 )
                                 tstats[TS_AGE_FIRES] = (
                                     tstats[TS_AGE_FIRES] + 1
                                 )
+                            if nbk > 1:
+                                # Bucket-order inversion: this age-guard
+                                # fire retires bucket ``bk_sel`` while a
+                                # LOWER bucket still holds entries - the
+                                # only path a higher bucket beats a
+                                # lower one (drain pops are bucket-
+                                # ordered by construction).
+                                lower = functools.reduce(
+                                    jnp.logical_or,
+                                    [
+                                        (jnp.int32(r2 % nbk) < bk_sel)
+                                        & (avails[r2] > 0)
+                                        for r2 in range(nrows)
+                                    ],
+                                )
+
+                                @pl.when(fire_now & lower)
+                                def _():
+                                    tstats[TS_INVERSIONS] = (
+                                        tstats[TS_INVERSIONS] + 1
+                                    )
+                        if nbk > 1:
+                            # Bucketed fire record: which bucket ring
+                            # retired, at what occupancy - the
+                            # per-bucket occupancy gauge decodes from
+                            # these (tracebuf.bucket_occupancy).
+                            @pl.when(fire_now)
+                            def _(bk_sel=bk_sel, fid=fid, take=take):
+                                tr.emit(
+                                    TR_FIRE_BUCKET, rt,
+                                    (bk_sel << 16) | take,
+                                    fid,
+                                )
+
+                            @pl.when(fire_now & (bk_sel > 0))
+                            def _():
+                                tstats[TS_BUCKET_FIRES] = (
+                                    tstats[TS_BUCKET_FIRES] + 1
+                                )
 
                         @pl.when(fire_now)
-                        def _(li=li, spec=spec, e0=e0):
-                            batch_round(li, spec, e0, rt)
+                        def _(row=row, fid=fid, spec=spec, e0=e0):
+                            batch_round(row, fid, spec, e0, rt)
 
-                        lane_fires[li] = lane_fires[li] | fire_now
+                        if nbk == 1:
+                            lane_fires[base] = lane_fires[base] | fire_now
+                        else:
+                            for r in range(base, base + nbk):
+                                lane_fires[r] = lane_fires[r] | (
+                                    fire_now & (row == jnp.int32(r))
+                                )
                         fired = fired | eligible
 
                 @pl.when(jnp.logical_not(fired) & ring_work)
@@ -1515,12 +1753,28 @@ class Megakernel:
                     # lanes never survive a kernel exit.
                     fn = tasks[idx, F_FN]
                     routed = jnp.bool_(False)
-                    for li, (fid, _) in enumerate(self.batch_specs):
+                    for li, (fid, spec) in enumerate(self.batch_specs):
                         hit = fn == jnp.int32(fid)
 
                         @pl.when(hit)
-                        def _(li=li, idx=idx):
-                            _lane_push(li, idx)
+                        def _(li=li, idx=idx, spec=spec):
+                            if nbk > 1 and spec.priority is not None:
+                                # Priority tier: the bucket id is a pure
+                                # function of the descriptor's own arg
+                                # words (clipped into the static set), so
+                                # spilled/stolen/resharded residue
+                                # re-buckets right here on its next
+                                # routing pop - the bucket rides the
+                                # descriptor, not the ring row.
+                                bk = jnp.clip(
+                                    spec.priority(
+                                        lambda i: tasks[idx, F_A0 + i]
+                                    ),
+                                    0, nbk - 1,
+                                ).astype(jnp.int32)
+                                _lane_push(jnp.int32(li * nbk) + bk, idx)
+                            else:
+                                _lane_push(li * nbk, idx)
 
                         routed = routed | hit
 
@@ -1538,12 +1792,14 @@ class Megakernel:
 
                 if max_age:
                     # Advance the starved-round clocks AFTER dispatch: a
-                    # lane that holds entries now (including one a scalar
+                    # row that holds entries now (including one a scalar
                     # pop just routed into) and did not fire this round
-                    # ages by one; a fire or an empty lane resets. The
-                    # worst age any lane reaches rides out in tstats -
-                    # the bounded-age gauge the acceptance pins.
-                    for li in range(nbatch):
+                    # ages by one; a fire or an empty row resets. The
+                    # worst age any row reaches rides out in tstats -
+                    # the bounded-age gauge the acceptance pins (under
+                    # the priority tier the clock is per bucket ring, so
+                    # the guard bounds HIGH-bucket latency too).
+                    for li in range(nrows):
                         has_now = (
                             lstate[li, LS_TAIL] - lstate[li, LS_HEAD]
                         ) > 0
@@ -1593,7 +1849,7 @@ class Megakernel:
                 # the ring mod capacity, and stage() widens its copy to
                 # the whole ring when the window wraps below zero.
                 rt_x = tr.now()
-                for li, (fid, spec) in enumerate(self.batch_specs):
+                for li, fid, spec in lane_rows:
                     h = lstate[li, LS_HEAD]
                     t = lstate[li, LS_TAIL]
                     if spec.prefetch:
@@ -1899,8 +2155,12 @@ class Megakernel:
             ]
             + (
                 [
-                    pltpu.SMEM((nbatch, self.capacity), jnp.int32),
-                    pltpu.SMEM((nbatch, LS_WORDS), jnp.int32),
+                    pltpu.SMEM(
+                        (self.lane_scratch_rows, self.capacity), jnp.int32
+                    ),
+                    pltpu.SMEM(
+                        (self.lane_scratch_rows, LS_WORDS), jnp.int32
+                    ),
                 ]
                 if nbatch
                 else []
@@ -1963,6 +2223,14 @@ class Megakernel:
             # traced runs).
             "age_fires": int(t[TS_AGE_FIRES]),
             "max_starved_age": int(t[TS_MAX_AGE]),
+            # Priority-bucket tier (priority_buckets; zeros when off):
+            # rounds fired from a nonzero bucket ring, and age-guard
+            # fires that jumped a lower non-empty bucket - the only
+            # legal bucket-order inversion (per-bucket occupancy rides
+            # separately on traced runs, off the TR_FIRE_BUCKET
+            # records).
+            "bucket_fires": int(t[TS_BUCKET_FIRES]),
+            "bucket_inversions": int(t[TS_INVERSIONS]),
         }
 
     def stats_dict(self) -> Dict[str, Any]:
@@ -2160,6 +2428,18 @@ class Megakernel:
                 info["tiers"]["lane_partial_age"] = max(
                     ages.values(), default=0
                 )
+                if self.priority_buckets:
+                    # Per-bucket occupancy gauge (the priority tier's
+                    # structural health read): retired descriptors over
+                    # offered slots per bucket ring, off TR_FIRE_BUCKET.
+                    from .tracebuf import bucket_occupancy
+
+                    info["tiers"]["bucket_occupancy"] = bucket_occupancy(
+                        info["trace"],
+                        {fid: spec.width for fid, spec in
+                         self.batch_specs},
+                        self.priority_buckets,
+                    )
         if quiesced:
             # The exported scheduler snapshot: everything resume() (and
             # CheckpointBundle) needs to relaunch mid-graph. succ is

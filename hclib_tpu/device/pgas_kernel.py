@@ -694,11 +694,12 @@ class PGASMegakernel:
             ]
             + (
                 [
-                    # Batched dispatch tier lane scratch (unpacked last).
+                    # Batched dispatch tier lane scratch (unpacked last;
+                    # rows = kinds x priority buckets).
                     pltpu.SMEM(
-                        (len(mk.batch_specs), mk.capacity), jnp.int32
+                        (mk.lane_scratch_rows, mk.capacity), jnp.int32
                     ),
-                    pltpu.SMEM((len(mk.batch_specs), LS_WORDS), jnp.int32),
+                    pltpu.SMEM((mk.lane_scratch_rows, LS_WORDS), jnp.int32),
                 ]
                 if mk.batch_specs
                 else []

@@ -2169,7 +2169,7 @@ class ResidentKernel:
         if mk.batch_specs:
             # Batched dispatch tier lane scratch (lanes + lane state);
             # re-entrant across sched() entries via the spill discipline.
-            nb = len(mk.batch_specs)
+            nb = mk.lane_scratch_rows  # kinds x priority buckets
             scratch += [
                 pltpu.SMEM((nb, mk.capacity), jnp.int32),  # lanes
                 pltpu.SMEM((nb, LS_WORDS), jnp.int32),  # lstate

@@ -72,6 +72,8 @@ __all__ = [
     "TR_SCALE",
     "TR_TENANT",
     "TR_FIRE_AGE",
+    "TR_FIRE_BUCKET",
+    "bucket_occupancy",
     "SC_HOLD",
     "SC_OUT",
     "SC_IN",
@@ -123,6 +125,13 @@ TR_FIRE_AGE = 17       # a = (lane_fn << 16) | take, b = starved age at
                        # is paired with the TR_FIRE_BATCH of the same
                        # round; a ring-drained fire emits only the
                        # latter, so the reason split is exact.
+TR_FIRE_BUCKET = 18    # a = (bucket << 16) | take, b = lane F_FN - the
+                       # priority-bucket tier's fire record (ISSUE 15,
+                       # priority_buckets builds only): which bucket
+                       # ring this round's batch retired, at what
+                       # occupancy. Paired with the round's
+                       # TR_FIRE_BATCH (same take); bucket_occupancy()
+                       # folds these into the per-bucket gauge.
 
 # TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
 SC_HOLD = 0
@@ -168,6 +177,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_SCALE: "scale",
     TR_TENANT: "tenant",
     TR_FIRE_AGE: "fire_age",
+    TR_FIRE_BUCKET: "fire_bucket",
 }
 
 # TR_CREDIT delta codes (b word).
@@ -445,6 +455,34 @@ def lane_partial_age(
         else:
             streak_start[fid] = None
     return out
+
+
+def bucket_occupancy(
+    trace: Dict[str, Any], widths: Dict[int, int], buckets: int,
+    ring: int = 0,
+) -> Dict[int, float]:
+    """Per-bucket occupancy off the TR_FIRE_BUCKET records (the priority
+    tier's structural gauge, ISSUE 15): for each bucket id, retired
+    descriptors over the slots its fired rounds offered - the same
+    tasks/offered ratio ``batch_occupancy`` reports per kind, split by
+    bucket ring. A healthy ordered workload shows the low buckets firing
+    near-full (the frontier lives there) and the high buckets sparse;
+    a flat profile means the priority function isn't separating the
+    work. ``widths`` maps lane F_FN -> batch width (the b word names the
+    firing lane); buckets without a single fire report 0.0."""
+    recs = records_of(trace, TR_FIRE_BUCKET, ring)
+    takes = {b: 0 for b in range(int(buckets))}
+    offered = {b: 0 for b in range(int(buckets))}
+    for _tag, _t, a, fid in recs:
+        b = int(a) >> 16
+        if b not in takes:
+            continue
+        takes[b] += int(a) & 0xFFFF
+        offered[b] += int(widths.get(int(fid), 0))
+    return {
+        b: (takes[b] / offered[b] if offered[b] else 0.0)
+        for b in takes
+    }
 
 
 def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:

@@ -114,6 +114,10 @@ REGISTRY = {
            "poll the quiesce word every Nth round"),
         _v("HCLIB_TPU_LANE_MAX_AGE", "int", "0 (off)",
            "age-triggered lane firing policy threshold, rounds"),
+        _v("HCLIB_TPU_PRIORITY_BUCKETS", "int", "0 (off)",
+           "priority-bucket dispatch tier: bucket rings per batch "
+           "lane, popped lowest-nonempty-first (2..8; malformed or "
+           "out-of-range text raises)"),
         _v("HCLIB_TPU_VERIFY", "bool", "off; on under pytest",
            "build-time static verifier (hclib_tpu.analysis; 0 forces "
            "off, nonzero forces on)"),

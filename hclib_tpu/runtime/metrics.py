@@ -178,6 +178,37 @@ class MetricsRegistry:
                 keep["lane_max_starved_age"] = [
                     float(a) for a in sages if a is not None
                 ]
+            # Priority-bucket tier gauges (ISSUE 15): bucket-order
+            # inversions (age-guard fires that jumped a lower
+            # non-empty bucket - a rising rate means the age knob is
+            # fighting the priority order) per device, and per-bucket
+            # occupancy (traced runs only; <name>.bucket_occupancy.<b>)
+            # so a dashboard sees the ordered-retirement structure
+            # without digging through trace rings.
+            invs = [
+                t.get("bucket_inversions")
+                for t in tiers
+                if isinstance(t, Mapping)
+            ]
+            if any(i is not None for i in invs):
+                keep["bucket_inversions"] = [
+                    float(i) for i in invs if i is not None
+                ]
+            boccs = [
+                t.get("bucket_occupancy")
+                for t in tiers
+                if isinstance(t, Mapping)
+            ]
+            if any(isinstance(b, Mapping) for b in boccs):
+                # One dict per device (mesh runs return tiers as a
+                # per-device list), flattened as
+                # <name>.bucket_occupancy.<device>.<bucket> - same
+                # per-device discipline as lane_occupancy.
+                keep["bucket_occupancy"] = [
+                    {str(k): float(v) for k, v in b.items()}
+                    for b in boccs
+                    if isinstance(b, Mapping)
+                ]
         # Edge-rate gauge (graph-analytics runs, device/frontier.py):
         # a run info carrying traversed edges and a wall time exports
         # traversed-edges/s directly - the TEPS headline as a metric.

@@ -52,6 +52,37 @@ SSSP_REF = host_sssp(G, 0)
 M0, REPS = 1 << 12, 64
 
 
+# Session-scoped shared builds (the PR 14 checkpoint-fixture pattern):
+# a Megakernel is re-entrant by construction - every run() stages fresh
+# state and the jitted executable is cached per (fuel, staging) - so
+# tests that previously compiled near-identical programs share ONE
+# build per (kind, width) family and only the wall clock changes.
+# Tests that need a DIFFERENT shape (checkpoint builds, other
+# capacities, traced pumps) still construct their own.
+
+
+@pytest.fixture(scope="session")
+def bfs_w4_mk():
+    """The batched BFS build (width=4, default capacity) shared by the
+    three-arm, metrics, and any other single-device batched-BFS test."""
+    return make_frontier_megakernel(
+        _KINDS["bfs"](), G, width=4, interpret=True
+    )
+
+
+@pytest.fixture(scope="session")
+def sssp_arms():
+    """The scalar + batched SSSP builds (bit-identity arms)."""
+    return {
+        0: make_frontier_megakernel(
+            _KINDS["sssp"](), G, width=0, interpret=True
+        ),
+        4: make_frontier_megakernel(
+            _KINDS["sssp"](), G, width=4, interpret=True
+        ),
+    }
+
+
 # -------------------------------------------------- graph container math
 
 
@@ -82,12 +113,13 @@ def test_rmat_and_blocked_csr_layout():
 # ------------------------------------------------- three-arm bit-identity
 
 
-def test_bfs_three_arms_bit_identical():
+def test_bfs_three_arms_bit_identical(bfs_w4_mk):
     d_sc, info_sc = run_frontier("bfs", G, 0, width=0, interpret=True)
     assert np.array_equal(d_sc, BFS_REF)
     assert info_sc["edges"] > 0 and info_sc["relaxations"] > 0
 
-    d_bt, info_bt = run_frontier("bfs", G, 0, width=4, interpret=True)
+    d_bt, info_bt = run_frontier("bfs", G, 0, mk=bfs_w4_mk,
+                                 interpret=True)
     assert np.array_equal(d_bt, BFS_REF)
     t = info_bt["tiers"]
     assert t["scalar_tasks"] == 0 and t["batch_tasks"] == info_bt["executed"]
@@ -97,10 +129,12 @@ def test_bfs_three_arms_bit_identical():
     assert info_bt["executed"] > 0
 
 
-def test_sssp_three_arms_bit_identical():
-    d_sc, _ = run_frontier("sssp", G, 0, width=0, interpret=True)
+def test_sssp_three_arms_bit_identical(sssp_arms):
+    d_sc, _ = run_frontier("sssp", G, 0, mk=sssp_arms[0],
+                           interpret=True)
     assert np.array_equal(d_sc, SSSP_REF)
-    d_bt, info = run_frontier("sssp", G, 0, width=4, interpret=True)
+    d_bt, info = run_frontier("sssp", G, 0, mk=sssp_arms[4],
+                              interpret=True)
     assert np.array_equal(d_bt, SSSP_REF)
     assert info["tiers"]["batch_tasks"] == info["executed"]
     # Unreached vertices stay INF in every arm (the min-combine identity
@@ -416,10 +450,10 @@ def test_starved_lane_beats_drain_priority_across_lanes():
     assert t["age_fires"] == 0, t
 
 
-def test_prebuilt_mk_refuses_other_graph_and_mesh_fuel():
-    fk = _KINDS["bfs"]()
-    mk = make_frontier_megakernel(fk, G, width=4, capacity=256,
-                                  interpret=True)
+def test_prebuilt_mk_refuses_other_graph_and_mesh_fuel(mesh_kernel):
+    # Reuse the mesh fixture's build: the refusal is a host-side layout
+    # check, so no fresh compile is needed.
+    mk, _ = mesh_kernel
     n2, s2, d2, w2 = rmat_edges(4, efactor=4, seed=9)
     other = Graph(n2, s2, d2, w2)
     with pytest.raises(ValueError, match="frontier layout"):
@@ -514,8 +548,8 @@ def test_resident_frontier_bfs_with_graph_hop_order():
 # ------------------------------------------------------- metrics gauges
 
 
-def test_metrics_edge_rate_and_age_gauges():
-    _, info = run_frontier("bfs", G, 0, width=4, interpret=True)
+def test_metrics_edge_rate_and_age_gauges(bfs_w4_mk):
+    _, info = run_frontier("bfs", G, 0, mk=bfs_w4_mk, interpret=True)
     info["elapsed_s"] = 0.5
     reg = hc.MetricsRegistry()
     reg.add_run_info("graph", info)

@@ -12,7 +12,7 @@ LESSONS = sorted(p.name for p in TUTORIAL.glob("[01][0-9]_*.py"))
 
 
 def test_tutorial_is_complete():
-    assert len(LESSONS) == 18
+    assert len(LESSONS) == 19
 
 
 @pytest.mark.parametrize("lesson", LESSONS)

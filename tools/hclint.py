@@ -110,6 +110,29 @@ def _programs() -> List[Tuple[str, "callable"]]:
             ),
         ))
 
+    # The ISSUE 15 priority-bucketed builders: delta-stepping SSSP and
+    # bounded-frontier PageRank (bucket rings over the frontier lane;
+    # the 5-tuple si_claim certifies the bucketed pop order), plus the
+    # branch-and-bound search (best-first = the speedup; optimum
+    # certified order-free).
+    for kf in (sssp_kernel, pagerank_kernel):
+        progs.append((
+            f"priority:{kf().name}",
+            lambda kf=kf: make_frontier_megakernel(
+                kf(), g, width=4, interpret=True, priority_buckets=4,
+            ),
+        ))
+
+    def bnb_builder():
+        from hclib_tpu.device.bnb import make_bnb_megakernel, make_knapsack
+
+        return make_bnb_megakernel(
+            make_knapsack(10, seed=5), width=4, priority_buckets=4,
+            interpret=True,
+        )
+
+    progs.append(("priority:bnb", bnb_builder))
+
     # The forasync tutorial's 2D Jacobi tile loop, with the whole-loop
     # store-window proof over its concrete tile space.
     N, TS = 32, 8

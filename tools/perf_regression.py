@@ -513,6 +513,99 @@ def _frontier_batch(quick: bool, trials: int) -> dict:
     }
 
 
+def _priority_tier(quick: bool, trials: int) -> dict:
+    """priority-tier guard (ISSUE 15), same-run arms on the SAME seeded
+    weighted R-MAT: (a) the unordered batched frontier (PR 10's
+    label-correcting SSSP - the bit-identity reference), (b) the
+    priority-bucketed build (TRUE delta-stepping: bucket = dist//delta,
+    lowest-nonempty-first). Distances must be bit-identical to each
+    other AND the host Dijkstra, and the bucketed arm must do at most
+    --priority-expand-ceiling (0.8x) of the unordered arm's executed
+    EXPANDs - ordered retirement is claimed as *asymptotically less
+    work*, so the guard prices the work count, which interpret mode
+    measures exactly (no DMA-overlap weather). A PageRank pair on the
+    same graph additionally bounds the bucketed arm's peak live row
+    set (info['allocated'] - the bump allocator's high-water mark) at
+    --priority-live-ceiling of the unordered arm's: the bounded-
+    frontier fix for the PR 10 breadth blowup."""
+    import numpy as np
+
+    from hclib_tpu.device.frontier import (
+        Graph, _KINDS, host_pagerank_push, host_sssp,
+        make_frontier_megakernel, run_frontier,
+    )
+    from hclib_tpu.device.workloads import rmat_edges
+
+    scale = 6 if quick else 8
+    width = 8
+    buckets = 8
+    n, src, dst, w = rmat_edges(scale, efactor=8, seed=7)
+    g = Graph(n, src, dst, w)
+    cap = 768 if quick else 1024
+    mk_u = make_frontier_megakernel(
+        _KINDS["sssp"](), g, width=width, capacity=cap, interpret=True,
+    )
+    mk_b = make_frontier_megakernel(
+        _KINDS["sssp"](), g, width=width, capacity=cap, interpret=True,
+        priority_buckets=buckets,
+    )
+    ref = host_sssp(g, 0)
+    d_u, info_u = run_frontier("sssp", g, 0, mk=mk_u, interpret=True)
+    d_b, info_b = run_frontier("sssp", g, 0, mk=mk_b, interpret=True)
+    if not (np.array_equal(d_u, ref) and np.array_equal(d_b, ref)):
+        raise AssertionError(
+            "priority-tier: SSSP arms diverged (unordered/delta-stepping"
+            "/host Dijkstra distances not bit-identical)"
+        )
+    # Work-count arms (deterministic - one run each IS the measurement;
+    # wall time also logged for the record).
+    n_tr = max(2, trials)
+    u_ns, b_ns = [], []
+    for _ in range(n_tr):
+        t0 = time.perf_counter_ns()
+        run_frontier("sssp", g, 0, mk=mk_u, interpret=True)
+        u_ns.append(time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
+        run_frontier("sssp", g, 0, mk=mk_b, interpret=True)
+        b_ns.append(time.perf_counter_ns() - t0)
+    teps_u = info_u["edges"] / (min(u_ns) / 1e9)
+    teps_b = info_b["edges"] / (min(b_ns) / 1e9)
+    # PageRank live-set arms: deep mass cascade (m0 = 1<<14) where the
+    # FIFO breadth-first push balloons the live set.
+    m0, reps = 1 << 14, 64
+    pscale = 5 if quick else 6
+    n2, s2, d2, w2 = rmat_edges(pscale, efactor=8, seed=7)
+    g2 = Graph(n2, s2, d2, w2)
+    twin, _ = host_pagerank_push(g2, m0=m0, reps=reps)
+    r_u, pr_u = run_frontier(
+        "pagerank", g2, width=width, m0=m0, reps=reps, interpret=True,
+        capacity=4096,
+    )
+    r_b, pr_b = run_frontier(
+        "pagerank", g2, width=width, m0=m0, reps=reps, interpret=True,
+        capacity=4096, priority_buckets=buckets,
+    )
+    if not (np.array_equal(np.asarray(r_u), twin)
+            and np.array_equal(np.asarray(r_b), twin)):
+        raise AssertionError(
+            "priority-tier: PageRank arms diverged from the integer twin"
+        )
+    return {
+        "edges": g.m,
+        "expanded_unordered": info_u["executed"],
+        "expanded_bucketed": info_b["executed"],
+        "expand_ratio": info_b["executed"] / info_u["executed"],
+        "unordered_teps": round(teps_u),
+        "bucketed_teps": round(teps_b),
+        "teps_ratio": teps_b / teps_u,
+        "bucket_inversions": info_b["tiers"]["bucket_inversions"],
+        "pr_live_unordered": pr_u["allocated"],
+        "pr_live_bucketed": pr_b["allocated"],
+        "pr_live_ratio": pr_b["allocated"] / pr_u["allocated"],
+        "bit_identical": True,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -595,6 +688,18 @@ def main(argv=None) -> int:
                     "batched BFS arm - the age-triggered firing policy "
                     "keeps it near zero; a climb means lanes are "
                     "starving again")
+    ap.add_argument("--priority-expand-ceiling", type=float, default=0.8,
+                    help="priority-tier guard: maximum executed-EXPAND "
+                         "ratio of delta-stepping SSSP over the "
+                         "unordered label-correcting arm on the same "
+                         "seeded weighted R-MAT (the ISSUE 15 "
+                         "ordered-work dividend; measured ~0.7x at "
+                         "scale 8, delta = w_max/8)")
+    ap.add_argument("--priority-live-ceiling", type=float, default=0.8,
+                    help="priority-tier guard: maximum peak-live-row "
+                         "ratio of bounded-frontier PageRank over the "
+                         "FIFO breadth-first arm (measured ~0.4-0.6x "
+                         "at m0=1<<14 - the live-set blowup fix)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -809,6 +914,46 @@ def main(argv=None) -> int:
                     "policy stopped bounding lane starvation"
                 )
                 line += "  AGE-REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "priority-tier" in wanted:
+        try:
+            pt = _priority_tier(args.quick, args.trials)
+        except Exception as e:
+            print(f"priority-tier FAILED: {e}", file=sys.stderr)
+            failures.append(f"priority-tier: failed ({e})")
+        else:
+            results["priority-tier"] = pt
+            line = (
+                f"{'priority-tier':15s} expand "
+                f"{pt['expand_ratio']:5.2f}x "
+                f"({pt['expanded_bucketed']} vs "
+                f"{pt['expanded_unordered']} EXPANDs, teps "
+                f"{pt['teps_ratio']:.2f}x, pr live "
+                f"{pt['pr_live_ratio']:.2f}x "
+                f"({pt['pr_live_bucketed']} vs "
+                f"{pt['pr_live_unordered']} rows), "
+                f"{pt['bucket_inversions']} inversions, bit-identical)"
+            )
+            if pt["expand_ratio"] > args.priority_expand_ceiling:
+                failures.append(
+                    f"priority-tier: delta-stepping executed "
+                    f"{pt['expand_ratio']:.2f}x the label-correction "
+                    f"EXPAND count (ceiling "
+                    f"{args.priority_expand_ceiling:.2f}x) - ordered "
+                    "retirement stopped cutting re-relaxation"
+                )
+                line += "  EXPAND-REGRESSED"
+            if pt["pr_live_ratio"] > args.priority_live_ceiling:
+                failures.append(
+                    f"priority-tier: bounded-frontier PageRank peak "
+                    f"live set is {pt['pr_live_ratio']:.2f}x the "
+                    f"unordered arm (ceiling "
+                    f"{args.priority_live_ceiling:.2f}x) - the "
+                    "magnitude-band ordering stopped bounding the "
+                    "frontier"
+                )
+                line += "  LIVE-REGRESSED"
             print(line, flush=True)
 
     if args.device:

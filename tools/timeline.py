@@ -404,6 +404,16 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                 fid, take = a >> 16, a & 0xFFFF
                 span(_TID_LANES + fid, f"lane fn{fid}", t, 0.25,
                      f"age fire x{take}", {"take": take, "age": b})
+            elif tag == tb.TR_FIRE_BUCKET:
+                # Priority-tier fire record (priority_buckets): which
+                # bucket ring this round's batch retired - rendered on
+                # the firing lane's track (the b word names it) so the
+                # lowest-nonempty-first discipline reads directly off
+                # the timeline next to the round's TR_FIRE_BATCH.
+                bkt, take = a >> 16, a & 0xFFFF
+                span(_TID_LANES + b, f"lane fn{b}", t, 0.25,
+                     f"b{bkt} fire x{take}", {"bucket": bkt,
+                                              "take": take})
             elif tag == tb.TR_PREFETCH_ISSUE:
                 span(_TID_LANES + a, f"lane fn{a}", t, 0.25,
                      "prefetch", {"count": b})
