@@ -1070,6 +1070,227 @@ def bench_tenants(quick: bool = False) -> None:
     log(f"tenant ingress bench written: {path}")
 
 
+def _bench_serve_stream(per_tenant: int) -> dict:
+    """The DEVICE arm of the serving bench: 3 lanes through the real
+    interpret-mode streaming kernel with the completion mailbox ON -
+    every request rides submit() -> egress mailbox -> Future.result(),
+    so the rate prices the whole request/response loop (admission, WRR
+    install, in-kernel retirement publish, host drain, ledger resolve),
+    not just ingress."""
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.egress import EgressSpec
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.device.tenants import TenantSpec, TenantTable
+
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    names = ("gold", "silver", "bronze")
+    region = max(64, per_tenant)
+    table = TenantTable(
+        [TenantSpec(t, weight=w) for t, w in
+         zip(names, (4, 2, 1))],
+        region, egress=EgressSpec(depth=64),
+    )
+    mk = Megakernel(
+        kernels=[("bump", bump)], capacity=3 * per_tenant + 64,
+        num_values=8, succ_capacity=8, interpret=True,
+    )
+    sm = StreamingMegakernel(mk, ring_capacity=3 * region,
+                             tenants=table)
+    futs = []
+    t0 = time.perf_counter()
+    for tid in names:
+        for i in range(per_tenant):
+            adm = sm.submit(tid, 0, args=[1])
+            assert adm, adm
+            futs.append(adm.future)
+    sm.close()
+    b = TaskGraphBuilder()
+    b.add(0, args=[0])
+    sm.run_stream(b)
+    lats = sorted(f.latency_s() for f in futs)
+    wall = time.perf_counter() - t0
+    assert all(f.state == "RESULT" for f in futs)
+    cons = table.futures.conservation()
+    assert cons["ok"] and cons["resolved"] == len(futs), cons
+    pct = (lambda p: lats[min(len(lats) - 1, int(p * len(lats)))])
+    return {
+        "requests": len(futs),
+        "req_per_sec": round(len(futs) / max(wall, 1e-9), 1),
+        "wall_s": round(wall, 4),
+        "p50_latency_s": round(pct(0.50), 6),
+        "p99_latency_s": round(pct(0.99), 6),
+    }
+
+
+def bench_serve(quick: bool = False) -> None:
+    """Request/response serving loop cost of record (ISSUE 16): a
+    3-tenant weighted roster (4:2:1) submitting through the futures
+    face of a 4-device mesh front door with per-device completion
+    mailboxes (WRR reference model + HostMailbox - the executable spec
+    of the in-kernel poll/publish), riding ONE live reshard cut 4 -> 2
+    with futures in flight (preempt -> reattach on the shared ledger).
+    The headline JSON - aggregate requests/s plus p50/p99
+    submit-to-result latency ACROSS the scale event - prints (and
+    flushes) FIRST, rc=124-proofed like every other headline; the
+    device arm (real interpret-mode stream with the mailbox on) and
+    per-tenant lines go to stderr budget-gated.
+
+    perf-logs/<ts>.serve.json schema::
+
+        {"bench": "serve", "backend": str, "tenants": 3,
+         "requests": int,            # total accepted submits
+         "req_per_sec": float,       # aggregate, across the cut
+         "wall_s": float,
+         "p50_latency_s": float,     # submit-to-RESULT, ACROSS the cut
+         "p99_latency_s": float,     #   (reattached futures keep their
+         "resize_latency_s": float,  #    original submit timestamp)
+         "reattached": int,          # futures that rode the cut
+         "ndev": "4->2",
+         "per_tenant": {tenant: {"weight": int, "requests": int,
+                                 "p50_latency_s": float,
+                                 "p99_latency_s": float}},
+         "conservation": {...},      # FutureTable.conservation()
+         "stream": {...} | null}     # device arm (same latency keys)
+    """
+    import jax
+
+    from hclib_tpu.device.descriptor import RING_ROW, TEN_TOKEN
+    from hclib_tpu.device.egress import EgressSpec, HostMailbox
+    from hclib_tpu.device.tenants import (
+        MeshTenantTable, TenantSpec, wrr_poll_reference,
+    )
+
+    per_tenant = 40 if quick else 200
+    weights = {"gold": 4, "silver": 2, "bronze": 1}
+    region = -(-per_tenant // (2 * 8)) * 8 + 16
+    spec = EgressSpec(depth=32)
+    specs = [TenantSpec(t, weight=w, queue_capacity=4 * per_tenant)
+             for t, w in weights.items()]
+    table = MeshTenantTable(specs, 4, region, egress=spec)
+    futures = table.futures
+    rings = np.zeros((4, len(specs) * region, RING_ROW), np.int32)
+    # Client view: token -> (tenant, submit time, latest Future). The
+    # submit stamp is OURS so a reattached future's latency still spans
+    # the cut (the ledger restamps t_submit at reattach).
+    client = {}
+
+    def drive(tbl, rg, polls, start):
+        boxes = [HostMailbox(spec, park_cap=len(specs) * region)
+                 for _ in range(tbl.ndev)]
+        tctl = tbl.pump(rg)
+        for r in range(start, start + polls):
+            for d in range(tbl.ndev):
+                rows = wrr_poll_reference(
+                    rg[d], tctl[d], region, r, 1 << 20
+                )
+                boxes[d].publish([
+                    (int(row[TEN_TOKEN]), 0, 0, 0, 1) for row in rows
+                ])
+        tbl.absorb(tctl)
+        for box in boxes:
+            box.drain(futures=futures)
+
+    def submit_half(tbl):
+        n = 0
+        for tid in weights:
+            for _ in range(per_tenant // 2):
+                adm = tbl.submit(tid, 0, args=[1])
+                assert adm, adm
+                client[adm.future.token] = (
+                    tid, time.monotonic(), adm.future
+                )
+                n += 1
+        return n
+
+    t0 = time.perf_counter()
+    total = submit_half(table)
+    rnd = 0
+    drive(table, rings, 4, rnd)
+    rnd += 4
+    # THE scale event: export preempts in-flight futures; the resized
+    # mesh shares the SAME ledger, so every resume token reattaches.
+    t_cut = time.perf_counter()
+    state = table.export_state(rings)
+    preempted = [(tok, f.resume_token)
+                 for tok, (_, _, f) in client.items()
+                 if f.state == "PREEMPTED"]
+    table = table.resized(2)
+    table.resume_from(state)
+    for tok, rt in preempted:
+        tid, ts, _ = client[tok]
+        client[tok] = (tid, ts, table.reattach(rt))
+    resize_s = time.perf_counter() - t_cut
+    rings = np.zeros((2, len(specs) * region, RING_ROW), np.int32)
+    total += submit_half(table)
+    for r in range(1024):
+        drive(table, rings, 2, rnd)
+        rnd += 2
+        if table.drained():
+            break
+    wall = time.perf_counter() - t0
+    assert table.drained(), "serve bench wedged"
+    cons = futures.conservation()
+    assert cons["ok"] and cons["resolved"] == total, cons
+    by_tenant = {t: [] for t in weights}
+    for tok, (tid, ts, f) in client.items():
+        assert f.state == "RESULT", (tok, f.state)
+        by_tenant[tid].append(f.t_done - ts)
+    lats = sorted(x for xs in by_tenant.values() for x in xs)
+    pct = (lambda p, xs: xs[min(len(xs) - 1, int(p * len(xs)))])
+    headline = {
+        "bench": "serve",
+        "backend": jax.default_backend(),
+        "tenants": len(weights),
+        "requests": total,
+        "req_per_sec": round(total / max(wall, 1e-9), 1),
+        "wall_s": round(wall, 4),
+        "p50_latency_s": round(pct(0.50, lats), 6),
+        "p99_latency_s": round(pct(0.99, lats), 6),
+        "resize_latency_s": round(resize_s, 6),
+        "reattached": len(preempted),
+        "ndev": "4->2",
+    }
+    print(json.dumps(headline), flush=True)  # headline FIRST, always
+    detail = {}
+    for tid, xs in by_tenant.items():
+        xs.sort()
+        detail[tid] = {
+            "weight": weights[tid],
+            "requests": len(xs),
+            "p50_latency_s": round(pct(0.50, xs), 6),
+            "p99_latency_s": round(pct(0.99, xs), 6),
+        }
+        log(f"serve tenant [{tid}] w={weights[tid]}: {len(xs)} "
+            f"requests across the 4->2 cut, submit-to-result p50 "
+            f"{detail[tid]['p50_latency_s'] * 1e3:.2f} ms / p99 "
+            f"{detail[tid]['p99_latency_s'] * 1e3:.2f} ms")
+    log(f"serve mesh arm: {total} requests at "
+        f"{headline['req_per_sec']:,} req/s across a 4->2 reshard "
+        f"({resize_s * 1e3:.2f} ms cut, {len(preempted)} futures "
+        f"reattached)")
+    stream = section(
+        "serve device arm", 120,
+        lambda: _bench_serve_stream(20 if quick else 50),
+    )
+    if stream:
+        log(f"serve device arm (interpret stream, mailbox on): "
+            f"{stream['requests']} requests at "
+            f"{stream['req_per_sec']:,} req/s, submit-to-result p50 "
+            f"{stream['p50_latency_s'] * 1e3:.1f} ms / p99 "
+            f"{stream['p99_latency_s'] * 1e3:.1f} ms")
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.serve.json")
+    with open(path, "w") as f:
+        json.dump({**headline, "per_tenant": detail,
+                   "conservation": cons, "stream": stream},
+                  f, indent=1)
+    log(f"serve bench written: {path}")
+
+
 def bench_forasync(quick: bool = False) -> None:
     """forasync device tier cost of record (ISSUE 9): the 2D Jacobi-style
     stencil and the map-style batched-apply loop through the tile tier
@@ -1522,6 +1743,16 @@ def main(argv=None) -> None:
         "replaces the single-device suite for this run",
     )
     ap.add_argument(
+        "--serve", action="store_true",
+        help="request/response serving mode: 3 weighted tenants "
+        "submitting through the futures face of a 4-device mesh front "
+        "door with completion mailboxes, across ONE live 4->2 reshard "
+        "with futures reattached; the req/s + p50/p99 submit-to-result "
+        "latency headline prints FIRST (stdout JSON), the device arm "
+        "and per-tenant lines to stderr and perf-logs/<ts>.serve.json; "
+        "replaces the single-device suite for this run",
+    )
+    ap.add_argument(
         "--forasync", action="store_true",
         help="forasync device-tier mode: stencil + map-loop tiles/s "
         "through the batch-lane tile tier; the combined tasks/s headline "
@@ -1563,6 +1794,9 @@ def main(argv=None) -> None:
     _T0 = time.monotonic()  # arm the wall budget for THIS driver run
     if args.tenants:
         bench_tenants(quick=args.quick)
+        return
+    if args.serve:
+        bench_serve(quick=args.quick)
         return
     if args.forasync:
         bench_forasync(quick=args.quick)

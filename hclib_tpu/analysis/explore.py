@@ -54,6 +54,7 @@ from .findings import ERROR, AnalysisReport
 __all__ = [
     "Action",
     "CreditExchangeModel",
+    "EgressMailboxModel",
     "ExploreResult",
     "InjectQuiesceModel",
     "check_protocols",
@@ -422,6 +423,148 @@ class CreditExchangeModel:
         return out
 
 
+# --------------------------------------------------- egress mailbox
+
+
+class EgressMailboxModel:
+    """The completion-mailbox egress protocol (device/egress.py +
+    inject.py, ISSUE 16) as a model: tokened rows install under the
+    credit gate (parked + in-flight < park capacity), retire into the
+    mailbox - or PARK when it is full (explicit backpressure, never a
+    drop) - parked rows flush as mailbox room opens, the host consumes
+    published rows, and a quiesce cut exports by draining BOTH regions
+    (the run_stream driver is the drainer at the entry boundary, so the
+    export does not depend on the client poller being alive).
+
+    The property the curated configs prove: a FULL mailbox - even with
+    a dead poller (``poller=False``: no consume action ever fires) -
+    cannot wedge the quiesce export or the drained exit. Every maximal
+    interleaving terminates with both regions empty and every seeded
+    row accounted for: resolved + preempted + still-pending == seeded,
+    exactly (the conservation identity the chaos soak checks at
+    runtime).
+
+    ``drain_parked=False`` plants the protocol bug where the export
+    forgets the park ring - the seeded egress-wedge fixture: rows
+    parked at the cut leak, and the exploration returns the concrete
+    action prefix that loses them.
+
+    State: (pending, inflight, mailbox, parked, resolved, preempted,
+    quiescing, done).
+    """
+
+    def __init__(self, rows: int = 4, depth: int = 1,
+                 park_cap: Optional[int] = None, poller: bool = True,
+                 quiesce: bool = False, drain_parked: bool = True) -> None:
+        self.rows = int(rows)
+        self.depth = int(depth)
+        # The shipped layout ties the park ring to the mailbox depth
+        # (park_cap = depth in inject.py); override only to model
+        # hypothetical geometries.
+        self.park_cap = self.depth if park_cap is None else int(park_cap)
+        self.poller = bool(poller)
+        self.quiesce = bool(quiesce)
+        self.drain_parked = bool(drain_parked)
+
+    def initial(self) -> Tuple:
+        return (self.rows, 0, 0, 0, 0, 0, 0, 0)
+
+    def enabled(self, state) -> List[Action]:
+        pend, infl, mail, park, _res, _pre, quiescing, done = state
+        if done:
+            return []
+        out: List[Action] = []
+        # Credit gate (the tpoll clamp): a retiring row ALWAYS has a
+        # mailbox slot or a park slot, by construction - remove this
+        # bound and the park append overflows.
+        if pend > 0 and not quiescing and infl + park < self.park_cap:
+            out.append(("install",))
+        if infl > 0:
+            out.append(("retire",))
+        if park > 0 and mail < self.depth:
+            out.append(("flush",))
+        if self.poller and mail > 0:
+            out.append(("consume",))
+        if self.quiesce and not quiescing:
+            out.append(("quiesce",))
+        if quiescing:
+            out.append(("export",))
+        return out
+
+    def apply(self, state, action) -> Tuple:
+        pend, infl, mail, park, res, pre, quiescing, done = state
+        kind = action[0]
+        if kind == "install":
+            return (pend - 1, infl + 1, mail, park, res, pre,
+                    quiescing, done)
+        if kind == "retire":
+            # Full mailbox -> park, never drop, never abort.
+            if mail < self.depth:
+                return (pend, infl - 1, mail + 1, park, res, pre,
+                        quiescing, done)
+            return (pend, infl - 1, mail, park + 1, res, pre,
+                    quiescing, done)
+        if kind == "flush":
+            return (pend, infl, mail + 1, park - 1, res, pre,
+                    quiescing, done)
+        if kind == "consume":
+            return (pend, infl, mail - 1, park, res + 1, pre,
+                    quiescing, done)
+        if kind == "quiesce":
+            return (pend, infl, mail, park, res, pre, 1, done)
+        # export: the driver drains the mailbox (and the park ring)
+        # directly - no client poller involved - then preempts the
+        # installed-but-unretired tokens (they ride the etok export and
+        # reattach after resume).
+        drained = mail + (park if self.drain_parked else 0)
+        return (pend, 0, 0, 0 if self.drain_parked else park,
+                res + drained, pre + infl, quiescing, 1)
+
+    def footprint(self, action) -> FrozenSet[str]:
+        return {
+            "install": frozenset({"ring", "etok"}),
+            "retire": frozenset({"etok", "mailbox", "park"}),
+            "flush": frozenset({"mailbox", "park"}),
+            "consume": frozenset({"mailbox"}),
+            "quiesce": frozenset({"quiesce"}),
+            "export": frozenset({"mailbox", "park", "quiesce"}),
+        }[action[0]]
+
+    def check_final(self, state) -> List[str]:
+        pend, infl, mail, park, res, pre, quiescing, done = state
+        out: List[str] = []
+        if pend + infl + mail + park + res + pre != self.rows:
+            out.append(
+                f"conservation: pending {pend} + in-flight {infl} + "
+                f"mailbox {mail} + parked {park} + resolved {res} + "
+                f"preempted {pre} != seeded {self.rows}"
+            )
+        if park > self.park_cap or mail > self.depth:
+            out.append(
+                f"egress-overflow: mailbox {mail}/{self.depth} or park "
+                f"{park}/{self.park_cap} over capacity - the credit "
+                "gate failed"
+            )
+        if done and (mail or park):
+            out.append(
+                f"egress-wedge: quiesce export exited with {mail} "
+                f"mailbox row(s) and {park} parked row(s) undrained - "
+                "their futures hang instead of resolving or preempting"
+            )
+        if quiescing and not done:
+            out.append(
+                "egress-wedge: quiesce observed but the export never "
+                "completed (a full mailbox wedged the cut)"
+            )
+        if not quiescing and self.poller and (pend or infl or mail or park):
+            out.append(
+                f"egress-wedge: live poller but terminal with pending "
+                f"{pend} / in-flight {infl} / mailbox {mail} / parked "
+                f"{park} - the drained exit would hang"
+            )
+        return out
+
+
 # ------------------------------------------------------------ curated
 
 
@@ -461,6 +604,21 @@ def check_protocols(report: Optional[AnalysisReport] = None,
             (
                 "steal-credit(clean)",
                 CreditExchangeModel((2, 1), max_steals=2),
+            ),
+            (
+                # A 1-deep mailbox, a DEAD poller, a mid-flight quiesce:
+                # the cut must still export clean - full mailboxes are
+                # backpressure, never a wedge.
+                "egress-mailbox(full, dead poller, quiesce)",
+                EgressMailboxModel(
+                    rows=4, depth=1, poller=False, quiesce=True,
+                ),
+            ),
+            (
+                # Live (arbitrarily slow) poller, no cut: every
+                # interleaving drains to resolved == seeded.
+                "egress-mailbox(slow poller, drain)",
+                EgressMailboxModel(rows=3, depth=1, poller=True),
             ),
         ]
     for label, model in configs:

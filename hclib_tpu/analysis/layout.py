@@ -44,6 +44,24 @@ LAYOUT = {
     "TEN_ID": (16, ("hclib_tpu.device.descriptor",)),
     "TEN_EXPIRED": (17, ("hclib_tpu.device.descriptor",)),
     "TEN_DEADLINE_MS": (18, ("hclib_tpu.device.descriptor",)),
+    "TEN_TOKEN": (19, ("hclib_tpu.device.descriptor",)),
+    # completion-mailbox EGR row ABI (device/egress.py, ISSUE 16): the
+    # host drain, the numpy executable spec, and the in-kernel publish
+    # path (device/inject.py) all index these words; the ectl cursor
+    # block (EC_*) rides beside them like the inject ctl row.
+    "EGR_STATUS": (0, ("hclib_tpu.device.egress",)),
+    "EGR_TOKEN": (1, ("hclib_tpu.device.egress",)),
+    "EGR_TEN": (2, ("hclib_tpu.device.egress",)),
+    "EGR_FN": (3, ("hclib_tpu.device.egress",)),
+    "EGR_SLOT": (4, ("hclib_tpu.device.egress",)),
+    "EGR_VALUE": (5, ("hclib_tpu.device.egress",)),
+    "EGR_WORDS": (8, ("hclib_tpu.device.egress",)),
+    "EC_WRITE": (0, ("hclib_tpu.device.egress",)),
+    "EC_CONSUMED": (1, ("hclib_tpu.device.egress",)),
+    "EC_PARKED": (2, ("hclib_tpu.device.egress",)),
+    "EC_PARK_COUNT": (3, ("hclib_tpu.device.egress",)),
+    "EC_PARK_HEAD": (4, ("hclib_tpu.device.egress",)),
+    "EC_INFLIGHT": (5, ("hclib_tpu.device.egress",)),
     # tctl ABI (one 8-word control row per tenant lane, device/tenants):
     # the host pump, the single-device stream poll, the resident-mesh
     # WRR poll, and the numpy reference model all index these words -
@@ -100,7 +118,7 @@ LAYOUT = {
 # checkpoint.py's export key sets: resharding and restore key on these
 # literal names riding the bundle npz.
 _CKPT_STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
-_CKPT_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats")
+_CKPT_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats", "etok")
 
 _cache: Optional[AnalysisReport] = None
 
@@ -136,15 +154,31 @@ def check_layout(report: Optional[AnalysisReport] = None,
     from ..device import megakernel as m
 
     if not (d.DESC_WORDS <= d.TEN_ID < d.TEN_EXPIRED
-            < d.TEN_DEADLINE_MS < d.RING_ROW):
+            < d.TEN_DEADLINE_MS < d.TEN_TOKEN < d.RING_ROW):
         report.add(
             "layout", ERROR, None,
             "ring-row transport words must sit beyond the descriptor "
             f"ABI and inside the padded row: DESC_WORDS={d.DESC_WORDS} "
             f"<= TEN_ID={d.TEN_ID} < TEN_EXPIRED={d.TEN_EXPIRED} < "
             f"TEN_DEADLINE_MS={d.TEN_DEADLINE_MS} < "
+            f"TEN_TOKEN={d.TEN_TOKEN} < "
             f"RING_ROW={d.RING_ROW} violated",
             word="TEN_ID",
+        )
+    from ..device import egress as e
+
+    if not (e.EGR_STATUS < e.EGR_TOKEN < e.EGR_TEN < e.EGR_FN
+            < e.EGR_SLOT < e.EGR_VALUE < e.EGR_WORDS
+            and 0 <= e.EC_WRITE < e.EC_CONSUMED < e.EC_PARKED
+            < e.EC_PARK_COUNT < e.EC_PARK_HEAD < e.EC_INFLIGHT < 8):
+        report.add(
+            "layout", ERROR, None,
+            "completion-mailbox words violate the transport-word "
+            f"ordering invariant: EGR {e.EGR_STATUS},{e.EGR_TOKEN},"
+            f"{e.EGR_TEN},{e.EGR_FN},{e.EGR_SLOT},{e.EGR_VALUE} must "
+            f"ascend below EGR_WORDS={e.EGR_WORDS} and the EC cursor "
+            "words must ascend inside the 8-word ectl row",
+            word="EGR_STATUS",
         )
     if not (m.LS_AGE < m.LS_WORDS
             and m.TS_MAX_AGE < m.TS_BUCKET_FIRES

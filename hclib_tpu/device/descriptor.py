@@ -55,11 +55,19 @@ ABI carry *transport metadata* the scheduler never copies
                    clock - deadlines survive a cut as remaining budget,
                    never as stale wall-clock instants. Host-only: the
                    device poll never reads it.
+    19 TEN_TOKEN   submit token of a tracked request (0 = fire-and-forget
+                   row, no completion published). Stamped at admission by
+                   the tenant front door when egress is enabled
+                   (device/egress.py): the egress-enabled inject poll
+                   records it per installed row and the retirement-time
+                   mailbox publish carries it back to the host, where it
+                   keys the ``Future`` ledger (``FutureTable``).
 
-Because the words ride the row itself, tenant identity - and a residue
-row's remaining deadline budget - survives every path a row can travel:
-checkpoint residue export, ``reshard``'s round-robin re-deal, and resume
-re-publication.
+Because the words ride the row itself, tenant identity - a residue
+row's remaining deadline budget, and its submit token - survives every
+path a row can travel: checkpoint residue export, ``reshard``'s
+round-robin re-deal, and resume re-publication (which is what lets
+futures re-attach across a cut via their resume tokens).
 """
 
 from __future__ import annotations
@@ -86,6 +94,7 @@ __all__ = [
     "TEN_ID",
     "TEN_EXPIRED",
     "TEN_DEADLINE_MS",
+    "TEN_TOKEN",
     "TaskGraphBuilder",
 ]
 
@@ -117,6 +126,7 @@ RING_ROW = 256
 TEN_ID = 16
 TEN_EXPIRED = 17
 TEN_DEADLINE_MS = 18
+TEN_TOKEN = 19
 
 
 class TaskGraphBuilder:

@@ -74,10 +74,32 @@ from ..runtime import resilience
 from ..runtime.resilience import CancelledError, StallError
 from .descriptor import (
     DESC_WORDS,
+    F_FN,
+    F_OUT,
     NO_TASK,
     RING_ROW,
     TEN_EXPIRED,
+    TEN_ID,
+    TEN_TOKEN,
     TaskGraphBuilder,
+)
+from .egress import (
+    EC_CONSUMED,
+    EC_INFLIGHT,
+    EC_PARK_COUNT,
+    EC_PARK_HEAD,
+    EC_PARKED,
+    EC_WRITE,
+    EGR_FN,
+    EGR_OK,
+    EGR_SLOT,
+    EGR_STATUS,
+    EGR_TEN,
+    EGR_TOKEN,
+    EGR_VALUE,
+    EGR_WORDS,
+    TOKEN_LIMIT,
+    EgressProtocolError,
 )
 from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
 from .tenants import (
@@ -97,6 +119,7 @@ from .tracebuf import (
     NullTracer,
     TR_ABORT,
     TR_CKPT,
+    TR_EGRESS,
     TR_INJECT,
     TR_QUIESCE,
     TR_TENANT,
@@ -155,6 +178,19 @@ class StreamingMegakernel:
             self.ring_capacity = (
                 len(self.tenants) * self.tenants.region_rows
             )
+        # Completion-mailbox egress (device/egress.py): compiled into
+        # the kernel only when the tenant table is egress-enabled - a
+        # mailbox ring + park buffer + ectl cursor block + per-task-row
+        # token table ride as four extra SMEM in/out pairs, retirements
+        # publish EGR rows through the complete_hook seam, and the
+        # driver drains both regions (resolving futures) after every
+        # entry. Egress-off builds compile ZERO of it - no extra
+        # operands, no extra words - and stay bit-identical to the
+        # pre-egress kernel (tests/test_serving.py pins the lowered
+        # text).
+        self._egress = (
+            self.tenants.egress if self.tenants is not None else None
+        )
         self._jitted: Dict[Any, Any] = {}
         self._lock = threading.Lock()
         self._pending_rows: List[np.ndarray] = []
@@ -248,6 +284,8 @@ class StreamingMegakernel:
             d = dict(self._stats)
         if self.tenants is not None:
             d["tenants"] = self.tenants.stats()
+            if self.tenants.futures is not None:
+                d["egress"] = self.tenants.futures.stats_dict()
         return d
 
     # ---- producer side (host; any thread) ----
@@ -384,17 +422,26 @@ class StreamingMegakernel:
         ndata = len(mk.data_specs)
         ntrace = 1 if trace is not None else 0
         nten = 1 if self.tenants is not None else 0
-        n_in = 7 + ndata + nten  # + ring, ctl (+ tctl, tenant lanes)
+        negr = 1 if (nten and self._egress is not None) else 0
+        depth = self._egress.depth if negr else 0
+        park_cap = depth  # bounds tokened in-flight work (credit gate)
+        # + ring, ctl (+ tctl, tenant lanes) (+ egr/park/ectl/etok, egress)
+        n_in = 7 + ndata + nten + 4 * negr
         in_refs = refs[:n_in]
-        # + ctl out (+ tctl echo, tenant lanes)
-        out_refs = refs[n_in : n_in + 5 + ndata + ntrace + nten]
-        rest = refs[n_in + 5 + ndata + ntrace + nten :]
+        # + ctl out (+ tctl echo) (+ egress echoes)
+        n_out = 5 + ndata + ntrace + nten + 4 * negr
+        out_refs = refs[n_in : n_in + n_out]
+        rest = refs[n_in + n_out :]
         nscratch = len(mk.scratch_specs)
         scratch_refs = rest[:nscratch]
         free, vfree, ctlbuf, rowbuf, isem = rest[nscratch:]
         tasks_in, succ, ready_in, counts_in, ivalues_in = in_refs[:5]
         ring, ctl_in = in_refs[5], in_refs[6]
         tctl_in = in_refs[7 + ndata] if nten else None
+        if negr:
+            egr_in, park_in, ectl_in, etok_in = in_refs[
+                8 + ndata : 12 + ndata
+            ]
         tasks, ready, counts, ivalues = out_refs[:4]
         ctl_out = out_refs[4]
         data = dict(zip(mk.data_specs.keys(), out_refs[5 : 5 + ndata]))
@@ -404,18 +451,88 @@ class StreamingMegakernel:
             else NullTracer()
         )
         tctl_out = out_refs[5 + ndata + ntrace] if nten else None
+        if negr:
+            egr_out, park_out, ectl_out, etok_out = out_refs[
+                6 + ndata + ntrace : 10 + ndata + ntrace
+            ]
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
+
+        def egress_complete(idx):
+            """Completion-mailbox publish, run at task retirement (the
+            complete_hook seam fires FIRST inside complete(), while the
+            row's words are intact). Tokened rows (etok != 0) publish an
+            EGR row into the mailbox; a full mailbox PARKS the row in
+            the park ring instead - counted (EC_PARKED cumulative,
+            EC_PARK_COUNT current), traced as TR_EGRESS, never dropped,
+            never an OVF abort. The install-side credit gate bounds
+            parked + in-flight below park_cap, so the park append here
+            cannot overflow by construction. egress_reference is the
+            executable spec - change one, change both."""
+            packed = etok_out[idx]
+
+            @pl.when(packed != 0)
+            def _():
+                token = jax.lax.rem(packed, jnp.int32(TOKEN_LIMIT))
+                ten = packed // jnp.int32(TOKEN_LIMIT)
+                slot = tasks[idx, F_OUT]
+                write = ectl_out[EC_WRITE]
+                room = depth - (write - ectl_out[EC_CONSUMED])
+
+                @pl.when(room > 0)
+                def _():
+                    s = jax.lax.rem(write, depth)
+                    egr_out[s, EGR_STATUS] = jnp.int32(EGR_OK)
+                    egr_out[s, EGR_TOKEN] = token
+                    egr_out[s, EGR_TEN] = ten
+                    egr_out[s, EGR_FN] = tasks[idx, F_FN]
+                    egr_out[s, EGR_SLOT] = slot
+                    egr_out[s, EGR_VALUE] = ivalues[slot]
+                    ectl_out[EC_WRITE] = write + 1
+
+                @pl.when(room <= 0)
+                def _():
+                    n = ectl_out[EC_PARK_COUNT]
+                    p = jax.lax.rem(
+                        ectl_out[EC_PARK_HEAD] + n, park_cap
+                    )
+                    park_out[p, EGR_STATUS] = jnp.int32(EGR_OK)
+                    park_out[p, EGR_TOKEN] = token
+                    park_out[p, EGR_TEN] = ten
+                    park_out[p, EGR_FN] = tasks[idx, F_FN]
+                    park_out[p, EGR_SLOT] = slot
+                    park_out[p, EGR_VALUE] = ivalues[slot]
+                    ectl_out[EC_PARK_COUNT] = n + 1
+                    ectl_out[EC_PARKED] = ectl_out[EC_PARKED] + 1
+                    tr.emit(TR_EGRESS, tr.now(), token, n + 1)
+
+                etok_out[idx] = jnp.int32(0)
+                ectl_out[EC_INFLIGHT] = ectl_out[EC_INFLIGHT] - 1
+
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True,
             tracer=tr if tr.enabled else None,
+            complete_hook=egress_complete if negr else None,
         )
         cap = mk.capacity
 
         core.stage()
 
         def install(row_slot) -> None:
-            core.install_descriptor(lambda w: rowbuf[row_slot, w])
+            idx = core.install_descriptor(lambda w: rowbuf[row_slot, w])
+            if negr:
+                # Stamp the submit token (packed token | tenant << 24)
+                # onto the allocated task-table row so retirement knows
+                # where to publish; count it in-flight for the credit
+                # gate.
+                token = rowbuf[row_slot, TEN_TOKEN]
+
+                @pl.when(token != 0)
+                def _():
+                    etok_out[idx] = token + (
+                        rowbuf[row_slot, TEN_ID] * jnp.int32(TOKEN_LIMIT)
+                    )
+                    ectl_out[EC_INFLIGHT] = ectl_out[EC_INFLIGHT] + 1
 
         def poll(consumed):
             """Acquire-read the ring: ctl first (tail publishes rows), then
@@ -487,6 +604,21 @@ class StreamingMegakernel:
                         jnp.minimum(weight, avail), core.headroom()
                     ),
                 )
+                if negr:
+                    # Egress credit gate: tokened rows currently parked
+                    # or in-flight never exceed park_cap, so a retiring
+                    # row ALWAYS has a mailbox slot or a park slot - a
+                    # full mailbox is ring backpressure (rows wait on
+                    # their lanes, cursors stop advancing), never loss.
+                    take = jnp.minimum(
+                        take,
+                        jnp.maximum(
+                            jnp.int32(park_cap)
+                            - ectl_out[EC_PARK_COUNT]
+                            - ectl_out[EC_INFLIGHT],
+                            0,
+                        ),
+                    )
                 target = cons + take
 
                 def chunk(carry, lane=lane, target=target):
@@ -623,6 +755,59 @@ class StreamingMegakernel:
             for i in range(T):
                 for w in range(8):
                     tctl_out[i, w] = tctl_in[i, w]
+        if negr:
+            # Mailbox/park/token staging: host-seeded per entry (the
+            # tctl pattern - no aliasing), mutated in place by the
+            # publish path, echoed back at exit for the host drain.
+            for w in range(8):
+                ectl_out[w] = ectl_in[w]
+
+            def _cp_egr(i, _):
+                for w in range(EGR_WORDS):
+                    egr_out[i, w] = egr_in[i, w]
+                return 0
+
+            jax.lax.fori_loop(0, depth, _cp_egr, 0)
+
+            def _cp_park(i, _):
+                for w in range(EGR_WORDS):
+                    park_out[i, w] = park_in[i, w]
+                return 0
+
+            jax.lax.fori_loop(0, park_cap, _cp_park, 0)
+
+            def _cp_tok(i, _):
+                etok_out[i] = etok_in[i]
+                return 0
+
+            jax.lax.fori_loop(0, cap, _cp_tok, 0)
+
+            # Entry-start parked retry: the host consumed between
+            # entries, so mailbox room may have opened - move parked
+            # rows (FIFO, off EC_PARK_HEAD) into the mailbox while room
+            # lasts. flush_parked_reference is the executable spec.
+            def _flush(i, _):
+                cnt = ectl_out[EC_PARK_COUNT]
+                room = depth - (
+                    ectl_out[EC_WRITE] - ectl_out[EC_CONSUMED]
+                )
+
+                @pl.when((cnt > 0) & (room > 0))
+                def _():
+                    h = ectl_out[EC_PARK_HEAD]
+                    s = jax.lax.rem(ectl_out[EC_WRITE], depth)
+                    for w in range(EGR_WORDS):
+                        egr_out[s, w] = park_out[h, w]
+                    for w in range(EGR_WORDS):
+                        park_out[h, w] = jnp.int32(0)
+                    ectl_out[EC_PARK_HEAD] = jax.lax.rem(
+                        h + 1, park_cap
+                    )
+                    ectl_out[EC_PARK_COUNT] = cnt - 1
+                    ectl_out[EC_WRITE] = ectl_out[EC_WRITE] + 1
+                return 0
+
+            jax.lax.fori_loop(0, park_cap, _flush, 0)
         # Initial ctl fetch: the consumed cursor (slot 2) persists across
         # entries through the host-echoed ctl.
         cp0 = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
@@ -663,10 +848,12 @@ class StreamingMegakernel:
         # tenant tctl block (host-published per entry, tiny) rides SMEM;
         # a tenants=None build compiles none of it.
         nten = 1 if self.tenants is not None else 0
+        negr = 1 if (nten and self._egress is not None) else 0
+        depth = self._egress.depth if negr else 0
         T = len(self.tenants) if nten else 0
         in_specs = (
             [smem()] * 5 + [anyspace(), anyspace()] + [anyspace()] * ndata
-            + [smem()] * nten
+            + [smem()] * nten + [smem()] * (4 * negr)
         )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -684,10 +871,18 @@ class StreamingMegakernel:
             + data_shapes
             + ([mk.trace.out_shape()] if ntrace else [])
             + ([jax.ShapeDtypeStruct((T, 8), jnp.int32)] if nten else [])
+            + ([
+                # mailbox ring, park ring, ectl cursor block, per-row
+                # token table - host-seeded, echoed (the tctl pattern).
+                jax.ShapeDtypeStruct((depth, EGR_WORDS), jnp.int32),
+                jax.ShapeDtypeStruct((depth, EGR_WORDS), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),
+                jax.ShapeDtypeStruct((mk.capacity,), jnp.int32),
+            ] if negr else [])
         )
         out_specs = tuple(
             [smem()] * 4 + [smem()] + [anyspace()] * ndata
-            + [smem()] * ntrace + [smem()] * nten
+            + [smem()] * ntrace + [smem()] * nten + [smem()] * (4 * negr)
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
@@ -784,6 +979,65 @@ class StreamingMegakernel:
             if unregister is not None:
                 unregister()
 
+    @staticmethod
+    def _drain_egress(table, egr, park, ectl) -> int:
+        """Consume the completion mailbox AND the park ring at an entry
+        boundary (this driver IS the poller), resolving each row's
+        future exactly once. Mutates the arrays in place: consumed
+        mailbox slots re-zero and EC_CONSUMED catches up to EC_WRITE;
+        parked rows resolve directly (they never occupied a mailbox
+        slot) and the park ring empties. Draining both regions here is
+        what makes a full mailbox unable to wedge quiesce or the
+        drained exit. Returns rows consumed."""
+        futures = table.futures
+        depth = egr.shape[0]
+        n = 0
+        consumed = int(ectl[EC_CONSUMED])
+        while consumed < int(ectl[EC_WRITE]):
+            row = egr[consumed % depth]
+            if int(row[EGR_STATUS]) != EGR_OK:
+                raise EgressProtocolError(
+                    f"mailbox slot {consumed % depth} consumed twice or "
+                    f"never published (status {int(row[EGR_STATUS])})"
+                )
+            futures.resolve(int(row[EGR_TOKEN]), int(row[EGR_VALUE]))
+            row[:] = 0
+            consumed += 1
+            n += 1
+        ectl[EC_CONSUMED] = consumed
+        head, cnt = int(ectl[EC_PARK_HEAD]), int(ectl[EC_PARK_COUNT])
+        cap = park.shape[0]
+        for k in range(cnt):
+            row = park[(head + k) % cap]
+            if int(row[EGR_STATUS]) != EGR_OK:
+                raise EgressProtocolError(
+                    f"park slot {(head + k) % cap} empty but counted "
+                    f"(status {int(row[EGR_STATUS])})"
+                )
+            futures.resolve(int(row[EGR_TOKEN]), int(row[EGR_VALUE]))
+            row[:] = 0
+            n += 1
+        ectl[EC_PARK_HEAD] = 0
+        ectl[EC_PARK_COUNT] = 0
+        return n
+
+    @staticmethod
+    def _adopt_etok(table, etok, tasks) -> None:
+        """Re-adopt installed-but-unretired submit tokens off a resumed
+        snapshot's etok table: each packed word (token | tenant << 24)
+        re-enters the futures ledger so the resumed stream's
+        retirements resolve - and preempted clients reattach - instead
+        of raising on an unknown token."""
+        tasks = np.asarray(tasks)
+        for idx in np.flatnonzero(etok):
+            packed = int(etok[idx])
+            table.futures.adopt_row_token(
+                packed % TOKEN_LIMIT,
+                table.ids[packed // TOKEN_LIMIT],
+                int(tasks[idx, F_FN]),
+                int(tasks[idx, F_OUT]),
+            )
+
     def _run_stream(
         self, builder, ivalues, data, quantum, max_rounds,
         poll_interval_s, deadline_s, resume_state=None,
@@ -795,6 +1049,19 @@ class StreamingMegakernel:
         table = self.tenants
         ring = np.zeros((self.ring_capacity, RING_ROW), np.int32)
         ctl = np.zeros(8, np.int32)  # [tail, close, consumed, abort, ...]
+        egspec = self._egress if table is not None else None
+        if egspec is not None:
+            # Completion-mailbox host halves: mailbox + park rings,
+            # cursor block, per-task-row token table. Host-seeded every
+            # entry, mutated by the kernel's publish path, drained (and
+            # futures resolved) right after every entry - so quiesce and
+            # the drained exit always run against an EMPTY mailbox and
+            # an empty park ring: a slow poller cannot wedge either.
+            depth = egspec.depth
+            egr_np = np.zeros((depth, EGR_WORDS), np.int32)
+            park_np = np.zeros((depth, EGR_WORDS), np.int32)
+            ectl_np = np.zeros(8, np.int32)
+            etok_np = np.zeros(mk.capacity, np.int32)
         injected = 0
         if resume_state is not None:
             # Same-object resume must behave like a fresh stream: clear
@@ -825,6 +1092,26 @@ class StreamingMegakernel:
             # re-publishes it per region - per-tenant counts conserved.
             if table is not None:
                 table.resume_from(st)
+                if egspec is not None:
+                    et = np.asarray(
+                        st.get("etok", np.zeros(mk.capacity, np.int32)),
+                        np.int32,
+                    ).reshape(-1)
+                    if et.shape[0] != mk.capacity:
+                        raise ValueError(
+                            f"resume etok table has {et.shape[0]} rows; "
+                            f"this kernel's task table has {mk.capacity}"
+                        )
+                    etok_np = et.copy()
+                    self._adopt_etok(table, etok_np, state[0])
+                    # The cut exported no ectl block (the mailbox and
+                    # park ring drained before export) - but the
+                    # adopted tokens ARE in flight, and the install
+                    # credit gate reads EC_INFLIGHT. Seeding it zero
+                    # would let each adopted retirement drive it
+                    # negative, inflating the gate until the park ring
+                    # overwraps its counted rows.
+                    ectl_np[EC_INFLIGHT] = int(np.count_nonzero(etok_np))
             elif "tctl" in st or "tstats" in st:
                 # The mirror of TenantTable.resume_from's guard: a
                 # tenant-tagged snapshot resumed on a plain stream would
@@ -900,6 +1187,11 @@ class StreamingMegakernel:
                     frozen = np.zeros((len(table), 8), np.int32)
                     frozen[:, TC_PAUSE] = 1
                     extra = [jnp.asarray(frozen)]
+                if egspec is not None:
+                    extra += [
+                        jnp.asarray(egr_np), jnp.asarray(park_np),
+                        jnp.asarray(ectl_np), jnp.asarray(etok_np),
+                    ]
                 outs = jitted(
                     jnp.asarray(state[0]), jnp.asarray(succ),
                     jnp.asarray(state[1]), jnp.asarray(state[2]),
@@ -909,6 +1201,21 @@ class StreamingMegakernel:
                 )
                 counts_ab = np.asarray(outs[2])
                 ctl_ab = np.asarray(outs[4])
+                if egspec is not None:
+                    # Degradation ladder, abort rung: results that made
+                    # it into the mailbox/park before the stop still
+                    # resolve RESULT; every other outstanding future
+                    # poisons - clients get a typed terminal state, not
+                    # a hang.
+                    nt_ab = 1 if mk.trace is not None else 0
+                    base = 6 + len(mk.data_specs) + nt_ab
+                    egr_np, park_np, ectl_np, etok_np = (
+                        np.array(outs[base + i]) for i in range(4)
+                    )
+                    self._drain_egress(table, egr_np, park_np, ectl_np)
+                    table.futures.poison_all(
+                        f"stream aborted: {abort_reason}"
+                    )
                 with self._lock:
                     t0 = self._abort_t
                     self._stats.update({
@@ -961,6 +1268,10 @@ class StreamingMegakernel:
                 jnp.asarray(state[3]), jnp.asarray(ring),
                 jnp.asarray(ctl), *[jnp.asarray(d) for d in data_np],
                 *([jnp.asarray(tctl_np)] if table is not None else []),
+                *([
+                    jnp.asarray(egr_np), jnp.asarray(park_np),
+                    jnp.asarray(ectl_np), jnp.asarray(etok_np),
+                ] if egspec is not None else []),
             )
             state = [np.asarray(o) for o in outs[:4]]
             ctl_o = np.asarray(outs[4])
@@ -974,6 +1285,16 @@ class StreamingMegakernel:
                 # (freeing in-flight budget), cumulative install/expire/
                 # sweep counters refresh, admission latencies record.
                 table.absorb(np.asarray(outs[5 + ndata + ntrace]))
+            if egspec is not None:
+                # Drain the mailbox AND the park ring at the entry
+                # boundary, resolving futures - both always empty when
+                # the loop reaches the quiesce/drained-exit checks
+                # below, so a full mailbox can never wedge either.
+                base = 6 + ndata + ntrace
+                egr_np, park_np, ectl_np, etok_np = (
+                    np.array(outs[base + i]) for i in range(4)
+                )
+                self._drain_egress(table, egr_np, park_np, ectl_np)
             counts_np = state[2]
             ctl[2] = ctl_o[2]  # device-consumed cursor persists
             if bool(counts_np[C_OVERFLOW]):
@@ -1037,6 +1358,13 @@ class StreamingMegakernel:
                     # (inject() on a tenant stream routes through
                     # submit(), so _pending_rows holds no untagged rows.)
                     assert not late, "tenant stream held untagged rows"
+                    if egspec is not None:
+                        # Installed-but-unretired tokens ride the cut
+                        # (mailbox/park already drained above); their
+                        # futures go PREEMPTED inside export_state and
+                        # reattach via resume tokens after resume_from
+                        # re-adopts this table.
+                        info["state"]["etok"] = etok_np.copy()
                     info["state"].update(table.export_state(ring))
                 else:
                     residue = (

@@ -113,7 +113,9 @@ from .descriptor import (
     TEN_DEADLINE_MS,
     TEN_EXPIRED,
     TEN_ID,
+    TEN_TOKEN,
 )
+from .egress import FutureTable, normalize_egress
 
 __all__ = [
     "ADMIT_ACCEPTED",
@@ -174,19 +176,25 @@ class Admission:
     ``ring`` | ``expired`` | ``quarantined`` | ``cancelled`` |
     ``closed``). Truthy iff the row was admitted (accepted OR queued).
     Mesh-routed admissions (:class:`MeshTenantTable`) additionally carry
-    ``device`` - the flat device id the row was routed to."""
+    ``device`` - the flat device id the row was routed to - and
+    egress-enabled tables attach ``future`` (device/egress.py), the
+    typed handle whose ``result(timeout=)`` rides the completion
+    mailbox; rejections carry ``future=None``."""
 
-    __slots__ = ("status", "tenant", "reason", "index", "device")
+    __slots__ = ("status", "tenant", "reason", "index", "device",
+                 "future")
 
     def __init__(self, status: str, tenant: str,
                  reason: Optional[str] = None,
                  index: Optional[int] = None,
-                 device: Optional[int] = None) -> None:
+                 device: Optional[int] = None,
+                 future=None) -> None:
         self.status = status
         self.tenant = tenant
         self.reason = reason
         self.index = index  # per-tenant admission sequence number
         self.device = device  # mesh routing target (MeshTenantTable)
+        self.future = future  # egress-enabled tables only
 
     def __bool__(self) -> bool:
         return self.status != ADMIT_REJECTED
@@ -344,7 +352,8 @@ def build_row(fn: int, args: Sequence[int] = (), out: int = 0,
 class _Pending:
     """One admitted row in flight on the host side."""
 
-    __slots__ = ("row", "deadline_at", "t_submit", "index", "marked")
+    __slots__ = ("row", "deadline_at", "t_submit", "index", "marked",
+                 "token")
 
     def __init__(self, row: np.ndarray, deadline_at: Optional[float],
                  t_submit: float) -> None:
@@ -353,6 +362,10 @@ class _Pending:
         self.t_submit = t_submit
         self.index = -1     # region-relative publish index (once published)
         self.marked = False  # host marked TEN_EXPIRED on the ring
+        # Submit token of a tracked request (rides the row's TEN_TOKEN
+        # word; 0 = untracked). Zeroed once its future reached a
+        # terminal state host-side, so each token resolves exactly once.
+        self.token = int(row[TEN_TOKEN])
 
 
 def _remaining_ms(deadline_at: Optional[float], now: float) -> int:
@@ -432,7 +445,9 @@ class TenantTable:
     thread admits while the stream driver pumps/absorbs."""
 
     def __init__(self, specs: Sequence[TenantSpec], region_rows: int,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 egress=None,
+                 futures: Optional[FutureTable] = None) -> None:
         specs = list(specs)
         if not specs:
             raise ValueError("at least one tenant lane")
@@ -461,6 +476,25 @@ class TenantTable:
         self._by_id: Dict[str, _Lane] = {
             lane.spec.id: lane for lane in self._lanes
         }
+        # Completion-mailbox egress (device/egress.py): ``futures=``
+        # shares an existing FutureTable (mesh replica tables all feed
+        # the MeshTenantTable's one ledger); otherwise an egress spec -
+        # explicit or HCLIB_TPU_EGRESS_DEPTH - makes this table OWN one.
+        # ``self.futures is None`` on non-serving tables: admit then
+        # stamps TEN_TOKEN = 0 and attaches no future, so every
+        # pre-egress call site behaves bit-identically.
+        self.egress = normalize_egress(egress)
+        if futures is not None:
+            self.futures: Optional[FutureTable] = futures
+            self._owns_futures = False
+        elif self.egress is not None:
+            self.futures = FutureTable(
+                backoff_s=self.egress.backoff_s, clock=clock
+            )
+            self._owns_futures = True
+        else:
+            self.futures = None
+            self._owns_futures = False
 
     # ---- lookups ----
 
@@ -586,11 +620,21 @@ class TenantTable:
             r[TEN_ID] = lane.idx
             r[TEN_EXPIRED] = 0
             r[TEN_DEADLINE_MS] = 0  # stamped only at checkpoint export
+            fut = None
+            if self.futures is not None:
+                # Token minted only after every admission gate passed:
+                # a rejected submit never enters the conservation ledger.
+                fut = self.futures.create(
+                    tid, int(r[F_FN]), int(r[F_OUT])
+                )
+                r[TEN_TOKEN] = fut.token
+            else:
+                r[TEN_TOKEN] = 0
             lane.queue.append(_Pending(r, deadline_at, now))
             lane.accepted += 1
             return Admission(
                 ADMIT_QUEUED if over else ADMIT_ACCEPTED, tid,
-                index=lane.accepted - 1,
+                index=lane.accepted - 1, future=fut,
             )
 
     def record_reject(self, tenant: Union[str, int], reason: str) -> (
@@ -601,6 +645,52 @@ class TenantTable:
         with self._lock:
             lane.rejected += 1
         return Admission(ADMIT_REJECTED, lane.spec.id, reason)
+
+    def submit(self, tenant: Union[str, int], fn: int,
+               args: Sequence[int] = (), out: int = 0,
+               succ0: int = NO_TASK, succ1: int = NO_TASK,
+               deadline_s: Optional[float] = None,
+               cancel_scope: Optional[CancelScope] = None) -> Admission:
+        """Build, deadline-resolve, and admit one request in a single
+        call - the serving-loop face (mirrors MeshTenantTable.submit).
+        On an egress-enabled table the returned Admission carries
+        ``.future``, whose ``result(timeout=)`` rides the completion
+        mailbox to exactly one terminal rung of the degradation ladder:
+        RESULT | EXPIRED | POISONED | PREEMPTED(resume_token)."""
+        row = build_row(fn, args, out, succ0, succ1)
+        deadline_at = self.resolve_deadline(tenant, deadline_s,
+                                            cancel_scope)
+        return self.admit(tenant, row, deadline_at, cancel_scope)
+
+    def reattach(self, resume_token):
+        """Re-attach a PREEMPTED future across a checkpoint cut: feed
+        the ``resume_token`` a FuturePreempted carried to the successor
+        table and get a fresh Future bound to the same in-flight
+        request (its token rode the residue row / etok export)."""
+        if self.futures is None:
+            raise ValueError(
+                "reattach needs an egress-enabled table (pass egress= "
+                "or set HCLIB_TPU_EGRESS_DEPTH)"
+            )
+        return self.futures.reattach(resume_token)
+
+    # ---- future-ledger plumbing (all called with the lock held) ----
+
+    def _expire_token_locked(self, p: _Pending, reason: str) -> None:
+        if self.futures is not None and p.token:
+            self.futures.expire(p.token, reason)
+        p.token = 0
+
+    def _poison_queue_locked(self, lane: _Lane, reason: str) -> None:
+        """Resolve the futures of every host-queued row the caller is
+        about to drop (quarantine / cancel / deadline-budget drains):
+        POISONED, never a hang - the ladder's no-wedge rung."""
+        if self.futures is None:
+            return
+        for p in lane.queue:
+            if p.token:
+                self.futures.poison(p.token, reason)
+                p.token = 0
 
     # ---- failure reporting / isolation ----
 
@@ -617,6 +707,7 @@ class TenantTable:
     def _quarantine_locked(self, lane: _Lane, reason: str) -> None:
         if lane.quarantined is None:
             lane.quarantined = reason
+        self._poison_queue_locked(lane, f"quarantined: {reason}")
         lane.dropped += len(lane.queue)
         lane.queue.clear()
 
@@ -654,6 +745,7 @@ class TenantTable:
         lane = self._lane(tenant)
         lane.scope.cancel(reason)
         with self._lock:
+            self._poison_queue_locked(lane, f"cancelled: {reason}")
             lane.dropped += len(lane.queue)
             lane.queue.clear()
 
@@ -673,6 +765,9 @@ class TenantTable:
                 base = lane.idx * self.region_rows
                 spec = lane.spec
                 if lane.paused() and lane.queue:
+                    self._poison_queue_locked(
+                        lane, lane.quarantined or "cancelled scope"
+                    )
                     lane.dropped += len(lane.queue)
                     lane.queue.clear()
                 # Deadline budget: too many expirations cancels the lane
@@ -687,6 +782,9 @@ class TenantTable:
                         f"({lane.expired} expired >= "
                         f"{spec.deadline_budget})"
                     )
+                    self._poison_queue_locked(
+                        lane, "deadline budget exhausted"
+                    )
                     lane.dropped += len(lane.queue)
                     lane.queue.clear()
                 # Expire published-but-unconsumed rows: mark the ring row
@@ -699,6 +797,9 @@ class TenantTable:
                     ):
                         ring[base + p.index, TEN_EXPIRED] = 1
                         p.marked = True
+                        # The client learns EXPIRED the moment the host
+                        # knows, not when the device sweeps the row.
+                        self._expire_token_locked(p, "deadline (on ring)")
                 # Publish backlog into the region, respecting the
                 # in-flight budget (budget freed as the consume cursor
                 # echoes forward).
@@ -715,6 +816,7 @@ class TenantTable:
                     p = lane.queue.popleft()
                     if p.deadline_at is not None and now >= p.deadline_at:
                         lane.expired_host += 1
+                        self._expire_token_locked(p, "deadline (queued)")
                         continue
                     if spec.validator is not None and not self._validate(
                         lane, p
@@ -751,14 +853,24 @@ class TenantTable:
                 ):
                     continue
                 if isinstance(e, (CancelledError, StallError)):
-                    # Control signals drop the row without poisoning.
+                    # Control signals drop the row without poisoning
+                    # the LANE; its future still resolves POISONED (the
+                    # request will never run - a hang would be worse).
                     lane.dropped += 1
+                    if self.futures is not None and p.token:
+                        self.futures.poison(
+                            p.token, "cancelled in validation"
+                        )
+                        p.token = 0
                     return False
                 # The poisoned row IS a dropped row: counting it keeps
                 # accepted == completed + expired + dropped reconciling
                 # exactly for validator-poisoned lanes too (the storm
                 # soak's per-cut identity).
                 lane.dropped += 1
+                if self.futures is not None and p.token:
+                    self.futures.poison(p.token, f"validator: {e!r}")
+                    p.token = 0
                 self._note_poison_locked(lane)
                 return False
         return False
@@ -781,6 +893,12 @@ class TenantTable:
                     p = lane.pub_meta.popleft()
                     if not p.marked and not swept:
                         lane.latencies.append(now - p.t_submit)
+                    elif swept and self.futures is not None and p.token:
+                        # Device SWEEP of a paused lane: the row was
+                        # consumed without installing - resolve its
+                        # future POISONED so no client waits on it.
+                        self.futures.poison(p.token, "swept (lane paused)")
+                        p.token = 0
                 lane.consumed = new_consumed
                 lane.dev_expired = int(tctl_out[lane.idx, TC_EXPIRED])
                 lane.installed = int(tctl_out[lane.idx, TC_INSTALLED])
@@ -847,6 +965,7 @@ class TenantTable:
                 # Doomed either way; count it now so the conservation
                 # identity holds across the cut.
                 lane.expired_host += 1
+                self._expire_token_locked(p, "deadline (at export)")
                 return
             r = np.array(row, np.int32)
             r[TEN_DEADLINE_MS] = _remaining_ms(p.deadline_at, now)
@@ -881,6 +1000,14 @@ class TenantTable:
             np.stack(rows).astype(np.int32)
             if rows else np.zeros((0, RING_ROW), np.int32)
         )
+        # Everything still pending at the cut - carried residue AND
+        # installed-but-unretired tasks - is preempted: each live future
+        # resolves PREEMPTED carrying a resume token the client feeds to
+        # the successor table's reattach(). Only the table that OWNS its
+        # FutureTable preempts; mesh replicas share the mesh ledger and
+        # the MeshTenantTable preempts once after every replica export.
+        if self.futures is not None and self._owns_futures:
+            self.futures.preempt_all()
         # tenant_ids rides the in-memory state dict so the direct
         # run_stream(resume_state=) path can validate the roster the
         # same way checkpoint.restore_stream's manifest guard does
@@ -957,6 +1084,7 @@ class TenantTable:
                         f"stream has {len(self._lanes)} lanes"
                     )
                 self._lanes[t].queue.append(_readmit_pending(r, now))
+                self._adopt_row_locked(self._lanes[t], r)
             for lane in self._lanes:
                 # The same residue-vs-capacity guard the plain stream
                 # raises: a lane's re-published residue must fit its
@@ -978,6 +1106,17 @@ class TenantTable:
         now = self.clock()
         with self._lock:
             lane.queue.append(_readmit_pending(row, now))
+            self._adopt_row_locked(lane, np.asarray(row))
+
+    def _adopt_row_locked(self, lane: _Lane, r: np.ndarray) -> None:
+        """A residue row stamped with a nonzero TEN_TOKEN re-enters the
+        conservation ledger on the resuming side: the token becomes
+        re-attachable (reattach binds a fresh Future to it)."""
+        if self.futures is not None and int(r[TEN_TOKEN]):
+            self.futures.adopt_row_token(
+                int(r[TEN_TOKEN]), lane.spec.id,
+                int(r[F_FN]), int(r[F_OUT]),
+            )
 
     # ---- telemetry ----
 
@@ -1085,6 +1224,7 @@ class MeshTenantTable:
                  region_rows: int,
                  clock: Callable[[], float] = time.monotonic,
                  placement: Optional[Dict[str, Sequence[int]]] = None,
+                 egress=None, futures: "Optional[FutureTable]" = None,
                  ) -> None:
         self.specs = list(specs)
         if not self.specs:
@@ -1116,8 +1256,27 @@ class MeshTenantTable:
             )
             for s in self.specs
         ]
+        # Completion-mailbox egress: ONE mesh-wide FutureTable shared by
+        # every replica (a future routed to device d must resolve no
+        # matter which successor device retires it after a reshard).
+        # Replicas are built egress=False so an env knob can never make
+        # one privately own a second ledger.
+        self.egress = normalize_egress(egress)
+        if futures is not None and self.egress is None:
+            raise ValueError(
+                "futures= (a shared ledger) needs egress= on too"
+            )
+        # ``futures=`` shares a predecessor mesh's ledger across a
+        # reshard cut (resized() passes it), so PREEMPTED tokens
+        # reattach against the SAME conservation identity.
+        self.futures: Optional[FutureTable] = (
+            futures if futures is not None
+            else None if self.egress is None
+            else FutureTable(backoff_s=self.egress.backoff_s, clock=clock)
+        )
         self.tables: List[TenantTable] = [
-            TenantTable(self._replicas, self.region_rows, clock)
+            TenantTable(self._replicas, self.region_rows, clock,
+                        egress=False, futures=self.futures)
             for _ in range(self.ndev)
         ]
         if placement is not None:
@@ -1504,6 +1663,13 @@ class MeshTenantTable:
                     int(tctl[i, TC_PAUSE]), int(st["tctl"][i, TC_PAUSE])
                 )
                 tctl[i, TC_WEIGHT] = int(st["tctl"][i, TC_WEIGHT])
+        if self.futures is not None:
+            # One mesh-wide preempt AFTER every replica export: the
+            # replicas share this ledger (and never preempt it
+            # themselves), so each export above already expired its
+            # doomed rows and everything still live preempts exactly
+            # once, carrying a resume token for the successor table.
+            self.futures.preempt_all()
         return {
             "ring_rows": rr, "ictl": ictl,
             "tctl": tctl.astype(np.int32),
@@ -1549,7 +1715,8 @@ class MeshTenantTable:
         # path re-feeds the same object every slice) must not count them
         # twice.
         self.tables = [
-            TenantTable(self._replicas, self.region_rows, self.clock)
+            TenantTable(self._replicas, self.region_rows, self.clock,
+                        egress=False, futures=self.futures)
             for _ in range(self.ndev)
         ]
         self._rotor = [0] * T
@@ -1612,6 +1779,7 @@ class MeshTenantTable:
                 tid: [d for d in devs if d < ndev_new] or [0]
                 for tid, devs in self.placement.items()
             },
+            egress=self.egress, futures=self.futures,
         )
 
     def reshard(self, rings: np.ndarray, ndev_new: int
@@ -1624,6 +1792,18 @@ class MeshTenantTable:
         nxt = self.resized(ndev_new)
         nxt.resume_from(st)
         return nxt, st
+
+    def reattach(self, resume_token):
+        """Re-attach a PREEMPTED future on this (successor) mesh: the
+        resume token a FuturePreempted carried binds a fresh Future to
+        the same in-flight request, whose TEN_TOKEN rode the re-dealt
+        residue row (or the etok export for installed tasks)."""
+        if self.futures is None:
+            raise ValueError(
+                "reattach needs an egress-enabled mesh (pass egress= "
+                "or set HCLIB_TPU_EGRESS_DEPTH)"
+            )
+        return self.futures.reattach(resume_token)
 
 
 # ------------------------------------------------------------- plumbing

@@ -73,6 +73,7 @@ __all__ = [
     "TR_TENANT",
     "TR_FIRE_AGE",
     "TR_FIRE_BUCKET",
+    "TR_EGRESS",
     "bucket_occupancy",
     "SC_HOLD",
     "SC_OUT",
@@ -132,6 +133,15 @@ TR_FIRE_BUCKET = 18    # a = (bucket << 16) | take, b = lane F_FN - the
                        # occupancy. Paired with the round's
                        # TR_FIRE_BATCH (same take); bucket_occupancy()
                        # folds these into the per-bucket gauge.
+TR_EGRESS = 19         # a = submit token of the retired row, b = park
+                       # depth after the event - the completion-mailbox
+                       # BACKPRESSURE record (ISSUE 16, egress builds
+                       # only): emitted when retirement finds the
+                       # mailbox full and PARKS the row instead of
+                       # publishing (counted in ectl[EC_PARKED], never
+                       # dropped, never an OVF abort). A publish emits
+                       # nothing: the write-cursor echo already counts
+                       # it, and the hot path stays record-free.
 
 # TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
 SC_HOLD = 0
@@ -178,6 +188,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_TENANT: "tenant",
     TR_FIRE_AGE: "fire_age",
     TR_FIRE_BUCKET: "fire_bucket",
+    TR_EGRESS: "egress_park",
 }
 
 # TR_CREDIT delta codes (b word).

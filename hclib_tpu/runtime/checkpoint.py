@@ -91,10 +91,13 @@ BUNDLE_VERSION = 1
 # state dict keys serialized for every kind (data buffers ride as
 # ``data/<name>`` entries; the stream kind adds ``ring_rows`` - plus the
 # per-tenant ``tctl``/``tstats`` counter blocks when the front door runs
-# tenant lanes - the resident kind adds its exported wait table and -
+# tenant lanes, and the per-row submit-token table ``etok`` when the
+# completion-mailbox egress runs (device/egress.py; tokens of
+# installed-but-unretired rows survive the cut so their futures resolve
+# after resume) - the resident kind adds its exported wait table and -
 # when injecting - the per-device ring residue + cursor words).
 _STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
-_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats")
+_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats", "etok")
 
 # Descriptor-word indices, bound once (descriptor ABI, device/descriptor).
 from ..device.descriptor import (  # noqa: E402

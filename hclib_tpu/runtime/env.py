@@ -152,6 +152,13 @@ REGISTRY = {
            "per-lane in-flight admission budget (whole number)"),
         _v("HCLIB_TPU_TENANT_DEADLINE_S", "float", "unset",
            "per-lane default admission deadline, seconds"),
+        # -- completion-mailbox egress (device/egress.py) --
+        _v("HCLIB_TPU_EGRESS_DEPTH", "int", "0 (off)",
+           "completion-mailbox ring depth, rows; enables submit "
+           "futures on tenant runs (malformed text raises)"),
+        _v("HCLIB_TPU_EGRESS_BACKOFF_S", "float", "0.05",
+           "Future.result() bounded-backoff poll cap, seconds "
+           "(malformed text raises)"),
         # -- native C++ runtime (read by getenv in native/, not here) --
         _v("HCLIB_TPU_AFFINITY", "str", "none",
            "native worker CPU pinning: strided | chunked | none",

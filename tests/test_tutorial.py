@@ -8,11 +8,11 @@ import sys
 import pytest
 
 TUTORIAL = pathlib.Path(__file__).resolve().parent.parent / "tutorial"
-LESSONS = sorted(p.name for p in TUTORIAL.glob("[01][0-9]_*.py"))
+LESSONS = sorted(p.name for p in TUTORIAL.glob("[0-2][0-9]_*.py"))
 
 
 def test_tutorial_is_complete():
-    assert len(LESSONS) == 19
+    assert len(LESSONS) == 20
 
 
 @pytest.mark.parametrize("lesson", LESSONS)

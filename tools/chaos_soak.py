@@ -46,12 +46,24 @@ fire_preempt landing mid-stream with three tenants live, per-tenant
 accepted/completed/residue conserved across the checkpoint/resume cut.
 All four run on the interpret-mode streaming kernel (no Mosaic needed).
 
+``--serve`` adds the seeded SERVING-LOOP scenarios (ISSUE 16): a
+depth-4 completion mailbox under a poller consuming one result per
+step (sustained backpressure parks rows - counted, never dropped - and
+every future still resolves RESULT with its exact payload); fire_preempt
+landing on the live egress-enabled stream with futures in flight (every
+future lands RESULT or PREEMPTED with a valid resume token, the resumed
+stream re-adopts and every reattached future resolves); and a mesh
+deadline storm resharded LIVE 4 -> 2 -> 4 with futures riding every cut,
+closing ``submitted == resolved + expired + poisoned`` EXACTLY, globally
+and per tenant. All three run interpret-mode/host-model (no Mosaic).
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
     python tools/chaos_soak.py --mesh --seeds 1   # device-mesh chaos (CI)
     python tools/chaos_soak.py --preempt-only --seeds 1  # checkpoint (CI)
     python tools/chaos_soak.py --storm-only --seeds 1  # preempt storms (CI)
+    python tools/chaos_soak.py --serve-only --seeds 2  # serving loop (CI)
 
 One JSON line per scenario; a machine-readable summary line last (seed
 base/count, faults injected, recoveries, failures, wall time) so CI and
@@ -1298,6 +1310,261 @@ def scenario_tenant_mesh_autoscale_pressure(seed: int, scale: str) -> dict:
             "recoveries": 1, "events": events}
 
 
+# ------------------- request/response serving loop (ISSUE 16)
+
+def scenario_serve_slow_poller(seed: int, scale: str) -> dict:
+    """SERVE: a depth-4 completion mailbox fed by bursty retirement
+    while the poller consumes ONE result per step - sustained
+    backpressure parks rows (counted, never dropped) and every
+    submitted future still resolves RESULT with its exact payload:
+    zero loss under a poller an order of magnitude too slow."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import RING_ROW, TEN_TOKEN
+    from hclib_tpu.device.egress import EgressSpec, HostMailbox
+    from hclib_tpu.device.tenants import (
+        TenantSpec, TenantTable, wrr_poll_reference,
+    )
+
+    rng = np.random.default_rng(6000 + seed)
+    n = 48 if scale == "smoke" else 192
+    region = 64
+    spec = EgressSpec(depth=4)
+    table = TenantTable(
+        [TenantSpec("gold", weight=2), TenantSpec("std")],
+        region, clock=lambda: 100.0, egress=spec,
+    )
+    # Host-model park capacity covers the whole storm: the DEVICE
+    # bounds park occupancy with its install credit gate; this
+    # reference drive retires whole poll batches at once, so the
+    # ring must hold everything the slow poller leaves behind.
+    box = HostMailbox(spec, park_cap=n)
+    ring = np.zeros((2 * region, RING_ROW), np.int32)
+    futs, values, submitted, drained = [], {}, 0, 0
+    for i in range(n):
+        adm = table.submit(int(rng.integers(0, 2)), 0, args=[i])
+        assert adm and adm.future.token > 0, adm
+        futs.append(adm.future)
+        values[adm.future.token] = 3 * i + 1
+        submitted += 1
+    rnd = 0
+    while drained < submitted:
+        tctl = table.pump(ring)
+        rows = wrr_poll_reference(ring, tctl, region, rnd, 1 << 20)
+        table.absorb(tctl)
+        rnd += 1
+        box.publish([
+            (int(r[TEN_TOKEN]), 0, 0, 0, values[int(r[TEN_TOKEN])])
+            for r in rows
+        ])
+        # The slow poller: one result per step, no matter the burst.
+        drained += len(box.drain(futures=table.futures, limit=1))
+        assert rnd < 16 * n, "slow poller wedged the serve loop"
+    assert box.park_events() > 0, "mailbox never backpressured"
+    assert box.occupancy() == 0 and box.parked() == 0
+    for f in futs:
+        assert f.result(timeout=1.0) == values[f.token]
+        assert f.state == "RESULT"
+    cons = table.futures.conservation()
+    assert cons["ok"] and cons["resolved"] == submitted, cons
+    return {"faults": int(box.park_events()), "recoveries": 1,
+            "submitted": submitted, "park_events":
+            int(box.park_events()), "steps": rnd}
+
+
+def scenario_serve_fire_preempt(seed: int, scale: str) -> dict:
+    """SERVE: fire_preempt lands with futures in flight on the live
+    egress-enabled stream - the cut lands every future in RESULT or
+    PREEMPTED (valid resume token, never a silent hang); the resumed
+    stream re-adopts the tokens and every reattached future resolves.
+    Conservation closes exactly on both ledgers."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.egress import EgressSpec
+    from hclib_tpu.device.tenants import TenantSpec, TenantTable
+    from hclib_tpu.runtime import resilience
+    from hclib_tpu.runtime.checkpoint import checkpoint_on_preempt
+
+    rng = np.random.default_rng(7000 + seed)
+    subs = {t: int(rng.integers(12, 24))
+            for t in ("alpha", "beta", "gamma")}
+
+    def table():
+        return TenantTable(
+            [TenantSpec(t) for t in subs], 256,
+            egress=EgressSpec(depth=16),
+        )
+
+    resilience.reset_preempt()
+    t1 = table()
+    sm = _tenant_sm(t1, checkpoint=True)
+    futs, expect = [], 0
+    for i, (tid, cnt) in enumerate(subs.items()):
+        for _ in range(cnt):
+            adm = sm.submit(tid, 0, args=[i + 1])
+            assert adm and adm.future is not None
+            futs.append(adm.future)
+            expect += i + 1
+
+    def preempter():
+        time.sleep(0.05 + 0.01 * (seed % 3))
+        resilience.fire_preempt(f"serve soak preemption seed {seed}")
+
+    t = threading.Thread(target=preempter)
+    t.start()
+    try:
+        with checkpoint_on_preempt(sm, after_executed=5):
+            iv, info = sm.run_stream(
+                TaskGraphBuilder(), quantum=8, deadline_s=120.0,
+            )
+    finally:
+        t.join()
+        resilience.reset_preempt()
+    assert info.get("quiesced"), "preemption never quiesced the stream"
+    st = info["state"]
+    assert "etok" in st, "egress tokens missing from the snapshot"
+    states = {f.state for f in futs}
+    assert states <= {"RESULT", "PREEMPTED"}, states
+    tokens = []
+    for f in futs:
+        if f.state == "PREEMPTED":
+            tok = f.resume_token
+            assert tok and tok[0] == "hclib-egress-resume", tok
+            tokens.append(tok)
+    c1 = t1.futures.conservation()
+    assert c1["ok"] and c1["preempted"] == len(tokens), c1
+    # Resume on a fresh equivalent stream; reattach AFTER resume_from
+    # has re-adopted the snapshot's tokens.
+    t2 = table()
+    sm2 = _tenant_sm(t2, checkpoint=True)
+    sm2.close()
+    iv2, info2 = sm2.run_stream(resume_state=st, deadline_s=120.0)
+    assert int(iv2[0]) == expect, (int(iv2[0]), expect)
+    for tok in tokens:
+        f = sm2.tenants.reattach(tok)
+        assert f.result(timeout=2.0) is not None
+        assert f.state == "RESULT", f.state
+    c2 = t2.futures.conservation()
+    assert c2["ok"] and c2["pending"] == 0, c2
+    return {"faults": 1, "recoveries": 1,
+            "executed_at_cut": info["executed"],
+            "preempted_futures": len(tokens),
+            "resolved_before_cut": int(c1["resolved"]),
+            **{f"tasks_{t}": c for t, c in subs.items()}}
+
+
+def scenario_serve_mesh_deadline_storm(seed: int, scale: str) -> dict:
+    """SERVE: the soak conservation arm - a 4-device mesh front door
+    under a seeded deadline storm, resharded LIVE 4 -> 2 -> 4 with
+    futures in flight (preempt -> reattach on the shared ledger at
+    every cut). At the end every future is terminal and
+    submitted == resolved + expired + poisoned EXACTLY, globally and
+    per tenant."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import RING_ROW, TEN_TOKEN
+    from hclib_tpu.device.egress import EgressSpec, HostMailbox
+    from hclib_tpu.device.tenants import (
+        MeshTenantTable, TenantSpec, wrr_poll_reference,
+    )
+
+    rng = np.random.default_rng(8000 + seed)
+    region = 16
+    clk = [100.0]
+    spec = EgressSpec(depth=4)
+    table = MeshTenantTable(
+        [TenantSpec("gold", weight=2), TenantSpec("std"),
+         TenantSpec("batch", queue_capacity=512)],
+        4, region, clock=lambda: clk[0], egress=spec,
+    )
+    futures = table.futures
+    assert futures is not None
+    per_batch = 10 if scale == "smoke" else 40
+    # Client view: token -> latest Future (reattach swaps in the new
+    # one); tenant name rides alongside for the per-tenant identity.
+    client = {}
+
+    def drive(table, rings, polls=2, start=0, dt=0.05):
+        boxes = [HostMailbox(spec, park_cap=8 * region)
+                 for _ in range(table.ndev)]
+        tctl = table.pump(rings)
+        for r in range(start, start + polls):
+            for d in range(table.ndev):
+                rows = wrr_poll_reference(
+                    rings[d], tctl[d], table.region_rows, r, 1 << 20
+                )
+                boxes[d].publish([
+                    (int(row[TEN_TOKEN]), 0, 0, 0, 7) for row in rows
+                ])
+        table.absorb(tctl)
+        for box in boxes:
+            box.drain(futures=futures)
+        clk[0] += dt
+
+    def rings_for(ndev):
+        return np.zeros((ndev, 3 * region, RING_ROW), np.int32)
+
+    submitted = 0
+    sizes = [4, 2, 4]
+    rings = rings_for(4)
+    names = ("gold", "std", "batch")
+    for phase, ndev in enumerate(sizes):
+        for i in range(per_batch):
+            tid = names[int(rng.integers(0, 3))]
+            doomed = rng.random() < 0.35
+            adm = table.submit(
+                tid, 0, args=[i],
+                deadline_s=(0.01 if doomed else 600.0),
+            )
+            if adm:
+                submitted += 1
+                client[adm.future.token] = (tid, adm.future)
+            clk[0] += float(rng.random() * 0.02)
+        drive(table, rings, polls=2, start=4 * phase)
+        if phase == len(sizes) - 1:
+            break
+        # The live cut: export preempts in-flight futures; the resized
+        # mesh shares the SAME ledger, so resume tokens reattach.
+        state = table.export_state(rings)
+        tokens = [(tok, tid, f.resume_token)
+                  for tok, (tid, f) in client.items()
+                  if f.state == "PREEMPTED"]
+        nxt = table.resized(sizes[phase + 1])
+        assert nxt.futures is futures, "ledger forked across the cut"
+        nxt.resume_from(state)
+        for tok, tid, rt in tokens:
+            client[tok] = (tid, nxt.reattach(rt))
+        table, rings = nxt, rings_for(nxt.ndev)
+    for r in range(40, 40 + 64):
+        drive(table, rings, polls=1, start=r)
+        if table.drained():
+            break
+    assert table.drained(), "deadline storm wedged the mesh drain"
+    cons = futures.conservation()
+    assert cons["ok"] and cons["pending"] == 0, cons
+    assert submitted == (
+        cons["resolved"] + cons["expired"] + cons["poisoned"]
+    ), (submitted, cons)
+    assert cons["expired"] > 0 and cons["resolved"] > 0, cons
+    assert cons["reattached"] > 0, "no future rode a cut"
+    per = {t: {"RESULT": 0, "EXPIRED": 0, "POISONED": 0}
+           for t in names}
+    for tok, (tid, f) in client.items():
+        assert f.state in per[tid], (tid, f.state)
+        per[tid][f.state] += 1
+    for tid, s in per.items():
+        lane = table.stats()[tid]
+        assert s["RESULT"] + s["EXPIRED"] + s["POISONED"] == (
+            lane["accepted"]
+        ), (tid, s, lane)
+    return {"faults": int(cons["expired"]), "recoveries": 2,
+            "submitted": submitted, "resolved": int(cons["resolved"]),
+            "expired": int(cons["expired"]),
+            "reattached": int(cons["reattached"]),
+            "per_tenant": {t: s for t, s in per.items()}}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -1335,6 +1602,12 @@ TENANT_SCENARIOS = [
      scenario_tenant_mesh_autoscale_pressure),
 ]
 
+SERVE_SCENARIOS = [
+    ("serve_slow_poller", scenario_serve_slow_poller),
+    ("serve_fire_preempt", scenario_serve_fire_preempt),
+    ("serve_mesh_deadline_storm", scenario_serve_mesh_deadline_storm),
+]
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -1367,6 +1640,14 @@ def main(argv=None) -> int:
                          "reconciliation, preempt with 3 tenants live)")
     ap.add_argument("--tenants-only", action="store_true",
                     help="run ONLY the multi-tenant ingress scenarios")
+    ap.add_argument("--serve", action="store_true",
+                    help="add the seeded serving-loop scenarios "
+                         "(slow poller vs mailbox backpressure, "
+                         "fire_preempt with futures in flight, mesh "
+                         "deadline storm with live 4->2->4 reshards "
+                         "and exact future conservation)")
+    ap.add_argument("--serve-only", action="store_true",
+                    help="run ONLY the serving-loop scenarios")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -1382,7 +1663,7 @@ def main(argv=None) -> int:
     scenarios = (
         []
         if (args.mesh_only or args.preempt_only or args.storm_only
-            or args.tenants_only)
+            or args.tenants_only or args.serve_only)
         else list(SCENARIOS)
     )
     if args.mesh or args.mesh_only:
@@ -1393,6 +1674,8 @@ def main(argv=None) -> int:
         scenarios += STORM_SCENARIOS
     if args.tenants or args.tenants_only:
         scenarios += TENANT_SCENARIOS
+    if args.serve or args.serve_only:
+        scenarios += SERVE_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

@@ -468,6 +468,15 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                     name += f" ({b} expired)"
                 span(_TID_TENANTS, "tenant ingress", t, 0.5, name,
                      {"lane": lane, "installed": inst, "expired": b})
+            elif tag == tb.TR_EGRESS:
+                # A retired row parked on a full completion mailbox
+                # (explicit backpressure, never loss): the submit token
+                # and the park ring occupancy after the park, on the
+                # events track so egress pressure reads off the
+                # timeline next to the installs that caused it.
+                span(_TID_EVENTS, "events", t, 0.5,
+                     f"egress park x{b}",
+                     {"token": a, "parked": b})
             elif tag == tb.TR_SCALE:
                 # Autoscaler decision (host-emitted ring, slice index as
                 # timebase): label resizes with their mesh arrow so the
