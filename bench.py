@@ -803,6 +803,71 @@ def bench_checkpoint():
         lambda: cholesky_buffers(a, nt),
     )
 
+    # Durable-store arms (ISSUE 17). Schema under out["store"]:
+    #   publish_fsync_s / publish_nofsync_s - median save() wall time
+    #     (stage + hash + atomic rename [+ fsync]) for the UTS bundle;
+    #   cold_load_clean_s - load_latest() on a healthy 3-gen store;
+    #   cold_load_healing_s - load_latest() with the 2 NEWEST gens
+    #     corrupt (2 quarantine moves + sha walk before the valid gen);
+    #   bundle_bytes - the payload all arms move.
+    # Every arm logs its own line as it lands, so a timeout kill
+    # (rc=124) still leaves the completed numbers in the transcript.
+    import shutil
+
+    from hclib_tpu.runtime.checkpoint import BundleStore
+
+    mk = make_uts_megakernel(checkpoint=True)
+    _, _, info_q = mk.run(uts_builder(), quiesce=8)
+    bundle = snapshot_megakernel(mk, info_q)
+    store_row = {}
+
+    def publish(fsync, trials=5):
+        times = []
+        for _ in range(trials):
+            d = tempfile.mkdtemp(prefix="hclib-bench-store-")
+            st = BundleStore(d, keep=3, fsync=fsync)
+            t0 = time.perf_counter()
+            st.save(bundle)
+            times.append(time.perf_counter() - t0)
+            shutil.rmtree(d, ignore_errors=True)
+        return round(sorted(times)[len(times) // 2], 4)
+
+    store_row["publish_fsync_s"] = publish(True)
+    store_row["publish_nofsync_s"] = publish(False)
+    log(f"store publish: {store_row['publish_fsync_s'] * 1e3:.1f} ms "
+        f"fsync'd / {store_row['publish_nofsync_s'] * 1e3:.1f} ms fast "
+        f"(atomic-rename generational save)")
+
+    def cold_load(corrupt_newest):
+        d = tempfile.mkdtemp(prefix="hclib-bench-store-")
+        st = BundleStore(d, keep=3, fsync=False)
+        for _ in range(3):
+            st.save(bundle)
+        for g in st.generations()[-corrupt_newest:] if corrupt_newest else []:
+            npz = os.path.join(st.path_of(g), "state.npz")
+            blob = open(npz, "rb").read()
+            with open(npz, "wb") as f:
+                f.write(blob[:-4] + b"\xff" * 4)
+        reader = BundleStore(d, fsync=False)
+        t0 = time.perf_counter()
+        got = reader.load_latest()
+        dt = time.perf_counter() - t0
+        assert len(reader.faults) == corrupt_newest
+        assert got.diff(bundle)["equal"]
+        shutil.rmtree(d, ignore_errors=True)
+        return round(dt, 4)
+
+    store_row["cold_load_clean_s"] = cold_load(0)
+    store_row["cold_load_healing_s"] = cold_load(2)
+    stats = bundle.save(tempfile.mkdtemp(prefix="hclib-bench-store-"))
+    store_row["bundle_bytes"] = stats["bundle_bytes"]
+    out["store"] = store_row
+    log(f"store cold load_latest: "
+        f"{store_row['cold_load_clean_s'] * 1e3:.1f} ms clean / "
+        f"{store_row['cold_load_healing_s'] * 1e3:.1f} ms healing past "
+        f"2 quarantined generations "
+        f"({store_row['bundle_bytes'] / 1024:.0f} KiB bundle)")
+
     logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
     os.makedirs(logdir, exist_ok=True)
     path = os.path.join(logdir, f"{int(time.time())}.checkpoint.json")
@@ -1725,7 +1790,9 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--checkpoint", action="store_true",
         help="also measure checkpoint/restore cost (quiesce latency + "
-        "bundle size for UTS and Cholesky) into perf-logs/ "
+        "bundle size for UTS and Cholesky) plus the durable-store arms "
+        "(save-publish latency fsync'd/fast, cold load_latest clean and "
+        "healing past 2 quarantined generations) into perf-logs/ "
         "(budget-gated like the other sections)",
     )
     ap.add_argument(

@@ -20,6 +20,8 @@ from .runtime import (  # noqa: F401
     RECURSIVE,
     Autoscaler,
     AutoscalerPolicy,
+    BundleFault,
+    BundleStore,
     CancelScope,
     CancelledError,
     CheckpointBundle,
@@ -55,6 +57,7 @@ from .runtime import (  # noqa: F401
     current_finish,
     current_runtime,
     current_worker,
+    default_store,
     end_finish,
     end_finish_nonblocking,
     finish,

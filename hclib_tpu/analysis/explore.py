@@ -53,6 +53,7 @@ from .findings import ERROR, AnalysisReport
 
 __all__ = [
     "Action",
+    "BundleStoreModel",
     "CreditExchangeModel",
     "EgressMailboxModel",
     "ExploreResult",
@@ -565,6 +566,112 @@ class EgressMailboxModel:
         return out
 
 
+class BundleStoreModel:
+    """The durable checkpoint store's publish protocol
+    (``runtime/checkpoint.BundleStore.save``, ISSUE 17) as a model:
+    a saver stages a generation member-by-member (npz blob, then
+    manifest, then the atomic rename that publishes), a crash can land
+    between ANY two steps, and concurrent ``load_latest`` readers walk
+    the published generations at any point. The property the curated
+    config proves: under the shipped ordering - rename LAST, after
+    every member is staged - no schedule exposes a partial generation
+    to a reader; a crash leaves only whole generations (or none), so
+    the self-healing walk always has a valid newest to land on.
+
+    ``publish_before_manifest=True`` plants the torn-publish bug (the
+    rename lands before the manifest is written): a reader interleaved
+    into that window observes a manifest-less generation, and the
+    exploration returns the concrete save/crash/read prefix that
+    exposes it - the seeded fixture for the durability soak's
+    crash-point matrix.
+
+    State: (saves_done, stage, gens_complete, gens_partial, crashed,
+    reads_done, exposed). ``stage`` walks one save: 0 idle, 1 npz
+    staged, 2 manifest staged (shipped ordering) or published-torn
+    (bug ordering).
+    """
+
+    def __init__(self, saves: int = 2, crash: bool = True,
+                 max_reads: int = 2,
+                 publish_before_manifest: bool = False) -> None:
+        self.saves = int(saves)
+        self.crash = bool(crash)
+        self.max_reads = int(max_reads)
+        self.publish_before_manifest = bool(publish_before_manifest)
+
+    def initial(self) -> Tuple:
+        return (0, 0, 0, 0, 0, 0, 0)
+
+    def enabled(self, state) -> List[Action]:
+        saves, stage, _gc, _gp, crashed, reads, _exp = state
+        out: List[Action] = []
+        saving = not crashed and saves < self.saves
+        if saving:
+            out.append(("step",))
+            if self.crash:
+                out.append(("crash",))
+        if reads < self.max_reads:
+            out.append(("read",))
+        return out
+
+    def apply(self, state, action) -> Tuple:
+        saves, stage, gc, gp, crashed, reads, exp = state
+        kind = action[0]
+        if kind == "crash":
+            # Whatever was staged (stage 1/2) dies invisible - EXCEPT a
+            # bug-ordering torn publish (counted in gens_partial), which
+            # a crash leaves ON DISK for every later reader to trip on.
+            return (saves, 0, gc, gp, 1, reads, exp)
+        if kind == "read":
+            # load_latest walks the published dirs: a partial
+            # generation on disk right now is an exposure.
+            return (saves, stage, gc, gp, crashed, reads + 1,
+                    exp or (1 if gp else 0))
+        # step: advance the in-flight save one member.
+        if stage == 0:
+            return (saves, 1, gc, gp, crashed, reads, exp)  # npz staged
+        if stage == 1:
+            if self.publish_before_manifest:
+                # BUG ordering: rename lands now, manifest still unwritten.
+                return (saves, 2, gc, gp + 1, crashed, reads, exp)
+            return (saves, 2, gc, gp, crashed, reads, exp)  # manifest
+        # stage == 2: the final member. Shipped ordering: fsync +
+        # atomic rename publishes a WHOLE generation; bug ordering: the
+        # late manifest completes the prematurely-published one.
+        if self.publish_before_manifest:
+            return (saves + 1, 0, gc + 1, gp - 1, crashed, reads, exp)
+        return (saves + 1, 0, gc + 1, gp, crashed, reads, exp)
+
+    def footprint(self, action) -> FrozenSet[str]:
+        return {
+            "step": frozenset({"store"}),
+            "crash": frozenset({"saver"}),
+            "read": frozenset({"store"}),
+        }[action[0]]
+
+    def check_final(self, state) -> List[str]:
+        saves, stage, gc, gp, crashed, _reads, exp = state
+        out: List[str] = []
+        if exp:
+            out.append(
+                "durable-store: a schedule exposed a partial generation "
+                "to load_latest (published before its manifest landed) - "
+                "the rename-LAST publish ordering is violated"
+            )
+        if crashed and gp:
+            out.append(
+                f"durable-store: crash left {gp} torn generation(s) "
+                "visible on disk - every restart pays a quarantine for "
+                "a save that never completed"
+            )
+        if not crashed and (saves < self.saves or stage):
+            out.append(
+                f"durable-store: saver wedged at {saves}/{self.saves} "
+                f"publish(es), stage {stage}"
+            )
+        return out
+
+
 # ------------------------------------------------------------ curated
 
 
@@ -619,6 +726,13 @@ def check_protocols(report: Optional[AnalysisReport] = None,
                 # interleaving drains to resolved == seeded.
                 "egress-mailbox(slow poller, drain)",
                 EgressMailboxModel(rows=3, depth=1, poller=True),
+            ),
+            (
+                # Two staged publishes, a crash between any two member
+                # writes, concurrent load_latest readers: no schedule
+                # may expose a partial generation (rename is LAST).
+                "bundle-store(crash x concurrent load)",
+                BundleStoreModel(saves=2, crash=True, max_reads=2),
             ),
         ]
     for label, model in configs:

@@ -84,6 +84,12 @@ __all__ = [
     "SC_DEADLINE_OUT",
     "SC_STRAND_HOLD",
     "SC_NAMES",
+    "CK_SAVE",
+    "CK_LOAD",
+    "CK_FALLBACK",
+    "CK_QUARANTINE",
+    "CK_POISON",
+    "CK_NAMES",
     "host_trace_info",
     "TAG_NAMES",
 ]
@@ -155,6 +161,17 @@ SC_DEADLINE_OUT = 6   # tenant deadline-pressure scale-out (no gates:
 SC_STRAND_HOLD = 7    # scale-in refused: it would strand a tenant's
                       # in-flight quota / ring residue
 
+# TR_CKPT store subcodes (the durable BundleStore, runtime/checkpoint
+# .py): host-emitted records ride the TR_CKPT tag with a NEGATIVE a
+# word - ``a = -(1 + CK_code)`` - so they can never collide with the
+# device export records, whose a word is a pending-row count (>= 0);
+# the b word is the store generation the event acted on.
+CK_SAVE = 0        # a generation published (staged, fsync'd, renamed)
+CK_LOAD = 1        # a generation validated and loaded
+CK_FALLBACK = 2    # load_latest fell back past >= 1 bad generation
+CK_QUARANTINE = 3  # a torn/corrupt/mismatched generation set aside
+CK_POISON = 4      # no generation validates: the store is unrecoverable
+
 # The ONE name table for SC_* codes: runtime/autoscaler.py derives its
 # kind->code map from it and tools/timeline.py labels TR_SCALE spans
 # with it, so a new kind is one edit here, not three drifting copies.
@@ -167,6 +184,17 @@ SC_NAMES: Dict[int, str] = {
     SC_FINISH: "finish",
     SC_DEADLINE_OUT: "deadline out",
     SC_STRAND_HOLD: "strand hold",
+}
+
+# The ONE name table for CK_* codes - runtime/checkpoint.py's
+# BundleStore emits them and tools/timeline.py labels the store spans
+# from this table, the SC_NAMES discipline exactly.
+CK_NAMES: Dict[int, str] = {
+    CK_SAVE: "store save",
+    CK_LOAD: "store load",
+    CK_FALLBACK: "store fallback",
+    CK_QUARANTINE: "store quarantine",
+    CK_POISON: "store poison",
 }
 
 TAG_NAMES: Dict[int, str] = {

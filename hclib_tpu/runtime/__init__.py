@@ -23,9 +23,12 @@ from .autoscaler import (
     ScaleEvent,
 )
 from .checkpoint import (
+    BundleFault,
+    BundleStore,
     CheckpointBundle,
     CheckpointError,
     checkpoint_on_preempt,
+    default_store,
     restore_megakernel,
     restore_resident,
     restore_stream,

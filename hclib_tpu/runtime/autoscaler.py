@@ -63,6 +63,7 @@ import numpy as np
 
 from . import resilience
 from .checkpoint import (
+    BundleStore,
     CheckpointBundle,
     CheckpointError,
     snapshot_resident,
@@ -546,10 +547,11 @@ class Autoscaler:
     (the quiesced state is saved there when a preemption notice arrives
     between slices).
 
-    A resize the bundle refuses (per-device data buffers, pending
-    waits, an overfull target) downgrades to a hold - the mesh keeps
-    serving on its current size and resize attempts back off for
-    ``policy.cooldown`` slices - instead of killing the loop.
+    A resize the bundle refuses (per-device data buffers, waits whose
+    satisfier sits in unexported host residue, an overfull target)
+    downgrades to a hold - the mesh keeps serving on its current size
+    and resize attempts back off for ``policy.cooldown`` slices -
+    instead of killing the loop.
 
     No controller thread: the loop runs on the calling thread, slicing
     the mesh via quiesce - the off-path (not using this class) is
@@ -573,7 +575,14 @@ class Autoscaler:
         self.slice_rounds = int(slice_rounds)
         self.max_slices = int(max_slices)
         self.metrics = metrics
+        if checkpoint_dir is None:
+            # The env-configured store root arms the preemption path
+            # without a code change (HCLIB_TPU_CKPT_DIR).
+            from .env import env_str
+
+            checkpoint_dir = env_str("HCLIB_TPU_CKPT_DIR")
         self.checkpoint_dir = checkpoint_dir
+        self._store: Optional[BundleStore] = None
         self.events: List[ScaleEvent] = []
         self.ndev: Optional[int] = None
         self.quarantined: frozenset = frozenset()
@@ -638,6 +647,17 @@ class Autoscaler:
             self._kernels[key] = rk
         return rk
 
+    def _bundle_store(self) -> Optional[BundleStore]:
+        """The durable store rooted at ``checkpoint_dir`` (lazily built
+        so an unused dir knob costs nothing): the preempt hook WRITES
+        THROUGH it - generational publish, crash-safe, retention-pruned
+        - instead of scattering loose timestamped bundle dirs."""
+        if self._store is None and self.checkpoint_dir:
+            self._store = BundleStore(
+                self.checkpoint_dir, metrics=self.metrics
+            )
+        return self._store
+
     def _event(self, ev: ScaleEvent) -> ScaleEvent:
         self.events.append(ev)
         self._t1_ns = time.monotonic_ns()
@@ -678,9 +698,11 @@ class Autoscaler:
         tenant_table=None,
     ):
         """Serve ``builders`` (one per starting device) - or continue a
-        saved ``resume_bundle`` (a resident CheckpointBundle or path) -
-        to completion under the policy. Returns ``(ivalues, data, info)``
-        of the final slice, with ``info['scale_events']`` (every typed
+        saved ``resume_bundle`` (a resident CheckpointBundle, a bundle
+        dir, a ``BundleStore`` - or a store ROOT dir, walked with the
+        self-healing ``load_latest``) - to completion under the policy.
+        Returns ``(ivalues, data, info)`` of the final slice, with
+        ``info['scale_events']`` (every typed
         decision) and ``info['ndev_final']`` attached; a preemption
         notice instead returns early with ``info['preempted'] = True``
         and (with ``checkpoint_dir``) ``info['bundle_path']``.
@@ -708,11 +730,26 @@ class Autoscaler:
         if run_base == 0:
             self._t0_ns = time.monotonic_ns()
         if resume_bundle is not None:
-            b = (
-                resume_bundle
-                if isinstance(resume_bundle, CheckpointBundle)
-                else CheckpointBundle.load(resume_bundle)
-            )
+            if isinstance(resume_bundle, CheckpointBundle):
+                b = resume_bundle
+            elif isinstance(resume_bundle, BundleStore):
+                # Self-healing restore: the newest generation that
+                # validates (corrupt ones quarantined); unrecoverable
+                # stores raise so the caller poisons futures instead
+                # of hanging.
+                b = resume_bundle.load_latest()
+            elif isinstance(resume_bundle, str) and not os.path.exists(
+                os.path.join(resume_bundle, "manifest.json")
+            ):
+                # A directory that is not itself a bundle is a STORE
+                # root (what checkpoint_dir now writes): walk its
+                # generations. Covers empty/missing dirs too - the
+                # store raises its every-fault diagnostic.
+                b = BundleStore(
+                    resume_bundle, metrics=self.metrics
+                ).load_latest()
+            else:
+                b = CheckpointBundle.load(resume_bundle)
             if b.kind != "resident":
                 raise CheckpointError(
                     f"Autoscaler.run got a {b.kind!r} bundle"
@@ -727,7 +764,7 @@ class Autoscaler:
                     ndev = target
                 except CheckpointError:
                     # The bundle cannot legally re-home into the policy
-                    # band (data buffers, pending waits, overfull
+                    # band (data buffers, host-residue waits, overfull
                     # target): resume at its original size and let the
                     # policy resize later, instead of dying at restart.
                     pass
@@ -789,12 +826,11 @@ class Autoscaler:
                 # now holding the WHOLE autoscaled deployment.
                 bundle = snapshot_resident(rk, info)
                 path = None
-                if self.checkpoint_dir:
-                    path = os.path.join(
-                        self.checkpoint_dir,
-                        f"autoscale-{int(time.time())}-s{slice_idx}",
-                    )
-                    bundle.save(path, metrics=self.metrics)
+                store = self._bundle_store()
+                if store is not None:
+                    gen = store.save(bundle)
+                    path = store.path_of(gen)
+                    info["bundle_generation"] = gen
                 self._event(ScaleEvent(
                     "checkpoint", slice_idx, rk.ndev, rk.ndev,
                     "preemption notice: checkpointed and stopped",

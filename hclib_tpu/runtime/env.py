@@ -105,6 +105,16 @@ REGISTRY = {
         _v("HCLIB_TPU_AUTOSCALE_TENANT_PRESSURE", "float", "0.25",
            "deadline-budget drain fraction per slice that triggers an "
            "immediate deadline_out scale-out (malformed text raises)"),
+        # -- durable checkpoint store (runtime/checkpoint.py) --
+        _v("HCLIB_TPU_CKPT_DIR", "str", "unset",
+           "BundleStore root directory: default_store() and the "
+           "autoscaler's preempt hook write generations under it"),
+        _v("HCLIB_TPU_CKPT_KEEP", "int", "3",
+           "BundleStore retention: generations kept after each "
+           "publish (>= 1; malformed text raises)"),
+        _v("HCLIB_TPU_CKPT_FSYNC", "bool", "on",
+           "fsync bundle members and directories at publish "
+           "(0 = fast mode for tests; crash-safety not guaranteed)"),
         # -- device megakernel (device/megakernel.py) --
         _v("HCLIB_TPU_TRACE", "int", "0 (off)",
            "flight-recorder ring capacity (1 = default capacity)"),

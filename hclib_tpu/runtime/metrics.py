@@ -40,7 +40,21 @@ import threading
 import time
 from typing import Any, Callable, Dict, Mapping, Optional
 
-__all__ = ["MetricsRegistry"]
+__all__ = ["MetricsRegistry", "CHECKPOINT_EVENTS"]
+
+# The canonical durable-store event series (runtime/checkpoint.py's
+# BundleStore records one ``record_event`` per store action, so each
+# exports as ``<name>.count`` plus ``<name>.last.*``): saves published,
+# generations validated+loaded, restores that fell back past a bad
+# generation, and generations quarantined. Dashboards alert on
+# ``checkpoint.quarantined.count`` rising - a quarantine is never
+# silent - and rate() the save/load pair for store traffic.
+CHECKPOINT_EVENTS = (
+    "checkpoint.save",
+    "checkpoint.load",
+    "checkpoint.fallback",
+    "checkpoint.quarantined",
+)
 
 
 def _is_num(v: Any) -> bool:

@@ -621,6 +621,23 @@ class FaultPlan:
     - procworld: rank ``peer_crash_rank``'s progress engine suffers a
       fatal ``InjectedFault`` once it has applied ``peer_crash_after``
       ops, exercising tombstones + reply poisoning on its peers.
+    - disk (the durable checkpoint store, runtime/checkpoint.py - one
+      hook call per ``BundleStore`` save/restore, so the per-site
+      counters ARE the store's save/restore ordinals):
+
+      * ``disk_torn`` - the ``state.npz`` blob is truncated at a
+        seeded byte k before it lands (a torn write the sha256 check
+        must catch);
+      * ``disk_flip`` - one seeded bit of the blob flips (bit rot);
+      * ``disk_manifest`` - the manifest goes missing entirely or is
+        truncated mid-JSON (seeded coin);
+      * ``preempt_save_at=n`` - the n-th store save dies with
+        ``InjectedFault`` AFTER staging but BEFORE the atomic publish
+        (the preempt-mid-save crash point: the generation must never
+        become visible);
+      * ``preempt_restore_at=n`` - the n-th ``load_latest`` dies
+        before touching any generation (preempt-mid-restore: a retry
+        must find the store unchanged).
 
     Every decision is a pure function of ``(seed, site, n)``, so the
     decision table - and therefore ``trace``, the list of faults that
@@ -640,6 +657,14 @@ class FaultPlan:
         kill_worker_after: int = 100,
         peer_crash_rank: Optional[int] = None,
         peer_crash_after: int = 0,
+        disk_torn_rate: float = 0.0,
+        disk_torn_at: Sequence[int] = (),
+        disk_flip_rate: float = 0.0,
+        disk_flip_at: Sequence[int] = (),
+        disk_manifest_rate: float = 0.0,
+        disk_manifest_at: Sequence[int] = (),
+        preempt_save_at: Optional[int] = None,
+        preempt_restore_at: Optional[int] = None,
     ) -> None:
         self.seed = int(seed)
         self.task_failure_rate = float(task_failure_rate)
@@ -650,6 +675,19 @@ class FaultPlan:
         self.kill_worker_after = int(kill_worker_after)
         self.peer_crash_rank = peer_crash_rank
         self.peer_crash_after = int(peer_crash_after)
+        self.disk_torn_rate = float(disk_torn_rate)
+        self.disk_torn_at = tuple(int(n) for n in disk_torn_at)
+        self.disk_flip_rate = float(disk_flip_rate)
+        self.disk_flip_at = tuple(int(n) for n in disk_flip_at)
+        self.disk_manifest_rate = float(disk_manifest_rate)
+        self.disk_manifest_at = tuple(int(n) for n in disk_manifest_at)
+        self.preempt_save_at = (
+            None if preempt_save_at is None else int(preempt_save_at)
+        )
+        self.preempt_restore_at = (
+            None if preempt_restore_at is None
+            else int(preempt_restore_at)
+        )
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._fired: Set[Tuple[str, int]] = set()
@@ -724,6 +762,81 @@ class FaultPlan:
             self._fired.add(key)
             self.trace.append(key)
         return True
+
+    # -- durable-store hooks (runtime/checkpoint.py BundleStore) --
+
+    def _fires(self, site: str, rate: float,
+               at: Sequence[int], n: int) -> bool:
+        return n in at or (
+            rate > 0.0 and _hash01(self.seed, site, n) < rate
+        )
+
+    def on_bundle_blob(self, blob: bytes) -> bytes:
+        """Called with each store save's serialized ``state.npz`` bytes
+        before they land on disk; may tear (truncate at a seeded byte)
+        or flip one seeded bit. Both corruptions publish - they model
+        latent media faults the sha256 validation must quarantine on
+        the NEXT load, not crashes (those are ``preempt_save_at``)."""
+        n = self._next("disk")
+        if self._fires("disk-torn", self.disk_torn_rate,
+                       self.disk_torn_at, n):
+            k = 1 + int(
+                _hash01(self.seed, "disk-torn-k", n) * max(1, len(blob) - 1)
+            )
+            with self._lock:
+                self.trace.append(("disk-torn", n))
+            return blob[:k]
+        if self._fires("disk-flip", self.disk_flip_rate,
+                       self.disk_flip_at, n):
+            k = int(_hash01(self.seed, "disk-flip-k", n) * len(blob))
+            bit = int(_hash01(self.seed, "disk-flip-b", n) * 8)
+            with self._lock:
+                self.trace.append(("disk-flip", n))
+            return blob[:k] + bytes([blob[k] ^ (1 << bit)]) + blob[k + 1:]
+        return blob
+
+    def on_manifest_text(self, text: str) -> Optional[str]:
+        """Called with each store save's manifest JSON; may truncate it
+        mid-document or drop it entirely (returns None) - the
+        missing/unreadable-manifest fault the self-healing restore
+        walks past."""
+        n = self._next("manifest")
+        if self._fires("disk-manifest", self.disk_manifest_rate,
+                       self.disk_manifest_at, n):
+            with self._lock:
+                self.trace.append(("disk-manifest", n))
+            if _hash01(self.seed, "disk-manifest-kind", n) < 0.5:
+                return None
+            return text[: max(1, len(text) // 2)]
+        return text
+
+    def on_store_publish(self) -> None:
+        """Called once per store save, after staging but before the
+        atomic rename; raising here simulates a preemption landing
+        mid-save - the staged generation must never become visible."""
+        n = self._next("publish")
+        if self.preempt_save_at is not None and n == self.preempt_save_at:
+            with self._lock:
+                self.trace.append(("preempt-save", n))
+            raise InjectedFault(
+                f"chaos: preempt mid-save (store save #{n}, staged but "
+                "unpublished)"
+            )
+
+    def on_store_restore(self) -> None:
+        """Called once per ``load_latest``, before any generation is
+        touched; raising simulates preempt-mid-restore - a retry must
+        see the store unchanged (restores never mutate generations)."""
+        n = self._next("restore")
+        if (
+            self.preempt_restore_at is not None
+            and n == self.preempt_restore_at
+        ):
+            with self._lock:
+                self.trace.append(("preempt-restore", n))
+            raise InjectedFault(
+                f"chaos: preempt mid-restore (load_latest call #{n})"
+            )
 
     # -- reproducibility --
 

@@ -15,6 +15,8 @@ forgot-the-park-ring bug the explorer must find. Off-path: an
 egress-off build lowers to the exact text an env-free build lowers to,
 even with the egress env knobs set."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -548,6 +550,82 @@ def test_mesh_serve_conservation_across_4_2_4_reshards():
     cons = futures.conservation()
     assert cons["ok"], cons
     assert cons["pending"] == 0, cons
+    assert submitted == (
+        cons["resolved"] + cons["expired"] + cons["poisoned"]
+    ), (submitted, cons)
+
+
+def test_mesh_serve_fallback_restore_reattaches_futures(tmp_path):
+    """DURABLE STORE x SERVING: a mesh export rides a CheckpointBundle
+    into a generational BundleStore; the newest generation is then
+    bit-flipped on disk. load_latest self-heals (quarantine + fallback
+    to the older valid save of the SAME cut), the table resumes from
+    the fallback arrays, preempted futures reattach, and the serving
+    ledger's conservation identity still closes exactly."""
+    from hclib_tpu.device.descriptor import RING_ROW, TEN_TOKEN
+    from hclib_tpu.device.tenants import wrr_poll_reference
+    from hclib_tpu.runtime.checkpoint import BundleStore, CheckpointBundle
+
+    region = 16
+    clk = [100.0]
+    spec = EgressSpec(depth=4)
+    table = MeshTenantTable(
+        [TenantSpec("gold", weight=2), TenantSpec("std")], 2, region,
+        clock=lambda: clk[0], egress=spec,
+    )
+    futures = table.futures
+    rings = np.zeros((2, 2 * region, RING_ROW), np.int32)
+    submitted = 0
+    live = []
+    for i in range(8):
+        adm = table.submit(i % 2, BUMP, args=[i], deadline_s=600.0)
+        if adm:
+            submitted += 1
+            live.append(adm.future)
+    # the cut: export preempts in-flight futures, bundle -> store x2.
+    state = table.export_state(rings)
+    tokens = [f.resume_token for f in live if f.state == "PREEMPTED"]
+    assert tokens, "expected in-flight futures at the cut"
+    store = BundleStore(str(tmp_path / "store"), keep=3, fsync=False)
+    bundle = CheckpointBundle(
+        "resident", {"schema": "mesh-serve-export"}, state
+    )
+    store.save(bundle)
+    store.save(bundle)
+    npz = os.path.join(store.path_of(2), "state.npz")
+    blob = open(npz, "rb").read()
+    with open(npz, "wb") as f:
+        f.write(blob[:12] + bytes([blob[12] ^ 0x40]) + blob[13:])
+    healer = BundleStore(str(tmp_path / "store"), fsync=False)
+    back = healer.load_latest()
+    assert back.generation == 1, "fallback to the older valid save"
+    assert [f.reason for f in healer.faults] == ["corrupt"]
+    # resume from the FALLBACK arrays, reattach, drive to the drain.
+    nxt = table.resized(2)
+    assert nxt.futures is futures
+    nxt.resume_from({k: back.arrays[k] for k in state})
+    for tok in tokens:
+        f = nxt.reattach(tok)
+        assert f.state == "PENDING"
+    boxes = [HostMailbox(spec) for _ in range(2)]
+    for r in range(40):
+        tctl = nxt.pump(rings)
+        for d in range(2):
+            rows = wrr_poll_reference(
+                rings[d], tctl[d], nxt.region_rows, r, 1 << 20
+            )
+            boxes[d].publish([
+                (int(row[TEN_TOKEN]), 0, BUMP, 0, 7) for row in rows
+            ])
+        nxt.absorb(tctl)
+        for box in boxes:
+            box.drain(futures=futures)
+        if nxt.drained():
+            break
+    cons = futures.conservation()
+    assert cons["ok"], cons
+    assert cons["pending"] == 0, cons
+    assert cons["reattached"] == len(tokens), cons
     assert submitted == (
         cons["resolved"] + cons["expired"] + cons["poisoned"]
     ), (submitted, cons)

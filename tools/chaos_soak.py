@@ -57,6 +57,17 @@ deadline storm resharded LIVE 4 -> 2 -> 4 with futures riding every cut,
 closing ``submitted == resolved + expired + poisoned`` EXACTLY, globally
 and per tenant. All three run interpret-mode/host-model (no Mosaic).
 
+``--durability`` adds the seeded DURABLE-STORE scenarios (ISSUE 17): the
+crash-point matrix over the generational ``BundleStore`` - torn npz,
+flipped bit, lost manifest, preempt mid-save, preempt mid-restore, and a
+fully-damaged store - proving bit-identical resume from the newest valid
+generation with typed quarantines (and the poison diagnostic when none
+survives); plus the serving loop restored THROUGH a fallback (newest
+generation damaged on disk) with futures reattached and the ledger
+closing exactly, and the reshard wait re-homing algebra (counts and
+per-channel need sums conserved 4 -> 2 -> 4; satisfier-in-residue
+refused whole-program). Both host-model (no Mosaic).
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
@@ -1565,6 +1576,357 @@ def scenario_serve_mesh_deadline_storm(seed: int, scale: str) -> dict:
             "per_tenant": {t: s for t, s in per.items()}}
 
 
+def _durability_bundle(seed: int, ndev: int = 4, cap: int = 16,
+                       live: int = 3, parked=(), channels=("left", "right"),
+                       host_residue=None, max_waits: int = 4):
+    """Schema-complete synthetic resident bundle (the durability matrix
+    exercises the STORE and the reshard algebra, not the kernel):
+    ``live`` ready link-free rows per device, optional wait-parked rows
+    (``parked``: (device, channel, need) triples), seeded ivalues so
+    two bundles of different seeds are bit-distinguishable."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import (
+        DESC_WORDS, F_DEP, F_FN, F_HOME, F_SUCC0, F_SUCC1, NO_TASK,
+    )
+    from hclib_tpu.device.megakernel import C_ALLOC, C_PENDING, C_VALLOC
+    from hclib_tpu.runtime.checkpoint import CheckpointBundle
+
+    rng = np.random.default_rng(seed)
+    tasks = np.zeros((ndev, cap, DESC_WORDS), np.int32)
+    tasks[:, :, F_SUCC0] = NO_TASK
+    tasks[:, :, F_SUCC1] = NO_TASK
+    tasks[:, :, F_HOME] = -1
+    ready = np.full((ndev, cap), NO_TASK, np.int32)
+    counts = np.zeros((ndev, 8), np.int32)
+    waits = np.zeros((ndev, max_waits + 1, 3), np.int32)
+    for d in range(ndev):
+        for i in range(live):
+            tasks[d, i, F_FN] = 1
+            ready[d, i] = i
+        npk = 0
+        for (pd, ch, need) in parked:
+            if pd != d:
+                continue
+            slot = live + npk
+            tasks[d, slot, F_FN] = 2
+            tasks[d, slot, F_DEP] = 1
+            w = int(waits[d, 0, 0])
+            waits[d, 1 + w] = (ch, need, slot)
+            waits[d, 0, 0] = w + 1
+            npk += 1
+        counts[d, 1] = live  # ready-ring tail
+        counts[d, C_ALLOC] = live + npk
+        counts[d, C_PENDING] = live + npk
+        counts[d, C_VALLOC] = 2
+    meta = {
+        "kernel_names": ["seed", "waiter"], "capacity": cap,
+        "num_values": 4, "succ_capacity": 4, "data_specs": [],
+        "ndev": ndev, "channels": list(channels),
+    }
+    if host_residue:
+        meta["host_residue"] = dict(host_residue)
+    return CheckpointBundle("resident", meta, {
+        "tasks": tasks,
+        "succ": np.full((ndev, 4), NO_TASK, np.int32),
+        "ready": ready, "counts": counts,
+        "ivalues": rng.integers(0, 1 << 20, (ndev, 4)).astype(np.int32),
+        "waits": waits,
+    })
+
+
+def scenario_durability_crashpoints(seed: int, scale: str) -> dict:
+    """DURABILITY: the seeded crash-point matrix over the BundleStore -
+    clean generational publishes reload bit-identically; a torn npz, a
+    flipped bit, and a lost manifest (FaultPlan disk sites) each
+    quarantine that generation with the right typed reason and fall
+    back bit-identically to the newest valid one; preempt-mid-save
+    leaves the store at its previous state (a staged save is never
+    visible); preempt-mid-restore retries idempotently; and an
+    unrecoverable store raises the poison diagnostic (naming every
+    fault) instead of hanging. Metrics counters and TR_CKPT trace
+    records are asserted alongside."""
+    import shutil
+    import tempfile
+
+    from hclib_tpu.device import tracebuf as tb
+    from hclib_tpu.runtime.checkpoint import BundleStore, CheckpointError
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+    from hclib_tpu.runtime.resilience import FaultPlan, InjectedFault
+
+    rounds = 3 if scale == "smoke" else 8
+    faults = recoveries = 0
+    root = tempfile.mkdtemp(prefix="hclib-durability-")
+    try:
+        metrics = MetricsRegistry()
+        # Clean generational publishes, retention, bit-identical reload.
+        store = BundleStore(root, keep=3, fsync=False, metrics=metrics)
+        bundles = []
+        for i in range(rounds):
+            b = _durability_bundle(1000 * seed + i)
+            store.save(b)
+            bundles.append(b)
+        gens = store.generations()
+        assert len(gens) == min(rounds, 3) and gens[-1] == rounds, gens
+        got = BundleStore(root, keep=3, fsync=False).load_latest()
+        assert got.diff(bundles[-1])["equal"], "clean reload diverged"
+
+        # Every disk damage class at a seeded crash point: the damaged
+        # generation publishes, the next restore quarantines it (typed)
+        # and falls back bit-identically to the previous generation.
+        for kind, plan_kw, reason in (
+            ("torn", {"disk_torn_at": (0,)}, "corrupt"),
+            ("flip", {"disk_flip_at": (0,)}, "corrupt"),
+            ("manifest", {"disk_manifest_at": (0,)}, "torn"),
+        ):
+            plan = FaultPlan(seed=seed, **plan_kw)
+            writer = BundleStore(root, keep=4, fsync=False,
+                                 metrics=metrics, fault_plan=plan)
+            gen = writer.save(_durability_bundle(9000 * seed + len(kind)))
+            faults += 1
+            healer = BundleStore(root, keep=4, fsync=False,
+                                 metrics=metrics)
+            back = healer.load_latest()
+            assert back.diff(bundles[-1])["equal"], (
+                kind, "fallback not bit-identical")
+            assert [f.generation for f in healer.faults] == [gen], (
+                kind, healer.faults)
+            assert healer.faults[0].reason == reason, (
+                kind, healer.faults[0])
+            assert all(
+                r[0] == tb.TR_CKPT and (-int(r[2]) - 1) in tb.CK_NAMES
+                for r in healer.events
+            ), healer.events
+            recoveries += 1
+
+        # Preempt mid-save: the InjectedFault lands BEFORE the rename,
+        # so the staged generation is invisible and the store unmoved.
+        before = BundleStore(root, fsync=False).generations()
+        plan = FaultPlan(seed=seed, preempt_save_at=0)
+        writer = BundleStore(root, keep=4, fsync=False, fault_plan=plan)
+        try:
+            writer.save(_durability_bundle(31 * seed + 7))
+            raise AssertionError("preempt-mid-save never fired")
+        except InjectedFault:
+            faults += 1
+        after = BundleStore(root, keep=4, fsync=False)
+        assert after.generations() == before, "a torn save became visible"
+        assert after.load_latest().diff(bundles[-1])["equal"]
+        recoveries += 1
+
+        # Preempt mid-restore: the retry is idempotent (same survivor).
+        plan = FaultPlan(seed=seed, preempt_restore_at=0)
+        reader = BundleStore(root, keep=4, fsync=False, fault_plan=plan)
+        try:
+            reader.load_latest()
+            raise AssertionError("preempt-mid-restore never fired")
+        except InjectedFault:
+            faults += 1
+        assert reader.load_latest().diff(bundles[-1])["equal"]
+        recoveries += 1
+
+        # Unrecoverable: every generation damaged -> the poison
+        # diagnostic names each fault; the caller's degradation ladder
+        # gets a signal instead of a hang.
+        dead = BundleStore(root, keep=4, fsync=False, metrics=metrics)
+        for g in dead.generations():
+            npz = os.path.join(dead.path_of(g), "state.npz")
+            with open(npz, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(npz) // 2))
+            faults += 1
+        try:
+            dead.load_latest()
+            raise AssertionError("unrecoverable store did not raise")
+        except CheckpointError as e:
+            assert "unrecoverable" in str(e) and "poison" in str(e), e
+        recoveries += 1
+
+        m = metrics.snapshot()["metrics"]
+        assert m.get("checkpoint.save.count", 0) >= rounds + 3, m
+        assert m.get("checkpoint.quarantined.count", 0) >= 3, m
+        assert m.get("checkpoint.fallback.count", 0) >= 3, m
+        assert m.get("checkpoint.poison.count", 0) >= 1, m
+        return {"faults": faults, "recoveries": recoveries,
+                "generations": rounds,
+                "quarantined": int(m["checkpoint.quarantined.count"])}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def scenario_durability_serve_fallback(seed: int, scale: str) -> dict:
+    """DURABILITY: fallback restore under the serving loop - the
+    deadline-storm mesh (4 devices, 3 tenants, futures in flight) cuts
+    at 4 -> 2, the exported state is published TWICE to a BundleStore,
+    the newest generation is then bit-flipped on disk, and the resume
+    path restores through ``load_latest`` - which quarantines the
+    damaged generation and falls back to the older, bit-identical one.
+    Futures reattach onto the restored table, the 2 -> 4 resize rides
+    the live path, and the serving ledger closes EXACTLY:
+    submitted == resolved + expired + poisoned. Alongside, the reshard
+    wait re-homing algebra: a bundle with pending host-declared waits
+    reshards 4 -> 2 -> 4 with wait counts and per-channel need sums
+    conserved, and a satisfier-in-residue bundle is refused with the
+    whole-program diagnostic."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import RING_ROW, TEN_TOKEN
+    from hclib_tpu.device.egress import EgressSpec, HostMailbox
+    from hclib_tpu.device.tenants import (
+        MeshTenantTable, TenantSpec, wrr_poll_reference,
+    )
+    from hclib_tpu.runtime.checkpoint import (
+        BundleStore, CheckpointBundle, CheckpointError,
+    )
+
+    rng = np.random.default_rng(8600 + seed)
+    region = 16
+    clk = [100.0]
+    spec = EgressSpec(depth=4)
+    table = MeshTenantTable(
+        [TenantSpec("gold", weight=2), TenantSpec("std"),
+         TenantSpec("batch", queue_capacity=512)],
+        4, region, clock=lambda: clk[0], egress=spec,
+    )
+    futures = table.futures
+    assert futures is not None
+    per_batch = 10 if scale == "smoke" else 30
+    client = {}
+
+    def drive(table, rings, polls=2, start=0):
+        boxes = [HostMailbox(spec, park_cap=8 * region)
+                 for _ in range(table.ndev)]
+        tctl = table.pump(rings)
+        for r in range(start, start + polls):
+            for d in range(table.ndev):
+                rows = wrr_poll_reference(
+                    rings[d], tctl[d], table.region_rows, r, 1 << 20
+                )
+                boxes[d].publish([
+                    (int(row[TEN_TOKEN]), 0, 0, 0, 7) for row in rows
+                ])
+        table.absorb(tctl)
+        for box in boxes:
+            box.drain(futures=futures)
+        clk[0] += 0.05
+
+    def rings_for(ndev):
+        return np.zeros((ndev, 3 * region, RING_ROW), np.int32)
+
+    submitted = 0
+    sizes = [4, 2, 4]
+    rings = rings_for(4)
+    names = ("gold", "std", "batch")
+    root = tempfile.mkdtemp(prefix="hclib-serve-fallback-")
+    try:
+        for phase, ndev in enumerate(sizes):
+            for i in range(per_batch):
+                tid = names[int(rng.integers(0, 3))]
+                doomed = rng.random() < 0.3
+                adm = table.submit(
+                    tid, 0, args=[i],
+                    deadline_s=(0.01 if doomed else 600.0),
+                )
+                if adm:
+                    submitted += 1
+                    client[adm.future.token] = (tid, adm.future)
+                clk[0] += float(rng.random() * 0.02)
+            drive(table, rings, polls=2, start=4 * phase)
+            if phase == len(sizes) - 1:
+                break
+            # A pre-cut burst with generous deadlines: futures that are
+            # GUARANTEED live at the export, so every cut exercises the
+            # preempt -> reattach path regardless of the seed's storm.
+            for j, tid in enumerate(names):
+                adm = table.submit(tid, 0, args=[1000 + j],
+                                   deadline_s=600.0)
+                if adm:
+                    submitted += 1
+                    client[adm.future.token] = (tid, adm.future)
+            state = table.export_state(rings)
+            tokens = [(tok, tid, f.resume_token)
+                      for tok, (tid, f) in client.items()
+                      if f.state == "PREEMPTED"]
+            if phase == 0:
+                # The durable cut: publish the exported state TWICE,
+                # damage the newest generation on disk, and restore
+                # through the self-healing walk - the fallback must be
+                # bit-identical to what was exported.
+                bundle = CheckpointBundle(
+                    "resident", {"schema": "mesh-serve-export"}, state,
+                )
+                store = BundleStore(root, keep=3, fsync=False)
+                store.save(bundle)
+                gen2 = store.save(bundle)
+                npz = os.path.join(store.path_of(gen2), "state.npz")
+                with open(npz, "r+b") as f:
+                    f.seek(12)
+                    byte = f.read(1)
+                    f.seek(12)
+                    f.write(bytes([byte[0] ^ 0x40]))
+                healer = BundleStore(root, keep=3, fsync=False)
+                back = healer.load_latest()
+                assert [f.generation for f in healer.faults] == [gen2], (
+                    healer.faults)
+                assert back.diff(bundle)["equal"], (
+                    "fallback generation not bit-identical")
+                state = {k: back.arrays[k] for k in state}
+            nxt = table.resized(sizes[phase + 1])
+            assert nxt.futures is futures, "ledger forked across the cut"
+            nxt.resume_from(state)
+            for tok, tid, rt in tokens:
+                client[tok] = (tid, nxt.reattach(rt))
+            table, rings = nxt, rings_for(nxt.ndev)
+        for r in range(40, 40 + 64):
+            drive(table, rings, polls=1, start=r)
+            if table.drained():
+                break
+        assert table.drained(), "fallback restore wedged the mesh drain"
+        cons = futures.conservation()
+        assert cons["ok"] and cons["pending"] == 0, cons
+        assert submitted == (
+            cons["resolved"] + cons["expired"] + cons["poisoned"]
+        ), (submitted, cons)
+        assert cons["reattached"] > 0, "no future rode the fallback cut"
+
+        # Reshard wait re-homing algebra (the checkpoint tentpole):
+        # counts and per-channel need sums conserved 4 -> 2 -> 4; a
+        # satisfier-in-residue bundle refused whole-program.
+        wb = _durability_bundle(
+            77 * seed + 5,
+            parked=[(0, 0, 3), (1, 1, 2), (2, 0, 1), (3, 1, 4)],
+        )
+        w0 = int(np.asarray(wb.arrays["waits"])[:, 0, 0].sum())
+        down = wb.reshard(2)
+        up = down.reshard(4)
+        for b2 in (down, up):
+            arr = np.asarray(b2.arrays["waits"])
+            assert int(arr[:, 0, 0].sum()) == w0, (w0, arr[:, 0, 0])
+        from hclib_tpu.device.megakernel import C_PENDING
+
+        assert int(up.arrays["counts"][:, C_PENDING].sum()) == int(
+            wb.arrays["counts"][:, C_PENDING].sum()
+        )
+        rb = _durability_bundle(
+            78 * seed, parked=[(0, 0, 3)],
+            host_residue={"left": 2},
+        )
+        try:
+            rb.reshard(2)
+            raise AssertionError("residue refusal never fired")
+        except CheckpointError as e:
+            assert "host residue" in str(e) and "left" in str(e), e
+        return {"faults": int(cons["expired"]) + 1, "recoveries": 3,
+                "submitted": submitted,
+                "resolved": int(cons["resolved"]),
+                "reattached": int(cons["reattached"]),
+                "rehomed_waits": w0}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -1608,6 +1970,11 @@ SERVE_SCENARIOS = [
     ("serve_mesh_deadline_storm", scenario_serve_mesh_deadline_storm),
 ]
 
+DURABILITY_SCENARIOS = [
+    ("durability_crashpoints", scenario_durability_crashpoints),
+    ("durability_serve_fallback", scenario_durability_serve_fallback),
+]
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -1648,6 +2015,16 @@ def main(argv=None) -> int:
                          "and exact future conservation)")
     ap.add_argument("--serve-only", action="store_true",
                     help="run ONLY the serving-loop scenarios")
+    ap.add_argument("--durability", action="store_true",
+                    help="add the seeded durable-store scenarios "
+                         "(crash-point matrix over the BundleStore: "
+                         "torn/flipped/lost members quarantined with "
+                         "bit-identical fallback, preempt mid-save/"
+                         "mid-restore, serving-ledger conservation "
+                         "across a fallback restore, reshard wait "
+                         "re-homing algebra)")
+    ap.add_argument("--durability-only", action="store_true",
+                    help="run ONLY the durable-store scenarios")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -1663,7 +2040,8 @@ def main(argv=None) -> int:
     scenarios = (
         []
         if (args.mesh_only or args.preempt_only or args.storm_only
-            or args.tenants_only or args.serve_only)
+            or args.tenants_only or args.serve_only
+            or args.durability_only)
         else list(SCENARIOS)
     )
     if args.mesh or args.mesh_only:
@@ -1676,6 +2054,8 @@ def main(argv=None) -> int:
         scenarios += TENANT_SCENARIOS
     if args.serve or args.serve_only:
         scenarios += SERVE_SCENARIOS
+    if args.durability or args.durability_only:
+        scenarios += DURABILITY_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

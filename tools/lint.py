@@ -185,16 +185,18 @@ TIMELINE = os.path.join("tools", "timeline.py")
 # Structural constants sharing the tag prefixes but not record tags.
 _TAG_EXEMPT = {"TR_WORDS"}
 # Tag/code families and the name table each must key into (TR_* record
-# tags; SC_* scale kinds; CR_* credit deltas; FLT_* fault codes; FS_*
-# reserved for fault-stats words if they ever move tracebuf-side).
+# tags; SC_* scale kinds; CR_* credit deltas; FLT_* fault codes; CK_*
+# checkpoint-store subcodes; FS_* reserved for fault-stats words if
+# they ever move tracebuf-side).
 _TAG_TABLES = {
     "TR_": "TAG_NAMES",
     "SC_": "SC_NAMES",
     "CR_": "CR_NAMES",
     "FLT_": "FLT_NAMES",
+    "CK_": "CK_NAMES",
     "FS_": "FS_NAMES",
 }
-_TAG_RE = re.compile(r"^(TR|SC|CR|FLT|FS)_[A-Z][A-Z0-9_]*$")
+_TAG_RE = re.compile(r"^(TR|SC|CR|FLT|CK|FS)_[A-Z][A-Z0-9_]*$")
 
 
 def check_trace_tables(repo: str) -> List[Tuple[str, int, str]]:

@@ -428,6 +428,17 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                 span(_TID_EVENTS, "events", t, 0.25, "quiesce",
                      {"at": a})
             elif tag == tb.TR_CKPT:
+                if a < 0:
+                    # Durable-store event (BundleStore, host-emitted):
+                    # a = -(1 + CK_code) keys the CK_NAMES table and b
+                    # is the generation acted on - save/load/fallback/
+                    # quarantine/poison land on the events track beside
+                    # the device export brackets.
+                    code = -int(a) - 1
+                    name = tb.CK_NAMES.get(code, f"ckpt<{code}>")
+                    span(_TID_EVENTS, "events", t, 0.5, name,
+                         {"generation": b})
+                    continue
                 # The checkpoint bracket: quiesce observation -> state
                 # export, rendered as one span so the drain cost (lane
                 # spills, wire settling on the mesh) is readable at a

@@ -1,0 +1,199 @@
+"""Lesson 21: durable checkpoints - the crash-safe generational store.
+
+Lesson 8 saved ONE `CheckpointBundle` to ONE directory. That is enough
+for a demo and exactly wrong for production: a preemption can land
+mid-save (a torn artifact is now your only copy), disks flip bits, and
+an operator wants to roll back a bad generation without archaeology.
+This lesson is `runtime/checkpoint.BundleStore` - the durability layer
+the autoscaler's preempt rung writes through.
+
+- **Crash-safe publish**: `store.save(bundle)` stages the whole
+  `gen-NNNNNN` directory under a temp name, fsyncs, and publishes with
+  a single atomic rename; the `CURRENT` pointer moves LAST. A crash at
+  ANY instant leaves either the old store or the new one - never a
+  half-written generation. The ordering is model-checked:
+  `analysis/explore.py`'s `BundleStoreModel` explores every
+  save x crash x concurrent-load interleaving and proves no schedule
+  exposes a partial generation (and catches the planted
+  publish-before-manifest bug if you flip the ordering).
+- **Self-healing restore**: `load_latest()` walks generations
+  newest-first, validates each (magic, version, kernel table, sha256
+  of the npz), and QUARANTINES anything torn or corrupt into
+  `root/quarantine/` with a typed `BundleFault` - then resumes from
+  the newest generation that validates. Only a store with NO valid
+  generation raises, naming every fault, so outstanding serving
+  futures poison through the degradation ladder instead of wedging.
+- **Bounded retention**: `keep=K` (default 3, `HCLIB_TPU_CKPT_KEEP`)
+  prunes the oldest generations at publish; the store never grows
+  without bound.
+- **Reshard with pending waits**: exported wait tables now RE-HOME
+  across mesh sizes - needs are rebased to arrivals-since-entry at
+  export, so `reshard(M)` re-deals parked rows with their wait entries
+  re-pointed, conserving wait counts and per-channel need sums. The
+  one refusal left: a wait whose *satisfier* sits in unexported host
+  residue (`meta['host_residue']`).
+
+Env spelling for wrapper scripts: `HCLIB_TPU_CKPT_DIR` (roots
+`hc.default_store()`), `HCLIB_TPU_CKPT_KEEP`, `HCLIB_TPU_CKPT_FSYNC=0`
+(trade durability for publish latency, e.g. under a test harness).
+`tools/chaos_soak.py --durability` soaks the whole crash-point matrix.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from hclib_tpu.device.descriptor import (  # noqa: E402
+    DESC_WORDS,
+    F_DEP,
+    F_FN,
+    F_HOME,
+    NO_TASK,
+)
+from hclib_tpu.runtime.checkpoint import (  # noqa: E402
+    BundleStore,
+    CheckpointBundle,
+    CheckpointError,
+)
+from hclib_tpu.runtime.metrics import MetricsRegistry  # noqa: E402
+
+
+def _bundle(seed, ndev=4, cap=8, live=2, parked=(), residue=None):
+    """A hand-built resident bundle (same shape the mesh exports):
+    ``live`` ready link-free rows per device plus optional wait-parked
+    rows - each ``parked`` triple (device, channel, need) parks a row
+    carrying one dep bump with its entry in the exported wait table."""
+    tasks = np.zeros((ndev, cap, DESC_WORDS), np.int32)
+    tasks[:, :, 2:4] = NO_TASK
+    tasks[:, :, F_HOME] = NO_TASK
+    ready = np.full((ndev, cap), NO_TASK, np.int32)
+    counts = np.zeros((ndev, 8), np.int32)
+    waits = np.zeros((ndev, 5, 3), np.int32)
+    for d in range(ndev):
+        for i in range(live):
+            tasks[d, i, F_FN] = 1
+            ready[d, i] = i
+        npk = 0
+        for (pd, ch, need) in parked:
+            if pd != d:
+                continue
+            slot = live + npk
+            tasks[d, slot, F_FN] = 2
+            tasks[d, slot, F_DEP] = 1
+            w = int(waits[d, 0, 0])
+            waits[d, 1 + w] = (ch, need, slot)
+            waits[d, 0, 0] = w + 1
+            npk += 1
+        counts[d, 1] = live
+        counts[d, 2] = counts[d, 3] = live + npk
+        counts[d, 4] = 2
+    meta = {"ndev": ndev, "channels": ["left", "right"]}
+    if residue:
+        meta["host_residue"] = dict(residue)
+    rng = np.random.default_rng(seed)
+    return CheckpointBundle("resident", meta, {
+        "tasks": tasks,
+        "succ": np.full((ndev, 8), -1, np.int32),
+        "ready": ready, "counts": counts,
+        "ivalues": rng.integers(0, 1 << 20, (ndev, 16)).astype(np.int32),
+        "waits": waits,
+    })
+
+
+def part_one_generations(root):
+    """Publish is atomic; retention is bounded; reload is exact."""
+    reg = MetricsRegistry()
+    store = BundleStore(root, keep=3, fsync=False, metrics=reg)
+    bundles = [_bundle(seed=i) for i in range(5)]
+    for b in bundles:
+        store.save(b)
+    assert store.generations() == [3, 4, 5], "keep=3 pruned gens 1-2"
+    back = store.load_latest()
+    assert back.generation == 5
+    assert back.diff(bundles[-1])["equal"], "bit-identical reload"
+    m = reg.snapshot()["metrics"]
+    assert m["checkpoint.save.count"] == 5
+    print(f"  5 saves -> generations {store.generations()} (keep=3), "
+          f"load_latest() == newest save bit-exactly")
+
+
+def part_two_self_healing(root):
+    """Corrupt the newest generation on disk; the store heals itself."""
+    npz = os.path.join(root, "gen-%06d" % 5, "state.npz")
+    blob = open(npz, "rb").read()
+    with open(npz, "wb") as f:  # one flipped bit, mid-payload
+        f.write(blob[:64] + bytes([blob[64] ^ 0x10]) + blob[65:])
+    healer = BundleStore(root, keep=3, fsync=False)
+    back = healer.load_latest()
+    assert back.generation == 4, "fell back to the newest VALID gen"
+    (fault,) = healer.faults
+    assert fault.generation == 5 and fault.reason == "corrupt"
+    assert os.path.isdir(fault.path) and "quarantine" in fault.path
+    assert healer.generations() == [3, 4], "bad gen moved aside"
+    print(f"  flipped one bit in gen 5: quarantined as "
+          f"{fault.reason!r}, resumed from gen {back.generation}")
+    return back
+
+
+def part_three_unrecoverable(root):
+    """A store with NO valid generation raises - poison, don't wedge."""
+    for g in BundleStore(root, fsync=False).generations():
+        os.remove(os.path.join(root, "gen-%06d" % g, "manifest.json"))
+    try:
+        BundleStore(root, fsync=False).load_latest()
+    except CheckpointError as e:
+        assert "unrecoverable" in str(e) and "poison" in str(e)
+        print("  all manifests gone: load_latest raises the poison "
+              "diagnostic (futures fail fast through the ladder)")
+    else:
+        raise AssertionError("expected CheckpointError")
+
+
+def part_four_reshard_waits():
+    """Pending waits re-home across mesh sizes; only satisfier-in-
+    residue refuses - with one whole-program diagnostic."""
+    parked = [(0, 0, 3), (1, 1, 2), (2, 0, 1), (3, 1, 4)]
+    b = _bundle(seed=9, parked=parked)
+
+    def needs(waits):
+        acc = {}
+        for d in range(waits.shape[0]):
+            for i in range(int(waits[d, 0, 0])):
+                ch, need, _ = (int(x) for x in waits[d, 1 + i])
+                acc[ch] = acc.get(ch, 0) + need
+        return acc
+
+    want = needs(b.arrays["waits"])
+    for m in (2, 8):
+        out = b.reshard(m)
+        w = np.asarray(out.arrays["waits"])
+        assert int(w[:, 0, 0].sum()) == len(parked)
+        assert needs(w) == want, "per-channel need sums conserved"
+    bad = _bundle(seed=9, parked=parked, residue={"left": 2})
+    try:
+        bad.reshard(2)
+    except CheckpointError as e:
+        assert "host residue" in str(e) and "'left'" in str(e)
+        print(f"  4 waits re-home onto 2 and 8 devices (needs {want} "
+              f"conserved); satisfier-in-residue refuses by name")
+    else:
+        raise AssertionError("expected the residue refusal")
+
+
+if __name__ == "__main__":
+    root = tempfile.mkdtemp(prefix="hclib-lesson21-")
+    try:
+        part_one_generations(root)
+        part_two_self_healing(root)
+        part_three_unrecoverable(root)
+        part_four_reshard_waits()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print("lesson 21 OK")
