@@ -131,6 +131,7 @@ class ICIStealMegakernel:
         self.window = int(window)
         self.scan = int(scan) if scan is not None else 2 * self.window
         self._jitted: Dict[Any, Any] = {}
+        self._pc_stats: Optional[Dict[str, Any]] = None
         # Power-of-two meshes delegate to the unified resident kernel
         # (device/resident.py) in its steal-only, whole-row-migration
         # configuration - this class remains the non-pof2 fallback (and
@@ -825,7 +826,17 @@ class ICIStealMegakernel:
             return iv_o, data_o, info
         key = (quantum, max_rounds)
         if key not in self._jitted:
-            self._jitted[key] = self._build(quantum, max_rounds)
+            from ..runtime.progcache import mesh_key, shared_build
+
+            variant = (
+                "ici", mesh_key(self.mesh),
+                tuple(sorted(self.migratable_fns)), self.window,
+                self.scan,
+            ) + key
+            self._jitted[key], self._pc_stats = shared_build(
+                self.mk, variant,
+                lambda: self._build(quantum, max_rounds),
+            )
         from .sharded import abort_words
 
         abort_arr = abort_words(abort, self.ndev)
@@ -835,6 +846,8 @@ class ICIStealMegakernel:
             data, ivalues, with_rounds=True, extra_inputs=[abort_arr],
         )
         t1_ns = time.monotonic_ns()
+        if self._pc_stats is not None:
+            info["program_cache"] = dict(self._pc_stats)
         tail = info.pop("extra_outputs", None)
         if self.mk.trace is not None and tail:
             info["trace"] = trace_info(

@@ -192,6 +192,7 @@ class StreamingMegakernel:
             self.tenants.egress if self.tenants is not None else None
         )
         self._jitted: Dict[Any, Any] = {}
+        self._pc_stats: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._pending_rows: List[np.ndarray] = []
         self._closed = False
@@ -1151,7 +1152,20 @@ class StreamingMegakernel:
             raise ValueError("data buffers != declared data_specs")
         key = (quantum, max_rounds)
         if key not in self._jitted:
-            self._jitted[key] = self._build(quantum, max_rounds)
+            from ..runtime.progcache import shared_build
+
+            # Only facts the stream compiles into the program key the
+            # variant: tenant count / region rows / egress ring depth.
+            # WRR weights and rate limits ride tctl at runtime.
+            variant = (
+                "stream", self.ring_capacity,
+                None if self.tenants is None
+                else (len(self.tenants), self.tenants.region_rows),
+                None if self._egress is None else self._egress.depth,
+            ) + key
+            self._jitted[key], self._pc_stats = shared_build(
+                mk, variant, lambda: self._build(quantum, max_rounds),
+            )
         jitted = self._jitted[key]
 
         data_np = [np.asarray(data[k]) for k in mk.data_specs.keys()]
@@ -1350,6 +1364,8 @@ class StreamingMegakernel:
                         "data": dict(zip(mk.data_specs.keys(), data_np)),
                     },
                 }
+                if self._pc_stats is not None:
+                    info["program_cache"] = dict(self._pc_stats)
                 if table is not None:
                     # Per-tenant residue (tenant-tagged rows) + the
                     # cumulative tctl/tstats counter blocks: resume_from
@@ -1399,6 +1415,8 @@ class StreamingMegakernel:
                         else injected
                     ),
                 }
+                if self._pc_stats is not None:
+                    info["program_cache"] = dict(self._pc_stats)
                 if table is not None:
                     info["tenants"] = table.stats()
                 if mk.trace is not None and trace_row is not None:

@@ -1006,6 +1006,11 @@ class Megakernel:
         # continuation transfer (dead writes otherwise - skipped).
         self.tracks_home = False
         self._jitted: Dict[int, Any] = {}  # fuel -> compiled call
+        # Last shared_build stats ({hit, build_s, cache_lookup_s}) for
+        # this instance's most recent program build - surfaced as
+        # info['program_cache'] (and the tiers timing gauges) so every
+        # run reports what its program cost to obtain.
+        self._pc_stats: Optional[Dict[str, Any]] = None
         # Last run()'s info dict (incl. the batched-tier counters), for
         # stats_dict() consumers that don't thread the return value.
         self._last_info: Optional[Dict[str, Any]] = None
@@ -2190,7 +2195,13 @@ class Megakernel:
         )
 
     def _build(self, fuel: int, reps: int = 1):
-        return jax.jit(self._build_raw(fuel, reps))
+        from ..runtime.progcache import shared_build
+
+        fn, self._pc_stats = shared_build(
+            self, ("megakernel-build", fuel, reps),
+            lambda: jax.jit(self._build_raw(fuel, reps)),
+        )
+        return fn
 
     def decode_tier_stats(self, tstats) -> Dict[str, Any]:
         """Decode the raw TS_WORDS counter row into the per-tier stats dict
@@ -2334,9 +2345,21 @@ class Megakernel:
                 "word is compiled into the round loop only then"
             )
         key = (fuel, bool(stage_all_values))
-        if key not in self._jitted:
-            self._jitted[key] = jax.jit(
-                self._build_raw(fuel, stage_all_values=stage_all_values)
+        first_build = key not in self._jitted
+        if first_build:
+            # Process-wide program cache (runtime/progcache.py): a
+            # content-identical program built by ANY instance this
+            # process is reused here - the returned callable is the
+            # same jitted object, so its first call skips trace/lower
+            # entirely. The per-instance dict stays as the L1 (repeat
+            # runs on one instance never pay fingerprinting).
+            from ..runtime.progcache import shared_build
+
+            self._jitted[key], self._pc_stats = shared_build(
+                self, ("megakernel-exec",) + key,
+                lambda: jax.jit(
+                    self._build_raw(fuel, stage_all_values=stage_all_values)
+                ),
             )
         jitted = self._jitted[key]
         import contextlib
@@ -2384,6 +2407,14 @@ class Megakernel:
             packs.append(outs[off_out])
         packed = np.asarray(self._packer(*packs))
         t1_ns = _time.monotonic_ns()
+        if first_build and self._pc_stats is not None:
+            if not self._pc_stats["hit"]:
+                # jax.jit is lazy: the trace/lower/compile this cache
+                # exists to skip is paid inside the first entry, so a
+                # MISS folds that first wall (compile + one execution)
+                # into build_s; a hit's first entry rides the already-
+                # traced callable and keeps build_s = 0.
+                self._pc_stats["build_s"] += (t1_ns - t0_ns) / 1e9
         counts_np = packed[:8]
         ivalues_np = packed[8 : 8 + self.num_values]
         info = {
@@ -2393,11 +2424,28 @@ class Megakernel:
             "value_alloc": int(counts_np[C_VALLOC]),
             "overflow": bool(counts_np[C_OVERFLOW]),
         }
+        if self._pc_stats is not None:
+            # How this run's program was obtained (the build that
+            # produced the executable, not this entry): cache hit flag
+            # plus build_s vs cache_lookup_s - the trade the program
+            # cache exists to win. Mirrored into the tier gauges below
+            # so MetricsRegistry.add_run_info exports it beside
+            # lane_occupancy.
+            info["program_cache"] = dict(self._pc_stats)
         off = 8 + self.num_values
         if self.batch_specs:
             info["tiers"] = self.decode_tier_stats(
                 packed[off : off + TS_WORDS]
             )
+            if self._pc_stats is not None:
+                # Host-side build-cost gauges ride the tier dict (the
+                # add_run_info export path). Cross-arm tier equality
+                # tests compare device counters only - these two keys
+                # are wall-clock noise by nature.
+                info["tiers"]["build_s"] = self._pc_stats["build_s"]
+                info["tiers"]["cache_lookup_s"] = (
+                    self._pc_stats["cache_lookup_s"]
+                )
             off += TS_WORDS
         quiesced = False
         if self.checkpoint:

@@ -175,6 +175,7 @@ class PGASMegakernel:
         self.ST_DATA = 4 + self.ndev * self.ndev  # [dst * nchan + chan]
         self.S = self.ST_DATA + self.ndev * self.nchan
         self._jitted: Dict[Any, Any] = {}
+        self._pc_stats: Optional[Dict[str, Any]] = None
 
     # -- the kernel --
 
@@ -803,7 +804,16 @@ class PGASMegakernel:
 
         key = (quantum, max_rounds)
         if key not in self._jitted:
-            self._jitted[key] = self._build(quantum, max_rounds)
+            from ..runtime.progcache import mesh_key, shared_build
+
+            variant = (
+                "pgas", mesh_key(self.mesh), tuple(self.channels),
+                self.am_window, self.outbox, self.max_waits,
+            ) + key
+            self._jitted[key], self._pc_stats = shared_build(
+                mk, variant,
+                lambda: self._build(quantum, max_rounds),
+            )
         from .sharded import abort_words
 
         abort_arr = abort_words(abort, ndev)
@@ -816,6 +826,8 @@ class PGASMegakernel:
             extra_inputs=[waits_arr, abort_arr],
         )
         t1_ns = _time.monotonic_ns()
+        if self._pc_stats is not None:
+            info["program_cache"] = dict(self._pc_stats)
         info["rounds"] = info.pop("steal_rounds")
         tail = info.pop("extra_outputs", None)
         if mk.trace is not None and tail:

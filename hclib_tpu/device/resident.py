@@ -535,6 +535,39 @@ class ResidentKernel:
         self.S_BL = nxt
         self.S = self.S_BL + 1
         self._jitted: Dict[Any, Any] = {}
+        self._pc_stats: Optional[Dict[str, Any]] = None
+
+    def _cache_variant(self, key) -> tuple:
+        """Everything this runner compiles into the program beyond the
+        Megakernel's own content: the program-cache variant key
+        (runtime/progcache.py). ``key`` is the per-run (quantum,
+        max_rounds, hop_bits) tuple the L1 dict uses."""
+        from ..runtime.progcache import mesh_key
+
+        return (
+            "resident", mesh_key(self.mesh), self.steal, self.homed,
+            tuple(sorted(self.migratable.items())),
+            tuple(self.channels), self.inject, self.window, self.scan,
+            self.am_window, self.outbox, self.max_waits,
+            self.ring_capacity, self.T, self.region_rows,
+            self.proxy_cap, self.plan, self.checkpoint,
+        ) + tuple(key)
+
+    def program_cached(
+        self, quantum: int = 64, max_rounds: int = 1 << 14,
+        hop_order=None,
+    ) -> bool:
+        """True when the compiled program for a ``run()`` with these
+        parameters is already warm - in this instance's own jit table
+        or the process-wide program cache (so a resize onto a shape
+        ANY kernel of this process ever built reports hot). The read
+        ``Autoscaler`` records as ``ScaleEvent.cache_hit``."""
+        key = (quantum, max_rounds, self._hop_bits(hop_order))
+        if key in self._jitted:
+            return True
+        from ..runtime.progcache import probe
+
+        return probe(self.mk, self._cache_variant(key))
 
     # -- mesh addressing (as ici_steal) --
 
@@ -2561,7 +2594,12 @@ class ResidentKernel:
         hop_bits = self._hop_bits(hop_order)
         key = (quantum, max_rounds, hop_bits)
         if key not in self._jitted:
-            self._jitted[key] = self._build(quantum, max_rounds, hop_bits)
+            from ..runtime.progcache import shared_build
+
+            self._jitted[key], self._pc_stats = shared_build(
+                mk, self._cache_variant(key),
+                lambda: self._build(quantum, max_rounds, hop_bits),
+            )
         t0_ns = time.monotonic_ns()
         iv_o, data_o, info = execute_partitions(
             mk, self.mesh, ndev, self._jitted[key], builders, data, ivalues,
@@ -2571,6 +2609,8 @@ class ResidentKernel:
             keep_inputs=self.checkpoint,
         )
         t1_ns = time.monotonic_ns()
+        if self._pc_stats is not None:
+            info["program_cache"] = dict(self._pc_stats)
         info["rounds"] = info.pop("steal_rounds")
         inputs = info.pop("inputs", None)
         tail = info.pop("extra_outputs")

@@ -283,6 +283,7 @@ class ShardedMegakernel:
                 f"table (0..{len(mk.kernel_names) - 1})"
             )
         self._jitted: Dict[Any, Any] = {}
+        self._pc_stats: Optional[Dict[str, Any]] = None
 
     @contextlib.contextmanager
     def _maybe_untraced(self):
@@ -568,15 +569,32 @@ class ShardedMegakernel:
             if steal else (False, fuel)
         )
         if key not in self._jitted:
-            self._jitted[key] = (
-                self._build_steal(quantum, window, max_rounds, hops)
-                if steal
-                else self._build(fuel)
+            # Content-keyed program cache (runtime/progcache.py): the
+            # variant names every static fact this runner compiles in
+            # beyond the Megakernel's own content - mesh shape/devices,
+            # migration whitelist, the env-suppression flags (a
+            # suppressed-trace build is a DIFFERENT program than the
+            # same mk built by a resident runner), and the steal
+            # parameters.
+            from ..runtime.progcache import mesh_key, shared_build
+
+            self._jitted[key], self._pc_stats = shared_build(
+                self.mk,
+                ("sharded", mesh_key(self.mesh),
+                 tuple(sorted(self.migratable_fns)),
+                 self._suppress_trace, self._suppress_ckpt) + key,
+                lambda: (
+                    self._build_steal(quantum, window, max_rounds, hops)
+                    if steal
+                    else self._build(fuel)
+                ),
             )
         iv_o, data_o, info = execute_partitions(
             self.mk, self.mesh, self.ndev, self._jitted[key], builders,
             data, ivalues, with_rounds=steal,
         )
+        if self._pc_stats is not None:
+            info["program_cache"] = dict(self._pc_stats)
         tail = info.pop("extra_outputs", None)
         if self.mk.batch_specs and tail:
             # Per-device batched-tier counters (cumulative over the steal

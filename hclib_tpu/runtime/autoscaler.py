@@ -188,18 +188,23 @@ class ScaleEvent:
     ``checkpoint`` (preemption cut) | ``finish`` (workload drained).
     ``resize_latency_s`` is the full quiesced-state -> resumable-state
     cost of a resize (snapshot + reshard + state rebuild), the number
-    ``bench.py --autoscale`` reports.
+    ``bench.py --autoscale`` reports. ``cache_hit`` (resizes only):
+    whether the target shape's program was already warm in the
+    process-wide program cache (runtime/progcache.py), i.e. the resume
+    pays zero trace/lower/compile work.
     """
 
     __slots__ = (
         "kind", "slice_idx", "t_ns", "from_ndev", "to_ndev", "reason",
         "backlog", "pending", "executed", "resize_latency_s",
+        "cache_hit",
     )
 
     def __init__(
         self, kind: str, slice_idx: int, from_ndev: int, to_ndev: int,
         reason: str, backlog: int = 0, pending: int = 0, executed: int = 0,
         resize_latency_s: Optional[float] = None,
+        cache_hit: Optional[bool] = None,
     ) -> None:
         if kind not in _KIND_CODES:
             raise ValueError(f"unknown ScaleEvent kind {kind!r}")
@@ -213,6 +218,7 @@ class ScaleEvent:
         self.pending = int(pending)
         self.executed = int(executed)
         self.resize_latency_s = resize_latency_s
+        self.cache_hit = cache_hit
 
     @property
     def resized(self) -> bool:
@@ -881,6 +887,13 @@ class Autoscaler:
                             obs.quarantined
                         )
                     rk = self._kernel_for(target)
+                    # Before the next slice triggers the (re)build:
+                    # warm means the target shape's program is already
+                    # in this kernel's jit table or the process-wide
+                    # program cache, so the resume traces nothing.
+                    cache_hit = rk.program_cached(
+                        quantum=quantum, max_rounds=max_rounds,
+                    )
                     state = bundle.state()
                     self.ndev = target
                     if tenant_table is not None:
@@ -896,6 +909,7 @@ class Autoscaler:
                         resize_latency_s=round(
                             time.monotonic() - t0r, 6
                         ),
+                        cache_hit=cache_hit,
                     ))
             else:
                 state = info["state"]

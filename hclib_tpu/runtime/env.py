@@ -131,6 +131,14 @@ REGISTRY = {
         _v("HCLIB_TPU_VERIFY", "bool", "off; on under pytest",
            "build-time static verifier (hclib_tpu.analysis; 0 forces "
            "off, nonzero forces on)"),
+        # -- program cache (runtime/progcache.py) --
+        _v("HCLIB_TPU_PROGRAM_CACHE", "bool", "on",
+           "process-wide content-keyed program cache: jitted "
+           "executables shared across content-identical builds "
+           "(byte-identical programs; 0 forces off)"),
+        _v("HCLIB_TPU_PROGRAM_CACHE_CAP", "int", "256",
+           "program-cache LRU entry bound (>= 1; malformed or "
+           "non-positive text raises)"),
         # -- model checker (hclib_tpu/analysis: explore.py / model.py) --
         _v("HCLIB_TPU_MODEL_DEPTH", "int", "64",
            "bounded-interleaving explorer depth bound, actions per "

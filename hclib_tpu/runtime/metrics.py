@@ -251,6 +251,16 @@ class MetricsRegistry:
             # otherwise also flatten as <name>.tenants.<id>.* and
             # double every tenant counter's scrape cardinality.
             keep.pop("tenants")
+        if "program_cache" in keep:
+            # Process-wide program-cache gauges (runtime/progcache.py):
+            # the run info's per-build hit/miss record stays under
+            # <name>.program_cache.*, while the canonical
+            # program_cache.{hits,misses,evictions,entries} series
+            # reflects the whole process cache - one series regardless
+            # of which run name the build landed under.
+            from .progcache import cache_stats
+
+            self.record("program_cache", cache_stats())
         self.record(name, keep)
 
     # -- snapshots --

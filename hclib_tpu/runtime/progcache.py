@@ -1,0 +1,453 @@
+"""Process-wide content-keyed program cache (ISSUE 18).
+
+Every ``Megakernel`` (and every runner embedding one - sharded steal,
+resident mesh, ICI/PGAS fallbacks, the streaming front door) pays the
+full JAX trace -> lower -> compile pipeline on its first run, even when
+a byte-identical program was built moments ago by another instance: the
+dominant cost on warm machines, the tier-1 wall-clock tax, and the whole
+price of a serving cold start or an autoscaler resize. The persistent
+``JAX_COMPILATION_CACHE_DIR`` does not help: it dedupes identical XLA
+*compilations*, after the trace/lower work that dominates warm builds
+has already been paid.
+
+This module is the layer above: a process-wide registry of JITTED
+EXECUTABLES keyed on a content fingerprint of everything that shapes the
+compiled program:
+
+- the kernel table, positionally (the comparison ``CheckpointBundle``
+  already uses), plus each kernel's CODE fingerprint (bytecode, consts,
+  closure cell values - arrays hash by content) so same-named but
+  different-bodied kernels can never collide;
+- routed ``BatchSpec``s (width/prefetch/body/drain/priority fns);
+- device-word knobs: checkpoint, quiesce_stride, lane_max_age,
+  priority_buckets, trace capacity, tenants/egress shape;
+- capacities and buffer specs (capacity, num_values, succ_capacity,
+  data_specs, scratch_specs, vmem_limit_bytes, uses_row_values,
+  tracks_home, interpret);
+- the runner's own static config (mesh shape + device ids + hop order,
+  steal windows, injection ring shape) via the ``variant`` argument;
+- the hclint layout-table fingerprint (``analysis/layout.py``), so any
+  device-word layout drift invalidates every key.
+
+A hit returns the very jitted callable a cache-off build would have
+produced for the same content - ``jax.jit`` tracing is lazy and cached
+per-callable, so the second instance's first call rides JAX's own
+fast path with zero trace/lower work. Lowered text is byte-identical
+by construction (asserted in ``tests/test_progcache.py`` and the
+``program-cache`` perf guard).
+
+Fail-open discipline: a value the fingerprinter cannot reduce to
+content (an exotic closure cell, a cycle deeper than the bound) makes
+that build UNCACHEABLE - it builds privately, never poisons the table.
+Address-bearing ``repr``s are safe by uniqueness: they can only ever
+miss, never falsely hit.
+
+Knobs (``runtime/env.py`` registry): ``HCLIB_TPU_PROGRAM_CACHE``
+(default on; ``0`` forces off - byte-identity makes on safe under
+pytest and in serving alike) and ``HCLIB_TPU_PROGRAM_CACHE_CAP``
+(bounded LRU entry count; malformed or non-positive text raises).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import types
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .env import env_int, env_raw
+
+__all__ = [
+    "enabled",
+    "cache_cap",
+    "fingerprint",
+    "layout_fingerprint",
+    "megakernel_fingerprint",
+    "mesh_key",
+    "shared_build",
+    "probe",
+    "cache_stats",
+    "reset",
+]
+
+_DEFAULT_CAP = 256
+_MAX_DEPTH = 32
+
+
+class Uncacheable(Exception):
+    """A build input the fingerprinter refuses to reduce to content
+    (cycle past the depth bound, an object that raises under
+    inspection). The build proceeds uncached - never a wrong hit."""
+
+
+class _FP:
+    """Streaming content hash. Every ``add`` reduces one object to
+    bytes fed into blake2b; containers and closures recurse with a
+    depth bound and an id-keyed cycle guard."""
+
+    def __init__(self) -> None:
+        self._h = hashlib.blake2b(digest_size=16)
+        self._seen: Dict[int, int] = {}
+        self._pins: list = []  # keep ids alive while memoized
+
+    def _feed(self, *parts) -> None:
+        for p in parts:
+            b = p if isinstance(p, bytes) else str(p).encode()
+            self._h.update(b)
+            self._h.update(b"\x1f")
+
+    def digest(self) -> str:
+        return self._h.hexdigest()
+
+    # -- recursive reduction --
+
+    def add(self, obj: Any, depth: int = 0) -> None:
+        if depth > _MAX_DEPTH:
+            raise Uncacheable("fingerprint depth bound exceeded")
+        if obj is None or isinstance(obj, (bool, int, float, complex)):
+            self._feed("s", type(obj).__name__, repr(obj))
+            return
+        if isinstance(obj, (str, bytes)):
+            self._feed("t", type(obj).__name__, obj)
+            return
+        oid = id(obj)
+        if oid in self._seen:
+            self._feed("cycle", self._seen[oid])
+            return
+        self._seen[oid] = len(self._seen)
+        self._pins.append(obj)
+        import numpy as np
+
+        if isinstance(obj, np.dtype):
+            self._feed("dtype", obj.str)
+            return
+        if isinstance(obj, np.generic):
+            self._feed("npscalar", obj.dtype.str, repr(obj.item()))
+            return
+        if isinstance(obj, np.ndarray) or type(obj).__name__ == "ArrayImpl":
+            a = np.asarray(obj)
+            self._feed("nd", a.shape, a.dtype.str)
+            self._h.update(np.ascontiguousarray(a).tobytes())
+            return
+        if isinstance(obj, (tuple, list)):
+            self._feed("seq", type(obj).__name__, len(obj))
+            for x in obj:
+                self.add(x, depth + 1)
+            return
+        if isinstance(obj, dict):
+            self._feed("map", len(obj))
+            for k in sorted(obj, key=repr):
+                self.add(k, depth + 1)
+                self.add(obj[k], depth + 1)
+            return
+        if isinstance(obj, (set, frozenset)):
+            self._feed("set", len(obj))
+            for x in sorted(obj, key=repr):
+                self.add(x, depth + 1)
+            return
+        import functools
+
+        if isinstance(obj, functools.partial):
+            self._feed("partial")
+            self.add(obj.func, depth + 1)
+            self.add(obj.args, depth + 1)
+            self.add(obj.keywords, depth + 1)
+            return
+        if isinstance(obj, types.MethodType):
+            self._feed("method")
+            self.add(obj.__func__, depth + 1)
+            self.add(obj.__self__, depth + 1)
+            return
+        if isinstance(obj, types.FunctionType):
+            self._add_fn(obj, depth)
+            return
+        if isinstance(obj, types.BuiltinFunctionType):
+            self._feed("builtin", getattr(obj, "__module__", ""),
+                       getattr(obj, "__qualname__", obj.__name__))
+            return
+        if isinstance(obj, types.CodeType):
+            self._add_code(obj, depth)
+            return
+        if isinstance(obj, type):
+            self._feed("class", obj.__module__, obj.__qualname__)
+            return
+        # ShapeDtypeStruct and kin: shape + dtype IS the content.
+        shape = getattr(obj, "shape", None)
+        dtype = getattr(obj, "dtype", None)
+        if shape is not None and dtype is not None:
+            self._feed("sds", type(obj).__name__, tuple(shape), str(dtype))
+            return
+        # Generic object: type identity + attribute dict. Objects
+        # without inspectable state fall through to repr below -
+        # address-bearing reprs are SAFE BY UNIQUENESS (permanent
+        # miss, never a false hit).
+        t = type(obj)
+        state = getattr(obj, "__dict__", None)
+        if state is None and hasattr(t, "__slots__"):
+            state = {
+                s: getattr(obj, s)
+                for s in t.__slots__ if hasattr(obj, s)
+            }
+        if isinstance(state, dict):
+            self._feed("obj", t.__module__, t.__qualname__)
+            self.add(state, depth + 1)
+            return
+        self._feed("repr", t.__module__, t.__qualname__, repr(obj))
+
+    def _add_fn(self, fn, depth: int) -> None:
+        self._feed("fn", getattr(fn, "__module__", ""),
+                   getattr(fn, "__qualname__", ""))
+        self._add_code(fn.__code__, depth)
+        self.add(fn.__defaults__, depth + 1)
+        kwd = fn.__kwdefaults__
+        if kwd:
+            self.add(dict(kwd), depth + 1)
+        if fn.__closure__:
+            self._feed("closure", len(fn.__closure__))
+            for cell in fn.__closure__:
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    self._feed("emptycell")
+                    continue
+                self.add(v, depth + 1)
+
+    def _add_code(self, code, depth: int) -> None:
+        self._feed("code", code.co_name, code.co_argcount,
+                   code.co_flags & 0x0F)
+        self._h.update(code.co_code)
+        self._feed(*code.co_names)
+        for c in code.co_consts:
+            if isinstance(c, types.CodeType):
+                self._add_code(c, depth + 1)
+            else:
+                self.add(c, depth + 1)
+
+
+def fingerprint(*objs: Any) -> str:
+    """Content digest of arbitrary host objects (the test/verification
+    entry point; raises :class:`Uncacheable` on irreducible input)."""
+    fp = _FP()
+    for o in objs:
+        fp.add(o)
+    return fp.digest()
+
+
+def layout_fingerprint() -> str:
+    """Digest of the hclint device-word layout table
+    (``analysis/layout.py``: LAYOUT + the checkpoint state-key rosters).
+    Part of every program key, so ANY layout drift - a new word, a
+    moved offset, a renamed checkpoint member - invalidates the whole
+    cache rather than risking a stale program against a new ABI.
+    Recomputed per call (the table is small) so tests can prove the
+    sensitivity by patching the table."""
+    from ..analysis import layout as L
+
+    fp = _FP()
+    fp._feed("layout", len(L.LAYOUT))
+    for name in sorted(L.LAYOUT):
+        fp._feed(name)
+        fp.add(L.LAYOUT[name])
+    fp._feed(*L._CKPT_STATE_KEYS)
+    fp._feed(*L._CKPT_OPT_KEYS)
+    return fp.digest()
+
+
+def mesh_key(mesh) -> Tuple:
+    """The mesh facts a compiled program is pinned to: axis names,
+    per-axis extents, and the flat device-id order (a reshuffled mesh
+    must not reuse another's executable)."""
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(d) for d in mesh.devices.shape),
+        tuple(int(d.id) for d in mesh.devices.flat),
+    )
+
+
+def megakernel_fingerprint(mk) -> str:
+    """Content digest of one ``Megakernel``'s program-shaping state:
+    the kernel table (positional names + body fingerprints), routed
+    BatchSpecs, buffer specs and capacities, and every device-word
+    knob, prefixed with :func:`layout_fingerprint`. Raises
+    :class:`Uncacheable` when some component resists content
+    reduction (the caller then builds uncached)."""
+    fp = _FP()
+    fp._feed("hclib-progcache-v1", layout_fingerprint())
+    fp._feed("kernels", len(mk.kernel_names))
+    for name, fn in zip(mk.kernel_names, mk.kernel_fns):
+        fp._feed(name)
+        fp.add(fn)
+    fp._feed("batch", len(mk.batch_specs))
+    for fid, spec in mk.batch_specs:
+        fp._feed(fid)
+        fp.add(spec)
+    fp.add(mk.data_specs)
+    fp.add(mk.scratch_specs)
+    fp.add((
+        mk.capacity, mk.num_values, mk.succ_capacity,
+        bool(mk.interpret), bool(mk.uses_row_values),
+        mk.vmem_limit_bytes, bool(mk.tracks_home),
+        bool(mk.checkpoint), getattr(mk, "quiesce_stride", 1),
+        mk.lane_max_age, mk.priority_buckets,
+    ))
+    tr = mk.trace
+    fp.add(None if tr is None
+           else (getattr(tr, "capacity", None), getattr(tr, "words", None)))
+    return fp.digest()
+
+
+# ------------------------------------------------------------ registry
+
+def enabled() -> bool:
+    """``HCLIB_TPU_PROGRAM_CACHE``: unset -> on (byte-identity makes
+    the cache safe by default, under pytest and in serving alike);
+    ``''``/``'0'`` -> off; anything else -> on."""
+    v = env_raw("HCLIB_TPU_PROGRAM_CACHE")
+    if v is None:
+        return True
+    return v not in ("", "0")
+
+
+def cache_cap() -> int:
+    """``HCLIB_TPU_PROGRAM_CACHE_CAP``: LRU entry bound (default
+    256). Malformed text raises via the env registry; non-positive
+    values raise here - a cap of 0 would silently disable caching
+    under an innocent-looking spelling."""
+    cap = env_int("HCLIB_TPU_PROGRAM_CACHE_CAP", _DEFAULT_CAP)
+    if cap < 1:
+        raise ValueError(
+            f"HCLIB_TPU_PROGRAM_CACHE_CAP={cap} must be >= 1 (set "
+            "HCLIB_TPU_PROGRAM_CACHE=0 to turn the cache off)"
+        )
+    return cap
+
+
+class ProgramCache:
+    """Bounded-LRU registry of jitted executables. Thread-safe; builds
+    run outside the lock (a racing identical build is wasted work, not
+    a correctness problem - first insert wins so every holder shares
+    one callable)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple[str, str], Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def contains(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def get(self, key):
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            return fn
+
+    def put(self, key, fn, cap: int):
+        with self._lock:
+            self.misses += 1
+            kept = self._entries.setdefault(key, fn)
+            self._entries.move_to_end(key)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return kept
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "entries": len(self._entries),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.evictions = 0
+
+
+_CACHE = ProgramCache()
+
+
+def cache_stats() -> Dict[str, int]:
+    """Process-wide counters: ``hits`` / ``misses`` / ``evictions`` /
+    ``entries`` (the ``program_cache.*`` gauges MetricsRegistry
+    exports)."""
+    return _CACHE.stats()
+
+
+def reset() -> None:
+    """Drop every entry and zero the counters (test isolation)."""
+    _CACHE.reset()
+
+
+def _key(mk, variant) -> Optional[Tuple[str, str]]:
+    try:
+        return (megakernel_fingerprint(mk), fingerprint(variant))
+    except Uncacheable:
+        return None
+    except Exception:
+        # Fingerprinting must NEVER sink a build: an inspection that
+        # raises (an exotic closure, a half-built object) means
+        # uncacheable, not broken.
+        return None
+
+
+def probe(mk, variant) -> bool:
+    """True when the program for (mk content, runner variant) is warm
+    in the registry - the zero-rebuild read the autoscaler's
+    ``ScaleEvent.cache_hit`` records. Does not touch LRU order or the
+    hit counters."""
+    if not enabled():
+        return False
+    key = _key(mk, variant)
+    return key is not None and _CACHE.contains(key)
+
+
+def shared_build(mk, variant, build: Callable[[], Any]):
+    """The one integration point every runner threads its jit through:
+
+    ``fn, stats = shared_build(mk, variant, lambda: jax.jit(...))``
+
+    ``variant`` is any content-reducible object naming the runner's own
+    static build parameters (fuel/quantum/windows/mesh/hop order...);
+    the megakernel fingerprint plus the variant digest is the cache
+    key. Returns the shared callable and a stats dict: ``hit``,
+    ``cache_lookup_s`` (fingerprint + registry probe), ``build_s``
+    (0.0 on a hit). Cache off / uncacheable input degrade to a plain
+    timed build with ``hit=False``."""
+    t0 = time.perf_counter()
+    key = None
+    if enabled():
+        key = _key(mk, variant)
+        if key is not None:
+            fn = _CACHE.get(key)
+            if fn is not None:
+                return fn, {
+                    "hit": True,
+                    "cache_lookup_s": time.perf_counter() - t0,
+                    "build_s": 0.0,
+                }
+    lookup_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    fn = build()
+    build_s = time.perf_counter() - t1
+    if key is not None:
+        fn = _CACHE.put(key, fn, cache_cap())
+    return fn, {
+        "hit": False,
+        "cache_lookup_s": lookup_s,
+        "build_s": build_s,
+    }

@@ -489,3 +489,95 @@ def test_scale_event_new_kinds_ride_trace_and_metrics():
     assert any(n.startswith("strand hold") for n in names), names
     with pytest.raises(ValueError, match="kind"):
         hc.ScaleEvent("strand", 0, 1, 1, "typo")
+
+
+# ----------------------------------- program cache across resizes (ISSUE 18)
+
+
+def test_scale_event_carries_cache_hit():
+    """cache_hit rides the typed event: set on resizes, None elsewhere,
+    present in as_dict (the flattener drops None, so non-resize events
+    cost no gauge)."""
+    ev = hc.ScaleEvent("scale_in", 5, 4, 2, "idle",
+                       resize_latency_s=0.1, cache_hit=True)
+    assert ev.as_dict()["cache_hit"] is True
+    assert hc.ScaleEvent("hold", 0, 2, 2, "x").cache_hit is None
+
+
+def test_program_cached_probe_reads_process_cache():
+    """ResidentKernel.program_cached: False cold; True on a DIFFERENT
+    content-identical instance once the (mk, variant) program is in the
+    process-wide registry; parameter changes miss. Host-only - the probe
+    never builds."""
+    from hclib_tpu.device.resident import ResidentKernel
+    from hclib_tpu.device.workloads import UTS_NODE, make_uts_megakernel
+    from hclib_tpu.parallel.mesh import cpu_mesh
+    from hclib_tpu.runtime import progcache
+
+    def rk():
+        mk = make_uts_megakernel(seed=19, max_depth=4, interpret=True,
+                                 checkpoint=True)
+        return ResidentKernel(
+            mk, cpu_mesh(2, axis_name="q"), migratable_fns=[UTS_NODE],
+            window=4, homed=False,
+        )
+
+    progcache.reset()
+    try:
+        a = rk()
+        assert a.program_cached(quantum=8) is False
+        key = (8, 1 << 14, a._hop_bits(None))
+        _, stats = progcache.shared_build(
+            a.mk, a._cache_variant(key), object
+        )
+        assert stats["hit"] is False
+        assert rk().program_cached(quantum=8) is True
+        assert rk().program_cached(quantum=16) is False
+    finally:
+        progcache.reset()
+
+
+@needs_mosaic
+@pytest.mark.chaos
+def test_autoscale_resizes_with_both_shapes_warm_hit_cache():
+    """ACCEPTANCE (ISSUE 18): with both mesh shapes pre-warmed by
+    content-identical kernels, every controller resize reports
+    cache_hit=True and the whole autoscaled run performs ZERO new
+    trace/lower work (the process-wide miss counter does not move)."""
+    from hclib_tpu.runtime import progcache
+
+    make_kernel = _uts_kernel_factory(6)
+    progcache.reset()
+    try:
+        # Pre-warm BOTH shapes with fresh instances (their private jit
+        # tables die with them; only the process cache carries over).
+        for ndev in (2, 4):
+            make_kernel(ndev).run(
+                _uts_builders(ndev), quantum=8, max_rounds=1 << 14,
+            )
+        warm = progcache.cache_stats()
+        assert warm["misses"] >= 2 and warm["entries"] >= 2
+
+        asc = hc.Autoscaler(
+            make_kernel,
+            hc.AutoscalerPolicy(min_devices=1, max_devices=4,
+                                scale_out_backlog=4.0,
+                                scale_in_backlog=1.0,
+                                hysteresis=1, cooldown=1),
+            slice_rounds=8,
+        )
+        iv, _, info = asc.run(_uts_builders(2), quantum=8)
+        assert info["pending"] == 0
+        resizes = [
+            e for e in info["scale_events"]
+            if e["from_ndev"] != e["to_ndev"]
+        ]
+        assert resizes, info["scale_events"]
+        assert all(e["cache_hit"] is True for e in resizes), resizes
+        # Zero rebuilds anywhere in the run: every slice's program came
+        # from the registry (hits moved, misses did not).
+        after = progcache.cache_stats()
+        assert after["misses"] == warm["misses"]
+        assert after["hits"] > warm["hits"]
+    finally:
+        progcache.reset()

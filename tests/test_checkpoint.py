@@ -58,16 +58,15 @@ def _uts_builder():
     return b
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture
 def uts_ckpt_mk():
-    """ONE checkpoint-enabled UTS megakernel shared by every round-trip
-    test in this file (the heaviest repeated build of the suite: seven
-    tests previously compiled the identical program). A Megakernel is
-    re-entrant by construction - every run() stages fresh state from
-    its builder and the jitted executables are cached per (fuel,
-    stage_all_values) - so sharing the build changes nothing but the
-    wall clock. Tests that NEED a fresh build (restore onto a new
-    instance, program-mismatch rejection) still construct their own."""
+    """A checkpoint-enabled UTS megakernel per round-trip test (the
+    heaviest repeated build of the suite). Function-scoped since ISSUE
+    18: every test gets a FRESH instance - no cross-test object
+    aliasing - and the process-wide program cache
+    (runtime/progcache.py) dedupes the content-identical compiles that
+    session scope used to dedupe by object sharing. With the cache
+    forced off each test simply pays its own build."""
     return make_uts_megakernel(checkpoint=True, **UTS_KW)
 
 

@@ -146,7 +146,16 @@ def test_off_path_byte_identical_and_priority_ignored():
     assert lowered_p == lowered_n
     o_p, i_p = _run_seq(mk_p)
     o_n, i_n = _run_seq(mk_n)
-    assert o_p == o_n and i_p["tiers"] == i_n["tiers"]
+
+    def device_tiers(info):
+        # build_s / cache_lookup_s are host-side program-cache timings,
+        # not device counters - never comparable across arms.
+        return {
+            k: v for k, v in info["tiers"].items()
+            if k not in ("build_s", "cache_lookup_s")
+        }
+
+    assert o_p == o_n and device_tiers(i_p) == device_tiers(i_n)
     assert i_p["tiers"]["bucket_fires"] == 0
     assert i_p["tiers"]["bucket_inversions"] == 0
 

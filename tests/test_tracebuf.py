@@ -121,12 +121,21 @@ def test_trace_off_is_bit_identical_with_no_ring_output():
     # Tracing adds the trace key plus the trace-DERIVED tier gauges
     # (lane_partial_age, ISSUE 9); every device-computed number is
     # identical.
-    on = {k: v for k, v in info_on.items() if k != "trace"}
+    # (program_cache and the tiers build_s/cache_lookup_s keys are
+    # host-side program-cache facts - different per build, not device
+    # output - so they are excluded from the cross-arm identity.)
+    on = {k: v for k, v in info_on.items()
+          if k not in ("trace", "program_cache")}
+    off = {k: v for k, v in info_off.items() if k != "program_cache"}
+    host_keys = ("lane_partial_age", "lane_partial_ages",
+                 "build_s", "cache_lookup_s")
     on["tiers"] = {
-        k: v for k, v in on["tiers"].items()
-        if k not in ("lane_partial_age", "lane_partial_ages")
+        k: v for k, v in on["tiers"].items() if k not in host_keys
     }
-    assert on == info_off
+    off["tiers"] = {
+        k: v for k, v in off["tiers"].items() if k not in host_keys
+    }
+    assert on == off
     assert "lane_partial_age" in info_on["tiers"]
     assert "lane_partial_age" not in info_off["tiers"]
     # No appended ring output on the off build: its pallas out tree is

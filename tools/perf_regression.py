@@ -606,6 +606,122 @@ def _priority_tier(quick: bool, trials: int) -> dict:
     }
 
 
+def _program_cache(quick: bool, trials: int) -> dict:
+    """Program-cache guard (ISSUE 18), same-run arms:
+
+    (a) cold-vs-warm: two content-identical megakernel instances; the
+        second instance's FIRST run must ride the process-wide program
+        cache (hit asserted) and beat the cold build by
+        --progcache-floor (the whole point of the cache is killing the
+        trace/lower/compile tax);
+    (b) cache-off bit identity: a fresh instance with
+        HCLIB_TPU_PROGRAM_CACHE=0 must produce the cold arm's exact
+        result bytes with the registry counters untouched;
+    (c) eviction correctness: at cap=1 a second distinct program evicts
+        the first; rebuilding the first misses (counted) and is
+        bit-identical to its original run.
+    """
+    import os as _os
+
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.runtime import progcache
+
+    ntasks = 16 if quick else 48
+
+    def mark(ctx):
+        ctx.set_value(ctx.arg(1), ctx.arg(0))
+
+    def mark2(ctx):
+        ctx.set_value(ctx.arg(1), ctx.arg(0) + 1)
+
+    def mk(body=mark):
+        return Megakernel(
+            kernels=[("mark", body)], capacity=max(64, ntasks + 8),
+            num_values=ntasks + 8, succ_capacity=8, interpret=True,
+        )
+
+    def run_once(m) -> Tuple[int, bytes, dict]:
+        b = TaskGraphBuilder()
+        for i in range(ntasks):
+            b.add(0, args=[i + 1, i + 1])
+        t0 = time.perf_counter_ns()
+        iv, _, info = m.run(b)
+        dt = time.perf_counter_ns() - t0
+        return dt, np.asarray(iv).tobytes(), info["program_cache"]
+
+    saved = {
+        k: _os.environ.pop(k, None)
+        for k in ("HCLIB_TPU_PROGRAM_CACHE", "HCLIB_TPU_PROGRAM_CACHE_CAP")
+    }
+    try:
+        progcache.reset()
+        # (a) cold vs warm: first runs of fresh identical instances.
+        cold_ns, cold_bytes, pc = run_once(mk())
+        if pc["hit"]:
+            raise AssertionError("program-cache: cold arm reported a hit")
+        warm = []
+        for _ in range(max(2, trials)):
+            warm_ns, warm_bytes, pc = run_once(mk())
+            if not pc["hit"]:
+                raise AssertionError(
+                    "program-cache: content-identical rebuild missed"
+                )
+            if warm_bytes != cold_bytes:
+                raise AssertionError(
+                    "program-cache: warm result bytes diverged"
+                )
+            warm.append(warm_ns)
+        warm_ns = min(warm)
+        # (b) cache off: bit-identical, counters untouched.
+        before = progcache.cache_stats()
+        _os.environ["HCLIB_TPU_PROGRAM_CACHE"] = "0"
+        off_ns, off_bytes, pc = run_once(mk())
+        del _os.environ["HCLIB_TPU_PROGRAM_CACHE"]
+        if pc["hit"] or off_bytes != cold_bytes:
+            raise AssertionError(
+                "program-cache: cache-off arm hit or diverged"
+            )
+        if progcache.cache_stats() != before:
+            raise AssertionError(
+                "program-cache: cache-off arm moved the counters"
+            )
+        # (c) eviction correctness at cap=1.
+        _os.environ["HCLIB_TPU_PROGRAM_CACHE_CAP"] = "1"
+        progcache.reset()
+        _, first_bytes, _ = run_once(mk())
+        run_once(mk(mark2))  # distinct program: evicts the first
+        if progcache.cache_stats()["evictions"] < 1:
+            raise AssertionError("program-cache: cap=1 never evicted")
+        _, again_bytes, pc = run_once(mk())
+        if pc["hit"]:
+            raise AssertionError(
+                "program-cache: evicted program reported a hit"
+            )
+        if again_bytes != first_bytes:
+            raise AssertionError(
+                "program-cache: post-eviction rebuild diverged"
+            )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        progcache.reset()
+    return {
+        "cold_ns": cold_ns,
+        "warm_ns": warm_ns,
+        "off_ns": off_ns,
+        "speedup": cold_ns / warm_ns,
+        "tasks": ntasks,
+        "bit_identical": True,
+        "eviction_correct": True,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -700,6 +816,10 @@ def main(argv=None) -> int:
                          "ratio of bounded-frontier PageRank over the "
                          "FIFO breadth-first arm (measured ~0.4-0.6x "
                          "at m0=1<<14 - the live-set blowup fix)")
+    ap.add_argument("--progcache-floor", type=float, default=3.0,
+                    help="program-cache guard: minimum cold/warm "
+                         "first-build speedup for a content-identical "
+                         "second instance (the compile-tax kill)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -954,6 +1074,32 @@ def main(argv=None) -> int:
                     "frontier"
                 )
                 line += "  LIVE-REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "program-cache" in wanted:
+        try:
+            pg = _program_cache(args.quick, args.trials)
+        except Exception as e:
+            print(f"program-cache FAILED: {e}", file=sys.stderr)
+            failures.append(f"program-cache: failed ({e})")
+        else:
+            results["program-cache"] = pg
+            line = (
+                f"{'program-cache':15s} warm "
+                f"{pg['speedup']:5.2f}x "
+                f"({pg['cold_ns']/1e6:.1f}ms cold vs "
+                f"{pg['warm_ns']/1e6:.1f}ms warm first build, "
+                f"off {pg['off_ns']/1e6:.1f}ms, bit-identical, "
+                f"eviction-correct)"
+            )
+            if pg["speedup"] < args.progcache_floor:
+                failures.append(
+                    f"program-cache: warm first build only "
+                    f"{pg['speedup']:.2f}x faster than cold (floor "
+                    f"{args.progcache_floor:.2f}x) - the cache "
+                    "stopped killing the compile tax"
+                )
+                line += "  REGRESSED"
             print(line, flush=True)
 
     if args.device:

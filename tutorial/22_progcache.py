@@ -1,0 +1,138 @@
+"""Lesson 22: the program cache - killing the compile tax.
+
+Every lesson so far paid the full JAX trace -> lower -> compile
+pipeline the first time its megakernel ran, even when a byte-identical
+program was built by the previous cell. That tax is the whole price of
+a serving cold start and of an autoscaler resize onto a shape this
+process ever built before. `runtime/progcache.py` is a process-wide
+registry of JITTED EXECUTABLES keyed on a content fingerprint of
+everything that shapes the program:
+
+- the kernel table positionally PLUS each body's code fingerprint
+  (bytecode, consts, closure cell values - arrays hash by content);
+- routed BatchSpecs, buffer shapes, and every device-word knob
+  (checkpoint, quiesce_stride, lane_max_age, priority_buckets, trace);
+- the runner's static variant (mesh shape + device order + hop order,
+  steal windows, quantum, injection-ring/tenant/egress shape);
+- the hclint layout-table fingerprint, so ANY device-word ABI drift
+  invalidates the whole cache.
+
+A hit hands the new instance the very callable a cache-off build would
+have produced: `jax.jit` tracing is lazy and cached per-callable, so a
+content-identical second instance's FIRST run does zero trace/lower
+work. The cache changes WHEN a program is built, never WHAT - lowered
+text is byte-identical by construction, which is why it defaults ON
+(`HCLIB_TPU_PROGRAM_CACHE=0` forces off, CAP bounds the LRU).
+"""
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from hclib_tpu.device.descriptor import TaskGraphBuilder  # noqa: E402
+from hclib_tpu.device.megakernel import Megakernel  # noqa: E402
+from hclib_tpu.runtime import progcache  # noqa: E402
+
+
+def _mark_mk(bump=0):
+    """One tiny kernel; ``bump`` rides a closure cell, so bump=1 is a
+    DIFFERENT program by content even though the code object matches."""
+
+    def mark(ctx):
+        ctx.set_value(ctx.arg(1), ctx.arg(0) + bump)
+
+    return Megakernel(
+        kernels=[("mark", mark)], capacity=64, num_values=24,
+        succ_capacity=8, interpret=True,
+    )
+
+
+def _run(mk, n=16):
+    b = TaskGraphBuilder()
+    for i in range(n):
+        b.add(0, args=[i + 1, i + 1])
+    t0 = time.perf_counter()
+    iv, _, info = mk.run(b)
+    return time.perf_counter() - t0, np.asarray(iv).tobytes(), info
+
+
+def part_one_cold_vs_warm():
+    """A content-identical second instance's first run is a cache hit:
+    same bytes, a fraction of the wall."""
+    progcache.reset()
+    cold_s, cold_bytes, info = _run(_mark_mk())
+    assert info["program_cache"]["hit"] is False
+    warm_s, warm_bytes, info = _run(_mark_mk())  # a FRESH instance
+    assert info["program_cache"]["hit"] is True
+    assert info["program_cache"]["build_s"] == 0.0
+    assert warm_bytes == cold_bytes, "a hit is bit-identical"
+    s = progcache.cache_stats()
+    assert (s["hits"], s["misses"], s["entries"]) == (1, 1, 1)
+    print(f"  cold first run {cold_s*1e3:7.1f}ms, warm first run "
+          f"{warm_s*1e3:6.1f}ms ({cold_s/warm_s:.0f}x) - "
+          "zero trace/lower work on the hit")
+
+
+def part_two_content_is_the_key():
+    """Change anything that shapes the program - a closure constant, a
+    knob - and the key provably misses; runtime facts do not key."""
+    progcache.reset()
+    _run(_mark_mk())
+    _, _, info = _run(_mark_mk(bump=1))  # closure cell differs
+    assert info["program_cache"]["hit"] is False, "bump=1 is new content"
+    fp0 = progcache.megakernel_fingerprint(_mark_mk())
+    for kw in ({"checkpoint": True}, {"trace": 4096},
+               {"quiesce_stride": 4}):
+        mk = Megakernel(
+            kernels=[("mark", _mark_mk().kernel_fns[0])], capacity=64,
+            num_values=24, succ_capacity=8, interpret=True, **kw,
+        )
+        assert progcache.megakernel_fingerprint(mk) != fp0, kw
+    print("  closure constants, knobs, layout drift: all miss; "
+          "per-run words (fuel, quiesce, tctl) never key")
+
+
+def part_three_off_switch_and_cap():
+    """The off switch proves byte-identity; the LRU cap proves an
+    eviction is only ever a rebuild."""
+    progcache.reset()
+    _, on_bytes, _ = _run(_mark_mk())
+    before = progcache.cache_stats()
+    os.environ["HCLIB_TPU_PROGRAM_CACHE"] = "0"
+    try:
+        _, off_bytes, info = _run(_mark_mk())
+        assert info["program_cache"]["hit"] is False
+        assert off_bytes == on_bytes, "cache off = same bytes, just slower"
+        assert progcache.cache_stats() == before, "off moves no counters"
+    finally:
+        del os.environ["HCLIB_TPU_PROGRAM_CACHE"]
+    os.environ["HCLIB_TPU_PROGRAM_CACHE_CAP"] = "1"
+    try:
+        progcache.reset()
+        _, first, _ = _run(_mark_mk())
+        _run(_mark_mk(bump=1))           # second program evicts the first
+        assert progcache.cache_stats()["evictions"] >= 1
+        _, again, info = _run(_mark_mk())
+        assert info["program_cache"]["hit"] is False  # honest rebuild
+        assert again == first, "post-eviction rebuild is bit-identical"
+    finally:
+        del os.environ["HCLIB_TPU_PROGRAM_CACHE_CAP"]
+    print("  off-switch bytes == on-switch bytes; cap=1 evicts, "
+          "rebuild bit-identical (counters: "
+          f"{progcache.cache_stats()})")
+
+
+if __name__ == "__main__":
+    try:
+        part_one_cold_vs_warm()
+        part_two_content_is_the_key()
+        part_three_off_switch_and_cap()
+    finally:
+        progcache.reset()
+    print("lesson 22 OK")
