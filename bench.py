@@ -1141,11 +1141,15 @@ def _bench_serve_stream(per_tenant: int) -> dict:
     every request rides submit() -> egress mailbox -> Future.result(),
     so the rate prices the whole request/response loop (admission, WRR
     install, in-kernel retirement publish, host drain, ledger resolve),
-    not just ingress."""
+    not just ingress. The telemetry plane (ISSUE 19) rides the same
+    run: the on-device histogram's p50/p99 (rounds -> seconds via the
+    entry epoch bracket) report beside the host-stamped quantiles - the
+    agreement the acceptance holds to one log2 bucket."""
     from hclib_tpu.device.descriptor import TaskGraphBuilder
     from hclib_tpu.device.egress import EgressSpec
     from hclib_tpu.device.inject import StreamingMegakernel
     from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.device.telemetry import TelemetryBlock
     from hclib_tpu.device.tenants import TenantSpec, TenantTable
 
     def bump(ctx):
@@ -1163,7 +1167,7 @@ def _bench_serve_stream(per_tenant: int) -> dict:
         num_values=8, succ_capacity=8, interpret=True,
     )
     sm = StreamingMegakernel(mk, ring_capacity=3 * region,
-                             tenants=table)
+                             tenants=table, telemetry=True)
     futs = []
     t0 = time.perf_counter()
     for tid in names:
@@ -1181,13 +1185,26 @@ def _bench_serve_stream(per_tenant: int) -> dict:
     cons = table.futures.conservation()
     assert cons["ok"] and cons["resolved"] == len(futs), cons
     pct = (lambda p: lats[min(len(lats) - 1, int(p * len(lats)))])
-    return {
+    out = {
         "requests": len(futs),
         "req_per_sec": round(len(futs) / max(wall, 1e-9), 1),
         "wall_s": round(wall, 4),
         "p50_latency_s": round(pct(0.50), 6),
         "p99_latency_s": round(pct(0.99), 6),
     }
+    snap = sm.telemetry_snapshot()
+    if snap is not None:
+        blk = TelemetryBlock(snap["tele"], snap.get("ns_per_round"))
+        out["hist_requests"] = blk.total()
+        out["hist_rounds"] = snap["rounds"]
+        for q, key in ((0.50, "hist_p50"), (0.99, "hist_p99")):
+            r = blk.quantile(q)
+            if r is not None:
+                out[f"{key}_rounds"] = r
+            s = blk.quantile_s(q)
+            if s is not None:
+                out[f"{key}_latency_s"] = round(s, 6)
+    return out
 
 
 def bench_serve(quick: bool = False) -> None:
@@ -1346,6 +1363,12 @@ def bench_serve(quick: bool = False) -> None:
             f"{stream['req_per_sec']:,} req/s, submit-to-result p50 "
             f"{stream['p50_latency_s'] * 1e3:.1f} ms / p99 "
             f"{stream['p99_latency_s'] * 1e3:.1f} ms")
+        if "hist_p99_latency_s" in stream:
+            log(f"serve device histograms (on-device, "
+                f"{stream['hist_rounds']} rounds): p50 "
+                f"{stream['hist_p50_latency_s'] * 1e3:.1f} ms / p99 "
+                f"{stream['hist_p99_latency_s'] * 1e3:.1f} ms from "
+                f"{stream['hist_requests']} tracked retirements")
     logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
     os.makedirs(logdir, exist_ok=True)
     path = os.path.join(logdir, f"{int(time.time())}.serve.json")

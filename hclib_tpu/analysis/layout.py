@@ -45,6 +45,7 @@ LAYOUT = {
     "TEN_EXPIRED": (17, ("hclib_tpu.device.descriptor",)),
     "TEN_DEADLINE_MS": (18, ("hclib_tpu.device.descriptor",)),
     "TEN_TOKEN": (19, ("hclib_tpu.device.descriptor",)),
+    "TEN_ADMIT_ROUND": (20, ("hclib_tpu.device.descriptor",)),
     # completion-mailbox EGR row ABI (device/egress.py, ISSUE 16): the
     # host drain, the numpy executable spec, and the in-kernel publish
     # path (device/inject.py) all index these words; the ectl cursor
@@ -55,6 +56,8 @@ LAYOUT = {
     "EGR_FN": (3, ("hclib_tpu.device.egress",)),
     "EGR_SLOT": (4, ("hclib_tpu.device.egress",)),
     "EGR_VALUE": (5, ("hclib_tpu.device.egress",)),
+    "EGR_T_ADMIT": (6, ("hclib_tpu.device.egress",)),
+    "EGR_T_SPANS": (7, ("hclib_tpu.device.egress",)),
     "EGR_WORDS": (8, ("hclib_tpu.device.egress",)),
     "EC_WRITE": (0, ("hclib_tpu.device.egress",)),
     "EC_CONSUMED": (1, ("hclib_tpu.device.egress",)),
@@ -113,12 +116,31 @@ LAYOUT = {
     "QC_AFTER": (1, ("hclib_tpu.device.megakernel",)),
     "C_EXECUTED": (5, ("hclib_tpu.device.megakernel",)),
     "C_ROUNDS": (7, ("hclib_tpu.device.megakernel",)),
+    # live-telemetry word ABI (device/telemetry.py, ISSUE 19): the
+    # per-row stamp table (tlat), the gauge row (TG_*), and the
+    # histogram width the kernel fold, the host wrapper, and the
+    # reconciliation tests all index.
+    "LAT_ADMIT": (0, ("hclib_tpu.device.telemetry",)),
+    "LAT_INSTALL": (1, ("hclib_tpu.device.telemetry",)),
+    "LAT_FIRE": (2, ("hclib_tpu.device.telemetry",)),
+    "LAT_WORDS": (4, ("hclib_tpu.device.telemetry",)),
+    "LAT_BUCKETS": (16, ("hclib_tpu.device.telemetry",)),
+    "TG_ROUNDS": (0, ("hclib_tpu.device.telemetry",)),
+    "TG_INSTALLS": (1, ("hclib_tpu.device.telemetry",)),
+    "TG_RETIRES": (2, ("hclib_tpu.device.telemetry",)),
+    "TG_PARKED": (3, ("hclib_tpu.device.telemetry",)),
+    "TG_BACKLOG": (4, ("hclib_tpu.device.telemetry",)),
+    "TG_ENTRIES": (5, ("hclib_tpu.device.telemetry",)),
+    "TG_WORDS": (8, ("hclib_tpu.device.telemetry",)),
 }
 
 # checkpoint.py's export key sets: resharding and restore key on these
 # literal names riding the bundle npz.
 _CKPT_STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
-_CKPT_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats", "etok")
+_CKPT_OPT_KEYS = (
+    "ring_rows", "waits", "ictl", "tctl", "tstats", "etok",
+    "tele", "tlat",
+)
 
 _cache: Optional[AnalysisReport] = None
 
@@ -154,7 +176,8 @@ def check_layout(report: Optional[AnalysisReport] = None,
     from ..device import megakernel as m
 
     if not (d.DESC_WORDS <= d.TEN_ID < d.TEN_EXPIRED
-            < d.TEN_DEADLINE_MS < d.TEN_TOKEN < d.RING_ROW):
+            < d.TEN_DEADLINE_MS < d.TEN_TOKEN
+            < d.TEN_ADMIT_ROUND < d.RING_ROW):
         report.add(
             "layout", ERROR, None,
             "ring-row transport words must sit beyond the descriptor "
@@ -162,23 +185,41 @@ def check_layout(report: Optional[AnalysisReport] = None,
             f"<= TEN_ID={d.TEN_ID} < TEN_EXPIRED={d.TEN_EXPIRED} < "
             f"TEN_DEADLINE_MS={d.TEN_DEADLINE_MS} < "
             f"TEN_TOKEN={d.TEN_TOKEN} < "
+            f"TEN_ADMIT_ROUND={d.TEN_ADMIT_ROUND} < "
             f"RING_ROW={d.RING_ROW} violated",
             word="TEN_ID",
         )
     from ..device import egress as e
 
     if not (e.EGR_STATUS < e.EGR_TOKEN < e.EGR_TEN < e.EGR_FN
-            < e.EGR_SLOT < e.EGR_VALUE < e.EGR_WORDS
+            < e.EGR_SLOT < e.EGR_VALUE < e.EGR_T_ADMIT
+            < e.EGR_T_SPANS < e.EGR_WORDS
             and 0 <= e.EC_WRITE < e.EC_CONSUMED < e.EC_PARKED
             < e.EC_PARK_COUNT < e.EC_PARK_HEAD < e.EC_INFLIGHT < 8):
         report.add(
             "layout", ERROR, None,
             "completion-mailbox words violate the transport-word "
             f"ordering invariant: EGR {e.EGR_STATUS},{e.EGR_TOKEN},"
-            f"{e.EGR_TEN},{e.EGR_FN},{e.EGR_SLOT},{e.EGR_VALUE} must "
+            f"{e.EGR_TEN},{e.EGR_FN},{e.EGR_SLOT},{e.EGR_VALUE},"
+            f"{e.EGR_T_ADMIT},{e.EGR_T_SPANS} must "
             f"ascend below EGR_WORDS={e.EGR_WORDS} and the EC cursor "
             "words must ascend inside the 8-word ectl row",
             word="EGR_STATUS",
+        )
+    from ..device import telemetry as t
+
+    if not (0 <= t.LAT_ADMIT < t.LAT_INSTALL < t.LAT_FIRE < t.LAT_WORDS
+            and t.TG_ROUNDS < t.TG_INSTALLS < t.TG_RETIRES
+            < t.TG_PARKED < t.TG_BACKLOG < t.TG_ENTRIES
+            < t.TG_WORDS <= t.LAT_BUCKETS):
+        report.add(
+            "layout", ERROR, None,
+            "telemetry words violate the ordering invariant: the LAT "
+            f"stamps ({t.LAT_ADMIT},{t.LAT_INSTALL},{t.LAT_FIRE}) must "
+            f"ascend below LAT_WORDS={t.LAT_WORDS}, and the TG gauge "
+            f"words must ascend below TG_WORDS={t.TG_WORDS} which must "
+            f"fit the LAT_BUCKETS={t.LAT_BUCKETS}-wide gauge row",
+            word="LAT_ADMIT",
         )
     if not (m.LS_AGE < m.LS_WORDS
             and m.TS_MAX_AGE < m.TS_BUCKET_FIRES

@@ -62,6 +62,19 @@ ABI carry *transport metadata* the scheduler never copies
                    records it per installed row and the retirement-time
                    mailbox publish carries it back to the host, where it
                    keys the ``Future`` ledger (``FutureTable``).
+    20 TEN_ADMIT_ROUND  admit-round stamp of the request, in the stream's
+                   cumulative scheduler-round timebase (device/telemetry
+                   .py): the host pump stamps the round gauge it last saw
+                   echoed (``TenantTable.set_admit_round``), the
+                   telemetry-enabled install path copies it into the
+                   per-row stamp table, and retirement folds
+                   ``retire - admit`` into the on-device latency
+                   histogram. 0 = unstamped (telemetry off, or the
+                   stream's first entry). A nonzero stamp is PRESERVED by
+                   the pump on re-publication, so residue re-published
+                   after a checkpoint cut keeps its original admission
+                   round (the round gauge itself rides the echoed
+                   telemetry block across the cut).
 
 Because the words ride the row itself, tenant identity - a residue
 row's remaining deadline budget, and its submit token - survives every
@@ -95,6 +108,7 @@ __all__ = [
     "TEN_EXPIRED",
     "TEN_DEADLINE_MS",
     "TEN_TOKEN",
+    "TEN_ADMIT_ROUND",
     "TaskGraphBuilder",
 ]
 
@@ -127,6 +141,7 @@ TEN_ID = 16
 TEN_EXPIRED = 17
 TEN_DEADLINE_MS = 18
 TEN_TOKEN = 19
+TEN_ADMIT_ROUND = 20
 
 
 class TaskGraphBuilder:

@@ -73,6 +73,8 @@ __all__ = [
     "EGR_FN",
     "EGR_SLOT",
     "EGR_VALUE",
+    "EGR_T_ADMIT",
+    "EGR_T_SPANS",
     "EGR_WORDS",
     "EGR_EMPTY",
     "EGR_OK",
@@ -111,7 +113,16 @@ EGR_TEN = 2      # tenant lane index (TEN_ID of the injected row)
 EGR_FN = 3       # kernel-table F_FN of the retired task
 EGR_SLOT = 4     # result slot (descriptor F_OUT)
 EGR_VALUE = 5    # ivalues[F_OUT] at retirement
-EGR_WORDS = 8    # row stride (words 6..7 reserved)
+EGR_T_ADMIT = 6  # telemetry builds only: the row's TEN_ADMIT_ROUND
+                 # stamp (absolute cumulative scheduler round at host
+                 # admission; 0 = unstamped / telemetry off)
+EGR_T_SPANS = 7  # telemetry builds only: packed lifecycle deltas
+                 # ((fire - install) << 16) | (install - admit), each
+                 # half clamped to [0, 0xFFFF]. Retirement happens in
+                 # the same inner round as fire in this core (dispatch
+                 # and completion are atomic per round), so retire ==
+                 # fire and two deltas reconstruct the whole span.
+EGR_WORDS = 8    # row stride
 
 EGR_EMPTY = 0
 EGR_OK = 1

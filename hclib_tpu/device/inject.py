@@ -72,12 +72,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..runtime import resilience
 from ..runtime.resilience import CancelledError, StallError
+from ..runtime.clockprobe import EpochBracket
+from ..runtime.env import env_bool
 from .descriptor import (
     DESC_WORDS,
     F_FN,
     F_OUT,
     NO_TASK,
     RING_ROW,
+    TEN_ADMIT_ROUND,
     TEN_EXPIRED,
     TEN_ID,
     TEN_TOKEN,
@@ -94,6 +97,8 @@ from .egress import (
     EGR_OK,
     EGR_SLOT,
     EGR_STATUS,
+    EGR_T_ADMIT,
+    EGR_T_SPANS,
     EGR_TEN,
     EGR_TOKEN,
     EGR_VALUE,
@@ -101,7 +106,29 @@ from .egress import (
     TOKEN_LIMIT,
     EgressProtocolError,
 )
-from .megakernel import C_EXECUTED, C_OVERFLOW, C_PENDING, C_VALLOC, Megakernel
+from .megakernel import (
+    C_EXECUTED,
+    C_HEAD,
+    C_OVERFLOW,
+    C_PENDING,
+    C_TAIL,
+    C_VALLOC,
+    Megakernel,
+)
+from .telemetry import (
+    LAT_ADMIT,
+    LAT_BUCKETS,
+    LAT_FIRE,
+    LAT_INSTALL,
+    LAT_WORDS,
+    TG_BACKLOG,
+    TG_ENTRIES,
+    TG_INSTALLS,
+    TG_PARKED,
+    TG_RETIRES,
+    TG_ROUNDS,
+    unpack_spans,
+)
 from .tenants import (
     TC_CONSUMED,
     TC_DROPPED,
@@ -121,6 +148,7 @@ from .tracebuf import (
     TR_CKPT,
     TR_EGRESS,
     TR_INJECT,
+    TR_LATENCY,
     TR_QUIESCE,
     TR_TENANT,
     Tracer,
@@ -159,7 +187,7 @@ class StreamingMegakernel:
     """
 
     def __init__(self, mk: Megakernel, ring_capacity: int = 1024,
-                 tenants=None) -> None:
+                 tenants=None, telemetry=None) -> None:
         self.mk = mk
         # Rounded up to a whole 8-row chunk: the kernel fetches the ring in
         # 8-row DMAs, and the final chunk must not run off the array.
@@ -191,6 +219,32 @@ class StreamingMegakernel:
         self._egress = (
             self.tenants.egress if self.tenants is not None else None
         )
+        # Live telemetry plane (ISSUE 19, device/telemetry.py):
+        # per-row lifecycle stamps + per-tenant on-device latency
+        # histograms + a live-gauge row, riding two extra host-seeded/
+        # echoed SMEM pairs (the ctl-echo discipline) so the host can
+        # scrape them MID-STREAM (telemetry_snapshot / TelemetryPoller).
+        # Requires an egress-enabled tenant stream: the latency fold
+        # runs at the egress publish hook, keyed by the retiring row's
+        # tenant. None reads HCLIB_TPU_TELEMETRY; False forces off.
+        # Off compiles ZERO of it - no extra operands, no hooks - and
+        # stays bit-identical to the pre-telemetry kernel
+        # (tests/test_telemetry.py pins the lowered text).
+        if telemetry is None:
+            telemetry = env_bool("HCLIB_TPU_TELEMETRY")
+        self.telemetry = bool(telemetry)
+        if self.telemetry and self._egress is None:
+            raise ValueError(
+                "telemetry needs an egress-enabled tenant stream (the "
+                "latency histograms are per-tenant and fold at the "
+                "egress publish hook): build with tenants= plus an "
+                "EgressSpec, or set HCLIB_TPU_EGRESS_DEPTH"
+            )
+        # Last entry's echoed telemetry block + conversion state, under
+        # self._lock (written by the driver thread, read by pollers).
+        self._tele_seq = 0
+        self._tele_snapshot: Optional[Dict[str, Any]] = None
+        self._spans: Dict[int, Tuple[int, int, int]] = {}
         self._jitted: Dict[Any, Any] = {}
         self._pc_stats: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
@@ -424,13 +478,15 @@ class StreamingMegakernel:
         ntrace = 1 if trace is not None else 0
         nten = 1 if self.tenants is not None else 0
         negr = 1 if (nten and self._egress is not None) else 0
+        ntele = 1 if self.telemetry else 0
         depth = self._egress.depth if negr else 0
         park_cap = depth  # bounds tokened in-flight work (credit gate)
-        # + ring, ctl (+ tctl, tenant lanes) (+ egr/park/ectl/etok, egress)
-        n_in = 7 + ndata + nten + 4 * negr
+        # + ring, ctl (+ tctl, tenant lanes) (+ egr/park/ectl/etok,
+        # egress) (+ tele/tlat, telemetry)
+        n_in = 7 + ndata + nten + 4 * negr + 2 * ntele
         in_refs = refs[:n_in]
-        # + ctl out (+ tctl echo) (+ egress echoes)
-        n_out = 5 + ndata + ntrace + nten + 4 * negr
+        # + ctl out (+ tctl echo) (+ egress echoes) (+ telemetry echoes)
+        n_out = 5 + ndata + ntrace + nten + 4 * negr + 2 * ntele
         out_refs = refs[n_in : n_in + n_out]
         rest = refs[n_in + n_out :]
         nscratch = len(mk.scratch_specs)
@@ -443,6 +499,8 @@ class StreamingMegakernel:
             egr_in, park_in, ectl_in, etok_in = in_refs[
                 8 + ndata : 12 + ndata
             ]
+        if ntele:
+            tele_in, tlat_in = in_refs[12 + ndata : 14 + ndata]
         tasks, ready, counts, ivalues = out_refs[:4]
         ctl_out = out_refs[4]
         data = dict(zip(mk.data_specs.keys(), out_refs[5 : 5 + ndata]))
@@ -455,6 +513,10 @@ class StreamingMegakernel:
         if negr:
             egr_out, park_out, ectl_out, etok_out = out_refs[
                 6 + ndata + ntrace : 10 + ndata + ntrace
+            ]
+        if ntele:
+            tele_out, tlat_out = out_refs[
+                10 + ndata + ntrace : 12 + ndata + ntrace
             ]
         scratch = dict(zip(mk.scratch_specs.keys(), scratch_refs))
 
@@ -478,6 +540,24 @@ class StreamingMegakernel:
                 slot = tasks[idx, F_OUT]
                 write = ectl_out[EC_WRITE]
                 room = depth - (write - ectl_out[EC_CONSUMED])
+                if ntele:
+                    # Lifecycle span (telemetry builds only): retire
+                    # round == fire round (dispatch and completion are
+                    # atomic within one inner round), so the EGR span
+                    # word packs only (fire - install, install - admit)
+                    # and the fold below uses the live round gauge as
+                    # the retire stamp. unpack_spans / bucket_of /
+                    # hist_fold_reference (device/telemetry.py) are the
+                    # host spec of these three computations.
+                    now = tele_out[0, TG_ROUNDS]
+                    admit = tlat_out[idx, LAT_ADMIT]
+                    spans = (
+                        jnp.clip(
+                            now - tlat_out[idx, LAT_INSTALL], 0, 0xFFFF
+                        ) << 16
+                    ) | jnp.clip(
+                        tlat_out[idx, LAT_INSTALL] - admit, 0, 0xFFFF
+                    )
 
                 @pl.when(room > 0)
                 def _():
@@ -488,6 +568,9 @@ class StreamingMegakernel:
                     egr_out[s, EGR_FN] = tasks[idx, F_FN]
                     egr_out[s, EGR_SLOT] = slot
                     egr_out[s, EGR_VALUE] = ivalues[slot]
+                    if ntele:
+                        egr_out[s, EGR_T_ADMIT] = admit
+                        egr_out[s, EGR_T_SPANS] = spans
                     ectl_out[EC_WRITE] = write + 1
 
                 @pl.when(room <= 0)
@@ -502,18 +585,52 @@ class StreamingMegakernel:
                     park_out[p, EGR_FN] = tasks[idx, F_FN]
                     park_out[p, EGR_SLOT] = slot
                     park_out[p, EGR_VALUE] = ivalues[slot]
+                    if ntele:
+                        park_out[p, EGR_T_ADMIT] = admit
+                        park_out[p, EGR_T_SPANS] = spans
                     ectl_out[EC_PARK_COUNT] = n + 1
                     ectl_out[EC_PARKED] = ectl_out[EC_PARKED] + 1
                     tr.emit(TR_EGRESS, tr.now(), token, n + 1)
 
+                if ntele:
+                    # Histogram fold: log2 bucket of (retire - admit),
+                    # branch-free (b = sum of threshold crossings; the
+                    # last bucket is the counted overflow bucket). One
+                    # event, two views: the per-tenant counter bump the
+                    # poller scrapes, and the TR_LATENCY trace record.
+                    d = jnp.maximum(now - admit, 0)
+                    b = jnp.int32(0)
+                    for k in range(1, LAT_BUCKETS):
+                        b = b + (d >= (1 << k)).astype(jnp.int32)
+                    tele_out[1 + ten, b] = tele_out[1 + ten, b] + 1
+                    tele_out[0, TG_RETIRES] = (
+                        tele_out[0, TG_RETIRES] + 1
+                    )
+                    tr.emit(TR_LATENCY, tr.now(), (ten << 16) | b, d)
                 etok_out[idx] = jnp.int32(0)
                 ectl_out[EC_INFLIGHT] = ectl_out[EC_INFLIGHT] - 1
+
+        def tele_fire(idx):
+            """Telemetry fire stamp (the _make_core fire_hook seam):
+            runs at every dispatch site before the task body, so the
+            egress fold inside complete_hook sees it."""
+            tlat_out[idx, LAT_FIRE] = tele_out[0, TG_ROUNDS]
+
+        def tele_round():
+            """Telemetry round tick (the _make_core round_hook seam):
+            advances the cumulative round gauge - the stream's
+            timebase - and refreshes the point-in-time gauges."""
+            tele_out[0, TG_ROUNDS] = tele_out[0, TG_ROUNDS] + 1
+            tele_out[0, TG_BACKLOG] = counts[C_TAIL] - counts[C_HEAD]
+            tele_out[0, TG_PARKED] = ectl_out[EC_PARK_COUNT]
 
         core = mk._make_core(
             succ, tasks, ready, counts, ivalues, data, scratch, free, vfree,
             tasks_in, ready_in, counts_in, ivalues_in, True,
             tracer=tr if tr.enabled else None,
             complete_hook=egress_complete if negr else None,
+            fire_hook=tele_fire if ntele else None,
+            round_hook=tele_round if ntele else None,
         )
         cap = mk.capacity
 
@@ -521,6 +638,16 @@ class StreamingMegakernel:
 
         def install(row_slot) -> None:
             idx = core.install_descriptor(lambda w: rowbuf[row_slot, w])
+            if ntele:
+                # Lifecycle stamps: the ring row's host-stamped admit
+                # round rides into the per-row table (0 = unstamped),
+                # the install round is the live gauge, and installs
+                # count - tracked and untracked alike.
+                tlat_out[idx, LAT_ADMIT] = rowbuf[
+                    row_slot, TEN_ADMIT_ROUND
+                ]
+                tlat_out[idx, LAT_INSTALL] = tele_out[0, TG_ROUNDS]
+                tele_out[0, TG_INSTALLS] = tele_out[0, TG_INSTALLS] + 1
             if negr:
                 # Stamp the submit token (packed token | tenant << 24)
                 # onto the allocated task-table row so retirement knows
@@ -809,6 +936,24 @@ class StreamingMegakernel:
                 return 0
 
             jax.lax.fori_loop(0, park_cap, _flush, 0)
+        if ntele:
+            # Telemetry echo staging (the tctl pattern): host-seeded
+            # per entry, mutated by the hooks and the egress fold,
+            # echoed back at exit - the block the mid-run poller and
+            # the checkpoint cut both read.
+            def _cp_tele(i, _):
+                for w in range(LAT_BUCKETS):
+                    tele_out[i, w] = tele_in[i, w]
+                return 0
+
+            jax.lax.fori_loop(0, 1 + T, _cp_tele, 0)
+
+            def _cp_tlat(i, _):
+                for w in range(LAT_WORDS):
+                    tlat_out[i, w] = tlat_in[i, w]
+                return 0
+
+            jax.lax.fori_loop(0, cap, _cp_tlat, 0)
         # Initial ctl fetch: the consumed cursor (slot 2) persists across
         # entries through the host-echoed ctl.
         cp0 = pltpu.make_async_copy(ctl_in, ctlbuf, isem.at[0])
@@ -850,11 +995,13 @@ class StreamingMegakernel:
         # a tenants=None build compiles none of it.
         nten = 1 if self.tenants is not None else 0
         negr = 1 if (nten and self._egress is not None) else 0
+        ntele = 1 if self.telemetry else 0
         depth = self._egress.depth if negr else 0
         T = len(self.tenants) if nten else 0
         in_specs = (
             [smem()] * 5 + [anyspace(), anyspace()] + [anyspace()] * ndata
             + [smem()] * nten + [smem()] * (4 * negr)
+            + [smem()] * (2 * ntele)
         )
         data_shapes = [
             jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -880,10 +1027,18 @@ class StreamingMegakernel:
                 jax.ShapeDtypeStruct((8,), jnp.int32),
                 jax.ShapeDtypeStruct((mk.capacity,), jnp.int32),
             ] if negr else [])
+            + ([
+                # Telemetry: gauge row + per-tenant histograms, and the
+                # per-row lifecycle stamp table - host-seeded, echoed
+                # (the tctl pattern; device/telemetry.py).
+                jax.ShapeDtypeStruct((1 + T, LAT_BUCKETS), jnp.int32),
+                jax.ShapeDtypeStruct((mk.capacity, LAT_WORDS), jnp.int32),
+            ] if ntele else [])
         )
         out_specs = tuple(
             [smem()] * 4 + [smem()] + [anyspace()] * ndata
             + [smem()] * ntrace + [smem()] * nten + [smem()] * (4 * negr)
+            + [smem()] * (2 * ntele)
         )
         aliases = {0: 0, 2: 1, 3: 2, 4: 3}
         for i in range(ndata):
@@ -981,7 +1136,7 @@ class StreamingMegakernel:
                 unregister()
 
     @staticmethod
-    def _drain_egress(table, egr, park, ectl) -> int:
+    def _drain_egress(table, egr, park, ectl, spans=None) -> int:
         """Consume the completion mailbox AND the park ring at an entry
         boundary (this driver IS the poller), resolving each row's
         future exactly once. Mutates the arrays in place: consumed
@@ -989,8 +1144,19 @@ class StreamingMegakernel:
         parked rows resolve directly (they never occupied a mailbox
         slot) and the park ring empties. Draining both regions here is
         what makes a full mailbox unable to wedge quiesce or the
-        drained exit. Returns rows consumed."""
+        drained exit. ``spans`` (telemetry builds): a dict collecting
+        ``token -> (admit, install, fire)`` absolute rounds decoded off
+        the EGR span words. Returns rows consumed."""
         futures = table.futures
+
+        def _one(row):
+            if spans is not None:
+                spans[int(row[EGR_TOKEN])] = unpack_spans(
+                    row[EGR_T_ADMIT], row[EGR_T_SPANS]
+                )[:3]
+            futures.resolve(int(row[EGR_TOKEN]), int(row[EGR_VALUE]))
+            row[:] = 0
+
         depth = egr.shape[0]
         n = 0
         consumed = int(ectl[EC_CONSUMED])
@@ -1001,8 +1167,7 @@ class StreamingMegakernel:
                     f"mailbox slot {consumed % depth} consumed twice or "
                     f"never published (status {int(row[EGR_STATUS])})"
                 )
-            futures.resolve(int(row[EGR_TOKEN]), int(row[EGR_VALUE]))
-            row[:] = 0
+            _one(row)
             consumed += 1
             n += 1
         ectl[EC_CONSUMED] = consumed
@@ -1015,12 +1180,37 @@ class StreamingMegakernel:
                     f"park slot {(head + k) % cap} empty but counted "
                     f"(status {int(row[EGR_STATUS])})"
                 )
-            futures.resolve(int(row[EGR_TOKEN]), int(row[EGR_VALUE]))
-            row[:] = 0
+            _one(row)
             n += 1
         ectl[EC_PARK_HEAD] = 0
         ectl[EC_PARK_COUNT] = 0
         return n
+
+    # ---- live telemetry (ISSUE 19) ----
+
+    def telemetry_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Thread-safe copy of the LAST entry's echoed telemetry block
+        (None before the first telemetry entry completes): ``seq``
+        (monotone snapshot counter), ``tele`` (the (1+T, LAT_BUCKETS)
+        gauge+histogram block), ``rounds``/``entries`` (cumulative),
+        and ``ns_per_round`` (rounds->wall conversion from the entry
+        epoch brackets; None until a bracket with round progress
+        lands). This is the :class:`~..device.telemetry.TelemetryPoller`
+        source - call it from any thread while the stream runs."""
+        with self._lock:
+            if self._tele_snapshot is None:
+                return None
+            snap = dict(self._tele_snapshot)
+        snap["tele"] = np.array(snap["tele"])
+        return snap
+
+    def telemetry_spans(self) -> Dict[int, Tuple[int, int, int]]:
+        """``token -> (admit, install, fire)`` absolute rounds for every
+        retirement drained so far (telemetry builds; retire == fire).
+        tools/timeline.py joins these with Future submit/done wall
+        stamps into Perfetto flow events."""
+        with self._lock:
+            return dict(self._spans)
 
     @staticmethod
     def _adopt_etok(table, etok, tasks) -> None:
@@ -1063,6 +1253,20 @@ class StreamingMegakernel:
             park_np = np.zeros((depth, EGR_WORDS), np.int32)
             ectl_np = np.zeros(8, np.int32)
             etok_np = np.zeros(mk.capacity, np.int32)
+        if self.telemetry:
+            # Telemetry host halves: the gauge+histogram block and the
+            # per-row stamp table (host-seeded every entry, mutated by
+            # the kernel, snapshotted after) plus the epoch bracket
+            # that converts cumulative rounds to wall time.
+            tele_np = np.zeros((1 + len(table), LAT_BUCKETS), np.int32)
+            tlat_np = np.zeros((mk.capacity, LAT_WORDS), np.int32)
+            bracket = EpochBracket()
+            prev_rounds = 0
+            if resume_state is None:
+                with self._lock:
+                    self._spans = {}
+                    self._tele_snapshot = None
+                    self._tele_seq = 0
         injected = 0
         if resume_state is not None:
             # Same-object resume must behave like a fresh stream: clear
@@ -1113,6 +1317,27 @@ class StreamingMegakernel:
                     # negative, inflating the gate until the park ring
                     # overwraps its counted rows.
                     ectl_np[EC_INFLIGHT] = int(np.count_nonzero(etok_np))
+                if self.telemetry:
+                    # The telemetry block rides the cut: the round
+                    # gauge is cumulative, so resumed rows' measured
+                    # latencies span the preemption. Absent keys mean
+                    # the snapshot came from a telemetry-off stream -
+                    # start the plane fresh from zero.
+                    for name, cur in (
+                        ("tele", tele_np), ("tlat", tlat_np),
+                    ):
+                        blk = st.get(name)
+                        if blk is None:
+                            continue
+                        blk = np.asarray(blk, np.int32)
+                        if blk.shape != cur.shape:
+                            raise ValueError(
+                                f"resume {name} block has shape "
+                                f"{blk.shape}; this stream expects "
+                                f"{cur.shape}"
+                            )
+                        cur[:] = blk
+                    prev_rounds = int(tele_np[0, TG_ROUNDS])
             elif "tctl" in st or "tstats" in st:
                 # The mirror of TenantTable.resume_from's guard: a
                 # tenant-tagged snapshot resumed on a plain stream would
@@ -1162,6 +1387,7 @@ class StreamingMegakernel:
                 None if self.tenants is None
                 else (len(self.tenants), self.tenants.region_rows),
                 None if self._egress is None else self._egress.depth,
+                bool(self.telemetry),
             ) + key
             self._jitted[key], self._pc_stats = shared_build(
                 mk, variant, lambda: self._build(quantum, max_rounds),
@@ -1206,6 +1432,10 @@ class StreamingMegakernel:
                         jnp.asarray(egr_np), jnp.asarray(park_np),
                         jnp.asarray(ectl_np), jnp.asarray(etok_np),
                     ]
+                if self.telemetry:
+                    extra += [
+                        jnp.asarray(tele_np), jnp.asarray(tlat_np),
+                    ]
                 outs = jitted(
                     jnp.asarray(state[0]), jnp.asarray(succ),
                     jnp.asarray(state[1]), jnp.asarray(state[2]),
@@ -1226,7 +1456,15 @@ class StreamingMegakernel:
                     egr_np, park_np, ectl_np, etok_np = (
                         np.array(outs[base + i]) for i in range(4)
                     )
-                    self._drain_egress(table, egr_np, park_np, ectl_np)
+                    sp = {} if self.telemetry else None
+                    self._drain_egress(
+                        table, egr_np, park_np, ectl_np, spans=sp
+                    )
+                    if self.telemetry:
+                        tele_np = np.array(outs[base + 4])
+                        tlat_np = np.array(outs[base + 5])
+                        with self._lock:
+                            self._spans.update(sp)
                     table.futures.poison_all(
                         f"stream aborted: {abort_reason}"
                     )
@@ -1263,6 +1501,12 @@ class StreamingMegakernel:
                 # Tenant lanes: the pump expires/publishes the host
                 # backlogs into the per-lane ring regions and builds the
                 # tctl block this entry uploads; the plain tail is unused.
+                if self.telemetry:
+                    # Admit-round feedback: rows published by THIS pump
+                    # are stamped with the round gauge the last entry
+                    # echoed - ring-wait time is inside the measured
+                    # admission->retire span.
+                    table.set_admit_round(int(tele_np[0, TG_ROUNDS]))
                 tctl_np = table.pump(ring)
                 injected = table.total_published()
                 ctl[0] = 0
@@ -1286,6 +1530,9 @@ class StreamingMegakernel:
                     jnp.asarray(egr_np), jnp.asarray(park_np),
                     jnp.asarray(ectl_np), jnp.asarray(etok_np),
                 ] if egspec is not None else []),
+                *([
+                    jnp.asarray(tele_np), jnp.asarray(tlat_np),
+                ] if self.telemetry else []),
             )
             state = [np.asarray(o) for o in outs[:4]]
             ctl_o = np.asarray(outs[4])
@@ -1308,7 +1555,40 @@ class StreamingMegakernel:
                 egr_np, park_np, ectl_np, etok_np = (
                     np.array(outs[base + i]) for i in range(4)
                 )
-                self._drain_egress(table, egr_np, park_np, ectl_np)
+                sp = {} if self.telemetry else None
+                self._drain_egress(
+                    table, egr_np, park_np, ectl_np, spans=sp
+                )
+                if sp:
+                    with self._lock:
+                        self._spans.update(sp)
+            if self.telemetry:
+                # Absorb the echoed histogram/gauge + stamp blocks and
+                # publish a coherent snapshot for mid-run scrapers. The
+                # epoch bracket pairs this entry's host wall clock with
+                # the round-gauge delta so rounds convert to ns without
+                # any on-device clock.
+                tbase = 10 + ndata + ntrace
+                tele_np = np.array(outs[tbase])
+                tlat_np = np.array(outs[tbase + 1])
+                tele_np[0, TG_ENTRIES] += 1
+                t1_ns = time.monotonic_ns()
+                rounds = int(tele_np[0, TG_ROUNDS])
+                bracket.accumulate(
+                    entry_t0_ns, t1_ns, rounds - prev_rounds
+                )
+                prev_rounds = rounds
+                with self._lock:
+                    self._tele_seq += 1
+                    self._tele_snapshot = {
+                        "seq": self._tele_seq,
+                        "tele": tele_np.copy(),
+                        "rounds": rounds,
+                        "entries": int(tele_np[0, TG_ENTRIES]),
+                        "ns_per_round": bracket.ns_per_round(),
+                        "t0_ns": entry_t0_ns,
+                        "t1_ns": t1_ns,
+                    }
             counts_np = state[2]
             ctl[2] = ctl_o[2]  # device-consumed cursor persists
             if bool(counts_np[C_OVERFLOW]):
@@ -1381,6 +1661,12 @@ class StreamingMegakernel:
                         # reattach via resume tokens after resume_from
                         # re-adopts this table.
                         info["state"]["etok"] = etok_np.copy()
+                    if self.telemetry:
+                        # Histogram/gauge + stamp blocks ride the cut so
+                        # the resumed stream's round gauge and per-tenant
+                        # latency totals stay cumulative across it.
+                        info["state"]["tele"] = tele_np.copy()
+                        info["state"]["tlat"] = tlat_np.copy()
                     info["state"].update(table.export_state(ring))
                 else:
                     residue = (
@@ -1419,6 +1705,12 @@ class StreamingMegakernel:
                     info["program_cache"] = dict(self._pc_stats)
                 if table is not None:
                     info["tenants"] = table.stats()
+                if self.telemetry:
+                    info["telemetry"] = {
+                        "tele": tele_np.copy(),
+                        "ns_per_round": bracket.ns_per_round(),
+                        "rounds": int(tele_np[0, TG_ROUNDS]),
+                    }
                 if mk.trace is not None and trace_row is not None:
                     info["trace"] = trace_info(
                         [trace_row], entry_t0_ns, entry_t1_ns,

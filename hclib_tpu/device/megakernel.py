@@ -1132,6 +1132,8 @@ class Megakernel:
         tstats=None,
         tracer=None,
         quiesce_hook=None,
+        fire_hook=None,
+        round_hook=None,
     ):
         """Builds the scheduler core closures over a concrete set of refs:
         ``stage()`` (copy host state into the mutable windows), and
@@ -1154,6 +1156,15 @@ class Megakernel:
         drain), leaving the live scheduler state in the output windows.
         The hook owns observation bookkeeping (qstat, TR_QUIESCE). None
         compiles nothing - the checkpoint-off path is byte-identical.
+
+        ``fire_hook(idx)`` / ``round_hook()`` are the telemetry seams
+        (ISSUE 19, device/telemetry.py): round_hook() runs once per
+        scheduling round right after the trace tick (it owns the
+        cumulative round counter and the live gauges), fire_hook(idx)
+        runs at every dispatch site - scalar pop and each batch slot -
+        BEFORE the task body/complete, so the fire-round stamp is
+        visible to the egress fold inside complete_hook. None compiles
+        nothing - the telemetry-off path is byte-identical.
         """
         capacity = self.capacity
         num_values = value_limit if value_limit is not None else self.num_values
@@ -1454,6 +1465,8 @@ class Megakernel:
                 for s in range(B):
                     @pl.when(jnp.int32(s) < take)
                     def _(s=s):
+                        if fire_hook is not None:
+                            fire_hook(lanes[li, (base + s) % capacity])
                         complete(lanes[li, (base + s) % capacity])
                 if fifo:
                     lstate[li, LS_HEAD] = head + take
@@ -1500,6 +1513,8 @@ class Megakernel:
                 # host epoch brackets the launch and timeline.py
                 # interpolates).
                 rt = tr.tick()
+                if round_hook is not None:
+                    round_hook()
                 # Quiesce poll (checkpoint builds only): a True stops this
                 # round's pop - the round boundary the export contract
                 # promises - and exits the loop below.
@@ -1518,6 +1533,8 @@ class Megakernel:
                         idx = ready[(tail - 1) % capacity]
                         counts[C_TAIL] = tail - 1
                         tr.emit(TR_FIRE_SCALAR, rt, tasks[idx, F_FN], idx)
+                        if fire_hook is not None:
+                            fire_hook(idx)
                         step(idx)
 
                     return (

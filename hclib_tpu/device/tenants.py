@@ -110,6 +110,7 @@ from .descriptor import (
     NO_TASK,
     NUM_ARGS,
     RING_ROW,
+    TEN_ADMIT_ROUND,
     TEN_DEADLINE_MS,
     TEN_EXPIRED,
     TEN_ID,
@@ -470,6 +471,11 @@ class TenantTable:
         # "closed" verdict - never an ACCEPTED row that silently never
         # runs. resume_from reopens.
         self._closed = False
+        # Telemetry admit-round stamp (ISSUE 19, device/telemetry.py):
+        # the stream driver feeds back the last echoed cumulative round
+        # gauge and the next pump stamps it onto newly published rows'
+        # TEN_ADMIT_ROUND word. 0 = telemetry off / first entry.
+        self._admit_round = 0
         self._lanes: List[_Lane] = [
             _Lane(s, i, self.scope, clock) for i, s in enumerate(specs)
         ]
@@ -751,6 +757,14 @@ class TenantTable:
 
     # ---- the stream driver's half (pump before entry, absorb after) ----
 
+    def set_admit_round(self, r: int) -> None:
+        """Telemetry (ISSUE 19): record the stream's last echoed
+        cumulative round gauge; the next :meth:`pump` stamps it onto
+        newly published rows' TEN_ADMIT_ROUND word (never overwriting a
+        nonzero stamp - resumed residue keeps its original admission)."""
+        with self._lock:
+            self._admit_round = int(r)
+
     def pump(self, ring: np.ndarray) -> np.ndarray:
         """Expire, publish, and build the tctl block for one entry:
         drops expired host-queued rows, marks expired published rows for
@@ -823,6 +837,19 @@ class TenantTable:
                     ):
                         continue
                     ring[base + lane.published] = p.row
+                    # Telemetry admit stamp - PRESERVE a nonzero word:
+                    # residue re-published after a checkpoint cut keeps
+                    # its ORIGINAL admission round (the round gauge is
+                    # cumulative across the cut), so measured latency
+                    # spans the preemption, not just the resumed tail.
+                    if (
+                        self._admit_round
+                        and ring[base + lane.published,
+                                 TEN_ADMIT_ROUND] == 0
+                    ):
+                        ring[base + lane.published, TEN_ADMIT_ROUND] = (
+                            self._admit_round
+                        )
                     p.index = lane.published
                     lane.pub_meta.append(p)
                     lane.published += 1
@@ -1489,6 +1516,16 @@ class MeshTenantTable:
                     )
 
     # ---- the mesh driver's half ----
+
+    def set_admit_round(self, r: int, device: Optional[int] = None) -> None:
+        """Telemetry admit-round feedback, mesh face: one device's round
+        gauge (``device=``) or all replicas at once (mesh drivers with a
+        single merged gauge)."""
+        if device is not None:
+            self.tables[int(device)].set_admit_round(r)
+            return
+        for t in self.tables:
+            t.set_admit_round(r)
 
     def pump(self, rings: np.ndarray) -> np.ndarray:
         """Expire/publish every device's lanes and build the stacked

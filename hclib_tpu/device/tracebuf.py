@@ -74,6 +74,7 @@ __all__ = [
     "TR_FIRE_AGE",
     "TR_FIRE_BUCKET",
     "TR_EGRESS",
+    "TR_LATENCY",
     "bucket_occupancy",
     "SC_HOLD",
     "SC_OUT",
@@ -83,6 +84,7 @@ __all__ = [
     "SC_FINISH",
     "SC_DEADLINE_OUT",
     "SC_STRAND_HOLD",
+    "SC_SLO_OUT",
     "SC_NAMES",
     "CK_SAVE",
     "CK_LOAD",
@@ -148,6 +150,13 @@ TR_EGRESS = 19         # a = submit token of the retired row, b = park
                        # dropped, never an OVF abort). A publish emits
                        # nothing: the write-cursor echo already counts
                        # it, and the hot path stays record-free.
+TR_LATENCY = 20        # a = (tenant << 16) | latency bucket, b = raw
+                       # (retire - admit) delta in scheduler rounds -
+                       # the per-retirement LATENCY record (telemetry
+                       # builds only, device/telemetry.py): emitted at
+                       # the egress fold that also bumps the on-device
+                       # histogram, so the Perfetto track and the
+                       # scraped histogram are two views of one event.
 
 # TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
 SC_HOLD = 0
@@ -160,6 +169,11 @@ SC_DEADLINE_OUT = 6   # tenant deadline-pressure scale-out (no gates:
                       # it must beat the watchdog's strike ladder)
 SC_STRAND_HOLD = 7    # scale-in refused: it would strand a tenant's
                       # in-flight quota / ring residue
+SC_SLO_OUT = 8        # SLO burn-rate scale-out (runtime/slo.py): the
+                      # latency histogram's multi-window burn rate
+                      # crossed HCLIB_TPU_SLO_BURN. Like deadline_out
+                      # it bypasses hysteresis AND cooldown - an SLO
+                      # on fire must not wait out a cooldown window.
 
 # TR_CKPT store subcodes (the durable BundleStore, runtime/checkpoint
 # .py): host-emitted records ride the TR_CKPT tag with a NEGATIVE a
@@ -184,6 +198,7 @@ SC_NAMES: Dict[int, str] = {
     SC_FINISH: "finish",
     SC_DEADLINE_OUT: "deadline out",
     SC_STRAND_HOLD: "strand hold",
+    SC_SLO_OUT: "slo out",
 }
 
 # The ONE name table for CK_* codes - runtime/checkpoint.py's
@@ -217,6 +232,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_FIRE_AGE: "fire_age",
     TR_FIRE_BUCKET: "fire_bucket",
     TR_EGRESS: "egress_park",
+    TR_LATENCY: "latency",
 }
 
 # TR_CREDIT delta codes (b word).

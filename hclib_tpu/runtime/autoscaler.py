@@ -109,7 +109,7 @@ class Observation:
 
     __slots__ = (
         "ndev", "backlog", "pending", "executed_delta", "inject_backlog",
-        "quarantined", "slice_s", "tenants",
+        "quarantined", "slice_s", "tenants", "latency_pressure",
     )
 
     def __init__(
@@ -122,6 +122,7 @@ class Observation:
         quarantined: Sequence[int] = (),
         slice_s: float = 0.0,
         tenants: Optional[Dict[str, Dict[str, float]]] = None,
+        latency_pressure: float = 0.0,
     ) -> None:
         self.ndev = int(ndev)
         self.backlog = [int(b) for b in backlog]
@@ -131,6 +132,10 @@ class Observation:
         self.quarantined = tuple(sorted(set(int(q) for q in quarantined)))
         self.slice_s = float(slice_s)
         self.tenants = tenants
+        # Max burn rate across the SLO engine's windows (runtime/slo.py
+        # SloEstimator.latency_pressure(); 0.0 when no SLO is
+        # configured or the feed is absent - the rung is then dead).
+        self.latency_pressure = float(latency_pressure)
 
     @property
     def stranded_tenants(self) -> List[str]:
@@ -160,6 +165,7 @@ class Observation:
         cls, ndev: int, info: Dict[str, Any], executed_before: int,
         slice_s: float,
         tenants: Optional[Dict[str, Dict[str, float]]] = None,
+        latency_pressure: float = 0.0,
     ) -> "Observation":
         from ..device.megakernel import C_HEAD, C_TAIL
 
@@ -177,7 +183,7 @@ class Observation:
             ndev=ndev, backlog=backlog, pending=int(info["pending"]),
             executed_delta=int(info["executed"]) - int(executed_before),
             inject_backlog=inj, quarantined=quarantined, slice_s=slice_s,
-            tenants=tenants,
+            tenants=tenants, latency_pressure=latency_pressure,
         )
 
 
@@ -274,6 +280,11 @@ class AutoscalerPolicy:
       watchdog's strike ladder (budget exhaustion cancels the lane) to
       the punch, so this path has no flap guard, only the post-resize
       cooldown it sets;
+    - SLO BURN (ISSUE 19) rides the same no-guard lane: an observation
+      whose ``latency_pressure`` (max multi-window burn rate from
+      ``runtime/slo.py``) reaches ``slo_burn`` triggers an immediate
+      ``slo_out`` scale-out - the latency ladder's earliest rung,
+      firing before tail latency converts into deadline-budget drain;
     - EVACUATION bypasses both too: a quarantined chip is resharded
       around at the first observation that names it - fault recovery
       must not wait out a flap guard. The target drops to the largest
@@ -299,6 +310,7 @@ class AutoscalerPolicy:
         cooldown: int = 2,
         scale_out_delta: Optional[float] = None,
         tenant_pressure: Optional[float] = None,
+        slo_burn: Optional[float] = None,
     ) -> None:
         if min_devices < 1 or _pof2_floor(min_devices) != min_devices:
             raise ValueError(
@@ -358,6 +370,16 @@ class AutoscalerPolicy:
                 f"tenant_pressure must be in (0, 1], got "
                 f"{self.tenant_pressure} (it is a fraction of the "
                 "tenant's deadline budget drained per slice)"
+            )
+        # The SLO burn rung (ISSUE 19): raise semantics for the same
+        # reason as the live-delta knobs.
+        self.slo_burn = (
+            env_float("HCLIB_TPU_SLO_BURN", 2.0)
+            if slo_burn is None else float(slo_burn)
+        )
+        if self.slo_burn <= 0:
+            raise ValueError(
+                f"slo_burn must be > 0, got {self.slo_burn}"
             )
         self.hysteresis = int(hysteresis)
         self.cooldown = int(cooldown)
@@ -459,6 +481,23 @@ class AutoscalerPolicy:
                 f"tenant {worst!r} deadline budget draining "
                 f"({drain:.0%}/slice >= {self.tenant_pressure:.0%}): "
                 "scale out before the watchdog strikes",
+            )
+        # SLO burn (ISSUE 19) shares the no-flap-guard contract: a
+        # breaching burn rate means the latency error budget drains
+        # NOW, and the scale-out must land before the tail breaches
+        # hard enough to trip the deadline-budget rung above (or the
+        # watchdog behind it). Only the post-resize cooldown it sets
+        # gates repeats.
+        if (
+            obs.latency_pressure >= self.slo_burn
+            and obs.ndev < self.max_devices
+        ):
+            target = min(obs.ndev * 2, self.max_devices)
+            self._resized()
+            return (
+                target, "slo_out",
+                f"latency burn {obs.latency_pressure:.2f} >= "
+                f"{self.slo_burn:g}: SLO error budget draining",
             )
         if self._cooling > 0:
             self._cooling -= 1

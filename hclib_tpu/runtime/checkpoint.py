@@ -115,9 +115,16 @@ BUNDLE_VERSION = 1
 # completion-mailbox egress runs (device/egress.py; tokens of
 # installed-but-unretired rows survive the cut so their futures resolve
 # after resume) - the resident kind adds its exported wait table and -
-# when injecting - the per-device ring residue + cursor words).
+# when injecting - the per-device ring residue + cursor words. A
+# telemetry-enabled stream (device/telemetry.py) adds the echoed
+# histogram/gauge block ``tele`` and per-row stamp table ``tlat`` so
+# the round timebase and per-tenant latency totals stay cumulative
+# across the cut).
 _STATE_KEYS = ("tasks", "succ", "ready", "counts", "ivalues")
-_OPT_KEYS = ("ring_rows", "waits", "ictl", "tctl", "tstats", "etok")
+_OPT_KEYS = (
+    "ring_rows", "waits", "ictl", "tctl", "tstats", "etok",
+    "tele", "tlat",
+)
 
 # Descriptor-word indices, bound once (descriptor ABI, device/descriptor).
 from ..device.descriptor import (  # noqa: E402

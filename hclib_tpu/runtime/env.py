@@ -177,6 +177,26 @@ REGISTRY = {
         _v("HCLIB_TPU_EGRESS_BACKOFF_S", "float", "0.05",
            "Future.result() bounded-backoff poll cap, seconds "
            "(malformed text raises)"),
+        # -- live telemetry plane (device/telemetry.py, runtime/slo.py) --
+        _v("HCLIB_TPU_TELEMETRY", "bool", "off",
+           "compile the live telemetry plane into egress-enabled "
+           "streams: per-request lifecycle stamps + on-device latency "
+           "histograms, scrapeable mid-run (0 forces off)"),
+        _v("HCLIB_TPU_TELEMETRY_POLL_S", "float", "0.05",
+           "TelemetryPoller snapshot interval, seconds (malformed "
+           "text raises)"),
+        _v("HCLIB_TPU_SLO_QUANTILE", "float", "0.99",
+           "SLO objective quantile for the burn-rate engine, in "
+           "(0, 1] (malformed text raises)"),
+        _v("HCLIB_TPU_SLO_OBJECTIVE_ROUNDS", "int", "unset",
+           "SLO latency objective, scheduler rounds: requests over "
+           "this are burn-budget violations (malformed text raises)"),
+        _v("HCLIB_TPU_SLO_BURN", "float", "2.0",
+           "burn-rate threshold that fires the slo_out scale-out "
+           "(max over windows; malformed text raises)"),
+        _v("HCLIB_TPU_SLO_WINDOWS_S", "str", "60,300",
+           "comma-separated burn-rate window lengths, seconds "
+           "(malformed text raises)"),
         # -- native C++ runtime (read by getenv in native/, not here) --
         _v("HCLIB_TPU_AFFINITY", "str", "none",
            "native worker CPU pinning: strided | chunked | none",

@@ -24,6 +24,16 @@ Model:
   become rates when divided by the timestamp delta).
 - ``to_json()`` / ``to_prometheus()`` render a snapshot; the Prometheus
   form sanitizes keys into ``<namespace>_<key>`` gauges.
+- ``watch(name, source)`` (ISSUE 19) is the live-refresh face: a
+  daemon thread polls the source every interval and records the latest
+  mapping, so a scrape endpoint (tools/metrics_serve.py) serves fresh
+  numbers without snapshotting on the request path - and the last
+  value survives the source going away. ``record_latency(block)``
+  stores a scraped ``TelemetryBlock`` whose per-tenant histograms
+  export in the native Prometheus histogram form
+  (``hclib_latency_bucket{tenant=...,le=...}``, cumulative, ``+Inf``
+  capped, plus ``hclib_latency_count``; ``le`` is in scheduler rounds,
+  with ``hclib_latency_ns_per_round`` alongside for conversion).
 
 Enable runtime-side via ``Runtime(metrics=True)`` or
 ``HCLIB_TPU_METRICS=1``: the runtime registers its own ``stats_dict``
@@ -40,7 +50,12 @@ import threading
 import time
 from typing import Any, Callable, Dict, Mapping, Optional
 
-__all__ = ["MetricsRegistry", "CHECKPOINT_EVENTS"]
+__all__ = ["MetricsRegistry", "CHECKPOINT_EVENTS", "LATENCY_FAMILY"]
+
+# The latency-histogram family name is a dashboard ABI (ISSUE 19's
+# acceptance scrapes it literally), pinned independently of the
+# registry namespace.
+LATENCY_FAMILY = "hclib_latency"
 
 # The canonical durable-store event series (runtime/checkpoint.py's
 # BundleStore records one ``record_event`` per store action, so each
@@ -90,6 +105,8 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._sources: Dict[str, Callable[[], Mapping]] = {}
         self._records: Dict[str, Mapping] = {}
+        self._watches: Dict[str, threading.Event] = {}
+        self._latency = None  # (TelemetryBlock, {index: label})
 
     # -- wiring --
 
@@ -108,6 +125,71 @@ class MetricsRegistry:
         """Store a static snapshot under ``name`` (latest wins)."""
         with self._lock:
             self._records[name] = dict(mapping)
+
+    def watch(
+        self,
+        name: str,
+        source: Callable[[], Optional[Mapping]],
+        interval_s: Optional[float] = None,
+        on_update: Optional[Callable[[Mapping], None]] = None,
+    ) -> None:
+        """Live refresh (ISSUE 19): poll ``source`` on a daemon thread
+        every ``interval_s`` (default HCLIB_TPU_TELEMETRY_POLL_S) and
+        ``record`` the latest mapping under ``name`` - scrapes then
+        read fresh values off the record table without touching the
+        source on the request path, and the last value outlives the
+        source. ``None`` returns skip (a stream before its first
+        entry); a raising source records ``<name>.error = 1`` once and
+        keeps polling. ``unwatch(name)`` stops the thread; re-watching
+        a name replaces the old watch."""
+        if interval_s is None:
+            from .env import env_float
+
+            interval_s = env_float("HCLIB_TPU_TELEMETRY_POLL_S", 0.05)
+        interval_s = float(interval_s)
+        if interval_s <= 0:
+            raise ValueError(
+                f"watch interval must be > 0 seconds, got {interval_s}"
+            )
+        stop = threading.Event()
+        with self._lock:
+            old = self._watches.pop(name, None)
+            self._watches[name] = stop
+        if old is not None:
+            old.set()
+
+        def _loop() -> None:
+            while not stop.is_set():
+                try:
+                    m = source()
+                except Exception:
+                    m = {"error": 1}
+                if m is not None:
+                    self.record(name, m)
+                    if on_update is not None:
+                        on_update(m)
+                stop.wait(interval_s)
+
+        threading.Thread(
+            target=_loop, name=f"hclib-metrics-watch-{name}", daemon=True
+        ).start()
+
+    def unwatch(self, name: str) -> None:
+        """Stop a ``watch`` thread; its last recorded value remains."""
+        with self._lock:
+            stop = self._watches.pop(name, None)
+        if stop is not None:
+            stop.set()
+
+    def record_latency(self, block, labels: Optional[Mapping] = None):
+        """Store a scraped ``TelemetryBlock`` (device/telemetry.py) for
+        native histogram exposition. ``labels`` maps tenant INDEX ->
+        label text (defaults to the index)."""
+        with self._lock:
+            self._latency = (
+                block,
+                None if labels is None else dict(labels),
+            )
 
     def record_event(self, name: str, mapping: Mapping) -> None:
         """Record one occurrence of a recurring event (an autoscaler
@@ -327,5 +409,45 @@ class MetricsRegistry:
             v = snap["metrics"][k]
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {float(v)!r}")
+        lines.extend(self._latency_lines())
         lines.append("")
         return "\n".join(lines)
+
+    def _latency_lines(self) -> list:
+        """Native Prometheus histogram exposition of the recorded
+        TelemetryBlock: per tenant, CUMULATIVE bucket counts with
+        ``le`` = the bucket's upper edge in scheduler rounds (the
+        overflow bucket folds into ``+Inf``), plus ``_count``; and the
+        rounds->ns factor as a gauge when the block carries one."""
+        with self._lock:
+            rec = self._latency
+        if rec is None:
+            return []
+        from ..device.telemetry import bucket_edges
+
+        block, labels = rec
+        fam = LATENCY_FAMILY
+        edges = bucket_edges()
+        lines = [f"# TYPE {fam} histogram"]
+        for t in range(block.tenants):
+            label = str(t if labels is None else labels.get(t, t))
+            counts = block.hist(t)
+            cum = 0
+            for (_, hi), c in zip(edges, counts.tolist()):
+                cum += int(c)
+                if hi is None:
+                    continue  # the overflow mass lands in +Inf below
+                lines.append(
+                    f'{fam}_bucket{{tenant="{label}",le="{hi}"}} {cum}'
+                )
+            total = int(counts.sum())
+            lines.append(
+                f'{fam}_bucket{{tenant="{label}",le="+Inf"}} {total}'
+            )
+            lines.append(f'{fam}_count{{tenant="{label}"}} {total}')
+        if block.ns_per_round is not None:
+            lines.append(f"# TYPE {fam}_ns_per_round gauge")
+            lines.append(
+                f"{fam}_ns_per_round {float(block.ns_per_round)!r}"
+            )
+        return lines

@@ -1,0 +1,217 @@
+"""SLO engine over the live telemetry plane (ISSUE 19): streaming
+latency quantiles and multi-window burn rates from histogram deltas.
+
+Input model: the on-device latency histograms (device/telemetry.py) are
+CUMULATIVE log2-bucketed counters in scheduler rounds, echoed to the
+host every streaming entry. ``SloEstimator.observe(counts, t_s)`` folds
+one such snapshot in; everything else derives:
+
+- ``quantiles()``: p50/p95/p99 over the whole stream so far, each the
+  UPPER edge of the bucket holding that rank (``quantile_from_hist``) -
+  a one-bucket-resolution bound, which is exactly the precision the
+  acceptance tests hold the host-stamp comparison to.
+- ``burn_rates()``: per configured window, the classic SRE burn rate
+  ``(bad / total) / (1 - q)`` computed on the DELTA between now and the
+  oldest retained sample inside the window - ``bad`` counts requests in
+  buckets whose lower edge is >= the objective (whole buckets only, so
+  a bucket straddling the objective is charitably counted good; the
+  estimator never cries wolf from quantization). A burn of 1.0 means
+  violations arrive exactly at the budget rate; 2.0 means the error
+  budget for the window halves.
+- ``latency_pressure()``: max burn across windows - the one scalar the
+  autoscaler's ``Observation`` carries and the ``slo_out`` policy rung
+  thresholds against (HCLIB_TPU_SLO_BURN).
+
+No objective configured (``objective_rounds`` None and
+HCLIB_TPU_SLO_OBJECTIVE_ROUNDS unset) means burn rates and pressure
+read 0.0: the estimator is then a pure quantile tracker and the policy
+rung is structurally dead - the same off-path discipline as the rest of
+the plane.
+
+Host-side only: no device words, no threads; samples are pruned to the
+longest window so a long-lived server holds O(window / poll interval)
+snapshots.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..device.telemetry import quantile_from_hist
+
+__all__ = ["SloEstimator", "parse_windows"]
+
+
+def parse_windows(text: str) -> Tuple[float, ...]:
+    """Parse a comma-separated window list ("60,300") into seconds.
+    Malformed or non-positive entries raise, naming the knob - an SLO
+    misconfiguration must not silently change alerting windows."""
+    out = []
+    for part in str(text).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            w = float(part)
+        except ValueError:
+            raise ValueError(
+                f"HCLIB_TPU_SLO_WINDOWS_S entry {part!r} is not a number"
+            ) from None
+        if w <= 0:
+            raise ValueError(
+                f"HCLIB_TPU_SLO_WINDOWS_S entry {part!r} must be > 0"
+            )
+        out.append(w)
+    if not out:
+        raise ValueError("HCLIB_TPU_SLO_WINDOWS_S parsed to no windows")
+    return tuple(out)
+
+
+class SloEstimator:
+    """Streaming quantiles + burn rates over cumulative histograms.
+
+    ``objective_rounds``/``quantile``/``windows_s`` default from the
+    SLO registry knobs (runtime/env.py; malformed text raises there).
+    Feed it with ``observe(counts, t_s)`` where
+    ``counts`` is one tenant's (or the summed) cumulative bucket vector
+    and ``t_s`` a monotonic clock - tests pass a fake clock.
+    """
+
+    def __init__(
+        self,
+        objective_rounds: Optional[int] = None,
+        quantile: Optional[float] = None,
+        windows_s: Optional[Sequence[float]] = None,
+    ) -> None:
+        from .env import env_float, env_int, env_str
+
+        if objective_rounds is None:
+            objective_rounds = env_int("HCLIB_TPU_SLO_OBJECTIVE_ROUNDS")
+        if quantile is None:
+            quantile = env_float("HCLIB_TPU_SLO_QUANTILE", 0.99)
+        if windows_s is None:
+            windows_s = parse_windows(
+                env_str("HCLIB_TPU_SLO_WINDOWS_S", "60,300")
+            )
+        if not 0 < float(quantile) <= 1:
+            raise ValueError(
+                f"SLO quantile must be in (0, 1], got {quantile}"
+            )
+        if objective_rounds is not None and int(objective_rounds) < 0:
+            raise ValueError(
+                f"objective_rounds must be >= 0, got {objective_rounds}"
+            )
+        self.objective_rounds = (
+            None if objective_rounds is None else int(objective_rounds)
+        )
+        self.quantile = float(quantile)
+        self.windows_s = tuple(sorted(float(w) for w in windows_s))
+        # (t_s, cumulative counts) samples, oldest first, pruned past
+        # the longest window (one extra retained so a window's delta
+        # always has a baseline at or before its left edge).
+        self._samples: List[Tuple[float, np.ndarray]] = []
+
+    # -- ingestion --
+
+    def observe(self, counts, t_s: float) -> None:
+        """Fold one cumulative histogram snapshot taken at ``t_s``."""
+        c = np.asarray(counts, dtype=np.int64).reshape(-1)
+        if self._samples and c.shape != self._samples[-1][1].shape:
+            raise ValueError(
+                f"histogram width changed: {self._samples[-1][1].shape}"
+                f" -> {c.shape}"
+            )
+        self._samples.append((float(t_s), c.copy()))
+        horizon = float(t_s) - max(self.windows_s)
+        # Keep the newest sample at-or-before the horizon as the
+        # baseline; drop everything older.
+        while (
+            len(self._samples) >= 2 and self._samples[1][0] <= horizon
+        ):
+            self._samples.pop(0)
+
+    # -- derivations --
+
+    @property
+    def total(self) -> int:
+        if not self._samples:
+            return 0
+        return int(self._samples[-1][1].sum())
+
+    def quantiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99)):
+        """{q: upper-edge rounds} over the whole stream (None-valued
+        before any sample lands)."""
+        if not self._samples:
+            return {float(q): None for q in qs}
+        counts = self._samples[-1][1]
+        return {
+            float(q): quantile_from_hist(counts, float(q)) for q in qs
+        }
+
+    def _bad_total(self, delta: np.ndarray) -> Tuple[int, int]:
+        """(violations, total) in a delta histogram: whole buckets whose
+        lower edge is >= the objective count bad."""
+        total = int(delta.sum())
+        obj = self.objective_rounds
+        if obj is None or total == 0:
+            return 0, total
+        bad = 0
+        for i, c in enumerate(delta.tolist()):
+            lo = 0 if i == 0 else 1 << i
+            if lo >= obj:
+                bad += int(c)
+        return bad, total
+
+    def burn_rates(self, now_s: Optional[float] = None):
+        """{window_s: burn rate} from histogram deltas. A window with no
+        baseline sample yet (stream younger than the window) deltas
+        against the oldest sample - early storms still register."""
+        out: Dict[float, float] = {}
+        if not self._samples or self.objective_rounds is None:
+            return {w: 0.0 for w in self.windows_s}
+        t_now, cur = self._samples[-1]
+        if now_s is not None:
+            t_now = float(now_s)
+        budget = 1.0 - self.quantile
+        for w in self.windows_s:
+            base = self._samples[0][1]
+            for t, c in self._samples:
+                if t <= t_now - w:
+                    base = c
+                else:
+                    break
+            bad, total = self._bad_total(cur - base)
+            if total <= 0:
+                out[w] = 0.0
+            elif budget <= 0:
+                # q == 1.0: zero error budget - any violation is an
+                # infinite burn; report a large finite sentinel so the
+                # pressure comparison stays total.
+                out[w] = float("inf") if bad else 0.0
+            else:
+                out[w] = (bad / total) / budget
+        return out
+
+    def latency_pressure(self, now_s: Optional[float] = None) -> float:
+        """Max burn rate across windows - 0.0 with no objective, no
+        samples, or no violations; the Observation's pressure scalar."""
+        rates = self.burn_rates(now_s)
+        return max(rates.values()) if rates else 0.0
+
+    def stats(self) -> Dict[str, object]:
+        """Numeric summary for the metrics registry: total, quantile
+        upper edges, per-window burns, pressure."""
+        qs = self.quantiles()
+        out: Dict[str, object] = {
+            "total": self.total,
+            "pressure": self.latency_pressure(),
+            "objective_rounds": self.objective_rounds or 0,
+        }
+        for q, v in qs.items():
+            if v is not None:
+                out[f"p{int(q * 100)}_rounds"] = v
+        for w, b in self.burn_rates().items():
+            out[f"burn_{int(w)}s"] = b
+        return out

@@ -68,6 +68,15 @@ closing exactly, and the reshard wait re-homing algebra (counts and
 per-channel need sums conserved 4 -> 2 -> 4; satisfier-in-residue
 refused whole-program). Both host-model (no Mosaic).
 
+``--slo`` adds the seeded SLO-BURN scenario (ISSUE 19): a request
+stream whose latency tail degrades mid-run; the streaming burn-rate
+estimator (fed cumulative on-device latency histograms, the
+TelemetryPoller shape) crosses the policy threshold and fires a typed
+``slo_out`` scale-out BEFORE the deadline-budget watchdog rung (no
+deadline has expired - the same observation with the burn signal
+zeroed holds), riding TR_SCALE, the metrics registry, and the Perfetto
+exporter. Host-model (no Mosaic).
+
 Usage:
     python tools/chaos_soak.py                    # fast smoke (tier-1)
     python tools/chaos_soak.py --scale soak --seeds 8   # standalone soak
@@ -1927,6 +1936,105 @@ def scenario_durability_serve_fallback(seed: int, scale: str) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+# ------------------------------- SLO burn-rate autoscaling (ISSUE 19)
+
+def scenario_slo_burn_scaleout(seed: int, scale: str) -> dict:
+    """SLO: the seeded burn-rate storm (ISSUE 19) - a healthy request
+    stream degrades its tail mid-run; the streaming estimator (fed
+    cumulative on-device latency histograms, the TelemetryPoller
+    shape) reports latency_pressure over the policy threshold and the
+    policy fires a typed ``slo_out`` scale-out BEFORE the
+    deadline-budget watchdog rung (no deadline has expired - with the
+    burn signal zeroed the same observation HOLDS), during cooldown.
+    The typed event rides TR_SCALE, the metrics registry, and the
+    Perfetto exporter. A no-objective estimator replaying the same
+    degraded stream stays at zero pressure (the off path)."""
+    import numpy as np
+
+    import hclib_tpu as hc
+    from hclib_tpu.device.telemetry import LAT_BUCKETS, bucket_of
+    from hclib_tpu.runtime.slo import SloEstimator
+
+    rng = np.random.default_rng(9100 + seed)
+    objective = 64  # rounds: whole buckets at/above this edge are bad
+    windows = (5.0, 30.0)
+    est = SloEstimator(objective_rounds=objective, quantile=0.99,
+                       windows_s=windows)
+    counts = np.zeros(LAT_BUCKETS, np.int64)
+    snapshots = []
+    per_tick = 16 if scale == "smoke" else 64
+    t, bad_total = 0.0, 0
+
+    def tick(lo, hi):
+        nonlocal t
+        for d in rng.integers(lo, hi, size=per_tick):
+            counts[bucket_of(int(d))] += 1
+        t += 1.0
+        snapshots.append((t, counts.copy()))
+        est.observe(counts.copy(), t)
+
+    # Healthy phase: every request lands well under the objective.
+    for _ in range(6):
+        tick(4, 32)
+    healthy_pressure = est.latency_pressure(t)
+    assert healthy_pressure < 2.0, healthy_pressure
+    # Degradation: the tail walks past the objective bucket edge.
+    for _ in range(6):
+        tick(128, 2048)
+        bad_total += per_tick
+    pressure = est.latency_pressure(t)
+    assert pressure >= 2.0, (pressure, est.stats())
+    p99 = est.quantiles((0.99,))[0.99]
+    assert p99 >= 128, p99
+
+    policy = hc.AutoscalerPolicy(
+        min_devices=1, max_devices=8, scale_out_backlog=1e9,
+        scale_in_backlog=4.0, hysteresis=2, cooldown=3,
+        tenant_pressure=0.25, slo_burn=2.0,
+    )
+    # Prime the cooldown gate (prove the burn path bypasses it).
+    policy._cooling = 3
+
+    def observe(p):
+        return hc.Observation(2, [8, 8], executed_delta=8, slice_s=1.0,
+                              latency_pressure=p)
+
+    # BEFORE the watchdog rung: nothing expired, no deadline budget
+    # drained - the SAME observation with the burn signal zeroed holds.
+    assert policy.decide(observe(0.0))[1] == "hold"
+    target, kind, reason = policy.decide(observe(pressure))
+    assert kind == "slo_out", (kind, reason)
+    assert target == 4 and "burn" in reason, (target, reason)
+
+    # The typed event rides TR_SCALE + metrics + Perfetto.
+    reg = hc.MetricsRegistry()
+    asc = hc.Autoscaler(lambda n: None, policy, metrics=reg)
+    asc._event(hc.ScaleEvent("slo_out", 1, 2, target, reason))
+    from hclib_tpu.device.tracebuf import TR_SCALE, records_of
+
+    recs = records_of(asc.trace_info(), TR_SCALE)
+    assert len(recs) == 1 and int(recs[0][2]) == (2 << 8) | target
+    assert reg.snapshot()["metrics"]["autoscale.slo_out.count"] == 1
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__))))
+    import timeline
+
+    doc = timeline.export_perfetto("", traces=[asc.trace_info()])
+    names = [e.get("name", "") for e in doc["traceEvents"]]
+    assert any(n.startswith(f"slo out 2→{target}") for n in names), names
+
+    # Off path: no objective -> zero pressure on the SAME stream.
+    quiet = SloEstimator(objective_rounds=None, quantile=0.99,
+                         windows_s=windows)
+    for ts, c in snapshots:
+        quiet.observe(c, ts)
+    assert quiet.latency_pressure(t) == 0.0
+    return {"faults": bad_total, "recoveries": 1,
+            "pressure": round(float(pressure), 3),
+            "healthy_pressure": round(float(healthy_pressure), 4),
+            "p99_rounds": float(p99), "target": target}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -1973,6 +2081,10 @@ SERVE_SCENARIOS = [
 DURABILITY_SCENARIOS = [
     ("durability_crashpoints", scenario_durability_crashpoints),
     ("durability_serve_fallback", scenario_durability_serve_fallback),
+]
+
+SLO_SCENARIOS = [
+    ("slo_burn_scaleout", scenario_slo_burn_scaleout),
 ]
 
 
@@ -2025,6 +2137,14 @@ def main(argv=None) -> int:
                          "re-homing algebra)")
     ap.add_argument("--durability-only", action="store_true",
                     help="run ONLY the durable-store scenarios")
+    ap.add_argument("--slo", action="store_true",
+                    help="add the seeded SLO burn-rate scenario (tail "
+                         "degradation crossing the multi-window burn "
+                         "threshold fires a typed slo_out scale-out "
+                         "before the deadline watchdog rung, riding "
+                         "TR_SCALE/metrics/Perfetto)")
+    ap.add_argument("--slo-only", action="store_true",
+                    help="run ONLY the SLO burn-rate scenario")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -2041,7 +2161,7 @@ def main(argv=None) -> int:
         []
         if (args.mesh_only or args.preempt_only or args.storm_only
             or args.tenants_only or args.serve_only
-            or args.durability_only)
+            or args.durability_only or args.slo_only)
         else list(SCENARIOS)
     )
     if args.mesh or args.mesh_only:
@@ -2056,6 +2176,8 @@ def main(argv=None) -> int:
         scenarios += SERVE_SCENARIOS
     if args.durability or args.durability_only:
         scenarios += DURABILITY_SCENARIOS
+    if args.slo or args.slo_only:
+        scenarios += SLO_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

@@ -1,0 +1,151 @@
+"""Stdlib-only Prometheus scrape endpoint over a MetricsRegistry.
+
+ISSUE 19's export leg: ``serve(registry)`` binds an ``http.server`` on
+localhost and answers ``GET /metrics`` with the registry's Prometheus
+text exposition - flat gauges plus the native latency-histogram family
+(``hclib_latency_bucket{tenant=...,le=...}``) when a scraped
+``TelemetryBlock`` has been recorded (``MetricsRegistry.
+record_latency``). Pair it with ``MetricsRegistry.watch(...)`` so the
+request path only formats the record table; a scrape never touches a
+live stream.
+
+No dependencies beyond the standard library - the same constraint as
+the rest of tools/. The server thread is a daemon; ``server.shutdown()``
+stops it cleanly (tests and the CI smoke step do).
+
+Usage (library)::
+
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+    from tools.metrics_serve import serve
+
+    reg = MetricsRegistry()
+    reg.watch("stream", sm.telemetry_snapshot_metrics)  # or any source
+    server, thread = serve(reg, port=9108)
+    ...
+    server.shutdown()
+
+Usage (CLI)::
+
+    python tools/metrics_serve.py --self-test   # serve + scrape + exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+__all__ = ["serve"]
+
+
+def _make_handler(registry):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server ABI)
+            if self.path.split("?", 1)[0] != "/metrics":
+                self.send_error(404, "try /metrics")
+                return
+            try:
+                body = registry.to_prometheus().encode()
+            except Exception as e:  # a half-dead registry still answers
+                self.send_error(500, f"exposition failed: {e}")
+                return
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass  # scrapes are periodic; stderr noise helps nobody
+
+    return Handler
+
+
+def serve(
+    registry, port: int = 0, host: str = "127.0.0.1"
+) -> Tuple[HTTPServer, threading.Thread]:
+    """Start the endpoint on a daemon thread; returns (server, thread).
+    ``port=0`` binds an ephemeral port - read it back from
+    ``server.server_address[1]``. Stop with ``server.shutdown()``."""
+    server = HTTPServer((host, int(port)), _make_handler(registry))
+    thread = threading.Thread(
+        target=server.serve_forever,
+        name="hclib-metrics-serve",
+        daemon=True,
+    )
+    thread.start()
+    return server, thread
+
+
+def _self_test(port: int) -> int:
+    """Serve a registry with one record + a synthetic latency block,
+    scrape it once over real HTTP, and verify the exposition shape."""
+    import urllib.request
+
+    import numpy as np
+
+    from hclib_tpu.device.telemetry import LAT_BUCKETS, TelemetryBlock
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.record("selftest", {"alive": 1})
+    tele = np.zeros((2, LAT_BUCKETS), np.int64)
+    tele[1, 3] = 5
+    reg.record_latency(TelemetryBlock(tele, ns_per_round=1000.0))
+    server, _ = serve(reg, port=port)
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        server.shutdown()
+    for needle in (
+        "hclib_tpu_selftest_alive 1.0",
+        'hclib_latency_bucket{tenant="0",le="16"} 5',
+        'hclib_latency_count{tenant="0"} 5',
+    ):
+        if needle not in text:
+            print(f"self-test FAILED: missing {needle!r}")
+            return 1
+    print("self-test ok:", len(text.splitlines()), "exposition lines")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="listen port (0 = ephemeral)",
+    )
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="serve a synthetic registry, scrape once, exit",
+    )
+    args = p.parse_args(argv)
+    if args.self_test:
+        return _self_test(args.port)
+    # Standalone mode serves an empty registry (useful only to check
+    # wiring); real deployments call serve() with their registry.
+    from hclib_tpu.runtime.metrics import MetricsRegistry
+
+    server, thread = serve(MetricsRegistry(), port=args.port)
+    print(
+        f"serving /metrics on "
+        f"http://127.0.0.1:{server.server_address[1]} (ctrl-c stops)"
+    )
+    try:
+        thread.join()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -722,6 +722,168 @@ def _program_cache(quick: bool, trials: int) -> dict:
     }
 
 
+def _telemetry_overhead(quick: bool, trials: int) -> dict:
+    """Telemetry-tax guard (ISSUE 19), same-run arms: the same
+    submitted workload through (a) a telemetry-OFF egress stream and
+    (b) the telemetry-ON stream. Off compiles ZERO new device words -
+    asserted by lowered-text byte identity: a build forced off while
+    the telemetry env knob is SET must lower to the exact text the
+    env-free default build lowers to (and the enabled build must
+    differ - the tele/tlat words exist only on-path). The on arm's
+    result vector must be bit-identical to (a), its on-device
+    histogram must account for every submitted retirement exactly,
+    and its wall is bounded by --telemetry-tolerance (it pays the
+    tele/tlat echo plus the branch-free log2 fold per retire)."""
+    import os as _os
+
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import RING_ROW, TaskGraphBuilder
+    from hclib_tpu.device.egress import EGR_WORDS, EgressSpec
+    from hclib_tpu.device.inject import StreamingMegakernel
+    from hclib_tpu.device.megakernel import Megakernel
+    from hclib_tpu.device.telemetry import (
+        LAT_BUCKETS, LAT_WORDS, TelemetryBlock,
+    )
+    from hclib_tpu.device.tenants import TenantSpec, TenantTable
+
+    ntasks = 48 if quick else 160
+    cap = max(256, ntasks + 8)
+
+    def mark(ctx):
+        ctx.set_value(ctx.arg(1), ctx.arg(0))
+
+    def mk():
+        return Megakernel(
+            kernels=[("mark", mark)], capacity=cap,
+            num_values=ntasks + 8, succ_capacity=8, interpret=True,
+        )
+
+    def sm_new(tel):
+        table = TenantTable(
+            [TenantSpec("t0")], cap, clock=lambda: 0.0,
+            egress=EgressSpec(depth=cap),
+        )
+        return StreamingMegakernel(mk(), ring_capacity=cap,
+                                   tenants=table, telemetry=tel)
+
+    def lower_text(sm) -> str:
+        m = sm.mk
+        b = TaskGraphBuilder()
+        b.add(0, args=[0, 0])
+        tasks, succ, ready, counts = b.finalize(
+            capacity=m.capacity, succ_capacity=m.succ_capacity
+        )
+        args = [
+            tasks, succ, ready, counts,
+            np.zeros(m.num_values, np.int32),
+            np.zeros((sm.ring_capacity, RING_ROW), np.int32),
+            np.zeros(8, np.int32),
+            np.zeros((len(sm.tenants), 8), np.int32),
+            np.zeros((sm._egress.depth, EGR_WORDS), np.int32),
+            np.zeros((sm._egress.depth, EGR_WORDS), np.int32),
+            np.zeros(8, np.int32),
+            np.zeros(m.capacity, np.int32),
+        ]
+        if sm.telemetry:
+            args += [
+                np.zeros((1 + len(sm.tenants), LAT_BUCKETS), np.int32),
+                np.zeros((m.capacity, LAT_WORDS), np.int32),
+            ]
+        return sm._build(1 << 10, 64).lower(*args).as_text()
+
+    # Off-path identity first, outside the timed arms: env knob SET
+    # but constructor-forced off must be byte-identical to env-free.
+    saved_env = _os.environ.pop("HCLIB_TPU_TELEMETRY", None)
+    try:
+        base_text = lower_text(sm_new(None))    # env-free default: off
+        _os.environ["HCLIB_TPU_TELEMETRY"] = "1"
+        forced_off = lower_text(sm_new(False))
+        env_on = lower_text(sm_new(None))
+    finally:
+        if saved_env is None:
+            _os.environ.pop("HCLIB_TPU_TELEMETRY", None)
+        else:
+            _os.environ["HCLIB_TPU_TELEMETRY"] = saved_env
+    if forced_off != base_text:
+        raise AssertionError(
+            "telemetry-overhead: telemetry=False with the env knob set "
+            "lowered DIFFERENT text than the env-free build - the off "
+            "path is compiling telemetry words"
+        )
+    if env_on == base_text:
+        raise AssertionError(
+            "telemetry-overhead: the enabled build lowered the SAME "
+            "text as the off build - the tele/tlat words never compiled"
+        )
+
+    def run_once(tel) -> Tuple[int, bytes]:
+        sm = sm_new(tel)
+        futs = []
+        for i in range(ntasks):
+            h = sm.submit("t0", 0, args=[i + 1, i + 1])
+            assert h
+            futs.append(h.future)
+        sm.close()
+        b = TaskGraphBuilder()
+        b.add(0, args=[0, 0])
+        t0 = time.perf_counter_ns()
+        iv, info = sm.run_stream(b)
+        dt = time.perf_counter_ns() - t0
+        iv = np.asarray(iv)
+        expect = np.zeros(ntasks + 8, iv.dtype)
+        expect[1 : ntasks + 1] = np.arange(1, ntasks + 1)
+        if not np.array_equal(iv, expect):
+            raise AssertionError(
+                f"telemetry-overhead: arm (telemetry={tel!r}) dropped "
+                f"or misrouted rows: {np.flatnonzero(iv != expect)}"
+            )
+        bad = [f.state for f in futs if f.state != "RESULT"]
+        if bad:
+            raise AssertionError(
+                f"telemetry-overhead: {len(bad)} futures unresolved "
+                f"(telemetry={tel!r}): {sorted(set(bad))}"
+            )
+        if tel:
+            snap = sm.telemetry_snapshot()
+            total = TelemetryBlock(snap["tele"]).total() if snap else -1
+            if total != ntasks:
+                raise AssertionError(
+                    "telemetry-overhead: on-device histogram counted "
+                    f"{total} retirements, expected {ntasks}"
+                )
+        else:
+            # Telemetry off = no new surfaces anywhere in the run.
+            assert "telemetry" not in info
+            assert sm.telemetry_snapshot() is None
+        return dt, iv.tobytes()
+
+    run_once(False)  # warm both jits outside the timed arms
+    run_once(True)
+    n = max(2, trials)
+    base, tele, values = [], [], set()
+    for _ in range(n):
+        dt, v = run_once(False)
+        base.append(dt)
+        values.add(v)
+        dt, v = run_once(True)
+        tele.append(dt)
+        values.add(v)
+    if len(values) != 1:
+        raise AssertionError(
+            "telemetry-overhead: telemetry-on ivalues diverged from "
+            f"the off stream ({len(values)} distinct result vectors)"
+        )
+    return {
+        "base_ns": min(base),
+        "telemetry_ns": min(tele),
+        "ratio": min(tele) / min(base),
+        "tasks": ntasks,
+        "bit_identical": True,
+        "off_text_identical": True,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -820,6 +982,12 @@ def main(argv=None) -> int:
                     help="program-cache guard: minimum cold/warm "
                          "first-build speedup for a content-identical "
                          "second instance (the compile-tax kill)")
+    ap.add_argument("--telemetry-tolerance", type=float, default=1.3,
+                    help="max telemetry-on/off wall ratio for the "
+                         "telemetry-overhead guard (the tele/tlat echo "
+                         "plus per-retire histogram fold; results must "
+                         "be bit-identical and the off path must lower "
+                         "byte-identical text regardless)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -1098,6 +1266,30 @@ def main(argv=None) -> int:
                     f"{pg['speedup']:.2f}x faster than cold (floor "
                     f"{args.progcache_floor:.2f}x) - the cache "
                     "stopped killing the compile tax"
+                )
+                line += "  REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "telemetry-overhead" in wanted:
+        try:
+            to = _telemetry_overhead(args.quick, args.trials)
+        except Exception as e:
+            print(f"telemetry-overhead FAILED: {e}", file=sys.stderr)
+            failures.append(f"telemetry-overhead: failed ({e})")
+        else:
+            results["telemetry-overhead"] = to
+            line = (
+                f"{'telemetry-overhead':15s} ratio {to['ratio']:5.2f}x "
+                f"({to['telemetry_ns'] / 1e6:.1f} ms on vs "
+                f"{to['base_ns'] / 1e6:.1f} ms off, {to['tasks']} "
+                f"tasks, bit-identical, off-text-identical)"
+            )
+            if to["ratio"] > args.telemetry_tolerance:
+                failures.append(
+                    f"telemetry-overhead: the telemetry plane is "
+                    f"{to['ratio']:.2f}x slower than the off stream "
+                    f"(bound {args.telemetry_tolerance:.2f}x) - the "
+                    "histogram fold is taxing the round loop"
                 )
                 line += "  REGRESSED"
             print(line, flush=True)

@@ -488,6 +488,15 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                 span(_TID_EVENTS, "events", t, 0.5,
                      f"egress park x{b}",
                      {"token": a, "parked": b})
+            elif tag == tb.TR_LATENCY:
+                # One tracked retirement (telemetry plane, ISSUE 19):
+                # tenant lane and log2 bucket packed in a, the raw
+                # admit->retire delta (rounds) in b - latency outliers
+                # read off the events track right where they retired.
+                ten, bkt = a >> 16, a & 0xFFFF
+                span(_TID_EVENTS, "events", t, 0.25,
+                     f"latency t{ten} 2^{bkt}",
+                     {"tenant": ten, "bucket": bkt, "rounds": b})
             elif tag == tb.TR_SCALE:
                 # Autoscaler decision (host-emitted ring, slice index as
                 # timebase): label resizes with their mesh arrow so the
@@ -508,6 +517,87 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
             span(_TID_ROUNDS, "rounds", rb, 1, "round (open)", args)
         for tid, tname in sorted(used_tids.items()):
             events.append(_meta(pid, tid, "thread_name", tname))
+    return events
+
+
+def request_flow_events(
+    spans: Dict[int, Sequence[int]],
+    futures: Sequence = (),
+    ns_per_round: Optional[float] = None,
+    pid: int = 90,
+) -> List[Dict]:
+    """Per-request Perfetto flow events (ISSUE 19): join the device
+    lifecycle stamps with the host submit/resolve wall stamps.
+
+    ``spans`` is ``StreamingMegakernel.telemetry_spans()`` -
+    ``{token: (admit, install, fire)}`` in cumulative scheduler rounds
+    (retire == fire). ``futures`` are the submit-side ``Future``
+    objects (matched by ``.token``); a resolved one contributes the
+    host-measured submit->result wall span, mapped onto the round
+    timebase through ``ns_per_round`` (the stream's epoch-bracket
+    factor) so the RESULT marker lands where the host actually saw the
+    value - the host/device gap IS the egress+poll latency. Each
+    request renders as two phase slices (queued: admit->install,
+    inflight: install->fire) on one "requests" track plus a flow chain
+    (``s``/``t``/``f`` sharing the token as id) threading
+    submit->admit->install->fire/retire->result, so Perfetto draws the
+    arrows across tracks. The round timebase renders as 1 round = 1 us
+    (the same convention as the device rings)."""
+    events: List[Dict] = []
+    fut_by_token = {}
+    for f in futures:
+        tok = getattr(f, "token", None)
+        if tok is not None:
+            fut_by_token[int(tok)] = f
+    tid = 1
+    for tok in sorted(spans):
+        admit, install, fire = (int(x) for x in spans[tok][:3])
+        flow = {"cat": "request", "id": int(tok), "pid": pid,
+                "tid": tid, "name": f"req {tok}"}
+        events.append({
+            "ph": "X", "pid": pid, "tid": tid, "ts": admit,
+            "dur": max(install - admit, 0) + 0.25,
+            "name": f"req {tok} queued",
+            "args": {"token": tok, "admit": admit, "install": install},
+        })
+        events.append({
+            "ph": "X", "pid": pid, "tid": tid, "ts": install,
+            "dur": max(fire - install, 0) + 0.25,
+            "name": f"req {tok} inflight",
+            "args": {"token": tok, "fire": fire, "retire": fire},
+        })
+        events.append({**flow, "ph": "s", "ts": admit})
+        events.append({**flow, "ph": "t", "ts": install})
+        f = fut_by_token.get(int(tok))
+        t_done = getattr(f, "t_done", None)
+        t_submit = getattr(f, "t_submit", None)
+        if (
+            f is not None and t_done is not None
+            and t_submit is not None and ns_per_round
+        ):
+            # Host wall span mapped to rounds, anchored at admit (the
+            # pump stamps admission at publish, so submit-to-admit ring
+            # wait is inside the host span but before the anchor).
+            result_r = admit + (
+                (float(t_done) - float(t_submit)) * 1e9 / ns_per_round
+            )
+            events.append({**flow, "ph": "t", "ts": fire})
+            events.append({
+                **flow, "ph": "f", "bp": "e",
+                "ts": max(result_r, fire),
+            })
+            events.append({
+                "ph": "X", "pid": pid, "tid": tid,
+                "ts": max(result_r, fire), "dur": 0.25,
+                "name": f"req {tok} result",
+                "args": {"token": tok,
+                         "host_latency_s": float(t_done)
+                         - float(t_submit)},
+            })
+        else:
+            events.append({**flow, "ph": "f", "bp": "e", "ts": fire})
+    events.append(_meta(pid, tid, "thread_name", "requests"))
+    events.append(_meta(pid, 0, "process_name", "requests"))
     return events
 
 

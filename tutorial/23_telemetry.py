@@ -1,0 +1,179 @@
+"""Lesson 23: the live telemetry plane - histograms, scrape, SLO burn.
+
+Lesson 20's serving loop measured submit->result latency HOST-side
+(Future wall stamps) and surfaced device counters only after a run
+exited. The telemetry plane (device/telemetry.py) moves the
+measurement ON-DEVICE and makes it scrapeable MID-RUN:
+
+- **Lifecycle stamps**: the host pump stamps each ring row's
+  TEN_ADMIT_ROUND transport word with the round counter it last saw;
+  the kernel stamps install and fire rounds per row (retire == fire -
+  dispatch and completion are atomic within one inner round), and the
+  egress publish carries the span back (EGR_T_ADMIT / EGR_T_SPANS).
+- **On-device histograms**: every tracked retirement bumps one log2
+  bucket of (retire - admit) in a per-tenant histogram row of the
+  ``tele`` block, which rides the ctl-echo discipline - so every entry
+  boundary re-exports it and a ``TelemetryPoller`` thread snapshots a
+  LIVE stream without stopping it.
+- **Units**: everything device-side is in scheduler ROUNDS (there is
+  no device wall clock); the host converts rounds->ns with the
+  ``EpochBracket`` wall bracket around each entry.
+- **SLO engine**: ``runtime/slo.py`` turns cumulative histogram
+  snapshots into streaming quantiles and multi-window burn rates; the
+  autoscaler policy grows a typed ``slo_out`` rung that fires BEFORE
+  the deadline watchdog when the error budget drains.
+
+Off path: telemetry unset compiles ZERO new device words - the
+lowered text is byte-identical (tests/test_telemetry.py pins it).
+Env spelling for wrapper scripts: ``HCLIB_TPU_TELEMETRY=1`` plus the
+SLO knobs (see ``runtime/env.py`` registry).
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import hclib_tpu as hc  # noqa: E402
+from hclib_tpu.device.descriptor import TaskGraphBuilder  # noqa: E402
+from hclib_tpu.device.egress import EgressSpec  # noqa: E402
+from hclib_tpu.device.inject import StreamingMegakernel  # noqa: E402
+from hclib_tpu.device.megakernel import Megakernel  # noqa: E402
+from hclib_tpu.device.telemetry import (  # noqa: E402
+    LAT_BUCKETS,
+    TelemetryBlock,
+    TelemetryPoller,
+    bucket_of,
+)
+from hclib_tpu.device.tenants import TenantSpec, TenantTable  # noqa: E402
+from hclib_tpu.runtime.slo import SloEstimator  # noqa: E402
+
+BUMP = 0
+
+
+def _mk():
+    def bump(ctx):
+        ctx.set_value(0, ctx.value(0) + ctx.arg(0))
+
+    return Megakernel(
+        kernels=[("bump", bump)], capacity=256, num_values=8,
+        succ_capacity=8, interpret=True,
+    )
+
+
+def _stream(region=32, depth=64):
+    table = TenantTable(
+        [TenantSpec("gold", weight=2, queue_capacity=64),
+         TenantSpec("std", queue_capacity=64)],
+        region, egress=EgressSpec(depth=depth),
+    )
+    return StreamingMegakernel(
+        _mk(), ring_capacity=64, tenants=table, telemetry=True,
+    )
+
+
+def part_one_on_device_histograms():
+    """Submit through two tenants; the device folds every tracked
+    retirement into a per-tenant log2 histogram, and the per-row
+    stamps reconcile with it exactly."""
+    sm = _stream()
+    for i in range(9):
+        assert sm.submit("gold" if i % 3 else "std", BUMP, args=[1])
+    sm.close()
+    b = TaskGraphBuilder()
+    b.add(BUMP, args=[100])
+    sm.run_stream(b)
+    snap = sm.telemetry_snapshot()
+    blk = TelemetryBlock(snap["tele"], snap.get("ns_per_round"))
+    g = blk.gauges()
+    assert g["retires"] == blk.total() == 9, g
+    # The spans are the histogram's witnesses: refolding the per-row
+    # (fire - admit) deltas reproduces the device's bucket counts.
+    spans = sm.telemetry_spans()
+    assert len(spans) == 9
+    refold = np.zeros(LAT_BUCKETS, np.int64)
+    for admit, install, fire in spans.values():
+        assert admit <= install <= fire
+        refold[bucket_of(fire - admit)] += 1
+    assert np.array_equal(refold, blk.hist()), (refold, blk.hist())
+    p50, p99 = blk.quantile(0.5), blk.quantile(0.99)
+    npr = snap.get("ns_per_round")
+    print(f"  9 retirements across 2 tenant histograms "
+          f"(gold {blk.total(0)}, std {blk.total(1)}); p50 <= {p50:.0f} "
+          f"rounds, p99 <= {p99:.0f} rounds, "
+          f"~{npr / 1e3 if npr else 0:.0f}us/round - spans refold "
+          "bit-exactly")
+
+
+def part_two_midrun_scrape():
+    """A TelemetryPoller thread snapshots the echoed block while the
+    stream RUNS; the scrape feeds the Prometheus exposition."""
+    sm = _stream()
+    for i in range(40):
+        assert sm.submit(i % 2, BUMP, args=[1])
+    sm.close()
+    poller = TelemetryPoller(sm.telemetry_snapshot,
+                             interval_s=0.002).start()
+    b = TaskGraphBuilder()
+    b.add(BUMP, args=[100])
+    # A small per-entry round budget: the stream re-enters the kernel
+    # many times, and every entry boundary re-exports the echo blocks
+    # the poller is watching.
+    sm.run_stream(b, max_rounds=8)
+    poller.stop(final_poll=True)  # never miss the final state
+    seqs = [s["seq"] for s in poller.snapshots]
+    assert seqs and seqs == sorted(seqs), seqs
+    totals = [int(np.asarray(s["tele"])[1:].sum())
+              for s in poller.snapshots]
+    assert totals == sorted(totals) and totals[-1] == 40, totals
+    # The scrape is what a dashboard sees: cumulative bucket counts
+    # per tenant in Prometheus text form (tools/metrics_serve.py
+    # serves this over HTTP from a stdlib http.server).
+    reg = hc.MetricsRegistry()
+    reg.record_latency(poller.latest_block())
+    text = reg.to_prometheus()
+    assert "hclib_latency_bucket" in text and 'le="+Inf"' in text
+    lines = [ln for ln in text.splitlines() if "latency" in ln]
+    print(f"  {len(poller.snapshots)} mid-run snapshots, monotone "
+          f"({totals[0]} -> {totals[-1]} retirements); "
+          f"{len(lines)} Prometheus latency lines")
+
+
+def part_three_slo_burn():
+    """Histogram deltas -> streaming burn rates -> a typed slo_out
+    scale-out, fired before any deadline has expired."""
+    est = SloEstimator(objective_rounds=64, quantile=0.99,
+                       windows_s=(5.0, 30.0))
+    counts, t = np.zeros(LAT_BUCKETS, np.int64), 0.0
+    for phase, (lo, hi) in enumerate([(4, 32), (256, 4096)]):
+        for _ in range(6):
+            for d in np.random.default_rng(int(t)).integers(
+                    lo, hi, size=16):
+                counts[bucket_of(int(d))] += 1
+            t += 1.0
+            est.observe(counts.copy(), t)
+        if phase == 0:
+            assert est.latency_pressure(t) < 2.0
+    pressure = est.latency_pressure(t)
+    assert pressure >= 2.0, est.stats()
+    policy = hc.AutoscalerPolicy(
+        min_devices=1, max_devices=8, scale_out_backlog=1e9,
+        scale_in_backlog=4.0, hysteresis=2, cooldown=3, slo_burn=2.0,
+    )
+    obs = hc.Observation(2, [4, 4], executed_delta=8, slice_s=1.0,
+                         latency_pressure=pressure)
+    target, kind, reason = policy.decide(obs)
+    assert kind == "slo_out" and target == 4, (kind, reason)
+    print(f"  tail walked past the 64-round objective: burn "
+          f"{pressure:.1f}x budget -> '{kind}' 2->4 ({reason[:40]}...)")
+
+
+if __name__ == "__main__":
+    part_one_on_device_histograms()
+    part_two_midrun_scrape()
+    part_three_slo_burn()
+    print("lesson 23 OK")
