@@ -1650,6 +1650,125 @@ def bench_graph(quick: bool = False) -> None:
     log(f"graph bench written: {path}")
 
 
+def bench_dyngraph(quick: bool = False) -> None:
+    """Dynamic-graph service cost of record (ISSUE 20): a concurrent
+    UPDATE storm + QUERY stream against the mutable blocked-CSR
+    adjacency, raced with the BFS/SSSP traversals on the batched
+    frontier tier - the incremental fixpoint asserted bit-identical to
+    the from-scratch host reference ON THE MUTATED GRAPH. The headline
+    JSON - updates applied per second, with the concurrent traversal's
+    query TEPS riding along - prints (and flushes) FIRST, rc=124-proofed
+    like every other headline; per-kind splice/query lines go to stderr
+    budget-gated and the full detail lands in
+    perf-logs/<ts>.dyngraph.json.
+
+    perf-logs schema (<ts>.dyngraph.json): the headline fields (metric/
+    value/unit, ``updates_per_sec`` / ``query_teps`` /
+    ``queries_per_sec``) merged with ``kernels.<kind>`` rows: edges /
+    relaxations / tasks / updates_applied / dropped / spare_in_use /
+    queries / elapsed_s."""
+    import jax
+    import numpy as np
+
+    from hclib_tpu.device.dyngraph import (
+        DynGraph, host_dyngraph, make_dyngraph_megakernel, run_dyngraph,
+    )
+    from hclib_tpu.device.workloads import rmat_edges
+
+    scale = 5 if quick else 7
+    n, src_e, dst_e, w_e = rmat_edges(scale, efactor=8, seed=7)
+    width = 8
+    capacity = 512 if quick else 1024
+    rng = np.random.default_rng(11)
+    n_ups = 8 if quick else 24
+    ups = [
+        (int(u), int(v), int(w))
+        for u, v, w in zip(
+            rng.integers(0, n, n_ups),
+            rng.integers(0, n, n_ups),
+            rng.integers(1, 8, n_ups),
+        )
+    ]
+    queries = [int(q) for q in rng.integers(0, n, 4)]
+
+    def arm(kind):
+        # Fresh graph per arm: the update stream registers on it and
+        # the spare rows mutate in-run.
+        g = DynGraph(
+            n, src_e, dst_e, w_e, spare_blocks=2,
+            upd_cap=max(16, n_ups),
+        )
+        mk = make_dyngraph_megakernel(
+            kind, g, width=width, capacity=capacity, interpret=True,
+        )
+        kw = dict(
+            updates=ups, queries=queries, capacity=capacity,
+            interpret=True, mk=mk,
+        )
+        run_dyngraph(kind, g, 0, **kw)  # warm the jit (mutates nothing
+        g = DynGraph(                   # host-side; rebuild regardless)
+            n, src_e, dst_e, w_e, spare_blocks=2,
+            upd_cap=max(16, n_ups),
+        )
+        t0 = time.perf_counter()
+        res, info = run_dyngraph(kind, g, 0, **dict(kw, mk=mk))
+        wall = time.perf_counter() - t0
+        assert np.array_equal(
+            np.asarray(res, np.int64),
+            np.asarray(host_dyngraph(kind, g), np.int64),
+        ), f"{kind}: incremental fixpoint diverged from the mutated-graph"
+        return info, wall
+
+    arms = {}
+    ups_total = edges_total = wall_total = 0.0
+    q_total = 0
+    for kind in ("bfs", "sssp"):
+        info, wall = arm(kind)
+        arms[kind] = (info, wall)
+        ups_total += info["updates_applied"]
+        edges_total += info["edges"]
+        q_total += info["queries"]
+        wall_total += wall
+
+    headline = {
+        "metric": f"dynamic-graph update+query service throughput "
+        f"(BFS+SSSP, R-MAT scale {scale}, {len(src_e)} static edges, "
+        f"{n_ups} updates, {len(queries)} queries, batched width "
+        f"{width})",
+        "value": round(ups_total / max(wall_total, 1e-9)),
+        "unit": "updates/sec",
+        "updates_per_sec": round(ups_total / max(wall_total, 1e-9)),
+        "query_teps": round(edges_total / max(wall_total, 1e-9)),
+        "queries_per_sec": round(q_total / max(wall_total, 1e-9)),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(headline), flush=True)  # headline FIRST, always
+    detail = {"kernels": {}}
+    for kind, (info, wall) in arms.items():
+        detail["kernels"][kind] = {
+            "edges": info["edges"],
+            "relaxations": info["relaxations"],
+            "tasks": info["executed"],
+            "updates_applied": info["updates_applied"],
+            "dropped": info["dropped"],
+            "spare_in_use": info["spare_in_use"],
+            "queries": info["queries"],
+            "elapsed_s": wall,
+        }
+        log(f"dyngraph {kind}: {info['updates_applied']} splices "
+            f"({info['dropped']} dropped, {info['spare_in_use']} spare "
+            f"blocks), {info['queries']} queries, {info['edges']} edges "
+            f"in {wall:.3f}s, bit-identical to the mutated-graph "
+            "reference")
+
+    logdir = os.path.join(os.path.dirname(__file__), "perf-logs")
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{int(time.time())}.dyngraph.json")
+    with open(path, "w") as f:
+        json.dump({**headline, **detail}, f, indent=1)
+    log(f"dyngraph bench written: {path}")
+
+
 def bench_bnb(quick: bool = False) -> None:
     """Branch-and-bound cost of record (ISSUE 15): best-first 0/1
     knapsack on the priority-bucket tier vs the unordered batched arm,
@@ -1851,13 +1970,17 @@ def main(argv=None) -> None:
         "single-device suite for this run",
     )
     ap.add_argument(
-        "--graph", action="store_true",
+        "--graph", nargs="?", const="static", default=None,
+        metavar="ARM",
         help="graph-analytics mode: BFS/SSSP/PageRank traversed-edges/s "
         "(TEPS) through the batched frontier tier on a seeded R-MAT "
         "graph; the combined TEPS headline prints FIRST (stdout JSON), "
         "per-kernel TEPS/occupancy/lane_partial_age to stderr and "
         "perf-logs/<ts>.graph.json; replaces the single-device suite "
-        "for this run",
+        "for this run. '--graph dyngraph' runs the dynamic-graph arm "
+        "instead: a concurrent update storm + queries against the "
+        "mutable adjacency, updates/s + query TEPS headline, detail to "
+        "perf-logs/<ts>.dyngraph.json",
     )
     ap.add_argument(
         "--bnb", action="store_true",
@@ -1890,6 +2013,9 @@ def main(argv=None) -> None:
         return
     if args.forasync:
         bench_forasync(quick=args.quick)
+        return
+    if args.graph == "dyngraph":
+        bench_dyngraph(quick=args.quick)
         return
     if args.graph:
         bench_graph(quick=args.quick)

@@ -54,7 +54,9 @@ from .model import (
     certify_bnb_schedule, certify_claim, certify_frontier_schedule,
     certify_tile_schedule,
 )
-from .races import boxes_overlap, check_batch_spec, check_tile_windows
+from .races import (
+    boxes_overlap, check_batch_spec, check_splice, check_tile_windows,
+)
 from .shim import ShimUnsupported
 from .waits import check_wait_graph, wait_graph
 
@@ -74,6 +76,7 @@ __all__ = [
     "certify_tile_schedule",
     "check_batch_spec",
     "check_layout",
+    "check_splice",
     "check_migratable",
     "check_protocols",
     "check_tile_windows",
@@ -103,6 +106,12 @@ def verify_megakernel(mk, suppress: Sequence[str] = (),
             name, fid, spec, mk.data_specs, mk.scratch_specs,
             report=report,
         )
+    # Dynamic-graph builds (mk._dyngraph, device/dyngraph.py) carry the
+    # splice protocol on top: prefetch off everywhere, spare-region
+    # bounds exact, blind block-row stores scoped to the spare region
+    # the append cursor owns (races.check_splice).
+    if getattr(mk, "_dyngraph", None) is not None:
+        check_splice(mk, report=report)
     # Wait-graph deadlock detection (waits.py): the construction gate
     # for any kind performing an on-device promise wait. A tree with no
     # wait ops pays a cheap code-object scan and zero shim passes; a

@@ -132,6 +132,20 @@ LAYOUT = {
     "TG_BACKLOG": (4, ("hclib_tpu.device.telemetry",)),
     "TG_ENTRIES": (5, ("hclib_tpu.device.telemetry",)),
     "TG_WORDS": (8, ("hclib_tpu.device.telemetry",)),
+    # dynamic-graph service ABI (device/dyngraph.py, ISSUE 20): the
+    # UPDATE/QUERY kernel-table positions (EXPAND keeps frontier.py's
+    # FR_EXPAND=0) and the counter value slots the splice ledger bumps -
+    # the reshard merge, the serving pump, and the conservation asserts
+    # all index these words. The per-vertex spare-region layout itself
+    # is a pure function stamped per build (mk._dyngraph) and checked
+    # structurally by races.check_splice, not a process constant.
+    "DG_UPDATE": (1, ("hclib_tpu.device.dyngraph",)),
+    "DG_QUERY": (2, ("hclib_tpu.device.dyngraph",)),
+    "V_UPDATES": (2, ("hclib_tpu.device.dyngraph",)),
+    "V_FREE": (3, ("hclib_tpu.device.dyngraph",)),
+    "V_DROPPED": (4, ("hclib_tpu.device.dyngraph",)),
+    "V_QUERIES": (5, ("hclib_tpu.device.dyngraph",)),
+    "TR_SPLICE": (21, ("hclib_tpu.device.tracebuf",)),
 }
 
 # checkpoint.py's export key sets: resharding and restore key on these
@@ -229,6 +243,24 @@ def check_layout(report: Optional[AnalysisReport] = None,
             "lane/tier state words exceed their declared row widths "
             "(or the bucket-tier counters overlap the age words)",
             word="LS_WORDS",
+        )
+    from ..device import dyngraph as dg
+    from ..device import frontier as fr
+
+    if not (fr.V_EDGES < fr.V_RELAX < dg.V_UPDATES < dg.V_FREE
+            < dg.V_DROPPED < dg.V_QUERIES < fr.VT_BASE
+            and fr.FR_EXPAND < dg.DG_UPDATE < dg.DG_QUERY):
+        report.add(
+            "layout", ERROR, None,
+            "dynamic-graph counter slots must ascend between the "
+            f"frontier counters and the vertex table (V_EDGES="
+            f"{fr.V_EDGES} < V_RELAX={fr.V_RELAX} < V_UPDATES="
+            f"{dg.V_UPDATES} < V_FREE={dg.V_FREE} < V_DROPPED="
+            f"{dg.V_DROPPED} < V_QUERIES={dg.V_QUERIES} < VT_BASE="
+            f"{fr.VT_BASE}), and the service kinds must follow EXPAND "
+            f"in the kernel table (FR_EXPAND={fr.FR_EXPAND} < "
+            f"DG_UPDATE={dg.DG_UPDATE} < DG_QUERY={dg.DG_QUERY})",
+            word="V_UPDATES",
         )
     from ..runtime import checkpoint as c
 

@@ -333,6 +333,147 @@ def certify_frontier_schedule(kind: str, *, reps: int = 64,
     return cert
 
 
+# ---------------------------------------------------------- dyngraph
+
+_dyngraph_cache: Dict[Tuple, Dict[str, Any]] = {}
+
+
+def certify_dyngraph_schedule(kind: str, *, reps: int = 64,
+                              buckets: int = 0,
+                              updates: Sequence[Tuple[int, int, int]] = (),
+                              perms: Optional[int] = None, seed: int = 0,
+                              report: Optional[AnalysisReport] = None,
+                              raise_on_error: bool = True,
+                              graph=None) -> Dict[str, Any]:
+    """Certify a dynamic-graph claim (device/dyngraph.py): the mutated
+    fixpoint is independent of how splices interleave with frontier
+    expansion. Runs the host incremental twin (same splice rule - spare
+    bounds, drop mirror - same relax) over a small seeded R-MAT
+    ``DynGraph`` carrying the claim's update stream, under K permuted
+    op-pool orders PLUS the two adversarial extremes (every update
+    before any expansion, and after all initial expansion), and proves
+    every fixpoint equal to the FROM-SCRATCH reference on the mutated
+    graph (bfs/sssp, bit-identity) or total mass conserved exactly
+    (pagerank - the result is schedule-dependent by design; the
+    certificate claims conservation, which is what the serving tier
+    promises). Update endpoints fold into the model graph's vertex
+    range - the certificate is about the SPLICE PROTOCOL, not the
+    caller's instance (the frontier discipline)."""
+    from ..device.dyngraph import (
+        DynGraph, host_dyngraph, host_incremental,
+        host_incremental_pagerank,
+    )
+
+    perms = _perms() if perms is None else int(perms)
+    ups = tuple(
+        (int(u), int(v), max(int(w), 0)) for u, v, w in updates
+    )
+    custom = graph is not None
+    key = ("dyngraph", kind, reps, perms, seed, buckets, ups)
+    if not custom and key in _dyngraph_cache:
+        return _dyngraph_cache[key]
+    if graph is None:
+        from ..device.workloads import rmat_edges
+
+        n, src, dst, w = rmat_edges(4, efactor=4, seed=seed + 11)
+        graph = DynGraph(n, src, dst, w, spare_blocks=2,
+                         upd_cap=max(len(ups), 1) + 1)
+    for u, v, w in ups:
+        graph.add_update(u % graph.n, v % graph.n, w)
+    cert: Dict[str, Any] = {
+        "claim": "dyngraph", "kind": kind,
+        "updates": len(graph.updates), "vertices": graph.n,
+        **({"buckets": int(buckets)} if buckets else {}),
+    }
+    rng = np.random.default_rng(seed * 1000 + 7)
+    m0 = 1 << 12
+
+    if kind == "pagerank":
+        rank0, _ = host_incremental_pagerank(graph, m0=m0, reps=reps)
+        total = int(rank0.sum())
+        cert["mass"] = total
+    elif kind in ("bfs", "sssp"):
+        ref = host_dyngraph(kind, graph, src=0)
+    else:
+        raise ValueError(
+            f"unknown dyngraph kind {kind!r} (bfs|sssp|pagerank)"
+        )
+
+    def order_list(tag):
+        if kind == "pagerank":
+            rank, _ = host_incremental_pagerank(
+                graph, m0=m0, reps=reps, order=tag
+            )
+            return rank
+        return host_incremental(kind, graph, src=0, order=tag)
+
+    # Pool size as the twins build it.
+    if kind == "pagerank":
+        npool = sum(
+            1
+            for v in range(graph.n)
+            for _u in graph.adj[v]
+            if _pr_survives(graph, v, m0, reps)
+        ) + len(graph.updates)
+    else:
+        npool = 1 + len(graph.updates)
+    idx = np.arange(npool)
+    upd_lo = npool - len(graph.updates)
+    extremes = [
+        np.concatenate([idx[upd_lo:], idx[:upd_lo]]),  # updates first
+        idx.copy(),                                    # updates last
+    ]
+    tags = [None] + [rng.permutation(npool) for _ in range(perms)]
+    tags += [e for e in extremes]
+    cert["orders"] = len(tags)
+    for t in tags:
+        got = order_list(None if t is None else list(int(i) for i in t))
+        if kind == "pagerank":
+            if int(got.sum()) != total:
+                report = report or AnalysisReport()
+                f = report.add(
+                    RULE, ERROR, "dg_update",
+                    "dyngraph pagerank mass is NOT conserved across "
+                    f"splice interleavings: {int(got.sum())} vs {total};"
+                    " certification refused",
+                    value_a=total, value_b=int(got.sum()),
+                )
+                cert["status"] = "refused (mass not conserved)"
+                cert["findings"] = _finding_jsonable(f)
+                if raise_on_error:
+                    report.raise_errors()
+                return cert
+        elif not np.array_equal(ref, got):
+            v = int(np.argwhere(ref != got)[0][0])
+            report = report or AnalysisReport()
+            f = report.add(
+                RULE, ERROR, "dg_update",
+                f"dyngraph kind {kind!r} incremental fixpoint is "
+                f"order-DEPENDENT: vertex {v} diverges "
+                f"({int(ref[v])} vs {int(got[v])}) from the "
+                "from-scratch reference on the mutated graph; "
+                "certification refused",
+                vertex=v, value_a=int(ref[v]), value_b=int(got[v]),
+            )
+            cert["status"] = "refused (order-dependent)"
+            cert["findings"] = _finding_jsonable(f)
+            if raise_on_error:
+                report.raise_errors()
+            return cert
+    cert["status"] = "certified"
+    if not custom:
+        _dyngraph_cache[key] = cert
+    return cert
+
+
+def _pr_survives(graph, v: int, m0: int, reps: int) -> bool:
+    from ..device.frontier import _pr_split
+
+    deg = int(graph.deg[v])
+    qc = _pr_split(m0, deg)
+    return m0 >= reps and qc > 0 and deg > 0
+
+
 # -------------------------------------------------------------- bnb
 
 _bnb_cache: Dict[Tuple, Dict[str, Any]] = {}
@@ -460,6 +601,24 @@ def certify_claim(mk, *, raise_on_error: bool = True,
         return certify_frontier_schedule(
             kind, reps=int(reps or 64), buckets=buckets, delta=delta,
             report=report, raise_on_error=raise_on_error,
+        )
+    if claim[0] == "dyngraph":
+        # (tag, kind, reps, buckets, updates) - the dynamic-graph
+        # service claim (ISSUE 20). ``updates`` is None at build time
+        # (the tile-claim discipline: certifying an unbound claim would
+        # prove a stream the build never ran); run_dyngraph stamps the
+        # registered stream before the run.
+        _tag, kind, reps, buckets, updates = claim
+        if updates is None:
+            return {
+                "claim": "dyngraph", "kind": kind,
+                "status": "unbound (no update stream run yet: "
+                          "run_dyngraph stamps it)",
+            }
+        return certify_dyngraph_schedule(
+            kind, reps=int(reps or 64), buckets=int(buckets or 0),
+            updates=updates, report=report,
+            raise_on_error=raise_on_error,
         )
     if claim[0] == "bnb":
         _tag, values, weights, cap, buckets = claim

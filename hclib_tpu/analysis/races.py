@@ -32,6 +32,7 @@ from .shim import (
 __all__ = [
     "boxes_overlap",
     "check_batch_spec",
+    "check_splice",
     "check_tile_windows",
 ]
 
@@ -279,6 +280,105 @@ def _check_round_trace(name: str, spec, t: BodyTrace,
             slot=s, announced=pfc,
         )
         break
+
+
+# ----------------------------------------------------- splice protocol
+
+
+def check_splice(mk, report: Optional[AnalysisReport] = None,
+                 suppress: Sequence[str] = ()) -> AnalysisReport:
+    """The dynamic-graph splice protocol (device/dyngraph.py; builds
+    stamped ``mk._dyngraph``). Three rules:
+
+    1. NO lane of a dyngraph build runs the cross-round prefetch: a
+       prefetched edge slab could race the write-back of the same block
+       row by an UPDATE in the current round (rule ``splice-protocol``).
+    2. The spare-region wiring is exact: ``spare_base + n * spare`` rows
+       of spares behind the static rows must equal the stamped block
+       total AND the ``indices`` buffer's leading dim - a mismatch means
+       splices write past the buffer or EXPANDs read phantom blocks.
+    3. Abstract-interpret the UPDATE batch body (recording shim) and
+       require every DMA store into a data buffer to be either a
+       READ-MODIFY-WRITE (the same window was DMA-read earlier in the
+       trace - the tail-append spelling) or target a row at/above
+       ``spare_base`` - the BLIND-OVERWRITE EXEMPTION: the append
+       cursor owns fresh spare rows uniquely, so building the row whole
+       in VMEM and storing it without a prior read is legal THERE and
+       only there. A blind store into a static row is the data-loss
+       spelling (it would clobber live edges) and is refused.
+    """
+    dg = getattr(mk, "_dyngraph", None)
+    report = report or AnalysisReport(suppress)
+    if dg is None:
+        return report
+    # (1) prefetch off on every routed lane.
+    for fid, spec in mk.batch_specs:
+        if spec.prefetch:
+            report.add(
+                "splice-protocol", ERROR, mk.kernel_names[fid],
+                "dyngraph build routes a lane WITH cross-round "
+                "prefetch: a prefetched edge slab can race an UPDATE's "
+                "block write-back in the same round - build dyngraph "
+                "megakernels with prefetch off on every kind",
+                fid=fid,
+            )
+    # (2) spare-region bounds wiring.
+    total = dg["spare_base"] + dg["n"] * dg["spare"]
+    rows = tuple(mk.data_specs["indices"].shape)[0]
+    if total != dg["total_blocks"] or rows != dg["total_blocks"]:
+        report.add(
+            "splice-protocol", ERROR, "dg_update",
+            f"spare-region bounds disagree: spare_base {dg['spare_base']}"
+            f" + n {dg['n']} * spare {dg['spare']} = {total}, stamped "
+            f"total_blocks {dg['total_blocks']}, indices rows {rows} - "
+            "splices would write past the adjacency (or EXPANDs read "
+            "phantom rows)",
+            computed=total, stamped=dg["total_blocks"], rows=rows,
+        )
+    # (3) blind-overwrite exemption scoped to the spare region.
+    upd_fid = None
+    for fid, spec in mk.batch_specs:
+        if mk.kernel_names[fid] == "dg_update":
+            upd_fid = fid
+            upd_spec = spec
+    if upd_fid is None:
+        return report  # scalar build: no routed body to interpret
+    try:
+        t = run_batch_body(
+            upd_spec, upd_fid, mk.data_specs, mk.scratch_specs,
+            prefetch_count=0,
+        )
+    except ShimUnsupported as e:
+        report.add(
+            "shim-unsupported", INFO, "dg_update",
+            f"splice body not abstractly interpretable ({e}); "
+            "blind-overwrite scoping not verifiable",
+        )
+        return report
+    spare_base = int(dg["spare_base"])
+    for ev in t.dma:
+        if ev.op != "start" or ev.dst_kind != "data":
+            continue
+        row_lo = ev.dst[1][0][0] if ev.dst[1] else 0
+        if row_lo >= spare_base:
+            continue  # the exemption: fresh spare rows are owned
+        rmw = any(
+            o.op == "start" and o.seq < ev.seq and o.src[0] == ev.dst[0]
+            and boxes_overlap(o.src[1], ev.dst[1])
+            for o in t.dma
+        )
+        if not rmw:
+            report.add(
+                "splice-protocol", ERROR, "dg_update",
+                f"blind DMA store into STATIC block row {row_lo} of "
+                f"{ev.dst[0]!r} (< spare_base {spare_base}) with no "
+                "prior read of that window: static rows hold live "
+                "edges - append via read-modify-write, or target the "
+                "spare region the append cursor owns",
+                buffer=ev.dst[0], window=ev.dst[1],
+                spare_base=spare_base,
+            )
+    return report
 
 
 def _check_prefetch(name: str, fid: int, spec, data_specs, scratch_specs,

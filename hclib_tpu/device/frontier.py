@@ -310,6 +310,15 @@ class FrontierKernel:
             d["weights"] = graph.weights
         return d
 
+    def _eff_cnt(self, kctx, v, blk, cnt):
+        """Live-edge count of block ``blk`` as THIS device sees it -
+        the static tier trusts the descriptor (``cnt`` unchanged, so
+        static builds trace zero extra words); the dynamic-graph
+        subclass clamps to the local vertex table so an EXPAND spawned
+        after a splice the local replica has not applied yet never
+        reads past the locally-live edges (dyngraph.py)."""
+        return cnt
+
     def _relax_block(self, kctx, eslab, wslab, carry, cnt) -> None:
         """The shared relax loop over one loaded edge slab: the single
         arithmetic trace both dispatch spellings run. ``eslab``/``wslab``
@@ -353,6 +362,7 @@ class FrontierKernel:
             cp.start()
         for cp in copies:
             cp.wait()
+        cnt = self._eff_cnt(ctx, v, blk, cnt)
         self._relax_block(
             ctx,
             lambda e: ctx.scratch["fr_idx"][e],
@@ -435,7 +445,9 @@ class FrontierKernel:
                     if self.weighted
                     else None,
                     ctx.arg(b, 2),
-                    ctx.arg(b, 3),
+                    self._eff_cnt(
+                        kctx, ctx.arg(b, 0), ctx.arg(b, 1), ctx.arg(b, 3)
+                    ),
                 )
 
     def batch_drain(self, ctx) -> None:
@@ -451,9 +463,11 @@ class FrontierKernel:
 # ----------------------------------------------------- the three kernels
 
 
-def bfs_kernel() -> FrontierKernel:
+def bfs_kernel(spawn: Callable = _spawn_blocks) -> FrontierKernel:
     """Level-style BFS as monotone label correction: carry is dist[v] at
-    spawn; an improving hop re-spawns the target's blocks."""
+    spawn; an improving hop re-spawns the target's blocks. ``spawn``
+    is the block spawner (dyngraph.py substitutes the two-range spare-
+    aware spelling; the default traces byte-identically to PR 10)."""
 
     def relax(fk, kctx, u, w, carry) -> None:
         nd = carry + 1
@@ -464,12 +478,12 @@ def bfs_kernel() -> FrontierKernel:
         def _():
             kctx.ivalues[st] = nd
             kctx.ivalues[V_RELAX] = kctx.ivalues[V_RELAX] + 1
-            _spawn_blocks(kctx, u, nd)
+            spawn(kctx, u, nd)
 
     return FrontierKernel("fr_bfs", relax, weighted=False, state0=INF)
 
 
-def sssp_kernel() -> FrontierKernel:
+def sssp_kernel(spawn: Callable = _spawn_blocks) -> FrontierKernel:
     """SSSP (nonnegative int weights): the same monotone relaxation
     with ``carry + w``. Unordered, the lane's pop order stands in for
     the bucket discipline and re-expansions are the correction; with
@@ -486,7 +500,7 @@ def sssp_kernel() -> FrontierKernel:
         def _():
             kctx.ivalues[st] = nd
             kctx.ivalues[V_RELAX] = kctx.ivalues[V_RELAX] + 1
-            _spawn_blocks(kctx, u, nd)
+            spawn(kctx, u, nd)
 
     return FrontierKernel("fr_sssp", relax, weighted=True, state0=INF)
 
@@ -567,7 +581,8 @@ def _pr_split(q, deg):
     return (q * PR_NUM // PR_DEN) // jnp.maximum(deg, 1)
 
 
-def pagerank_kernel(reps: int = 64) -> FrontierKernel:
+def pagerank_kernel(reps: int = 64,
+                    spawn: Callable = _spawn_blocks) -> FrontierKernel:
     """Push-style PageRank on integer fixed-point mass: a delivery of
     ``q`` retains ``q - deg*q_child`` into rank[u] and forwards
     ``q_child`` per out-edge; ``q < reps`` (or a zero child, or a
@@ -591,7 +606,7 @@ def pagerank_kernel(reps: int = 64) -> FrontierKernel:
 
         @pl.when(expand)
         def _():
-            _spawn_blocks(kctx, u, qc)
+            spawn(kctx, u, qc)
 
     fk = FrontierKernel("fr_pagerank", relax, weighted=False, state0=0)
     fk.reps = reps

@@ -75,6 +75,7 @@ __all__ = [
     "TR_FIRE_BUCKET",
     "TR_EGRESS",
     "TR_LATENCY",
+    "TR_SPLICE",
     "bucket_occupancy",
     "SC_HOLD",
     "SC_OUT",
@@ -157,6 +158,14 @@ TR_LATENCY = 20        # a = (tenant << 16) | latency bucket, b = raw
                        # the egress fold that also bumps the on-device
                        # histogram, so the Perfetto track and the
                        # scraped histogram are two views of one event.
+TR_SPLICE = 21         # a = (applied << 16) | dropped delta observed
+                       # this pump visit, b = spare blocks in use
+                       # (V_FREE) after it - the dynamic-graph SPLICE
+                       # progress record (ISSUE 20, device/dyngraph.py
+                       # serving pump; host-emitted off the device
+                       # counters, the TR_SCALE ring discipline, so
+                       # update-storm progress renders beside the
+                       # rounds that absorbed it).
 
 # TR_SCALE kind codes (b word) - mirror autoscaler.ScaleEvent.kind.
 SC_HOLD = 0
@@ -233,6 +242,7 @@ TAG_NAMES: Dict[int, str] = {
     TR_FIRE_BUCKET: "fire_bucket",
     TR_EGRESS: "egress_park",
     TR_LATENCY: "latency",
+    TR_SPLICE: "splice",
 }
 
 # TR_CREDIT delta codes (b word).

@@ -162,7 +162,7 @@ def _fsync_dir(path: str) -> None:
 
 
 def _kernel_meta(mk) -> Dict[str, Any]:
-    return {
+    m = {
         "kernel_names": list(mk.kernel_names),
         "capacity": int(mk.capacity),
         "num_values": int(mk.num_values),
@@ -172,6 +172,16 @@ def _kernel_meta(mk) -> Dict[str, Any]:
             for k, s in mk.data_specs.items()
         },
     }
+    # Dynamic-graph builds stamp their layout (plain ints + the bound
+    # update stream) into the manifest: reshard's canonical-rebuild path
+    # keys off ``meta['dyngraph']`` (device/dyngraph.reshard_dyngraph).
+    dg = getattr(mk, "_dyngraph", None)
+    if dg is not None:
+        m["dyngraph"] = {
+            k: (list(map(list, v)) if k == "updates" else v)
+            for k, v in dg.items()
+        }
+    return m
 
 
 def _kind_classes(mk) -> Dict[str, str]:
@@ -493,6 +503,16 @@ class CheckpointBundle:
                 "is pof2-only; an evacuation drops to the next pof2 "
                 "below the survivor count)"
             )
+        if self.meta.get("dyngraph"):
+            # Mutable-adjacency bundles DO carry per-device data buffers
+            # (the spliced block rows) - but their layout stamp gives
+            # reshard what the generic path lacks: a canonical rebuild
+            # (static rows + union-applied updates in uid order) every
+            # new device can share. Delegate wholesale; the dyngraph
+            # merge owns its own eligibility/conservation story.
+            from ..device.dyngraph import reshard_dyngraph
+
+            return reshard_dyngraph(self, ndev_new)
         if any(k.startswith("data/") for k in self.arrays):
             raise CheckpointError(
                 "reshard cannot re-home per-device data buffers: restore "

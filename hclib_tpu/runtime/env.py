@@ -197,6 +197,16 @@ REGISTRY = {
         _v("HCLIB_TPU_SLO_WINDOWS_S", "str", "60,300",
            "comma-separated burn-rate window lengths, seconds "
            "(malformed text raises)"),
+        # -- dynamic graph service (device/dyngraph.py) --
+        _v("HCLIB_TPU_DYNGRAPH_SPARE_BLOCKS", "int", "2",
+           "spare edge blocks pre-allocated per vertex for in-kernel "
+           "edge splices (>= 1; malformed or non-positive text "
+           "raises)"),
+        _v("HCLIB_TPU_DYNGRAPH_UPDATE_PRIORITY", "int", "0",
+           "bucket ring the UPDATE kind routes into on priority-"
+           "bucketed dyngraph builds (0 = highest, fires before "
+           "queries; clipped into [0, priority_buckets); malformed "
+           "text raises)"),
         # -- native C++ runtime (read by getenv in native/, not here) --
         _v("HCLIB_TPU_AFFINITY", "str", "none",
            "native worker CPU pinning: strided | chunked | none",

@@ -45,7 +45,11 @@ miss, never falsely hit.
 Knobs (``runtime/env.py`` registry): ``HCLIB_TPU_PROGRAM_CACHE``
 (default on; ``0`` forces off - byte-identity makes on safe under
 pytest and in serving alike) and ``HCLIB_TPU_PROGRAM_CACHE_CAP``
-(bounded LRU entry count; malformed or non-positive text raises).
+(bounded entry count; malformed or non-positive text raises).
+Eviction is cost-weighted LRU: on overflow the victim is the entry
+with the smallest measured ``build_s`` among the quarter of entries
+least recently used, so expensive mesh builds outlive bursts of cheap
+scalar ones without letting any entry pin the cache forever.
 """
 
 from __future__ import annotations
@@ -55,6 +59,7 @@ import threading
 import time
 import types
 from collections import OrderedDict
+from itertools import islice
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from .env import env_int, env_raw
@@ -325,14 +330,24 @@ def cache_cap() -> int:
 
 
 class ProgramCache:
-    """Bounded-LRU registry of jitted executables. Thread-safe; builds
-    run outside the lock (a racing identical build is wasted work, not
-    a correctness problem - first insert wins so every holder shares
-    one callable)."""
+    """Bounded-LRU registry of jitted executables, with COST-WEIGHTED
+    eviction: each entry remembers its measured ``build_s``, and on
+    overflow the victim is the CHEAPEST-to-rebuild entry among the
+    ``len // 4`` least-recently-used (ties: least recently used, so
+    uniform costs - and any cache small enough that the window is one
+    entry - degrade to exact LRU). A 40 s resident-mesh build thus
+    survives a burst of 50 ms scalar builds that would have rolled it
+    off the tail, while a hot expensive entry still cannot pin the
+    cache forever (it ages into the window like everything else).
+    Thread-safe; builds run outside the lock (a racing identical build
+    is wasted work, not a correctness problem - first insert wins so
+    every holder shares one callable)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[Tuple[str, str], Any]" = OrderedDict()
+        # key -> (fn, build_s); OrderedDict order IS the recency order.
+        self._entries: "OrderedDict[Tuple[str, str], Tuple[Any, float]]" \
+            = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -347,21 +362,26 @@ class ProgramCache:
 
     def get(self, key):
         with self._lock:
-            fn = self._entries.get(key)
-            if fn is not None:
+            ent = self._entries.get(key)
+            if ent is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-            return fn
+                return ent[0]
+            return None
 
-    def put(self, key, fn, cap: int):
+    def put(self, key, fn, cap: int, build_s: float = 0.0):
         with self._lock:
             self.misses += 1
-            kept = self._entries.setdefault(key, fn)
+            kept = self._entries.setdefault(key, (fn, float(build_s)))
             self._entries.move_to_end(key)
             while len(self._entries) > cap:
-                self._entries.popitem(last=False)
+                k = max(1, len(self._entries) // 4)
+                window = list(islice(self._entries.items(), k))
+                # min() is stable: equal costs evict the oldest.
+                victim = min(window, key=lambda kv: kv[1][1])[0]
+                del self._entries[victim]
                 self.evictions += 1
-            return kept
+            return kept[0]
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -445,7 +465,7 @@ def shared_build(mk, variant, build: Callable[[], Any]):
     fn = build()
     build_s = time.perf_counter() - t1
     if key is not None:
-        fn = _CACHE.put(key, fn, cache_cap())
+        fn = _CACHE.put(key, fn, cache_cap(), build_s=build_s)
     return fn, {
         "hit": False,
         "cache_lookup_s": lookup_s,

@@ -1294,3 +1294,288 @@ def test_autoscaler_resume_from_store_root(tmp_path):
         os.remove(os.path.join(root, f"gen-{g:06d}", "manifest.json"))
     with pytest.raises(CheckpointError, match="unrecoverable"):
         scaler.run(resume_bundle=root)
+
+
+# --------------------------------------- dyngraph bundles (ISSUE 20)
+
+
+def _dyngraph_fixture(applied, *, serve_query=True, residue=True):
+    """A synthetic ``ndev=4`` dyngraph bundle: each device has applied
+    the uids in ``applied[d]`` (in that order - the host mirror of the
+    device splice arithmetic), labels show divergent partial progress,
+    and the scheduler holds residue rows (each device's UNapplied
+    updates, a dynamic EXPAND, one pending QUERY). Returns
+    ``(bundle, graph, ups, iv, counts)``."""
+    from hclib_tpu.device.descriptor import (
+        DESC_WORDS, F_A0, F_FN, F_OUT, NO_TASK,
+    )
+    from hclib_tpu.device.dyngraph import (
+        DG_QUERY, DG_UPDATE, DynGraph, V_FREE, V_QUERIES, V_UPDATES,
+        _bind_updates, make_dyngraph_megakernel,
+    )
+    from hclib_tpu.device.frontier import (
+        EBLOCK, INF, V_EDGES, V_RELAX, VT_BASE,
+    )
+    from hclib_tpu.device.megakernel import (
+        C_ALLOC, C_EXECUTED, C_PENDING, C_VALLOC,
+    )
+
+    rng = np.random.default_rng(0)
+    n, m = 12, 40
+    g = DynGraph(n, rng.integers(0, n, m), rng.integers(0, n, m),
+                 rng.integers(1, 8, m), spare_blocks=2, upd_cap=8)
+    ups = [(1, 5, 3), (2, 7, 1), (1, 9, 2), (4, 3, 6)]
+    for u, v, w in ups:
+        g.add_update(u, v, w)
+    mk = make_dyngraph_megakernel("sssp", g, width=0, interpret=True)
+    _bind_updates(mk, g)
+
+    ndev, cap, V = 4, 32, mk.num_values
+    sb, spare, bcs = g.spare_base, g.spare, g.blk_count.astype(np.int64)
+    flag_base, st = g.flag_base, g.st_base
+    iv = np.zeros((ndev, V), np.int64)
+    ind = np.zeros((ndev,) + g.indices.shape, np.int32)
+    wgt = np.zeros((ndev,) + g.weights.shape, np.int32)
+    for d in range(ndev):
+        iv[d] = g.preset_values(V, INF)
+        ind[d] = g.indices
+        wgt[d] = g.weights
+
+    def apply_on(d, uid):
+        u, v, w = ups[uid]
+        vt = iv[d, VT_BASE:VT_BASE + 3 * n].reshape(n, 3)
+        deg, bc = int(vt[u, 2]), int(vt[u, 1])
+        if deg == bc * EBLOCK:
+            r = sb + u * spare + (bc - int(bcs[u]))
+            ind[d, r, :] = -1
+            wgt[d, r, :] = 0
+            ind[d, r, 0] = v
+            wgt[d, r, 0] = w
+            vt[u, 1] = bc + 1
+            iv[d, V_FREE] += 1
+        else:
+            blk = deg // EBLOCK
+            r = (int(vt[u, 0]) + blk if blk < int(bcs[u])
+                 else sb + u * spare + (blk - int(bcs[u])))
+            ind[d, r, deg % EBLOCK] = v
+            wgt[d, r, deg % EBLOCK] = w
+        vt[u, 2] = deg + 1
+        iv[d, flag_base + uid] = 1
+        iv[d, V_UPDATES] += 1
+
+    for d, uids in applied.items():
+        for uid in uids:
+            apply_on(d, uid)
+    for d in range(ndev):
+        iv[d, st] = 0
+        for vtx in range(1, n):
+            iv[d, st + vtx] = INF if (vtx + d) % 3 else 10 + vtx + d
+        iv[d, V_EDGES] = 5 + d
+        iv[d, V_RELAX] = 2 + d
+    if serve_query:  # one served query on device 1, out slot st + n
+        iv[1, V_QUERIES] = 1
+        iv[1, st + n] = 13
+
+    tasks = np.zeros((ndev, cap, DESC_WORDS), np.int32)
+    counts = np.zeros((ndev, 8), np.int32)
+    ready = np.full((ndev, cap), NO_TASK, np.int32)
+    succ = np.full((ndev, 16), NO_TASK, np.int32)
+    for d in range(ndev):
+        rows = []
+        for uid in range(len(ups)):
+            if uid not in applied[d]:
+                u, v, w = ups[uid]
+                r = np.zeros(DESC_WORDS, np.int32)
+                r[F_FN] = DG_UPDATE
+                r[F_A0:F_A0 + 4] = (u, v, w, uid)
+                r[2] = r[3] = r[13] = NO_TASK
+                rows.append(r)
+        if residue:
+            r = np.zeros(DESC_WORDS, np.int32)  # a dynamic EXPAND
+            r[F_FN] = 0
+            r[F_A0:F_A0 + 2] = (d % n, 4)
+            r[2] = r[3] = r[13] = NO_TASK
+            rows.append(r)
+            if d == 2:  # one pending QUERY
+                r = np.zeros(DESC_WORDS, np.int32)
+                r[F_FN] = DG_QUERY
+                r[F_A0] = 7
+                r[F_OUT] = st + n + 1
+                r[2] = r[3] = r[13] = NO_TASK
+                rows.append(r)
+        for i, r in enumerate(rows):
+            tasks[d, i] = r
+            ready[d, i] = i
+        counts[d, 1] = counts[d, C_ALLOC] = len(rows)
+        counts[d, C_PENDING] = len(rows)
+        counts[d, C_VALLOC] = g.num_value_slots
+        counts[d, C_EXECUTED] = 3 + d
+    arrays = {
+        "tasks": tasks, "succ": succ, "ready": ready, "counts": counts,
+        "ivalues": iv.astype(np.int32),
+        "data/indices": ind, "data/weights": wgt,
+    }
+    meta = {"ndev": ndev, "dyngraph": dict(mk._dyngraph),
+            "kernel_names": list(mk.kernel_names)}
+    return CheckpointBundle("resident", meta, arrays), g, ups, iv, counts
+
+
+def test_dyngraph_reshard_shrink_grow_conserves():
+    """4 -> 2 -> 4: the canonical rebuilt adjacency broadcasts
+    identically, edge count conserves (static + union-applied), labels
+    min-fold, accumulators sum-fold, the served query value survives,
+    and residue deals without loss."""
+    from hclib_tpu.device.frontier import V_EDGES, VT_BASE
+    from hclib_tpu.device.dyngraph import V_QUERIES
+    from hclib_tpu.device.megakernel import C_EXECUTED, C_PENDING
+
+    applied = {d: [u for u in range(4) if (u + d) % 2 == 0]
+               for d in range(4)}
+    applied[1] = applied[1][::-1]  # order-divergent application
+    applied[3] = applied[3][::-1]
+    bundle, g, ups, iv, counts = _dyngraph_fixture(applied)
+    n, st = g.n, g.st_base
+
+    b2 = bundle.reshard(2)
+    assert b2.meta["ndev"] == 2
+    assert b2.meta["dyngraph_reshard"]["union_applied"] == 4
+    assert b2.meta["dyngraph_reshard"]["pending_updates"] == 0
+    i2 = b2.arrays["data/indices"]
+    assert np.array_equal(i2[0], i2[1])  # canonical broadcast
+    iv2 = b2.arrays["ivalues"].astype(np.int64)
+    vt2 = iv2[0, VT_BASE:VT_BASE + 3 * n].reshape(n, 3)
+    assert int(vt2[:, 2].sum()) == int(g.deg.sum()) + 4
+    c2 = b2.arrays["counts"]
+    assert int(c2[:, C_PENDING].sum()) == 5  # 4 EXPANDs + 1 QUERY dealt
+    assert int(c2[:, C_EXECUTED].sum()) == int(counts[:, C_EXECUTED].sum())
+    want = iv[:, st:st + n].min(axis=0)
+    assert np.array_equal(iv2[0, st:st + n], want)
+    assert np.array_equal(iv2[1, st:st + n], want)
+    assert int(iv2[:, V_EDGES].sum()) == int(iv[:, V_EDGES].sum())
+    assert int(iv2[:, V_QUERIES].sum()) == 1
+    assert int(iv2[0, st + n]) == 13  # served query value max-folds
+
+    b3 = b2.reshard(4)  # grow back
+    assert b3.meta["ndev"] == 4 and b3.meta["resharded_from"] == 2
+    for d in range(4):
+        assert np.array_equal(b3.arrays["data/indices"][d], i2[0])
+    iv3 = b3.arrays["ivalues"].astype(np.int64)
+    vt3 = iv3[0, VT_BASE:VT_BASE + 3 * n].reshape(n, 3)
+    assert int(vt3[:, 2].sum()) == int(g.deg.sum()) + 4
+    assert int(iv3[:, V_EDGES].sum()) == int(iv[:, V_EDGES].sum())
+
+
+def test_dyngraph_reshard_broadcasts_unapplied_update():
+    """A pending update NO replica has applied dedupes by uid and
+    broadcasts to every new device - the mesh invariant 'every replica
+    sees every update' survives the resize."""
+    from hclib_tpu.device.descriptor import F_A0, F_FN
+    from hclib_tpu.device.dyngraph import DG_UPDATE
+    from hclib_tpu.device.frontier import VT_BASE
+    from hclib_tpu.device.megakernel import C_ALLOC
+
+    applied = {0: [0], 1: [1, 0], 2: [], 3: [2]}  # uid 3 nowhere
+    bundle, g, ups, _, _ = _dyngraph_fixture(
+        applied, serve_query=False, residue=False,
+    )
+    b2 = bundle.reshard(2)
+    rs = b2.meta["dyngraph_reshard"]
+    assert rs["union_applied"] == 3 and rs["pending_updates"] == 1
+    t, c = b2.arrays["tasks"], b2.arrays["counts"]
+    for j in range(2):
+        uids = [int(t[j, i, F_A0 + 3]) for i in range(int(c[j, C_ALLOC]))
+                if int(t[j, i, F_FN]) == DG_UPDATE]
+        assert uids == [3], uids
+    n = g.n
+    vt = b2.arrays["ivalues"][0, VT_BASE:VT_BASE + 3 * n].reshape(n, 3)
+    assert int(vt[:, 2].sum()) == int(g.deg.sum()) + 3
+
+
+def test_dyngraph_reshard_refusals():
+    """Structured refusals: pagerank mid-run (no device-count-free
+    fold), dropped splices (adjacency no longer the stream's), and
+    foreign data buffers."""
+    from hclib_tpu.device.dyngraph import V_DROPPED
+
+    applied = {0: [0, 1, 2, 3], 1: [], 2: [], 3: []}
+    bundle, g, ups, _, _ = _dyngraph_fixture(applied)
+
+    pr = CheckpointBundle(
+        bundle.kind,
+        {**bundle.meta,
+         "dyngraph": {**bundle.meta["dyngraph"], "kind": "pagerank"}},
+        bundle.arrays,
+    )
+    with pytest.raises(CheckpointError, match="pagerank"):
+        pr.reshard(2)
+
+    dropped = {k: np.array(v) for k, v in bundle.arrays.items()}
+    dropped["ivalues"] = dropped["ivalues"].copy()
+    dropped["ivalues"][2, V_DROPPED] = 1
+    with pytest.raises(CheckpointError, match="spare"):
+        CheckpointBundle(bundle.kind, bundle.meta, dropped).reshard(2)
+
+    extra = dict(bundle.arrays)
+    extra["data/other"] = np.zeros((4, 8), np.int32)
+    with pytest.raises(CheckpointError, match="extra data buffers"):
+        CheckpointBundle(bundle.kind, bundle.meta, extra).reshard(2)
+
+
+def test_dyngraph_quiesce_mid_update_storm_resume_bit_identical():
+    """Quiesce a single-device dyngraph run mid-update-storm, snapshot
+    (the layout stamp rides bundle meta), resume, and the fixpoint is
+    bit-identical to the host twin on the mutated graph - with the
+    vertex-table degrees conserving static + applied edge counts."""
+    from hclib_tpu.device.dyngraph import (
+        DynGraph, _bind_updates, _seed_builders, fk_data, host_dyngraph,
+        make_dyngraph_megakernel,
+    )
+    from hclib_tpu.device.frontier import INF, VT_BASE
+
+    rng = np.random.default_rng(11)
+    n, m = 16, 48
+    g = DynGraph(n, rng.integers(0, n, m), rng.integers(0, n, m),
+                 rng.integers(1, 8, m), spare_blocks=2, upd_cap=8)
+    for u, v, w in [(1, 5, 3), (2, 7, 1), (0, 9, 2), (4, 3, 6)]:
+        g.add_update(u, v, w)
+    mk = make_dyngraph_megakernel(
+        "sssp", g, width=0, interpret=True, checkpoint=True,
+    )
+    _bind_updates(mk, g)
+    builders, _ = _seed_builders(
+        g, "sssp", 0, 1 << 14, 64, [5], mk.num_values, 1,
+        lambda i, tot: 0,
+    )
+    iv = g.preset_values(mk.num_values, INF)
+    iv[g.st_base] = 0
+    _, _, info_q = mk.run(
+        builders[0], data=dict(fk_data(g, mk)), ivalues=iv, quiesce=2,
+    )
+    assert info_q["quiesced"] is True and info_q["pending"] > 0
+    bundle = snapshot_megakernel(mk, info_q)
+    assert bundle.meta["dyngraph"]["kind"] == "sssp"
+    assert len(bundle.meta["dyngraph"]["updates"]) == 4
+
+    iv_r, _, info_r = mk.resume(info_q["state"])
+    row = np.asarray(iv_r, np.int64)
+    res = row[g.st_base : g.st_base + n].astype(np.int32)
+    assert np.array_equal(res, host_dyngraph("sssp", g, 0))
+    flags = row[g.flag_base : g.flag_base + g.upd_cap]
+    vt = row[VT_BASE : VT_BASE + 3 * n].reshape(n, 3)
+    assert int((flags != 0).sum()) == 4
+    assert int(vt[:, 2].sum()) == int(g.deg.sum()) + 4  # conservation
+    # The in-run query published SOME label for vertex 5 (tentative
+    # when it raced the traversal, exact once drained - monotone
+    # relaxation means it can only be an upper bound of the fixpoint).
+    assert int(row[g.st_base + n]) >= int(res[5])
+
+    # Restore THROUGH the bundle onto a fresh identical build: the
+    # mutated adjacency rides data/ and the run completes identically.
+    mk2 = make_dyngraph_megakernel(
+        "sssp", g, width=0, interpret=True, checkpoint=True,
+    )
+    _bind_updates(mk2, g)
+    iv_b, _, _ = restore_megakernel(bundle, mk2)
+    assert np.array_equal(
+        np.asarray(iv_b, np.int64)[g.st_base : g.st_base + n], res
+    )

@@ -403,6 +403,30 @@ def test_lru_order_refreshes_on_hit(monkeypatch):
     assert sa3["hit"] and a3 is a
 
 
+def test_eviction_is_cost_weighted():
+    """An expensive build survives a burst of cheap ones that would
+    have rolled it off a plain LRU tail; uniform costs stay exact LRU."""
+    from hclib_tpu.runtime.progcache import ProgramCache
+
+    cap = 8
+    pc = ProgramCache()
+    pc.put(("exp",), "EXP", cap, build_s=40.0)
+    for i in range(cap - 1):
+        pc.put(("cheap", i), i, cap, build_s=0.01)
+    assert len(pc) == cap and pc.evictions == 0
+    pc.put(("cheap", cap - 1), cap - 1, cap, build_s=0.01)  # overflow
+    assert pc.evictions == 1
+    assert pc.contains(("exp",))          # LRU-oldest, but costly: kept
+    assert not pc.contains(("cheap", 0))  # cheapest in the LRU window
+    assert pc.get(("exp",)) == "EXP"
+
+    pc2 = ProgramCache()
+    for i in range(cap + 1):
+        pc2.put(("u", i), i, cap, build_s=0.5)
+    assert not pc2.contains(("u", 0)) and pc2.contains(("u", 1))
+    assert pc2.evictions == 1
+
+
 def test_probe_reads_without_counting():
     mk = _bump_mk()
     assert probe(mk, ("v",)) is False

@@ -12,7 +12,7 @@ LESSONS = sorted(p.name for p in TUTORIAL.glob("[0-2][0-9]_*.py"))
 
 
 def test_tutorial_is_complete():
-    assert len(LESSONS) == 23
+    assert len(LESSONS) == 24
 
 
 @pytest.mark.parametrize("lesson", LESSONS)

@@ -2035,6 +2035,98 @@ def scenario_slo_burn_scaleout(seed: int, scale: str) -> dict:
             "p99_rounds": float(p99), "target": target}
 
 
+# --------------------------------------- dynamic graph service (ISSUE 20)
+
+
+def scenario_dyngraph_storm_reshard(seed: int, scale: str) -> dict:
+    """Dyngraph: an UPDATE storm cut LIVE mid-run, twice - two replicas
+    of the same registered stream quiesce at different points (divergent
+    applied subsets, labels, spare cursors), the stacked 2-replica
+    bundle reshards 2 -> 4 -> 1 through the canonical merge (union
+    flags broadcast, edge-count conservation, labels min-folded), and
+    the live replica resumes to a fixpoint bit-identical to the
+    from-scratch host run ON THE MUTATED GRAPH - the fault is the
+    mid-storm preemption, the recoveries are the reshard folds and the
+    exact drain."""
+    import numpy as np
+
+    from hclib_tpu.device.dyngraph import (
+        DynGraph, _bind_updates, _seed_builders, fk_data, host_dyngraph,
+        make_dyngraph_megakernel,
+    )
+    from hclib_tpu.device.frontier import INF, VT_BASE
+    from hclib_tpu.runtime.checkpoint import (
+        CheckpointBundle, snapshot_megakernel,
+    )
+
+    rng = np.random.default_rng(29 + seed)
+    n, m = (16, 48) if scale == "smoke" else (32, 128)
+    n_ups = 4 if scale == "smoke" else 8
+    g = DynGraph(n, rng.integers(0, n, m), rng.integers(0, n, m),
+                 rng.integers(1, 8, m), spare_blocks=2,
+                 upd_cap=max(8, n_ups))
+    for u, v, w in zip(rng.integers(0, n, n_ups),
+                       rng.integers(0, n, n_ups),
+                       rng.integers(1, 8, n_ups)):
+        g.add_update(int(u), int(v), int(w))
+    mk = make_dyngraph_megakernel(
+        "sssp", g, width=0, interpret=True, checkpoint=True,
+    )
+    _bind_updates(mk, g)
+
+    def cut(quiesce):
+        builders, _ = _seed_builders(
+            g, "sssp", 0, 1 << 14, 64, [1], mk.num_values, 1,
+            lambda i, tot: 0,
+        )
+        iv = g.preset_values(mk.num_values, INF)
+        iv[g.st_base] = 0
+        _, _, info_q = mk.run(
+            builders[0], data=dict(fk_data(g, mk)), ivalues=iv,
+            quiesce=quiesce,
+        )
+        assert info_q["quiesced"] and info_q["pending"] > 0, info_q
+        return info_q
+
+    qa, qb = cut(1), cut(3)  # divergent cuts of the same stream
+    ba, bb = snapshot_megakernel(mk, qa), snapshot_megakernel(mk, qb)
+    arrays = {k: np.stack([np.asarray(ba.arrays[k]),
+                           np.asarray(bb.arrays[k])])
+              for k in ba.arrays}
+    mesh = CheckpointBundle("resident", {**ba.meta, "ndev": 2}, arrays)
+
+    flag_base, st = g.flag_base, g.st_base
+    ivs = arrays["ivalues"].astype(np.int64)
+    union = ivs[:, flag_base:flag_base + g.upd_cap].max(axis=0)
+    recoveries = 0
+    for ndev_new in (4, 1):
+        out = mesh.reshard(ndev_new)
+        oiv = np.asarray(out.arrays["ivalues"]).astype(np.int64)
+        assert oiv.shape[0] == ndev_new
+        for d in range(ndev_new):
+            # Union flags + the canonical adjacency broadcast to every
+            # new device; degrees conserve static + union-applied.
+            assert np.array_equal(
+                oiv[d, flag_base:flag_base + g.upd_cap], union)
+            vt = oiv[d, VT_BASE:VT_BASE + 3 * n].reshape(n, 3)
+            assert int(vt[:, 2].sum()) == (
+                int(g.deg.sum()) + int(union.sum()))
+            assert np.array_equal(out.arrays["data/indices"][d],
+                                  out.arrays["data/indices"][0])
+        assert np.array_equal(  # labels min-fold across the replicas
+            oiv[0, st:st + n], ivs[:, st:st + n].min(axis=0))
+        recoveries += 1
+
+    # The live replica drains: bit-identical to the mutated-graph twin.
+    iv_r, _, _ = mk.resume(qa["state"])
+    res = np.asarray(iv_r, np.int64)[st:st + n].astype(np.int32)
+    assert np.array_equal(res, host_dyngraph("sssp", g, 0))
+    recoveries += 1
+    return {"faults": 2, "recoveries": recoveries, "updates": n_ups,
+            "union_applied": int(union.sum()),
+            "pending_at_cut": int(qa["pending"])}
+
+
 SCENARIOS = [
     ("fib_retry", scenario_fib_retry),
     ("uts_kill_worker", scenario_uts_kill_worker),
@@ -2085,6 +2177,10 @@ DURABILITY_SCENARIOS = [
 
 SLO_SCENARIOS = [
     ("slo_burn_scaleout", scenario_slo_burn_scaleout),
+]
+
+DYNGRAPH_SCENARIOS = [
+    ("dyngraph_storm_reshard", scenario_dyngraph_storm_reshard),
 ]
 
 
@@ -2145,6 +2241,15 @@ def main(argv=None) -> int:
                          "TR_SCALE/metrics/Perfetto)")
     ap.add_argument("--slo-only", action="store_true",
                     help="run ONLY the SLO burn-rate scenario")
+    ap.add_argument("--dyngraph", action="store_true",
+                    help="add the seeded dynamic-graph scenario (an "
+                         "update storm cut live at two divergent "
+                         "points, the stacked replicas resharded "
+                         "2->4->1 with canonical-merge conservation, "
+                         "and the live replica drained bit-identical "
+                         "to the mutated-graph host twin)")
+    ap.add_argument("--dyngraph-only", action="store_true",
+                    help="run ONLY the dynamic-graph scenario")
     ap.add_argument("--no-skip", action="store_true",
                     help="treat skipped scenarios as failures (CI gating "
                          "jobs must fail CLOSED: an environment that "
@@ -2161,7 +2266,8 @@ def main(argv=None) -> int:
         []
         if (args.mesh_only or args.preempt_only or args.storm_only
             or args.tenants_only or args.serve_only
-            or args.durability_only or args.slo_only)
+            or args.durability_only or args.slo_only
+            or args.dyngraph_only)
         else list(SCENARIOS)
     )
     if args.mesh or args.mesh_only:
@@ -2178,6 +2284,8 @@ def main(argv=None) -> int:
         scenarios += DURABILITY_SCENARIOS
     if args.slo or args.slo_only:
         scenarios += SLO_SCENARIOS
+    if args.dyngraph or args.dyngraph_only:
+        scenarios += DYNGRAPH_SCENARIOS
 
     # The tool's own hang enforcement: dump + hard-exit on overrun.
     faulthandler.dump_traceback_later(args.timeout_s, exit=True)

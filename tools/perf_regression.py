@@ -884,6 +884,112 @@ def _telemetry_overhead(quick: bool, trials: int) -> dict:
     }
 
 
+def _dyngraph_incremental(quick: bool, trials: int) -> dict:
+    """Dynamic-graph incremental-recompute guard (ISSUE 20): phase 1
+    runs the seeded SSSP to its fixpoint on the STATIC graph; phase 2
+    feeds ONLY the update stream into the same megakernel, reusing
+    phase 1's converged labels as the initial values - so the only
+    EXPANDs it executes are the re-relaxations the splices actually
+    caused. That incremental EXPAND count must stay a small fraction
+    of the from-scratch run on the mutated graph, measured in the same
+    process; both fixpoints are asserted bit-identical to the
+    ``host_dyngraph`` mutated-graph reference. Work counts are exact
+    (no timed arms), so ``trials`` is unused."""
+    import numpy as np
+
+    from hclib_tpu.device.descriptor import TaskGraphBuilder
+    from hclib_tpu.device.dyngraph import (
+        DG_UPDATE, INF, DynGraph, _bind_updates, _seed_builders,
+        fk_data, host_dyngraph, make_dyngraph_megakernel, run_dyngraph,
+    )
+    from hclib_tpu.device.workloads import rmat_edges
+
+    scale = 5 if quick else 7
+    n, src_e, dst_e, w_e = rmat_edges(scale, efactor=8, seed=7)
+    capacity = 512 if quick else 1024
+    rng = np.random.default_rng(13)
+    n_ups = 6 if quick else 16
+    ups = [
+        (int(u), int(v), int(w))
+        for u, v, w in zip(
+            rng.integers(0, n, n_ups),
+            rng.integers(0, n, n_ups),
+            rng.integers(1, 8, n_ups),
+        )
+    ]
+    src = 0
+    g = DynGraph(
+        n, src_e, dst_e, w_e, spare_blocks=2, upd_cap=max(16, n_ups),
+    )
+    mk = make_dyngraph_megakernel(
+        "sssp", g, width=8, capacity=capacity, interpret=True,
+    )
+    _bind_updates(mk, g)  # empty stream: phase 1 is the static run
+    st = g.st_base
+    iv0 = g.preset_values(mk.num_values, INF)
+    iv0[st + src] = 0
+    builders, _ = _seed_builders(
+        g, "sssp", src, 1 << 14, 64, (), mk.num_values, 1,
+        lambda i, tot: 0,
+    )
+    iv1, _, info1 = mk.run(
+        builders[0], data=dict(fk_data(g, mk)), ivalues=iv0,
+        fuel=1 << 22,
+    )
+
+    # Phase 2: the update stream ALONE, seeded with the converged
+    # labels. Fresh data buffers (pristine spare rows) are correct -
+    # phase 1 ran no splices, so its adjacency never mutated.
+    for u, v, w in ups:
+        g.add_update(u, v, w)
+    _bind_updates(mk, g)
+    b2 = TaskGraphBuilder()
+    b2.reserve_values(g.num_value_slots)
+    for uid, (u, v, w) in enumerate(g.updates):
+        b2.add(DG_UPDATE, args=[u, v, w, uid])
+    iv2, _, info2 = mk.run(
+        b2, data=dict(fk_data(g, mk)), ivalues=np.asarray(iv1),
+        fuel=1 << 22,
+    )
+    rows = np.asarray(iv2, np.int64)
+    res_incr = rows[st : st + n].astype(np.int64)
+    flags = rows[g.flag_base : g.flag_base + g.upd_cap]
+    applied = int((flags != 0).sum())
+    ref = np.asarray(host_dyngraph("sssp", g), np.int64)
+    if not np.array_equal(res_incr, ref):
+        raise AssertionError(
+            "dyngraph-incremental: the update-only rerun's fixpoint "
+            "diverged from the mutated-graph reference"
+        )
+
+    # From-scratch arm: the same storm raced with the traversal on a
+    # fresh graph - everything recomputes. The prebuilt megakernel is
+    # reusable (identical (n, kind, st_base) layout stamp).
+    g2 = DynGraph(
+        n, src_e, dst_e, w_e, spare_blocks=2, upd_cap=max(16, n_ups),
+    )
+    res_full, info_full = run_dyngraph(
+        "sssp", g2, src, updates=ups, capacity=capacity,
+        interpret=True, mk=mk,
+    )
+    if not np.array_equal(np.asarray(res_full, np.int64), ref):
+        raise AssertionError(
+            "dyngraph-incremental: the from-scratch arm diverged from "
+            "the mutated-graph reference"
+        )
+    incr_expands = int(info2["executed"]) - len(ups)
+    full_expands = int(info_full["executed"]) - len(ups)
+    return {
+        "incr_expands": incr_expands,
+        "full_expands": full_expands,
+        "expand_ratio": incr_expands / max(full_expands, 1),
+        "static_expands": int(info1["executed"]),
+        "updates": len(ups),
+        "updates_applied": applied,
+        "bit_identical": True,
+    }
+
+
 def _latest_log(log_dir: str, quick: bool) -> Dict[str, dict]:
     """Most recent log of the SAME size class (quick vs full): comparing
     tiny smoke inputs against full-size baselines is meaningless in either
@@ -988,6 +1094,14 @@ def main(argv=None) -> int:
                          "plus per-retire histogram fold; results must "
                          "be bit-identical and the off path must lower "
                          "byte-identical text regardless)")
+    ap.add_argument("--dyngraph-expand-ceiling", type=float, default=0.5,
+                    help="dyngraph-incremental guard: maximum "
+                         "incremental-EXPAND count of the update-only "
+                         "rerun as a fraction of the from-scratch run "
+                         "on the mutated graph (the ISSUE 20 "
+                         "incremental-recompute dividend; the rerun "
+                         "re-expands only what the splices actually "
+                         "invalidated)")
     ap.add_argument("--log-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "perf-logs"))
     ap.add_argument("--apps", default="", help="comma-separated subset")
@@ -1290,6 +1404,33 @@ def main(argv=None) -> int:
                     f"{to['ratio']:.2f}x slower than the off stream "
                     f"(bound {args.telemetry_tolerance:.2f}x) - the "
                     "histogram fold is taxing the round loop"
+                )
+                line += "  REGRESSED"
+            print(line, flush=True)
+
+    if not wanted or "dyngraph-incremental" in wanted:
+        try:
+            dy = _dyngraph_incremental(args.quick, args.trials)
+        except Exception as e:
+            print(f"dyngraph-incremental FAILED: {e}", file=sys.stderr)
+            failures.append(f"dyngraph-incremental: failed ({e})")
+        else:
+            results["dyngraph-incremental"] = dy
+            line = (
+                f"{'dyngraph-incr':15s} expand "
+                f"{dy['expand_ratio']:5.2f}x "
+                f"({dy['incr_expands']} incremental vs "
+                f"{dy['full_expands']} from-scratch EXPANDs, "
+                f"{dy['updates_applied']}/{dy['updates']} splices, "
+                "bit-identical)"
+            )
+            if dy["expand_ratio"] > args.dyngraph_expand_ceiling:
+                failures.append(
+                    f"dyngraph-incremental: the update-only rerun "
+                    f"re-expanded {dy['expand_ratio']:.2f}x the "
+                    f"from-scratch EXPAND count (ceiling "
+                    f"{args.dyngraph_expand_ceiling:.2f}x) - "
+                    "incremental recompute stopped paying for itself"
                 )
                 line += "  REGRESSED"
             print(line, flush=True)

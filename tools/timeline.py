@@ -497,6 +497,18 @@ def _device_events(trace: Dict, pid0: int) -> List[Dict]:
                 span(_TID_EVENTS, "events", t, 0.25,
                      f"latency t{ten} 2^{bkt}",
                      {"tenant": ten, "bucket": bkt, "rounds": b})
+            elif tag == tb.TR_SPLICE:
+                # Dynamic-graph splice progress (ISSUE 20): applied and
+                # dropped update deltas observed by one serving-pump
+                # visit packed in a, spare-block occupancy after it in
+                # b - the update storm's absorption rate reads off the
+                # events track beside the rounds that did the work.
+                app, drop = a >> 16, a & 0xFFFF
+                name = f"splice +{app}"
+                if drop:
+                    name += f" ({drop} dropped)"
+                span(_TID_EVENTS, "events", t, 0.5, name,
+                     {"applied": app, "dropped": drop, "spare_used": b})
             elif tag == tb.TR_SCALE:
                 # Autoscaler decision (host-emitted ring, slice index as
                 # timebase): label resizes with their mesh arrow so the
